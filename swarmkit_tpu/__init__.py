@@ -1,0 +1,31 @@
+"""swarmkit_tpu — a TPU-native cluster-orchestration framework.
+
+A ground-up rebuild of the capabilities of SwarmKit (reference: wk8/swarmkit,
+pure Go) designed TPU-first:
+
+- The Raft consensus core is a *batched, pure-functional* state machine:
+  N simulated managers are rows of device arrays and one jit-compiled tick
+  kernel advances all of them at once (``swarmkit_tpu.raft.kernel``).  Vote
+  counting and append acknowledgements are reductions over sharded axes, so
+  under a ``jax.sharding.Mesh`` they lower to XLA collectives (psum) over
+  ICI — replacing the reference's goroutine-per-peer gRPC fan-out
+  (reference: manager/state/raft/transport/).
+- The replicated state machine (MemoryStore), orchestrators, scheduler,
+  dispatcher and agent are an asyncio control plane with deterministic
+  fake-clock testing, mirroring the reference's component inventory
+  (reference: manager/, agent/).
+
+Layout:
+    api/        data model: objects, specs, task states, store actions
+    watch/      event bus (reference: watch/watch.go)
+    store/      transactional in-memory object store (manager/state/store)
+    raft/       golden model, JAX tick kernel, Node shell, storage
+    transport/  Transport seam: in-process, device-mesh (+ gRPC bridge)
+    parallel/   mesh + sharding helpers for the batched raft state
+    manager/    control plane services and leader loops
+    agent/      worker/executor side
+    ca/         certificate authority + TLS identities
+    utils/      ids, clocks, logging
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,20 @@
+from swarmkit_tpu.api.types import (
+    TaskState, NodeRole, NodeState, NodeAvailability, Meta, Version,
+    Annotations, TaskStatus,
+)
+from swarmkit_tpu.api.specs import (
+    NodeSpec, ServiceSpec, TaskSpec, ClusterSpec, NetworkSpec, SecretSpec,
+    ConfigSpec, RaftConfig, CAConfig, DispatcherConfig, TaskDefaults,
+    EndpointSpec, Mode, RestartPolicy, UpdateConfig, Placement,
+    ContainerSpec, Resources, ResourceRequirements, ReplicatedService,
+    GlobalService, RestartCondition, UpdateFailureAction, UpdateOrder,
+    OrchestrationConfig, EncryptionConfig,
+)
+from swarmkit_tpu.api.objects import (
+    Node, Service, Task, Network, Cluster, Secret, Config, Resource,
+    Extension, OBJECT_KINDS, kind_of,
+)
+from swarmkit_tpu.api.raft_msgs import (
+    StoreAction, StoreActionKind, InternalRaftRequest, Snapshot,
+    StoreSnapshot, ClusterMember, ClusterSnapshot,
+)

@@ -1,0 +1,4 @@
+from swarmkit_tpu.utils.identity import new_id
+from swarmkit_tpu.utils.clock import Clock, SystemClock, FakeClock
+
+__all__ = ["new_id", "Clock", "SystemClock", "FakeClock"]

@@ -1,0 +1,3 @@
+from swarmkit_tpu.watch.queue import Queue, Watcher, WatcherClosed
+
+__all__ = ["Queue", "Watcher", "WatcherClosed"]

@@ -1,0 +1,34 @@
+"""Test configuration.
+
+Force JAX onto the CPU backend with 8 virtual devices BEFORE jax is imported
+anywhere, so sharding/multi-chip tests run without TPU hardware (the driver
+separately dry-runs the multichip path the same way).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import asyncio
+import functools
+
+import pytest
+
+
+def async_test(fn):
+    """Run an async test function to completion on a fresh event loop."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        return asyncio.run(fn(*args, **kwargs))
+
+    return wrapper
+
+
+@pytest.fixture
+def run():
+    return asyncio.run

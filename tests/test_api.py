@@ -1,0 +1,89 @@
+"""Data-model round-trip tests (reference: api/ generated code behavior)."""
+
+from swarmkit_tpu.api import (
+    Annotations, ClusterSpec, Mode, Node, NodeRole, NodeSpec, ReplicatedService,
+    Service, ServiceSpec, StoreAction, StoreActionKind, Task, TaskSpec,
+    TaskState, TaskStatus, InternalRaftRequest, Snapshot, StoreSnapshot,
+)
+from swarmkit_tpu.api.specs import ContainerSpec, RestartPolicy
+from swarmkit_tpu.api.objects import kind_of
+
+
+def _service() -> Service:
+    return Service(
+        id="svc1",
+        spec=ServiceSpec(
+            annotations=Annotations(name="web", labels={"tier": "frontend"}),
+            task=TaskSpec(
+                container=ContainerSpec(image="nginx:latest", env=["A=1"]),
+                restart=RestartPolicy(delay=1.5),
+            ),
+            mode=Mode.REPLICATED,
+            replicated=ReplicatedService(replicas=3),
+        ),
+    )
+
+
+def test_roundtrip_service():
+    s = _service()
+    data = s.to_dict()
+    s2 = Service.from_dict(data)
+    assert s2 == s
+    assert s2.spec.task.container.image == "nginx:latest"
+    assert s2.spec.replica_count() == 3
+
+
+def test_encode_decode_bytes_stable():
+    s = _service()
+    raw = s.encode()
+    assert Service.decode(raw) == s
+    assert s.encode() == raw  # canonical
+
+
+def test_copy_is_deep():
+    s = _service()
+    c = s.copy()
+    c.spec.annotations.labels["tier"] = "backend"
+    assert s.spec.annotations.labels["tier"] == "frontend"
+
+
+def test_task_state_ordering():
+    assert TaskState.NEW < TaskState.PENDING < TaskState.ASSIGNED
+    assert TaskState.RUNNING < TaskState.COMPLETE
+    assert TaskState.ORPHANED == 832
+    # gaps of 64 like the reference enum
+    assert TaskState.PENDING == 64 and TaskState.RUNNING == 448
+
+
+def test_store_action_roundtrip():
+    t = Task(id="t1", service_id="svc1", slot=2,
+             status=TaskStatus(state=TaskState.RUNNING),
+             desired_state=int(TaskState.RUNNING))
+    a = StoreAction.make(StoreActionKind.CREATE, t)
+    req = InternalRaftRequest(id=7, actions=[a])
+    req2 = InternalRaftRequest.decode(req.encode())
+    obj = req2.actions[0].object()
+    assert isinstance(obj, Task) and obj.slot == 2
+    assert obj.status.state == TaskState.RUNNING
+
+
+def test_kind_of():
+    assert kind_of(Node(id="n")) == "node"
+    assert kind_of(_service()) == "service"
+
+
+def test_snapshot_roundtrip():
+    snap = Snapshot(version=42, store=StoreSnapshot(
+        objects={"node": [Node(id="n1", spec=NodeSpec(
+            desired_role=NodeRole.MANAGER)).to_dict()]}))
+    snap2 = Snapshot.decode(snap.encode())
+    assert snap2.version == 42
+    n = Node.from_dict(snap2.store.objects["node"][0])
+    assert n.spec.desired_role == NodeRole.MANAGER
+
+
+def test_cluster_spec_defaults():
+    cs = ClusterSpec()
+    assert cs.raft.snapshot_interval == 10000
+    assert cs.raft.election_tick == 10
+    assert cs.dispatcher.heartbeat_period == 5.0

@@ -1,0 +1,245 @@
+"""MemoryStore tests (reference: manager/state/store/memory_test.go)."""
+
+import pytest
+
+from swarmkit_tpu.api import (
+    Annotations, Node, NodeRole, NodeSpec, Service, ServiceSpec, Task,
+    TaskState, TaskStatus,
+)
+from swarmkit_tpu.store import (
+    All, ByID, ByIDPrefix, ByName, ByNamePrefix, ByNode, ByRole, ByService,
+    BySlot, ByDesiredState, ByTaskState, Custom, Or,
+    ErrExist, ErrNameConflict, ErrNotExist, ErrSequenceConflict,
+    MemoryStore, NopProposer, MAX_CHANGES_PER_TRANSACTION,
+)
+from swarmkit_tpu.store.memory import Event, EventCommit, match, match_commit
+from tests.conftest import async_test
+
+
+def _node(i, role=NodeRole.WORKER):
+    return Node(id=f"node{i}", role=role,
+                spec=NodeSpec(annotations=Annotations(name=f"name{i}")))
+
+
+def _task(i, service="svc1", node="node1", state=TaskState.RUNNING, slot=0):
+    return Task(id=f"task{i}", service_id=service, node_id=node,
+                slot=slot or i, desired_state=int(TaskState.RUNNING),
+                status=TaskStatus(state=state))
+
+
+@async_test
+async def test_create_get_update_delete():
+    s = MemoryStore()
+    n = _node(1)
+    await s.update(lambda tx: tx.create(n))
+    got = s.get("node", "node1")
+    assert got.id == "node1" and got.meta.version.index == 1
+
+    got.spec.annotations.labels["x"] = "y"
+    await s.update(lambda tx: tx.update(got))
+    got2 = s.get("node", "node1")
+    assert got2.meta.version.index == 2
+    assert got2.spec.annotations.labels == {"x": "y"}
+
+    await s.update(lambda tx: tx.delete("node", "node1"))
+    assert s.get("node", "node1") is None
+
+
+@async_test
+async def test_create_duplicate_and_name_conflict():
+    s = MemoryStore()
+    await s.update(lambda tx: tx.create(_node(1)))
+    with pytest.raises(ErrExist):
+        await s.update(lambda tx: tx.create(_node(1)))
+    dup = _node(2)
+    dup.spec.annotations.name = "name1"
+    with pytest.raises(ErrNameConflict):
+        await s.update(lambda tx: tx.create(dup))
+
+
+@async_test
+async def test_update_nonexistent_and_sequence_conflict():
+    s = MemoryStore()
+    with pytest.raises(ErrNotExist):
+        await s.update(lambda tx: tx.update(_node(9)))
+    await s.update(lambda tx: tx.create(_node(1)))
+    stale = s.get("node", "node1")
+    fresh = s.get("node", "node1")
+    await s.update(lambda tx: tx.update(fresh))
+    with pytest.raises(ErrSequenceConflict):
+        await s.update(lambda tx: tx.update(stale))
+
+
+@async_test
+async def test_tx_reads_see_writes():
+    s = MemoryStore()
+
+    def cb(tx):
+        tx.create(_node(1))
+        assert tx.get("node", "node1") is not None
+        assert len(tx.find("node", All())) == 1
+        tx.delete("node", "node1")
+        assert tx.get("node", "node1") is None
+        assert tx.find("node", All()) == []
+
+    await s.update(cb)
+    assert s.get("node", "node1") is None
+
+
+@async_test
+async def test_find_combinators():
+    s = MemoryStore()
+
+    def cb(tx):
+        tx.create(_node(1, NodeRole.MANAGER))
+        tx.create(_node(2))
+        tx.create(Service(id="svc1", spec=ServiceSpec(
+            annotations=Annotations(name="web"))))
+        tx.create(_task(1, node="node1", state=TaskState.RUNNING))
+        tx.create(_task(2, node="node2", state=TaskState.PENDING))
+        tx.create(_task(3, service="svc2", node="node2",
+                        state=TaskState.RUNNING))
+
+    await s.update(cb)
+
+    assert {t.id for t in s.find("task", ByService("svc1"))} == {"task1", "task2"}
+    assert {t.id for t in s.find("task", ByNode("node2"))} == {"task2", "task3"}
+    assert [t.id for t in s.find("task", BySlot("svc1", 2))] == ["task2"]
+    assert {t.id for t in s.find("task", ByTaskState(TaskState.RUNNING))} == \
+        {"task1", "task3"}
+    assert len(s.find("task", ByDesiredState(TaskState.RUNNING))) == 3
+    assert [n.id for n in s.find("node", ByRole(NodeRole.MANAGER))] == ["node1"]
+    assert [n.id for n in s.find("node", ByName("name2"))] == ["node2"]
+    assert len(s.find("node", ByNamePrefix("name"))) == 2
+    assert len(s.find("task", ByIDPrefix("task"))) == 3
+    assert [s_.id for s_ in s.find("service", ByName("web"))] == ["svc1"]
+    assert {t.id for t in s.find(
+        "task", Or(BySlot("svc1", 1), ByService("svc2")))} == {"task1", "task3"}
+    assert [t.id for t in s.find(
+        "task", Custom(lambda t: t.slot == 3))] == ["task3"]
+    assert [n.id for n in s.find("node", ByID("node1"))] == ["node1"]
+
+
+@async_test
+async def test_index_maintenance_on_update():
+    s = MemoryStore()
+    await s.update(lambda tx: tx.create(_task(1, node="node1")))
+    t = s.get("task", "task1")
+    t.node_id = "node9"
+    t.status.state = TaskState.FAILED
+    await s.update(lambda tx: tx.update(t))
+    assert s.find("task", ByNode("node1")) == []
+    assert [x.id for x in s.find("task", ByNode("node9"))] == ["task1"]
+    assert [x.id for x in s.find("task", ByTaskState(TaskState.FAILED))] == ["task1"]
+
+
+@async_test
+async def test_events_and_commit_event():
+    s = MemoryStore()
+    w = s.watch()
+    commits = s.watch(match_commit)
+    await s.update(lambda tx: tx.create(_node(1)))
+    evs = w.poll()
+    assert any(isinstance(e, Event) and e.action == "create" for e in evs)
+    assert any(isinstance(e, EventCommit) for e in evs)
+    assert len(commits.poll()) == 1
+
+    task_events = s.watch(match(kind="task"))
+    await s.update(lambda tx: tx.create(_task(1)))
+    n = s.get("node", "node1")
+    n.spec.availability = 1
+    await s.update(lambda tx: tx.update(n))
+    got = task_events.poll()
+    assert len(got) == 1 and got[0].kind == "task"
+
+
+@async_test
+async def test_update_event_carries_old_object():
+    s = MemoryStore()
+    await s.update(lambda tx: tx.create(_node(1)))
+    w = s.watch(match(kind="node", action="update"))
+    n = s.get("node", "node1")
+    n.spec.annotations.labels["k"] = "v"
+    await s.update(lambda tx: tx.update(n))
+    (ev,) = w.poll()
+    assert ev.old_object.spec.annotations.labels == {}
+    assert ev.object.spec.annotations.labels == {"k": "v"}
+
+
+@async_test
+async def test_rollback_on_error():
+    s = MemoryStore()
+
+    def cb(tx):
+        tx.create(_node(1))
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError):
+        await s.update(cb)
+    assert s.get("node", "node1") is None
+    assert s.version == 0
+
+
+@async_test
+async def test_batch_splits_transactions():
+    s = MemoryStore(proposer=NopProposer())
+    batch = s.batch()
+    n_objs = MAX_CHANGES_PER_TRANSACTION + 50
+    for i in range(n_objs):
+        await batch.update(lambda tx, i=i: tx.create(_task(i)))
+    applied = await batch.commit()
+    assert applied == n_objs
+    assert len(s.find("task")) == n_objs
+    # two proposals: one full chunk + remainder
+    assert len(s._proposer.proposed) == 2
+    assert len(s._proposer.proposed[0]) == MAX_CHANGES_PER_TRANSACTION
+
+
+@async_test
+async def test_proposer_receives_actions():
+    p = NopProposer()
+    s = MemoryStore(proposer=p)
+    await s.update(lambda tx: tx.create(_node(1)))
+    assert len(p.proposed) == 1
+    assert p.proposed[0][0].kind == "node"
+    assert s.get("node", "node1").meta.version.index == p.get_version()
+
+
+@async_test
+async def test_apply_store_actions_follower_path():
+    leader = MemoryStore(proposer=NopProposer())
+    follower = MemoryStore()
+    w = follower.watch(match(kind="node"))
+    await leader.update(lambda tx: tx.create(_node(1)))
+    actions = leader._proposer.proposed[0]
+    follower.apply_store_actions(actions, version=1)
+    got = follower.get("node", "node1")
+    assert got is not None and got.meta.version.index == 1
+    assert len(w.poll()) == 1
+
+
+@async_test
+async def test_save_restore():
+    s = MemoryStore()
+
+    def cb(tx):
+        tx.create(_node(1))
+        tx.create(_task(1))
+
+    await s.update(cb)
+    snap = s.save()
+    s2 = MemoryStore()
+    s2.restore(snap, version=s.version)
+    assert s2.get("node", "node1") is not None
+    assert [t.id for t in s2.find("task", ByService("svc1"))] == ["task1"]
+
+
+@async_test
+async def test_view_and_watch_atomicity():
+    s = MemoryStore()
+    await s.update(lambda tx: tx.create(_node(1)))
+    nodes, w = s.view_and_watch(lambda tx: tx.find("node"))
+    assert len(nodes) == 1
+    await s.update(lambda tx: tx.create(_node(2)))
+    evs = [e for e in w.poll() if isinstance(e, Event)]
+    assert len(evs) == 1 and evs[0].object.id == "node2"

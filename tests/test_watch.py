@@ -1,0 +1,78 @@
+"""Event bus tests (reference: watch/watch_test.go, watch/queue/queue_test.go)."""
+
+import asyncio
+
+import pytest
+
+from swarmkit_tpu.watch import Queue, WatcherClosed
+from tests.conftest import async_test
+
+
+def test_publish_and_poll():
+    q = Queue()
+    w = q.watch()
+    q.publish(1)
+    q.publish(2)
+    assert w.poll() == [1, 2]
+    assert w.poll() == []
+
+
+def test_filtering():
+    q = Queue()
+    evens = q.watch(lambda e: e % 2 == 0)
+    q.publish_all([1, 2, 3, 4])
+    assert evens.poll() == [2, 4]
+
+
+def test_multiple_matchers_is_or():
+    q = Queue()
+    w = q.watch(lambda e: e == 1, lambda e: e == 3)
+    q.publish_all([1, 2, 3])
+    assert w.poll() == [1, 3]
+
+
+def test_overflow_closes_watcher():
+    # reference watch/queue/queue.go LimitQueue: exceeding the limit closes
+    # the watcher instead of blocking the publisher.
+    q = Queue()
+    w = q.watch(limit=3)
+    for i in range(3):
+        q.publish(i)
+    assert not w.closed
+    q.publish(3)
+    assert w.closed and w.overflowed
+    assert len(q) == 0
+
+
+@async_test
+async def test_async_get_wakes():
+    q = Queue()
+    w = q.watch()
+
+    async def producer():
+        await asyncio.sleep(0)
+        q.publish("ev")
+
+    task = asyncio.ensure_future(producer())
+    got = await w.get()
+    assert got == "ev"
+    await task
+
+
+@async_test
+async def test_get_after_close_raises():
+    q = Queue()
+    w = q.watch()
+    q.publish("last")
+    w.close()
+    # buffered events still drain, then WatcherClosed
+    assert await w.get() == "last"
+    with pytest.raises(WatcherClosed):
+        await w.get()
+
+
+def test_close_queue_closes_watchers():
+    q = Queue()
+    w1, w2 = q.watch(), q.watch()
+    q.close()
+    assert w1.closed and w2.closed
