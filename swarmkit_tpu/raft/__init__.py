@@ -1,0 +1,22 @@
+"""Raft consensus for swarmkit_tpu.
+
+- messages/log/core/rawnode: host-side golden state machine (reference:
+  vendor/github.com/coreos/etcd/raft), used by the Node shell and as the
+  differential-test oracle.
+- sim/: the batched JAX/XLA kernel where N simulated managers are rows of
+  device arrays (the north-star backend).
+"""
+
+from swarmkit_tpu.raft.core import Config, ProposalDropped, Raft
+from swarmkit_tpu.raft.log import RaftLog
+from swarmkit_tpu.raft.messages import (
+    ConfChange, ConfChangeType, Entry, EntryType, HardState, Message, MsgType,
+    Snapshot, SnapshotMeta, SoftState,
+)
+from swarmkit_tpu.raft.rawnode import RawNode, Ready
+
+__all__ = [
+    "Config", "ProposalDropped", "Raft", "RaftLog", "ConfChange",
+    "ConfChangeType", "Entry", "EntryType", "HardState", "Message", "MsgType",
+    "Snapshot", "SnapshotMeta", "SoftState", "RawNode", "Ready",
+]

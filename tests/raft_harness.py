@@ -1,0 +1,179 @@
+"""Deterministic in-memory multi-node raft harness for tests.
+
+Mirrors the role of manager/state/raft/testutils (real nodes, fake clock) in
+the reference: real RawNode state machines, an explicit message bus instead of
+gRPC, and ticks pumped by the test.
+"""
+
+from __future__ import annotations
+
+import pickle
+import random
+from typing import Callable, Optional
+
+from swarmkit_tpu.raft import (
+    Config, ConfChange, ConfChangeType, Entry, EntryType, MsgType, RawNode,
+)
+
+
+class InMemCluster:
+    def __init__(self, ids, election_tick=10, heartbeat_tick=1,
+                 check_quorum=False, pre_vote=False, seed=0,
+                 max_size_per_msg=64):
+        self.ids = list(ids)
+        self.nodes: dict[int, RawNode] = {}
+        self.applied: dict[int, list[bytes]] = {i: [] for i in ids}
+        self.down: set[int] = set()
+        self.partitions: set[tuple[int, int]] = set()  # directed (frm, to)
+        self.drop_fn: Optional[Callable[[object], bool]] = None
+        self.rng = random.Random(seed)
+        self.cfg = dict(election_tick=election_tick,
+                        heartbeat_tick=heartbeat_tick,
+                        check_quorum=check_quorum, pre_vote=pre_vote,
+                        max_size_per_msg=max_size_per_msg)
+        for i in ids:
+            self.nodes[i] = RawNode(
+                Config(id=i, peers=tuple(ids), seed=seed, **self.cfg))
+
+    # -- topology control --------------------------------------------------
+    def stop(self, pid: int) -> None:
+        self.down.add(pid)
+
+    def start(self, pid: int) -> None:
+        self.down.discard(pid)
+
+    def restart(self, pid: int, wipe: bool = False) -> None:
+        """Recreate the node from its 'persisted' state (log survives unless
+        wiped), modeling a process restart."""
+        old = self.nodes[pid]
+        if wipe:
+            node = RawNode(Config(id=pid, peers=tuple(self.ids),
+                                  seed=self.rng.randrange(1 << 30), **self.cfg))
+            self.applied[pid] = []
+        else:
+            log = old.raft.log
+            log.pending_snapshot = None
+            # Unapplied committed entries re-apply after restart.
+            log.applied = log.offset
+            self.applied[pid] = self.applied[pid][: log.offset]
+            node = RawNode(
+                Config(id=pid, peers=(), seed=self.rng.randrange(1 << 30),
+                       **self.cfg),
+                log=log, hard_state=old.raft.hard_state(),
+                voters=old.raft.voter_ids())
+        self.nodes[pid] = node
+        self.down.discard(pid)
+
+    def partition(self, *groups) -> None:
+        """Only nodes within the same group can talk."""
+        self.partitions = set()
+        group_of = {}
+        for gi, g in enumerate(groups):
+            for pid in g:
+                group_of[pid] = gi
+        for a in self.ids:
+            for b in self.ids:
+                if a != b and group_of.get(a) != group_of.get(b):
+                    self.partitions.add((a, b))
+
+    def heal(self) -> None:
+        self.partitions = set()
+
+    # -- pumping -----------------------------------------------------------
+    def _deliverable(self, m) -> bool:
+        if m.to in self.down or m.frm in self.down:
+            return False
+        if (m.frm, m.to) in self.partitions:
+            return False
+        if self.drop_fn is not None and self.drop_fn(m):
+            return False
+        return True
+
+    def flush(self, max_rounds: int = 100) -> None:
+        """Drain Readys and deliver messages until quiescent."""
+        for _ in range(max_rounds):
+            moved = False
+            for pid in self.ids:
+                if pid in self.down:
+                    continue
+                node = self.nodes[pid]
+                if not node.has_ready():
+                    continue
+                rd = node.ready()
+                moved = moved or rd.contains_updates()
+                for e in rd.committed_entries:
+                    self._apply(pid, e)
+                node.advance(rd)
+                for m in rd.messages:
+                    if m.to in self.nodes and self._deliverable(m):
+                        self.nodes[m.to].step(m)
+            if not moved:
+                return
+
+    def _apply(self, pid: int, e: Entry) -> None:
+        if e.type == EntryType.CONF_CHANGE:
+            cc: ConfChange = pickle.loads(e.data)
+            self.nodes[pid].apply_conf_change(cc)
+            if cc.type == ConfChangeType.ADD_NODE and cc.node_id not in self.nodes:
+                # Instantiate the new member (empty log; will catch up).
+                self.ids.append(cc.node_id)
+                self.applied[cc.node_id] = []
+                self.nodes[cc.node_id] = RawNode(
+                    Config(id=cc.node_id, peers=(),
+                           seed=self.rng.randrange(1 << 30), **self.cfg),
+                    voters=(cc.node_id,))
+                # Joiner learns membership out of band (reference: JoinResponse
+                # carries the member list).
+                for v in self.nodes[pid].raft.voter_ids():
+                    self.nodes[cc.node_id].raft.add_node(v)
+        elif e.data:
+            self.applied[pid].append(e.data)
+
+    def tick(self, pid: Optional[int] = None) -> None:
+        targets = [pid] if pid is not None else self.ids
+        for t in targets:
+            if t not in self.down:
+                self.nodes[t].tick()
+        self.flush()
+
+    def ticks(self, n: int, pid: Optional[int] = None) -> None:
+        for _ in range(n):
+            self.tick(pid)
+
+    # -- queries -----------------------------------------------------------
+    def leader(self) -> Optional[int]:
+        leaders = {p for p in self.ids
+                   if p not in self.down
+                   and self.nodes[p].raft.state == "leader"}
+        if not leaders:
+            return None
+        # With partitions there may transiently be two; report highest term.
+        return max(leaders, key=lambda p: self.nodes[p].raft.term)
+
+    def elect(self, pid: int) -> None:
+        self.nodes[pid].campaign()
+        self.flush()
+        assert self.nodes[pid].raft.state == "leader", self.status()
+
+    def wait_leader(self, max_ticks: int = 200) -> int:
+        for _ in range(max_ticks):
+            lead = self.leader()
+            if lead is not None:
+                return lead
+            self.tick()
+        raise AssertionError(f"no leader after {max_ticks} ticks: {self.status()}")
+
+    def propose(self, data: bytes, pid: Optional[int] = None) -> None:
+        target = pid if pid is not None else self.leader()
+        assert target is not None, "no leader to propose to"
+        self.nodes[target].propose(data)
+        self.flush()
+
+    def committed(self, pid: int) -> int:
+        return self.nodes[pid].raft.log.committed
+
+    def status(self) -> dict:
+        return {p: self.nodes[p].status() for p in self.ids}
+
+    def up_ids(self):
+        return [p for p in self.ids if p not in self.down]
