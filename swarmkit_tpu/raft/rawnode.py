@@ -120,7 +120,6 @@ class RawNode:
             rd.snapshot = r.log.pending_snapshot
         rd.messages = r.msgs
         r.msgs = []
-        self._pending_ready = rd
         return rd
 
     def advance(self, rd: Ready) -> None:

@@ -1,0 +1,339 @@
+"""The XLA-compiled raft tick kernel.
+
+One call advances ALL N simulated managers by one logical tick, replacing the
+reference's goroutine-per-node event loops (manager/state/raft/raft.go:540
+Node.Run and vendor etcd raft Step/stepLeader/stepCandidate/stepFollower)
+with branchless masked array ops:
+
+- elections      = masked one-hot grant matrices + row reductions (poll)
+- append fan-out = per-receiver chosen-sender gathers from the ring buffers
+- commit         = per-leader quorum-median of the match row, exactly the
+                   sort-and-take rule of vendor raft.go:478-486 maybeCommit
+- network faults = per-edge boolean drop/partition masks; crashes = alive mask
+
+The network model is tick-synchronous: requests and their responses complete
+within one tick unless masked out. Control flow divergence (leader vs
+candidate vs follower) is handled with `jnp.where` over role masks — there is
+no data-dependent Python control flow, so the whole step jits once and scans.
+
+Semantics deliberately simplified vs the host golden core (swarmkit_tpu.raft
+.core): no PreVote, no CheckQuorum lease, no leader transfer, and rejection
+hints are coarse (hint = follower last index). Safety properties (election
+safety, log matching, leader completeness) are preserved and asserted by
+tests/test_raft_sim.py invariant checks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from swarmkit_tpu.raft.sim.state import (
+    CANDIDATE, FOLLOWER, LEADER, NONE, SimConfig, SimState, hash32,
+    rand_timeout,
+)
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+
+def _slot(cfg: SimConfig, idx):
+    """Ring slot of 1-based log index (clamped so idx=0 is harmless)."""
+    return (jnp.maximum(idx, 1) - 1) % cfg.log_len
+
+
+def _term_own(cfg, log_term, snap_idx, snap_term, last, idx):
+    """Per-node own-log term lookup. idx may be [N] or [N, K]."""
+    if idx.ndim == 1:
+        sidx, sterm, slast = snap_idx, snap_term, last
+        ring = jnp.take_along_axis(log_term, _slot(cfg, idx)[:, None],
+                                   axis=1)[:, 0]
+    else:
+        sidx, sterm, slast = (snap_idx[:, None], snap_term[:, None],
+                              last[:, None])
+        ring = jnp.take_along_axis(log_term, _slot(cfg, idx), axis=1)
+    in_ring = (idx > sidx) & (idx <= slast)
+    return jnp.where(idx == sidx, sterm, jnp.where(in_ring, ring, 0))
+
+
+def _entry_chk(idx, data):
+    """Order-independent state-machine checksum contribution of one entry."""
+    return hash32(idx.astype(U32) * U32(0x01000193) ^ data.astype(U32))
+
+
+def step(state: SimState, cfg: SimConfig,
+         alive: Optional[jax.Array] = None,
+         drop: Optional[jax.Array] = None) -> SimState:
+    """Advance every simulated manager by one tick.
+
+    alive: [N] bool — False rows are crashed (frozen, no send/receive).
+    drop:  [N, N] bool — drop[i, j] drops all i->j traffic this tick.
+    """
+    n = cfg.n
+    node = jnp.arange(n, dtype=I32)
+    eye = jnp.eye(n, dtype=bool)
+    if alive is None:
+        alive = jnp.ones((n,), bool)
+    if drop is None:
+        drop = jnp.zeros((n, n), bool)
+
+    term, vote, role, lead = state.term, state.vote, state.role, state.lead
+    elapsed, hb_elapsed = state.elapsed, state.hb_elapsed
+    timeout = state.timeout
+    last, commit, applied = state.last, state.commit, state.applied
+    snap_idx, snap_term = state.snap_idx, state.snap_term
+    snap_chk, apply_chk = state.snap_chk, state.apply_chk
+    log_term, log_data, log_chk = state.log_term, state.log_data, state.log_chk
+    match, next_, granted = state.match, state.next_, state.granted
+    active = state.active
+
+    up = alive & active
+    n_active = jnp.sum(active.astype(I32))
+    quorum = n_active // 2 + 1
+
+    # ---- Phase A: timers + campaign start --------------------------------
+    is_leader = (role == LEADER) & up
+    elapsed = jnp.where(up, elapsed + 1, elapsed)
+    hb_elapsed = jnp.where(is_leader, hb_elapsed + 1, hb_elapsed)
+
+    campaign = up & (role != LEADER) & (elapsed >= timeout)
+    term = term + campaign.astype(I32)
+    vote = jnp.where(campaign, node, vote)
+    role = jnp.where(campaign, CANDIDATE, role)
+    lead = jnp.where(campaign, NONE, lead)
+    elapsed = jnp.where(campaign, 0, elapsed)
+    timeout = jnp.where(campaign, rand_timeout(cfg, node, term), timeout)
+    granted = jnp.where(campaign[:, None], eye, granted)
+
+    # ---- Phase B: vote exchange ------------------------------------------
+    is_cand = (role == CANDIDATE) & up
+    req = is_cand[:, None] & up[None, :] & ~eye & ~drop          # [i, j]
+    # Receiver-side term catch-up (Step m.Term > r.Term with MsgVote).
+    req_term = jnp.where(req, term[:, None], -1)
+    mt = jnp.max(req_term, axis=0)                               # [j]
+    newer = mt > term
+    term = jnp.where(newer, mt, term)
+    role = jnp.where(newer, FOLLOWER, role)
+    vote = jnp.where(newer, NONE, vote)
+    lead = jnp.where(newer, NONE, lead)
+    is_cand = (role == CANDIDATE) & up  # stepped-down candidates drop out
+
+    last_term = _term_own(cfg, log_term, snap_idx, snap_term, last, last)
+    lt_i, lt_j = last_term[:, None], last_term[None, :]
+    log_ok = (lt_i > lt_j) | ((lt_i == lt_j) & (last[:, None] >= last[None, :]))
+    can_vote = (vote[None, :] == NONE) | (vote[None, :] == node[:, None])
+    # Compare the SEND-TIME candidate term (req_term) with the receiver's
+    # post-catch-up term: a candidate whose own term was bumped this tick by
+    # a higher-term rival must not have its stale request treated as current.
+    grantable = req & (req_term == term[None, :]) & can_vote & log_ok
+    any_grant = jnp.any(grantable, axis=0)                       # [j]
+    chosen_cand = jnp.argmax(grantable, axis=0).astype(I32)      # first True
+    grant_mat = grantable & (node[:, None] == chosen_cand[None, :])
+    vote = jnp.where(any_grant, chosen_cand, vote)
+    elapsed = jnp.where(any_grant, 0, elapsed)
+    # Responses travel j -> i; may be dropped independently.
+    resp_arrive = grant_mat & ~drop.T
+    granted = granted | (resp_arrive & is_cand[:, None])
+
+    votes = jnp.sum((granted & active[None, :]).astype(I32), axis=1)
+    win = is_cand & (votes >= quorum)
+    # becomeLeader: reset progress, append a no-op entry at the new term.
+    role = jnp.where(win, LEADER, role)
+    lead = jnp.where(win, node, lead)
+    hb_elapsed = jnp.where(win, 0, hb_elapsed)
+    elapsed = jnp.where(win, 0, elapsed)
+    next_ = jnp.where(win[:, None], (last + 1)[:, None], next_)
+    match = jnp.where(win[:, None], 0, match)
+    noop_slot = _slot(cfg, last + 1)
+    log_term = log_term.at[node, noop_slot].set(
+        jnp.where(win, term, log_term[node, noop_slot]))
+    log_data = log_data.at[node, noop_slot].set(
+        jnp.where(win, U32(0), log_data[node, noop_slot]))
+    last = last + win.astype(I32)
+    is_leader = (role == LEADER) & up
+    match = jnp.where(win[:, None] & eye, last[:, None], match)
+
+    # ---- Phase C: append / heartbeat fan-out -----------------------------
+    prev = next_ - 1                                             # [i, j]
+    can_ring = prev >= snap_idx[:, None]
+    send_base = is_leader[:, None] & up[None, :] & active[None, :] & ~eye & ~drop
+    send_app = send_base & can_ring
+    send_snap = send_base & ~can_ring
+
+    # Receiver-side term catch-up from append/snapshot senders.
+    app_term = jnp.where(send_app | send_snap, term[:, None], -1)
+    mt2 = jnp.max(app_term, axis=0)
+    newer2 = mt2 > term
+    term = jnp.where(newer2, mt2, term)
+    role = jnp.where(newer2, FOLLOWER, role)
+    vote = jnp.where(newer2, NONE, vote)
+    lead = jnp.where(newer2, NONE, lead)
+
+    # Receiver picks its (unique) current-term leader, judged by the
+    # SEND-TIME sender term (a leader deposed this tick sent at its old term).
+    eligible = (send_app | send_snap) & (app_term == term[None, :])
+    has_lmsg = jnp.any(eligible, axis=0)
+    src = jnp.argmax(eligible, axis=0).astype(I32)               # [j]
+    role = jnp.where(has_lmsg & (role == CANDIDATE), FOLLOWER, role)
+    lead = jnp.where(has_lmsg, src, lead)
+    elapsed = jnp.where(has_lmsg, 0, elapsed)
+    is_leader = (role == LEADER) & up
+
+    got_app = has_lmsg & send_app[src, node]
+    got_snap = has_lmsg & send_snap[src, node]
+
+    # -- append receive: window gather from the chosen sender's ring.
+    # NOTE all sender-side log reads use the POST-noop local arrays so a
+    # just-elected leader replicates its no-op entry in the same tick.
+    p = prev[src, node]                                          # [j]
+    p_term_sent = jnp.where(
+        p == snap_idx[src], snap_term[src],
+        jnp.where((p > snap_idx[src]) & (p <= last[src]),
+                  log_term[src, _slot(cfg, p)], 0))
+    n_avail = jnp.clip(last[src] - p, 0, cfg.window)
+    k = jnp.arange(cfg.window, dtype=I32)                        # [W]
+    ent_idx = p[:, None] + 1 + k[None, :]                        # [j, W]
+    ent_valid = (k[None, :] < n_avail[:, None]) & got_app[:, None]
+    ent_slot = _slot(cfg, ent_idx)
+    e_term = jnp.where(ent_valid, log_term[src[:, None], ent_slot], 0)
+    e_data = jnp.where(ent_valid, log_data[src[:, None], ent_slot], U32(0))
+
+    commit0 = commit  # pre-append commit (handleAppendEntries fast path)
+    local_p_term = _term_own(cfg, log_term, snap_idx, snap_term, last,
+                             jnp.minimum(p, last))
+    prev_ok = (p <= last) & (p >= snap_idx) & (local_p_term == p_term_sent)
+    stale = p < commit0
+    accept = got_app & prev_ok & ~stale
+
+    # find_conflict: first incoming entry missing or with mismatched term.
+    own_term_at = _term_own(cfg, log_term, snap_idx, snap_term, last, ent_idx)
+    exists = ent_idx <= last[:, None]
+    mism = ent_valid & (~exists | (own_term_at != e_term))
+    any_mism = jnp.any(mism, axis=1)
+    ci = jnp.where(any_mism, jnp.argmax(mism, axis=1).astype(I32), cfg.window)
+    write_mask = ent_valid & accept[:, None] & (k[None, :] >= ci[:, None])
+    log_term = log_term.at[node[:, None], ent_slot].set(
+        jnp.where(write_mask, e_term, log_term[node[:, None], ent_slot]))
+    log_data = log_data.at[node[:, None], ent_slot].set(
+        jnp.where(write_mask, e_data, log_data[node[:, None], ent_slot]))
+    lastnewi = p + n_avail
+    last = jnp.where(accept,
+                     jnp.where(any_mism, lastnewi, jnp.maximum(last, lastnewi)),
+                     last)
+    commit = jnp.where(accept,
+                       jnp.maximum(commit,
+                                   jnp.minimum(commit0[src], lastnewi)),
+                       commit)
+
+    # -- snapshot receive: jump to the sender's compaction watermark.
+    do_restore = got_snap & (snap_idx[src] > commit)
+    r_src = src
+    last = jnp.where(do_restore, snap_idx[r_src], last)
+    commit = jnp.where(do_restore, snap_idx[r_src], commit)
+    applied = jnp.where(do_restore, snap_idx[r_src], applied)
+    apply_chk = jnp.where(do_restore, snap_chk[r_src], apply_chk)
+    new_snap_term = jnp.where(do_restore, snap_term[r_src], snap_term)
+    new_snap_chk = jnp.where(do_restore, snap_chk[r_src], snap_chk)
+    new_snap_idx = jnp.where(do_restore, snap_idx[r_src], snap_idx)
+    snap_term, snap_chk, snap_idx = new_snap_term, new_snap_chk, new_snap_idx
+    log_term = jnp.where(do_restore[:, None], 0, log_term)
+    log_data = jnp.where(do_restore[:, None], U32(0), log_data)
+
+    # -- responses back to senders (j -> i), may be dropped.
+    # A duplicate snapshot (sender watermark <= our commit) still gets an
+    # APP_RESP at our commit (core.py _handle_snapshot else-branch) so the
+    # leader's progress un-wedges even if the original ack was dropped.
+    resp_match = jnp.where(stale & got_app, commit0,
+                           jnp.where(got_snap, commit, lastnewi))
+    resp_ok = accept | got_snap | (stale & got_app)
+    resp_reject = got_app & ~prev_ok & ~stale
+    reject_hint = last                                           # [j]
+
+    is_resp_tgt = node[:, None] == src[None, :]                  # [i, j]
+    arrive_back = ~drop.T & is_resp_tgt & is_leader[:, None] & has_lmsg[None, :]
+    ok_mat = arrive_back & resp_ok[None, :]
+    rej_mat = arrive_back & resp_reject[None, :]
+    match = jnp.where(ok_mat, jnp.maximum(match, resp_match[None, :]), match)
+    next_ = jnp.where(ok_mat, jnp.maximum(next_, resp_match[None, :] + 1), next_)
+    # Probe decrement (maybeDecrTo, coarse): jump next back to the hint.
+    next_ = jnp.where(
+        rej_mat,
+        jnp.maximum(1, jnp.minimum(next_ - 1, reject_hint[None, :] + 1)),
+        next_)
+
+    # ---- Phase D: leader commit (quorum median of match row) -------------
+    match = jnp.where(is_leader[:, None] & eye, last[:, None], match)
+    masked = jnp.where(active[None, :], match, -1)
+    sorted_desc = -jnp.sort(-masked, axis=1)
+    mci = jnp.take_along_axis(
+        sorted_desc, jnp.full((n, 1), 1, I32) * (quorum - 1), axis=1)[:, 0]
+    mci_term = _term_own(cfg, log_term, snap_idx, snap_term, last, mci)
+    can_commit = is_leader & (mci > commit) & (mci_term == term)
+    commit = jnp.where(can_commit, mci, commit)
+
+    # ---- Phase E: apply + per-entry checksum ring ------------------------
+    ka = jnp.arange(cfg.apply_batch, dtype=I32)
+    app_idx = applied[:, None] + 1 + ka[None, :]
+    app_valid = app_idx <= commit[:, None]
+    app_slot = _slot(cfg, app_idx)
+    app_data = jnp.take_along_axis(log_data, app_slot, axis=1)
+    contrib = jnp.where(app_valid, _entry_chk(app_idx, app_data), U32(0))
+    cum = apply_chk[:, None] + jnp.cumsum(contrib, axis=1, dtype=U32)
+    log_chk = log_chk.at[node[:, None], app_slot].set(
+        jnp.where(app_valid, cum, log_chk[node[:, None], app_slot]))
+    apply_chk = apply_chk + jnp.sum(contrib, axis=1, dtype=U32)
+    applied = jnp.minimum(commit, applied + cfg.apply_batch)
+
+    # ---- Phase F: compaction (ring-pressure driven) ----------------------
+    # Compact to applied-keep (mirroring LogEntriesForSlowFollowers=500)
+    # when the ring is running out of writable headroom.
+    pressure = (last - snap_idx) > (cfg.log_len - 2 * cfg.max_props - 1)
+    new_snap = jnp.maximum(snap_idx, applied - cfg.keep)
+    do_compact = pressure & (new_snap > snap_idx)
+    nst = _term_own(cfg, log_term, snap_idx, snap_term, last, new_snap)
+    nsc = jnp.take_along_axis(log_chk, _slot(cfg, new_snap)[:, None],
+                              axis=1)[:, 0]
+    snap_term = jnp.where(do_compact, nst, snap_term)
+    snap_chk = jnp.where(do_compact, nsc, snap_chk)
+    snap_idx = jnp.where(do_compact, new_snap, snap_idx)
+
+    return dataclasses.replace(
+        state,
+        term=term, vote=vote, role=role, lead=lead,
+        elapsed=elapsed, hb_elapsed=hb_elapsed, timeout=timeout,
+        last=last, commit=commit, applied=applied,
+        snap_idx=snap_idx, snap_term=snap_term,
+        snap_chk=snap_chk, apply_chk=apply_chk,
+        log_term=log_term, log_data=log_data, log_chk=log_chk,
+        match=match, next_=next_, granted=granted,
+        tick=state.tick + 1,
+    )
+
+
+def propose(state: SimState, cfg: SimConfig, payloads: jax.Array,
+            count) -> SimState:
+    """Append up to `count` payload entries to every node currently acting
+    as leader (clients talk to whoever claims leadership; only a real
+    leader's entries can ever commit). payloads: [max_props] uint32."""
+    n = cfg.n
+    node = jnp.arange(n, dtype=I32)
+    is_leader = (state.role == LEADER) & state.active
+    room = (state.last + cfg.max_props - state.snap_idx) <= cfg.log_len
+    ok = is_leader & room
+    k = jnp.arange(cfg.max_props, dtype=I32)
+    valid = (k[None, :] < count) & ok[:, None]                   # [N, B]
+    idx = state.last[:, None] + 1 + k[None, :]
+    slot = _slot(cfg, idx)
+    pl = jnp.broadcast_to(payloads[None, :], (n, cfg.max_props))
+    log_term = state.log_term.at[node[:, None], slot].set(
+        jnp.where(valid, state.term[:, None], state.log_term[node[:, None], slot]))
+    log_data = state.log_data.at[node[:, None], slot].set(
+        jnp.where(valid, pl, state.log_data[node[:, None], slot]))
+    new_last = state.last + jnp.where(ok, count, 0).astype(I32)
+    eye = jnp.eye(n, dtype=bool)
+    match = jnp.where(ok[:, None] & eye, new_last[:, None], state.match)
+    return dataclasses.replace(state, log_term=log_term, log_data=log_data,
+                               last=new_last, match=match)

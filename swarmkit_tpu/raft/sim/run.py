@@ -1,0 +1,109 @@
+"""Scan-compiled simulation drivers for the BASELINE.json bench configs.
+
+These wrap the tick kernel in `lax.scan`/`lax.while_loop` so an entire
+benchmark run (election + steady-state replication + crash/churn schedules)
+executes as ONE XLA program on device — the host only sees the final state
+and per-tick summary rows. This is the swarm-bench analogue
+(cmd/swarm-bench/benchmark.go:38) for simulated manager quorums.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from swarmkit_tpu.raft.sim.kernel import propose, step
+from swarmkit_tpu.raft.sim.state import (
+    LEADER, SimConfig, SimState, drop_matrix, hash32, init_state,
+)
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+
+def leader_mask(state: SimState) -> jax.Array:
+    return (state.role == LEADER) & state.active
+
+
+def has_leader(state: SimState) -> jax.Array:
+    return jnp.any(leader_mask(state))
+
+
+def _payloads(cfg: SimConfig, tick, count) -> jax.Array:
+    """Deterministic device-generated payload batch: payload ids encode the
+    (tick, k) origin so the applied-checksum detects loss/reorder."""
+    k = jnp.arange(cfg.max_props, dtype=I32)
+    return (tick.astype(U32) * U32(1 << 16) + k.astype(U32) + U32(1))
+
+
+@partial(jax.jit, static_argnames=("cfg", "n_ticks", "prop_count",
+                                   "drop_rate", "crash_every", "down_for"))
+def run_ticks(state: SimState, cfg: SimConfig, n_ticks: int,
+              prop_count: int = 0, drop_rate: float = 0.0,
+              crash_every: int = 0, down_for: int = 5):
+    """Advance n_ticks. Per tick: optionally propose `prop_count` entries to
+    the current leader(s), optionally drop traffic per-edge at `drop_rate`,
+    and optionally crash the sitting leader every `crash_every` ticks for
+    `down_for` ticks (BASELINE configs 3-5).
+
+    Returns (final_state, trace) where trace rows are per-tick
+    [n_leaders, max_commit, max_term].
+    """
+    n = cfg.n
+
+    def body(carry, _):
+        st, downed, down_left = carry
+        tick = st.tick
+        alive = jnp.ones((n,), bool)
+        if crash_every:
+            crash_now = (tick % crash_every == 0) & (tick > 0)
+            lm = leader_mask(st)
+            new_downed = jnp.where(crash_now & jnp.any(lm),
+                                   jnp.argmax(lm).astype(I32), downed)
+            new_left = jnp.where(crash_now & jnp.any(lm), down_for,
+                                 jnp.maximum(down_left - 1, 0))
+            downed, down_left = new_downed, new_left
+            alive = alive & ~((jnp.arange(n, dtype=I32) == downed)
+                              & (down_left > 0))
+        if prop_count:
+            st = propose(st, cfg, _payloads(cfg, tick, prop_count),
+                         jnp.asarray(prop_count, I32))
+        drop = drop_matrix(cfg, tick, drop_rate) if drop_rate else None
+        st = step(st, cfg, alive=alive, drop=drop)
+        row = jnp.stack([jnp.sum(leader_mask(st).astype(I32)),
+                         jnp.max(st.commit), jnp.max(st.term)])
+        return (st, downed, down_left), row
+
+    init = (state, jnp.asarray(-1, I32), jnp.asarray(0, I32))
+    (final, _, _), trace = jax.lax.scan(body, init, None, length=n_ticks)
+    return final, trace
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_ticks"))
+def run_until_leader(state: SimState, cfg: SimConfig, max_ticks: int = 1000):
+    """Tick until some node is leader (leader-election latency measurement).
+    Returns (state, ticks_taken)."""
+
+    def cond(carry):
+        st, t = carry
+        return (~has_leader(st)) & (t < max_ticks)
+
+    def body(carry):
+        st, t = carry
+        return step(st, cfg), t + 1
+
+    return jax.lax.while_loop(cond, body, (state, jnp.asarray(0, I32)))
+
+
+def committed_entries(state: SimState) -> jax.Array:
+    """Total entries committed through consensus (max commit across rows)."""
+    return jnp.max(state.commit)
+
+
+def quorum_applied_checksum(state: SimState):
+    """(applied, checksum) pairs — equal applied MUST imply equal checksum
+    (state-machine safety); checked by tests and the bench verifier."""
+    return state.applied, state.apply_chk

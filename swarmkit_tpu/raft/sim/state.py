@@ -1,0 +1,148 @@
+"""Device-array state for the batched raft simulation.
+
+N simulated managers are rows of device arrays (the north-star layout from
+BASELINE.json): per-node scalars are [N], the leader's per-peer progress view
+is [N, N], and each node's log is a fixed-width ring buffer [N, L] with an
+explicit compaction watermark (snap_idx) replacing the reference's unbounded
+Go slices + WAL (manager/state/raft/raft.go Node state, vendor etcd raft
+struct raft.go:209-264).
+
+Node indices are 0-based on device; `NONE` (no leader / no vote) is -1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+# Roles
+FOLLOWER = 0
+CANDIDATE = 1
+LEADER = 2
+
+NONE = -1
+
+
+@dataclass(frozen=True)
+class SimConfig:
+    """Static (compile-time) simulation parameters.
+
+    Mirrors the reference defaults where meaningful: election_tick=10,
+    heartbeat_tick=1 (raft.go:484-488); keep=500 entries retained for slow
+    followers after compaction (raft.go:501).
+    """
+
+    n: int = 64                 # simulated managers
+    log_len: int = 8192         # ring-buffer slots per manager (L)
+    window: int = 1024          # max entries per append message (W)
+    apply_batch: int = 2048     # entries applied per node per tick (A)
+    max_props: int = 1024       # proposal batch width (B)
+    election_tick: int = 10
+    heartbeat_tick: int = 1
+    keep: int = 500             # entries kept behind `applied` at compaction
+    seed: int = 0
+
+    def __post_init__(self):
+        assert self.apply_batch >= self.max_props
+        assert self.log_len > self.keep + 2 * self.max_props + self.window
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class SimState:
+    # per-node scalars [N]
+    term: jax.Array
+    vote: jax.Array        # voted-for node index, NONE if none
+    role: jax.Array        # FOLLOWER / CANDIDATE / LEADER
+    lead: jax.Array        # known leader index, NONE if unknown
+    elapsed: jax.Array     # election timer (ticks since last leader contact)
+    hb_elapsed: jax.Array  # leader heartbeat timer
+    timeout: jax.Array     # randomized election timeout in ticks
+    last: jax.Array        # last log index
+    commit: jax.Array
+    applied: jax.Array
+    snap_idx: jax.Array    # compaction watermark (log holds (snap_idx, last])
+    snap_term: jax.Array
+    snap_chk: jax.Array    # state-machine checksum at snap_idx (uint32)
+    apply_chk: jax.Array   # state-machine checksum at applied (uint32)
+    # log ring buffers [N, L]; slot of index i (1-based) = (i-1) % L
+    log_term: jax.Array
+    log_data: jax.Array    # uint32 payload ids
+    log_chk: jax.Array     # uint32 state-machine checksum AFTER applying idx
+                           # (written during apply; read at compaction)
+    # leader-view progress [N, N]: row i = node i's view as (potential) leader
+    match: jax.Array
+    next_: jax.Array
+    granted: jax.Array     # bool: granted[i, j] = j voted for i this term
+    # membership / liveness [N] bool
+    active: jax.Array      # raft membership (conf changes flip these)
+    # global tick counter (scalar) — also the PRNG stream position
+    tick: jax.Array
+
+
+def init_state(cfg: SimConfig) -> SimState:
+    n, L = cfg.n, cfg.log_len
+    i32 = jnp.int32
+    z = lambda *s: jnp.zeros(s, i32)  # noqa: E731
+    return SimState(
+        term=z(n),
+        vote=jnp.full((n,), NONE, i32),
+        role=z(n),
+        lead=jnp.full((n,), NONE, i32),
+        elapsed=z(n),
+        hb_elapsed=z(n),
+        timeout=_initial_timeouts(cfg),
+        last=z(n), commit=z(n), applied=z(n),
+        snap_idx=z(n), snap_term=z(n),
+        snap_chk=jnp.zeros((n,), jnp.uint32),
+        apply_chk=jnp.zeros((n,), jnp.uint32),
+        log_term=z(n, L),
+        log_data=jnp.zeros((n, L), jnp.uint32),
+        log_chk=jnp.zeros((n, L), jnp.uint32),
+        match=z(n, n),
+        next_=jnp.ones((n, n), i32),
+        granted=jnp.zeros((n, n), jnp.bool_),
+        active=jnp.ones((n,), jnp.bool_),
+        tick=jnp.zeros((), i32),
+    )
+
+
+def hash32(x: jax.Array) -> jax.Array:
+    """splitmix32-style integer mix (uint32 -> uint32); the deterministic
+    PRNG behind randomized election timeouts and drop matrices."""
+    x = x.astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return x
+
+
+def rand_timeout(cfg: SimConfig, node: jax.Array, term: jax.Array) -> jax.Array:
+    """Randomized election timeout in [election_tick, 2*election_tick),
+    deterministic per (node, term, seed) — reference: vendor raft.go:255-258."""
+    h = hash32(node.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+               ^ term.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)
+               ^ jnp.uint32(cfg.seed))
+    return (cfg.election_tick + (h % jnp.uint32(cfg.election_tick))).astype(jnp.int32)
+
+
+def _initial_timeouts(cfg: SimConfig) -> jax.Array:
+    node = jnp.arange(cfg.n, dtype=jnp.int32)
+    return rand_timeout(cfg, node, jnp.zeros((cfg.n,), jnp.int32))
+
+
+def drop_matrix(cfg: SimConfig, tick: jax.Array, rate: float) -> jax.Array:
+    """Per-edge Bernoulli message-drop mask for this tick (BASELINE churn
+    configs). drop[i, j] = True drops messages i -> j."""
+    n = cfg.n
+    i = jnp.arange(n, dtype=jnp.uint32)
+    h = hash32(i[:, None] * jnp.uint32(0x01000193)
+               ^ i[None, :] * jnp.uint32(0x9E3779B1)
+               ^ tick.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)
+               ^ jnp.uint32(cfg.seed ^ 0xD1FF))
+    return (h.astype(jnp.float32) / jnp.float32(2**32)) < rate

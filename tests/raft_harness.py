@@ -23,6 +23,9 @@ class InMemCluster:
         self.ids = list(ids)
         self.nodes: dict[int, RawNode] = {}
         self.applied: dict[int, list[bytes]] = {i: [] for i in ids}
+        # log index of each item in self.applied (parallel lists), so restart
+        # can trim re-appliable entries by index rather than list position.
+        self.applied_idx: dict[int, list[int]] = {i: [] for i in ids}
         self.down: set[int] = set()
         self.partitions: set[tuple[int, int]] = set()  # directed (frm, to)
         self.drop_fn: Optional[Callable[[object], bool]] = None
@@ -50,12 +53,17 @@ class InMemCluster:
             node = RawNode(Config(id=pid, peers=tuple(self.ids),
                                   seed=self.rng.randrange(1 << 30), **self.cfg))
             self.applied[pid] = []
+            self.applied_idx[pid] = []
         else:
             log = old.raft.log
             log.pending_snapshot = None
-            # Unapplied committed entries re-apply after restart.
+            # Committed-but-compacted entries stay applied; everything above
+            # the snapshot boundary re-applies from the log after restart.
             log.applied = log.offset
-            self.applied[pid] = self.applied[pid][: log.offset]
+            keep = [k for k, i in enumerate(self.applied_idx[pid])
+                    if i <= log.offset]
+            self.applied[pid] = [self.applied[pid][k] for k in keep]
+            self.applied_idx[pid] = [self.applied_idx[pid][k] for k in keep]
             node = RawNode(
                 Config(id=pid, peers=(), seed=self.rng.randrange(1 << 30),
                        **self.cfg),
@@ -118,6 +126,7 @@ class InMemCluster:
                 # Instantiate the new member (empty log; will catch up).
                 self.ids.append(cc.node_id)
                 self.applied[cc.node_id] = []
+                self.applied_idx[cc.node_id] = []
                 self.nodes[cc.node_id] = RawNode(
                     Config(id=cc.node_id, peers=(),
                            seed=self.rng.randrange(1 << 30), **self.cfg),
@@ -128,6 +137,7 @@ class InMemCluster:
                     self.nodes[cc.node_id].raft.add_node(v)
         elif e.data:
             self.applied[pid].append(e.data)
+            self.applied_idx[pid].append(e.index)
 
     def tick(self, pid: Optional[int] = None) -> None:
         targets = [pid] if pid is not None else self.ids
