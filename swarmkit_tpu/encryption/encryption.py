@@ -1,0 +1,174 @@
+"""At-rest encryption for raft WAL/snapshots and TLS keys.
+
+Behavioral reference: manager/encryption/encryption.go — the
+``MaybeEncryptedRecord`` envelope (algorithm + data + nonce), a default
+authenticated-secretbox algorithm, a FIPS-friendly fernet alternative, and a
+``MultiDecrypter`` so key rotation can decrypt records written under either
+the old or the new key.
+
+TPU-era re-expression: instead of NaCl secretbox we use ChaCha20-Poly1305
+(the same AEAD family) from the ``cryptography`` package, which is what this
+environment ships.  Envelope wire format is msgpack.
+"""
+
+from __future__ import annotations
+
+import base64
+import enum
+import os
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+import msgpack
+from cryptography.fernet import Fernet, InvalidToken
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+
+class Algorithm(enum.IntEnum):
+    NONE = 0
+    SECRETBOX = 1   # ChaCha20-Poly1305 AEAD (NaCl-secretbox analog)
+    FERNET = 2      # AES128-CBC + HMAC (FIPS-friendly, like the reference)
+
+
+@dataclass
+class MaybeEncryptedRecord:
+    """Envelope around possibly-encrypted bytes
+    (reference: api/types.proto MaybeEncryptedRecord)."""
+
+    algorithm: Algorithm = Algorithm.NONE
+    data: bytes = b""
+    nonce: bytes = b""
+
+    def encode(self) -> bytes:
+        return msgpack.packb((int(self.algorithm), self.data, self.nonce))
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "MaybeEncryptedRecord":
+        alg, data, nonce = msgpack.unpackb(raw)
+        return cls(Algorithm(alg), data, nonce)
+
+
+class DecryptError(Exception):
+    pass
+
+
+class Encrypter:
+    def encrypt(self, data: bytes) -> MaybeEncryptedRecord:
+        raise NotImplementedError
+
+
+class Decrypter:
+    algorithm: Algorithm = Algorithm.NONE
+
+    def decrypt(self, rec: MaybeEncryptedRecord) -> bytes:
+        raise NotImplementedError
+
+
+class NopCrypter(Encrypter, Decrypter):
+    """Passthrough (reference: NoopCrypter)."""
+
+    algorithm = Algorithm.NONE
+
+    def encrypt(self, data: bytes) -> MaybeEncryptedRecord:
+        return MaybeEncryptedRecord(Algorithm.NONE, data, b"")
+
+    def decrypt(self, rec: MaybeEncryptedRecord) -> bytes:
+        if rec.algorithm != Algorithm.NONE:
+            raise DecryptError("record is encrypted; nop decrypter")
+        return rec.data
+
+
+class SecretboxCrypter(Encrypter, Decrypter):
+    """Default AEAD crypter keyed by a 32-byte secret
+    (reference: NACLSecretbox, encryption.go)."""
+
+    algorithm = Algorithm.SECRETBOX
+
+    def __init__(self, key: bytes) -> None:
+        if len(key) != 32:
+            raise ValueError("secretbox key must be 32 bytes")
+        self._aead = ChaCha20Poly1305(key)
+
+    def encrypt(self, data: bytes) -> MaybeEncryptedRecord:
+        nonce = os.urandom(12)
+        return MaybeEncryptedRecord(
+            Algorithm.SECRETBOX, self._aead.encrypt(nonce, data, b""), nonce)
+
+    def decrypt(self, rec: MaybeEncryptedRecord) -> bytes:
+        if rec.algorithm != Algorithm.SECRETBOX:
+            raise DecryptError(f"not a secretbox record: {rec.algorithm}")
+        try:
+            return self._aead.decrypt(rec.nonce, rec.data, b"")
+        except Exception as e:  # InvalidTag
+            raise DecryptError(str(e)) from e
+
+
+class FernetCrypter(Encrypter, Decrypter):
+    """FIPS-friendly alternative (reference: Fernet in encryption.go)."""
+
+    algorithm = Algorithm.FERNET
+
+    def __init__(self, key: bytes) -> None:
+        if len(key) != 32:
+            raise ValueError("fernet key must be 32 bytes")
+        self._f = Fernet(base64.urlsafe_b64encode(key))
+
+    def encrypt(self, data: bytes) -> MaybeEncryptedRecord:
+        return MaybeEncryptedRecord(Algorithm.FERNET, self._f.encrypt(data), b"")
+
+    def decrypt(self, rec: MaybeEncryptedRecord) -> bytes:
+        if rec.algorithm != Algorithm.FERNET:
+            raise DecryptError(f"not a fernet record: {rec.algorithm}")
+        try:
+            return self._f.decrypt(rec.data)
+        except InvalidToken as e:
+            raise DecryptError("invalid fernet token") from e
+
+
+class MultiDecrypter(Decrypter):
+    """Tries each decrypter whose algorithm matches
+    (reference: NewMultiDecrypter encryption.go:104)."""
+
+    def __init__(self, *decrypters: Decrypter) -> None:
+        self._decrypters = [d for d in decrypters if d is not None]
+
+    def decrypt(self, rec: MaybeEncryptedRecord) -> bytes:
+        last: Optional[Exception] = None
+        for d in self._decrypters:
+            if d.algorithm == rec.algorithm:
+                try:
+                    return d.decrypt(rec)
+                except DecryptError as e:
+                    last = e
+        raise DecryptError(
+            f"no decrypter succeeded for algorithm {rec.algorithm}"
+            + (f": {last}" if last else ""))
+
+
+def defaults(key: Optional[bytes], fips: bool = False
+             ) -> tuple[Encrypter, Decrypter]:
+    """Default encrypter/decrypter pair for a key
+    (reference: Defaults encryption.go:156)."""
+    if key is None:
+        nop = NopCrypter()
+        return nop, nop
+    if fips:
+        f = FernetCrypter(key)
+        return f, MultiDecrypter(f)
+    s = SecretboxCrypter(key)
+    return s, MultiDecrypter(s, FernetCrypter(key))
+
+
+def generate_secret_key() -> bytes:
+    return os.urandom(32)
+
+
+def human_readable_key(key: bytes) -> str:
+    return base64.b64encode(key).decode("ascii")
+
+
+def parse_human_readable_key(s: str) -> bytes:
+    key = base64.b64decode(s)
+    if len(key) != 32:
+        raise ValueError("key must decode to 32 bytes")
+    return key

@@ -15,6 +15,11 @@ from swarmkit_tpu.raft.messages import (
 )
 from swarmkit_tpu.raft.rawnode import RawNode, Ready
 
+# NOTE: the full consensus member lives in swarmkit_tpu.raft.node (Node,
+# NodeOpts), transport seam in .transport (Network, Transport), persistence
+# in .storage (EncryptedRaftLogger) — imported lazily by callers to keep this
+# package import light for the sim kernel.
+
 __all__ = [
     "Config", "ProposalDropped", "Raft", "RaftLog", "ConfChange",
     "ConfChangeType", "Entry", "EntryType", "HardState", "Message", "MsgType",

@@ -156,6 +156,9 @@ class Raft:
         self.randomized_election_timeout = 0
         self.lead_transferee = NONE
         self.pending_conf = False
+        # Materialized snapshot (set by the Node shell after each snapshot
+        # save) used to catch up followers behind the compaction watermark.
+        self.stored_snapshot: Optional[Snapshot] = None
         self._rng = random.Random((cfg.seed << 16) ^ cfg.id)
         self._step_fn: Callable[[Message], None] = self._step_follower
 
@@ -328,12 +331,17 @@ class Raft:
             # Follower is behind the compaction watermark: ship a snapshot.
             if not pr.recent_active:
                 return
-            meta = SnapshotMeta(index=self.log.offset,
-                                term=self.log.offset_term,
-                                voters=self.voter_ids())
-            snap = Snapshot(meta=meta, data=self._snapshot_data())
+            # Prefer the materialized snapshot installed by the Node shell
+            # (store + membership data at its index); fall back to a bare
+            # compaction-point snapshot (etcd MemoryStorage.Snapshot analog).
+            snap = self.stored_snapshot
+            if snap is None or snap.meta.index < self.log.offset:
+                meta = SnapshotMeta(index=self.log.offset,
+                                    term=self.log.offset_term,
+                                    voters=self.voter_ids())
+                snap = Snapshot(meta=meta, data=self._snapshot_data())
             self._send(Message(type=MsgType.SNAP, to=to, snapshot=snap))
-            pr.become_snapshot(meta.index)
+            pr.become_snapshot(snap.meta.index)
             return
         m = Message(type=MsgType.APP, to=to, index=prev, log_term=prev_term,
                     entries=tuple(ents), commit=self.log.committed)

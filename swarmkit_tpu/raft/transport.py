@@ -1,0 +1,242 @@
+"""Raft peer transport: async per-peer message fan-out behind the Transport
+seam.
+
+Behavioral reference: manager/state/raft/transport/ — ``Transport`` owns one
+``peer`` per remote with a non-blocking bounded send queue (4096 deep,
+transport/peer.go:61; messages DROPPED when full, peer.go:82-89), reports
+unreachable peers and snapshot delivery status back to the raft node through
+the ``Raft`` callback interface (transport.go:26), tracks per-peer activity
+for ``LongestActive``, and supports live address updates.
+
+This is the seam the TPU device-mesh backend slots behind (SURVEY.md §2.7):
+impl #1 here is an in-process asyncio network with per-edge drop/partition
+fault injection (replacing gRPC-over-mTLS); impl #3 (swarmkit_tpu.raft.sim)
+exchanges messages as device-array collectives.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from typing import Any, Optional, Protocol
+
+from swarmkit_tpu.raft.messages import Message, MsgType
+
+log = logging.getLogger("swarmkit_tpu.raft.transport")
+
+MAX_PEER_QUEUE = 4096  # reference: transport/peer.go:61
+
+
+class RaftHandlers(Protocol):
+    """Callbacks from transport into the raft node
+    (reference: transport.Raft transport.go:26)."""
+
+    async def process_raft_message(self, m: Message) -> None: ...
+    def report_unreachable(self, raft_id: int) -> None: ...
+    def report_snapshot(self, raft_id: int, ok: bool) -> None: ...
+    def is_id_removed(self, raft_id: int) -> bool: ...
+    def update_node(self, raft_id: int, addr: str) -> None: ...
+    def node_removed(self) -> None: ...
+
+
+class Unreachable(Exception):
+    pass
+
+
+class PeerRemoved(Exception):
+    """Raised by a server when the caller has been removed from the cluster
+    (reference: ErrMemberRemoved grpc error)."""
+
+
+class Network:
+    """In-process wire: addr -> server object, with fault injection.
+
+    Fault injection mirrors what the reference achieves with real sockets in
+    tests (WrappedListener drops, iptables partitions in BASELINE configs):
+    per-edge drop probability and partition groups.
+    """
+
+    def __init__(self, seed: int = 0) -> None:
+        self._servers: dict[str, Any] = {}
+        self._down: set[str] = set()
+        self._drop: dict[tuple[str, str], float] = {}
+        self._partitions: list[set[str]] = []
+        self._rng = random.Random(seed)
+        self.delivered = 0
+        self.dropped = 0
+
+    # -- topology ----------------------------------------------------------
+    def register(self, addr: str, server: Any) -> None:
+        self._servers[addr] = server
+        self._down.discard(addr)
+
+    def unregister(self, addr: str) -> None:
+        self._servers.pop(addr, None)
+
+    def set_down(self, addr: str, down: bool = True) -> None:
+        if down:
+            self._down.add(addr)
+        else:
+            self._down.discard(addr)
+
+    def set_drop(self, frm: str, to: str, p: float) -> None:
+        if p <= 0:
+            self._drop.pop((frm, to), None)
+        else:
+            self._drop[(frm, to)] = p
+
+    def partition(self, *groups: set[str]) -> None:
+        self._partitions = [set(g) for g in groups]
+
+    def heal(self) -> None:
+        self._partitions = []
+        self._drop = {}
+
+    # -- reachability ------------------------------------------------------
+    def _blocked(self, frm: str, to: str) -> bool:
+        if to in self._down or to not in self._servers:
+            return True
+        for group in self._partitions:
+            if (frm in group) != (to in group):
+                return True
+        return False
+
+    def reachable(self, frm: str, to: str) -> bool:
+        return not self._blocked(frm, to)
+
+    def healthy(self, addr: str) -> bool:
+        return addr in self._servers and addr not in self._down
+
+    def server(self, frm: str, to: str) -> Any:
+        """Dial: returns the server at `to` or raises Unreachable."""
+        if self._blocked(frm, to):
+            raise Unreachable(f"{to} unreachable from {frm}")
+        return self._servers[to]
+
+    def lossy(self, frm: str, to: str) -> bool:
+        p = self._drop.get((frm, to), 0.0)
+        return p > 0 and self._rng.random() < p
+
+
+class _Peer:
+    """One remote: bounded queue + drain task
+    (reference: transport/peer.go)."""
+
+    def __init__(self, tr: "Transport", raft_id: int, addr: str) -> None:
+        self.tr = tr
+        self.raft_id = raft_id
+        self.addr = addr
+        self.queue: asyncio.Queue = asyncio.Queue(maxsize=MAX_PEER_QUEUE)
+        self.active_since: float = 0.0
+        self._task = asyncio.get_running_loop().create_task(self._drain())
+
+    def send(self, m: Message) -> bool:
+        try:
+            self.queue.put_nowait(m)
+            return True
+        except asyncio.QueueFull:
+            return False  # drop, reference peer.go:82-89
+
+    async def _drain(self) -> None:
+        while True:
+            m = await self.queue.get()
+            await self._deliver(m)
+
+    async def _deliver(self, m: Message) -> None:
+        net, tr = self.tr.network, self.tr
+        try:
+            if net.lossy(tr.local_addr, self.addr):
+                net.dropped += 1
+                return  # silent loss: raft retries; not "unreachable"
+            server = net.server(tr.local_addr, self.addr)
+            await server.process_raft_message(m)
+            net.delivered += 1
+            if self.active_since == 0.0:
+                self.active_since = tr.clock.now() or 1e-9
+            if m.type == MsgType.SNAP:
+                tr.handlers.report_snapshot(self.raft_id, True)
+        except PeerRemoved:
+            tr.handlers.node_removed()
+        except Exception as e:
+            # Any delivery/processing failure counts as "peer unreachable"
+            # (matching the reference's RPC-error handling, peer.go:261),
+            # but log it — a receiver-side crash must not vanish silently.
+            if not isinstance(e, Unreachable):
+                log.warning("raft message delivery %s -> %s failed: %r",
+                            tr.local_addr, self.addr, e)
+            self.active_since = 0.0
+            if m.type == MsgType.SNAP:
+                tr.handlers.report_snapshot(self.raft_id, False)
+            tr.handlers.report_unreachable(self.raft_id)
+
+    def stop(self) -> None:
+        self._task.cancel()
+
+
+class Transport:
+    """reference: transport.Transport transport.go:47."""
+
+    def __init__(self, network: Network, handlers: RaftHandlers,
+                 local_addr: str, clock) -> None:
+        self.network = network
+        self.handlers = handlers
+        self.local_addr = local_addr
+        self.clock = clock
+        self._peers: dict[int, _Peer] = {}
+        self.stopped = False
+
+    def add_peer(self, raft_id: int, addr: str) -> None:
+        if raft_id in self._peers:
+            if self._peers[raft_id].addr == addr:
+                return
+            self._peers[raft_id].stop()
+        self._peers[raft_id] = _Peer(self, raft_id, addr)
+
+    def remove_peer(self, raft_id: int) -> None:
+        p = self._peers.pop(raft_id, None)
+        if p is not None:
+            p.stop()
+
+    def update_peer(self, raft_id: int, addr: str) -> None:
+        self.add_peer(raft_id, addr)
+
+    def peer_ids(self) -> list[int]:
+        return list(self._peers)
+
+    def send(self, m: Message) -> None:
+        """Non-blocking send (reference: Send transport.go:125)."""
+        if self.stopped:
+            return
+        if self.handlers.is_id_removed(m.to):
+            return
+        p = self._peers.get(m.to)
+        if p is None:
+            # unknown peer: the reference resolves via LongestActive; we just
+            # report unreachable so raft backs off
+            self.handlers.report_unreachable(m.to)
+            if m.type == MsgType.SNAP:
+                self.handlers.report_snapshot(m.to, False)
+            return
+        if not p.send(m):
+            if m.type == MsgType.SNAP:
+                self.handlers.report_snapshot(m.to, False)
+
+    def longest_active(self) -> Optional[int]:
+        """reference: LongestActive transport.go:299."""
+        best = None
+        for raft_id, p in self._peers.items():
+            if p.active_since <= 0:
+                continue
+            if best is None or p.active_since < self._peers[best].active_since:
+                best = raft_id
+        return best
+
+    def active_count(self) -> int:
+        return sum(1 for p in self._peers.values() if p.active_since > 0)
+
+    def stop(self) -> None:
+        self.stopped = True
+        for p in self._peers.values():
+            p.stop()
+        self._peers = {}
