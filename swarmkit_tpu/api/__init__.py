@@ -1,6 +1,8 @@
 from swarmkit_tpu.api.types import (
     TaskState, NodeRole, NodeState, NodeAvailability, Meta, Version,
-    Annotations, TaskStatus,
+    Annotations, TaskStatus, NodeDescription, NodeResources, Platform,
+    EngineDescription, Endpoint, EndpointVIP, PortConfig, NetworkAttachment,
+    Driver, Peer, IPAMConfig, IPAMOptions,
 )
 from swarmkit_tpu.api.specs import (
     NodeSpec, ServiceSpec, TaskSpec, ClusterSpec, NetworkSpec, SecretSpec,

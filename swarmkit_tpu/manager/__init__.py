@@ -1,0 +1,5 @@
+"""Manager control plane: API services and leader-only control loops.
+
+Reference: /root/reference/manager/ — re-expressed as asyncio event-loop
+components over the watchable MemoryStore (no goroutines/channels).
+"""

@@ -1,0 +1,336 @@
+"""Network allocator: assigns network resources before tasks can schedule.
+
+Reference: manager/allocator/ (allocator.go actor loop; network.go
+doNetworkInit :70 / doNetworkAlloc :164 / doNodeAlloc :307 / doTaskAlloc;
+cnmallocator/networkallocator.go IPAM; portallocator.go).  Tasks enter the
+cluster in NEW and only become PENDING (schedulable) once every allocator has
+acted — here that means: their service's endpoint (VIPs, published ports) and
+their network attachments exist.
+
+TPU-era simplification: a flat in-process IPAM — sequential /24 subnets from
+10.<n>.0.0, sequential host addresses, and a dynamic published-port range
+from 30000 (reference dynamicPortStart portallocator.go) — no external
+drivers.  The allocation *protocol* (watch → allocate → PENDING, idempotent
+re-allocation on restore) mirrors the reference.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from swarmkit_tpu.api import TaskState
+from swarmkit_tpu.api.types import (
+    Endpoint, EndpointVIP, IPAMConfig, IPAMOptions, NetworkAttachment,
+    PortConfig,
+)
+from swarmkit_tpu.store.memory import Event, EventCommit, MemoryStore, match, match_commit
+from swarmkit_tpu.utils.clock import Clock, SystemClock
+
+log = logging.getLogger("swarmkit_tpu.allocator")
+
+DYNAMIC_PORT_START = 30000   # reference: portallocator.go dynamicPortStart
+DYNAMIC_PORT_END = 32767
+INGRESS_NETWORK_NAME = "ingress"
+
+
+class PortConflict(Exception):
+    """An explicitly requested published port is already taken
+    (reference: portallocator.go allocation error)."""
+
+
+class SubnetExhausted(Exception):
+    """A network's /24 has no free host addresses left."""
+
+
+class IPAM:
+    """Flat sequential IPAM (cnmallocator analog)."""
+
+    def __init__(self) -> None:
+        self._next_subnet = 1
+        self._next_host: dict[str, int] = {}   # network id -> next host octet
+        self._subnets: dict[str, str] = {}     # network id -> subnet prefix
+
+    def allocate_subnet(self, network_id: str) -> str:
+        subnet = f"10.{self._next_subnet}.0.0/24"
+        self._next_subnet += 1
+        self._subnets[network_id] = subnet
+        self._next_host[network_id] = 2  # .1 = gateway
+        return subnet
+
+    def restore_subnet(self, network_id: str, subnet: str) -> None:
+        self._subnets[network_id] = subnet
+        try:
+            octet = int(subnet.split(".")[1])
+            self._next_subnet = max(self._next_subnet, octet + 1)
+        except (ValueError, IndexError):
+            pass
+        self._next_host.setdefault(network_id, 2)
+
+    def allocate_address(self, network_id: str) -> str:
+        if network_id not in self._subnets:
+            self.allocate_subnet(network_id)
+        base = self._subnets[network_id].rsplit(".", 2)[0]
+        host = self._next_host[network_id]
+        if host > 254:  # .255 is broadcast; stay inside the /24
+            raise SubnetExhausted(
+                f"network {network_id}: /24 address space exhausted")
+        self._next_host[network_id] = host + 1
+        return f"{base}.0.{host}/24"
+
+    def restore_address(self, network_id: str, addr: str) -> None:
+        try:
+            host_part = addr.split("/")[0].split(".")
+            host = int(host_part[2]) * 256 + int(host_part[3])
+            self._next_host[network_id] = max(
+                self._next_host.get(network_id, 2), host + 1)
+        except (ValueError, IndexError):
+            pass
+
+
+class PortAllocator:
+    """Published-port bookkeeping (reference: portallocator.go)."""
+
+    def __init__(self) -> None:
+        self._allocated: set[tuple[str, int]] = set()
+        self._next_dynamic = DYNAMIC_PORT_START
+
+    def allocate(self, proto: str, port: int = 0) -> int:
+        if port:
+            if (proto, port) in self._allocated:
+                raise PortConflict(f"{proto} port {port} is already published")
+            self._allocated.add((proto, port))
+            return port
+        while (proto, self._next_dynamic) in self._allocated:
+            self._next_dynamic += 1
+            if self._next_dynamic > DYNAMIC_PORT_END:
+                raise RuntimeError("dynamic port space exhausted")
+        self._allocated.add((proto, self._next_dynamic))
+        return self._next_dynamic
+
+    def restore(self, proto: str, port: int) -> None:
+        self._allocated.add((proto, port))
+
+    def release(self, proto: str, port: int) -> None:
+        self._allocated.discard((proto, port))
+
+
+class Allocator:
+    """reference: allocator.Allocator allocator.go:16 (network actor only —
+    the sole actor in the reference too)."""
+
+    def __init__(self, store: MemoryStore, clock: Optional[Clock] = None
+                 ) -> None:
+        self.store = store
+        self.clock = clock or SystemClock()
+        self.ipam = IPAM()
+        self.ports = PortAllocator()
+        self._pending_tasks: set[str] = set()
+        self._pending_services: set[str] = set()
+        self._pending_networks: set[str] = set()
+        self._task: Optional[asyncio.Task] = None
+        self._running = False
+
+    async def start(self) -> None:
+        watcher = self.store.watch(match(kind="task"), match(kind="service"),
+                                   match(kind="network"), match_commit)
+        # restore state from the store (reference: doNetworkInit network.go:70)
+        for net in self.store.find("network"):
+            if net.ipam is not None and net.ipam.configs:
+                self.ipam.restore_subnet(net.id, net.ipam.configs[0].subnet)
+            else:
+                self._pending_networks.add(net.id)
+        for svc in self.store.find("service"):
+            ep = svc.endpoint
+            if ep is not None:
+                for vip in ep.virtual_ips:
+                    self.ipam.restore_address(vip.network_id, vip.addr)
+                for p in ep.ports:
+                    if p.published_port:
+                        self.ports.restore(p.protocol, p.published_port)
+            if not self._service_allocated(svc):
+                self._pending_services.add(svc.id)
+        for t in self.store.find("task"):
+            if t.status.state == TaskState.NEW:
+                self._pending_tasks.add(t.id)
+            for att in t.networks:
+                for addr in att.addresses:
+                    self.ipam.restore_address(att.network_id, addr)
+        self._running = True
+        self._task = asyncio.get_running_loop().create_task(self._run(watcher))
+
+    async def stop(self) -> None:
+        self._running = False
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+
+    async def _run(self, watcher) -> None:
+        try:
+            if self._pending_networks or self._pending_services \
+                    or self._pending_tasks:
+                await self.tick()
+            while self._running:
+                ev = await watcher.get()
+                if isinstance(ev, Event):
+                    self._handle(ev)
+                elif isinstance(ev, EventCommit) and (
+                        self._pending_tasks or self._pending_services
+                        or self._pending_networks):
+                    await self.tick()
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.exception("allocator crashed")
+
+    def _handle(self, ev: Event) -> None:
+        if ev.action == "remove":
+            if ev.kind == "service" and ev.object.endpoint is not None:
+                for p in ev.object.endpoint.ports:
+                    if p.published_port:
+                        self.ports.release(p.protocol, p.published_port)
+            return
+        if ev.kind == "network":
+            self._pending_networks.add(ev.object.id)
+        elif ev.kind == "service":
+            if not self._service_allocated(ev.object):
+                self._pending_services.add(ev.object.id)
+        elif ev.kind == "task":
+            if ev.object.status.state == TaskState.NEW:
+                self._pending_tasks.add(ev.object.id)
+
+    # ------------------------------------------------------------------
+    def _service_allocated(self, svc) -> bool:
+        spec_ep = svc.spec.endpoint
+        if spec_ep is None or not spec_ep.ports:
+            return True
+        if svc.endpoint is None or svc.endpoint.spec is None:
+            return False
+        if svc.endpoint.spec.to_dict() != spec_ep.to_dict():
+            return False  # spec changed since last allocation
+        # only ingress-mode ports receive dynamic published ports; host-mode
+        # ports without an explicit published_port stay 0 by design
+        have = {(p.protocol, p.target_port) for p in svc.endpoint.ports
+                if p.published_port}
+        want = {(p.protocol, p.target_port) for p in spec_ep.ports
+                if p.publish_mode == "ingress" or p.published_port}
+        return want <= have
+
+    async def tick(self) -> None:
+        nets, self._pending_networks = self._pending_networks, set()
+        for nid in nets:
+            await self._alloc_network(nid)
+        svcs, self._pending_services = self._pending_services, set()
+        for sid in svcs:
+            await self._alloc_service(sid)
+        tasks, self._pending_tasks = self._pending_tasks, set()
+        if tasks:
+            await self._alloc_tasks(tasks)
+
+    async def _alloc_network(self, network_id: str) -> None:
+        """reference: doNetworkAlloc network.go:164."""
+        def txn(tx):
+            net = tx.get("network", network_id)
+            if net is None:
+                return
+            if net.ipam is not None and net.ipam.configs:
+                return  # already allocated
+            subnet = self.ipam.allocate_subnet(network_id)
+            net.ipam = IPAMOptions(driver="default", configs=[
+                IPAMConfig(subnet=subnet,
+                           gateway=subnet.rsplit(".", 2)[0] + ".0.1")])
+            tx.update(net)
+        await self.store.update(txn)
+
+    async def _alloc_service(self, service_id: str) -> None:
+        """Allocate endpoint: published ports + VIPs
+        (reference: allocateService networkallocator)."""
+        def txn(tx):
+            svc = tx.get("service", service_id)
+            if svc is None or self._service_allocated(svc):
+                return
+            spec_ep = svc.spec.endpoint
+            ep = svc.endpoint or Endpoint()
+            ep.spec = spec_ep.copy()
+            existing = {(p.protocol, p.target_port): p for p in ep.ports}
+            ports = []
+            for p in spec_ep.ports:
+                cur = existing.get((p.protocol, p.target_port))
+                if cur is not None and cur.published_port:
+                    ports.append(cur)
+                    continue
+                try:
+                    published = self.ports.allocate(
+                        p.protocol, p.published_port) \
+                        if p.publish_mode == "ingress" else p.published_port
+                except PortConflict as e:
+                    # leave the service unallocated; a later spec update
+                    # re-triggers allocation (reference: allocator records
+                    # the error on the service and retries on update)
+                    log.warning("service %s: %s", service_id, e)
+                    return
+                ports.append(PortConfig(
+                    name=p.name, protocol=p.protocol,
+                    target_port=p.target_port, published_port=published,
+                    publish_mode=p.publish_mode))
+            ep.ports = ports
+            # one VIP per attached network (+ ingress implicit for ports)
+            want_nets = list(svc.spec.networks) or list(svc.spec.task.networks)
+            have_vips = {v.network_id for v in ep.virtual_ips}
+            for nid in want_nets:
+                if nid not in have_vips:
+                    try:
+                        addr = self.ipam.allocate_address(nid)
+                    except SubnetExhausted as e:
+                        log.warning("service %s VIP: %s", service_id, e)
+                        continue
+                    ep.virtual_ips.append(EndpointVIP(network_id=nid,
+                                                      addr=addr))
+            svc.endpoint = ep
+            tx.update(svc)
+        await self.store.update(txn)
+
+    async def _alloc_tasks(self, task_ids: set[str]) -> None:
+        """reference: doTaskAlloc + taskBallot allocator.go:45 — move NEW
+        tasks to PENDING once their resources exist."""
+        batch = self.store.batch()
+        for tid in task_ids:
+            def txn(tx, tid=tid):
+                t = tx.get("task", tid)
+                if t is None or t.status.state != TaskState.NEW:
+                    return
+                svc = tx.get("service", t.service_id) if t.service_id else None
+                if svc is not None and not self._service_allocated(svc):
+                    self._pending_tasks.add(tid)  # retry after service alloc
+                    return
+                # attach task to its networks
+                want = list(t.spec.networks)
+                if svc is not None:
+                    want = want or list(svc.spec.networks)
+                have = {a.network_id for a in t.networks}
+                for nid in want:
+                    if nid in have:
+                        continue
+                    net = tx.get("network", nid)
+                    if net is None:
+                        continue
+                    try:
+                        addr = self.ipam.allocate_address(nid)
+                    except SubnetExhausted as e:
+                        log.warning("task %s: %s", tid, e)
+                        continue
+                    t.networks.append(NetworkAttachment(
+                        network_id=nid, addresses=[addr]))
+                if svc is not None and svc.endpoint is not None:
+                    t.endpoint = svc.endpoint.copy()
+                t.status.state = TaskState.PENDING
+                t.status.message = "pending task scheduling"
+                t.status.timestamp = self.clock.now()
+                tx.update(t)
+            await batch.update(txn)
+        await batch.commit()

@@ -1,0 +1,12 @@
+"""Orchestrators: reconcile service specs into tasks.
+
+Reference: manager/orchestrator/ — replicated + global orchestrators, the
+restart and update supervisors, task reaper, constraint enforcer, and the
+shared task helpers (task.go).
+"""
+
+from swarmkit_tpu.manager.orchestrator.common import (
+    new_task, is_task_dirty, restart_condition, slot_tuple,
+)
+
+__all__ = ["new_task", "is_task_dirty", "restart_condition", "slot_tuple"]

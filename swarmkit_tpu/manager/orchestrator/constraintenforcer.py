@@ -1,0 +1,91 @@
+"""Constraint enforcer: evicts tasks from nodes that stop satisfying their
+placement constraints or resource reservations.
+
+Reference: manager/orchestrator/constraintenforcer/constraint_enforcer.go —
+watches node updates, rejectNoncompliantTasks (:65) shuts down running tasks
+whose constraints no longer match the changed node.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from swarmkit_tpu.api import NodeAvailability, TaskState
+from swarmkit_tpu.manager import constraint as constraint_mod
+from swarmkit_tpu.manager.orchestrator import common
+from swarmkit_tpu.store.by import ByNode
+from swarmkit_tpu.store.memory import Event, MemoryStore, match
+from swarmkit_tpu.utils.clock import Clock, SystemClock
+
+log = logging.getLogger("swarmkit_tpu.orchestrator.constraintenforcer")
+
+
+class ConstraintEnforcer:
+    def __init__(self, store: MemoryStore, clock: Optional[Clock] = None
+                 ) -> None:
+        self.store = store
+        self.clock = clock or SystemClock()
+        self._task: Optional[asyncio.Task] = None
+        self._running = False
+
+    async def start(self) -> None:
+        watcher = self.store.watch(match(kind="node", action="update"))
+        self._running = True
+        self._task = asyncio.get_running_loop().create_task(self._run(watcher))
+
+    async def stop(self) -> None:
+        self._running = False
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+
+    async def _run(self, watcher) -> None:
+        try:
+            while self._running:
+                ev = await watcher.get()
+                if isinstance(ev, Event):
+                    await self.reject_noncompliant(ev.object)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.exception("constraint enforcer crashed")
+
+    async def reject_noncompliant(self, node) -> None:
+        """reference: rejectNoncompliantTasks constraint_enforcer.go:65."""
+        tasks = self.store.find("task", ByNode(node.id))
+        to_shutdown = []
+        drained = node.spec.availability == NodeAvailability.DRAIN
+        for t in tasks:
+            if t.desired_state > TaskState.RUNNING \
+                    or common.in_terminal_state(t):
+                continue
+            if drained:
+                to_shutdown.append(t)
+                continue
+            p = t.spec.placement
+            if p is not None and p.constraints:
+                try:
+                    cons = constraint_mod.parse(p.constraints)
+                except constraint_mod.InvalidConstraint:
+                    continue
+                if not constraint_mod.node_matches(cons, node):
+                    to_shutdown.append(t)
+        if not to_shutdown:
+            return
+
+        def txn(tx):
+            for t in to_shutdown:
+                cur = tx.get("task", t.id)
+                if cur is not None \
+                        and cur.desired_state <= TaskState.RUNNING:
+                    cur.desired_state = int(TaskState.SHUTDOWN)
+                    cur.status.message = \
+                        "node no longer satisfies task constraints"
+                    tx.update(cur)
+        await self.store.update(txn)

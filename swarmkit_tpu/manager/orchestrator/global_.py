@@ -1,0 +1,173 @@
+"""Global-service orchestrator: one task per eligible node.
+
+Reference: manager/orchestrator/global/global.go — reconcileServices (:253)
+creates a task on every READY, non-drained node matching the service's
+constraints and shuts down tasks on nodes that stopped qualifying; node
+add/remove events trigger reconciliation of every global service.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from swarmkit_tpu.api import Mode, NodeAvailability, NodeState, TaskState
+from swarmkit_tpu.manager import constraint as constraint_mod
+from swarmkit_tpu.manager.orchestrator import common
+from swarmkit_tpu.manager.orchestrator.restart import RestartSupervisor
+from swarmkit_tpu.store.by import ByService
+from swarmkit_tpu.store.memory import Event, EventCommit, MemoryStore, match, match_commit
+from swarmkit_tpu.utils.clock import Clock, SystemClock
+
+log = logging.getLogger("swarmkit_tpu.orchestrator.global")
+
+
+def _node_eligible(service, node) -> bool:
+    if node.status.state != NodeState.READY:
+        return False
+    if node.spec.availability in (NodeAvailability.DRAIN,):
+        return False
+    p = service.spec.task.placement
+    if p is not None and p.constraints:
+        try:
+            cons = constraint_mod.parse(p.constraints)
+        except constraint_mod.InvalidConstraint:
+            return False
+        if not constraint_mod.node_matches(cons, node):
+            return False
+    return True
+
+
+class GlobalOrchestrator:
+    def __init__(self, store: MemoryStore, clock: Optional[Clock] = None,
+                 restart: Optional[RestartSupervisor] = None) -> None:
+        self.store = store
+        self.clock = clock or SystemClock()
+        self.restart = restart or RestartSupervisor(store, clock=self.clock)
+        self._dirty: set[str] = set()
+        self._deleted: dict[str, object] = {}
+        self._restart_queue: list = []
+        self._nodes_changed = False
+        self._task: Optional[asyncio.Task] = None
+        self._running = False
+
+    async def start(self) -> None:
+        watcher = self.store.watch(match(kind="service"), match(kind="task"),
+                                   match(kind="node"), match_commit)
+        for s in self.store.find("service"):
+            if s.spec.mode == Mode.GLOBAL:
+                self._dirty.add(s.id)
+        self._running = True
+        self._task = asyncio.get_running_loop().create_task(self._run(watcher))
+
+    async def stop(self) -> None:
+        self._running = False
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+        await self.restart.stop()
+
+    async def _run(self, watcher) -> None:
+        try:
+            if self._dirty:
+                await self.tick()
+            while self._running:
+                ev = await watcher.get()
+                self._handle(ev)
+                if isinstance(ev, EventCommit) and (
+                        self._dirty or self._deleted or self._restart_queue):
+                    await self.tick()
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.exception("global orchestrator crashed")
+
+    def _handle(self, ev) -> None:
+        if not isinstance(ev, Event):
+            return
+        if ev.kind == "service":
+            if ev.object.spec.mode != Mode.GLOBAL:
+                return
+            if ev.action == "remove":
+                self._deleted[ev.object.id] = ev.object
+            else:
+                self._dirty.add(ev.object.id)
+        elif ev.kind == "node":
+            # any node change can affect every global service
+            for s in self.store.find("service"):
+                if s.spec.mode == Mode.GLOBAL:
+                    self._dirty.add(s.id)
+        elif ev.kind == "task":
+            t = ev.object
+            if not t.service_id:
+                return
+            if ev.action == "remove":
+                self._dirty.add(t.service_id)
+            elif ev.action == "update" and common.in_terminal_state(t) \
+                    and t.desired_state <= TaskState.RUNNING:
+                self._restart_queue.append(t)
+
+    async def tick(self) -> None:
+        deleted, self._deleted = self._deleted, {}
+        for service in deleted.values():
+            tasks = self.store.find("task", ByService(service.id))
+            if tasks:
+                def txn(tx, tasks=tasks):
+                    for t in tasks:
+                        if tx.get("task", t.id) is not None:
+                            tx.delete("task", t.id)
+                await self.store.update(txn)
+
+        restarts, self._restart_queue = self._restart_queue, []
+        for task in restarts:
+            service = self.store.get("service", task.service_id)
+            if service is None or service.spec.mode != Mode.GLOBAL:
+                continue
+            cluster = self._cluster()
+            await self.store.update(
+                lambda tx, s=service, t=task:
+                self.restart.restart(tx, cluster, s, t))
+
+        dirty, self._dirty = self._dirty, set()
+        for sid in dirty:
+            service = self.store.get("service", sid)
+            if service is not None and service.spec.mode == Mode.GLOBAL:
+                await self._reconcile(service)
+
+    def _cluster(self):
+        clusters = self.store.find("cluster")
+        return clusters[0] if clusters else None
+
+    async def _reconcile(self, service) -> None:
+        """reference: reconcileServices global.go:253."""
+        nodes = self.store.find("node")
+        eligible = {n.id for n in nodes if _node_eligible(service, n)}
+        tasks = self.store.find("task", ByService(service.id))
+        by_node: dict[str, list] = {}
+        for t in tasks:
+            if common.runnable(t):
+                by_node.setdefault(t.node_id, []).append(t)
+
+        cluster = self._cluster()
+        to_create = [nid for nid in eligible if nid not in by_node]
+        to_shutdown = [t for nid, ts in by_node.items()
+                       if nid not in eligible for t in ts]
+        if not to_create and not to_shutdown:
+            return
+
+        def txn(tx):
+            for nid in to_create:
+                tx.create(common.new_task(cluster, service, slot=0,
+                                          node_id=nid))
+            for t in to_shutdown:
+                cur = tx.get("task", t.id)
+                if cur is not None \
+                        and cur.desired_state <= TaskState.RUNNING:
+                    cur.desired_state = int(TaskState.SHUTDOWN)
+                    tx.update(cur)
+        await self.store.update(txn)

@@ -1,0 +1,193 @@
+"""Replicated-service orchestrator.
+
+Reference: manager/orchestrator/replicated/ — watches service/task/node
+events, reconciles on commit (replicated.go:47-93): scale up by creating
+tasks in free slots, scale down by removing the least-valuable slots
+(services.go), restart failed tasks via the restart supervisor (tasks.go),
+and hand dirty (spec-changed) slots to the update supervisor.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from swarmkit_tpu.api import Mode, TaskState
+from swarmkit_tpu.manager.orchestrator import common
+from swarmkit_tpu.manager.orchestrator.restart import RestartSupervisor
+from swarmkit_tpu.manager.orchestrator.update import UpdateSupervisor
+from swarmkit_tpu.store.by import ByService
+from swarmkit_tpu.store.memory import Event, EventCommit, MemoryStore, match, match_commit
+from swarmkit_tpu.utils.clock import Clock, SystemClock
+
+log = logging.getLogger("swarmkit_tpu.orchestrator.replicated")
+
+
+class ReplicatedOrchestrator:
+    def __init__(self, store: MemoryStore, clock: Optional[Clock] = None,
+                 restart: Optional[RestartSupervisor] = None,
+                 updater: Optional[UpdateSupervisor] = None) -> None:
+        self.store = store
+        self.clock = clock or SystemClock()
+        self.restart = restart or RestartSupervisor(store, clock=self.clock)
+        self.updater = updater or UpdateSupervisor(store, self.restart,
+                                                   clock=self.clock)
+        self._dirty_services: set[str] = set()
+        self._deleted_services: dict[str, object] = {}
+        self._restart_queue: list[tuple] = []
+        self._task: Optional[asyncio.Task] = None
+        self._running = False
+
+    async def start(self) -> None:
+        watcher = self.store.watch(match(kind="service"), match(kind="task"),
+                                   match_commit)
+        # initial reconciliation of everything (reference: init via taskinit)
+        for s in self.store.find("service"):
+            if s.spec.mode == Mode.REPLICATED:
+                self._dirty_services.add(s.id)
+        self._running = True
+        self._task = asyncio.get_running_loop().create_task(self._run(watcher))
+
+    async def stop(self) -> None:
+        self._running = False
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+        await self.updater.stop()
+        await self.restart.stop()
+
+    # ------------------------------------------------------------------
+    async def _run(self, watcher) -> None:
+        try:
+            if self._dirty_services:
+                await self.tick()
+            while self._running:
+                ev = await watcher.get()
+                self._handle(ev)
+                if isinstance(ev, EventCommit) and (
+                        self._dirty_services or self._restart_queue
+                        or self._deleted_services):
+                    await self.tick()
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.exception("replicated orchestrator crashed")
+
+    def _handle(self, ev) -> None:
+        if not isinstance(ev, Event):
+            return
+        if ev.kind == "service":
+            s = ev.object
+            if s.spec.mode != Mode.REPLICATED:
+                return
+            if ev.action == "remove":
+                self._deleted_services[s.id] = s
+            else:
+                self._dirty_services.add(s.id)
+        elif ev.kind == "task":
+            t = ev.object
+            if not t.service_id:
+                return
+            if ev.action == "remove":
+                self._dirty_services.add(t.service_id)
+                return
+            # a task reaching a terminal state may need a restart
+            if ev.action == "update" and common.in_terminal_state(t) \
+                    and t.desired_state <= TaskState.RUNNING:
+                self._restart_queue.append(t)
+
+    # ------------------------------------------------------------------
+    async def tick(self) -> None:
+        deleted, self._deleted_services = self._deleted_services, {}
+        for service in deleted.values():
+            await self._delete_service_tasks(service)
+
+        restarts, self._restart_queue = self._restart_queue, []
+        for task in restarts:
+            await self._restart_task(task)
+
+        dirty, self._dirty_services = self._dirty_services, set()
+        for sid in dirty:
+            service = self.store.get("service", sid)
+            if service is not None and service.spec.mode == Mode.REPLICATED:
+                await self._reconcile(service)
+
+    async def _delete_service_tasks(self, service) -> None:
+        """reference: replicated.go deleteServiceTasks."""
+        tasks = self.store.find("task", ByService(service.id))
+
+        def txn(tx):
+            for t in tasks:
+                cur = tx.get("task", t.id)
+                if cur is not None:
+                    tx.delete("task", t.id)
+        if tasks:
+            await self.store.update(txn)
+
+    async def _restart_task(self, task) -> None:
+        service = self.store.get("service", task.service_id)
+        if service is None or service.spec.mode != Mode.REPLICATED:
+            return
+        cluster = self._cluster()
+        await self.store.update(
+            lambda tx: self.restart.restart(tx, cluster, service, task))
+
+    def _cluster(self):
+        clusters = self.store.find("cluster")
+        return clusters[0] if clusters else None
+
+    async def _reconcile(self, service) -> None:
+        """reference: services.go reconcile."""
+        tasks = self.store.find("task", ByService(service.id))
+        # group live tasks by slot
+        slots: dict[int, list] = {}
+        for t in tasks:
+            if common.runnable(t):
+                slots.setdefault(t.slot, []).append(t)
+        want = service.spec.replica_count()
+        have = len(slots)
+
+        if have < want:
+            cluster = self._cluster()
+            used = set(slots)
+            free = [i for i in range(1, want + len(used) + 1)
+                    if i not in used]
+            new_tasks = []
+            for i in range(want - have):
+                new_tasks.append(common.new_task(cluster, service,
+                                                 slot=free[i]))
+
+            def txn(tx):
+                for t in new_tasks:
+                    tx.create(t)
+            await self.store.update(txn)
+        elif have > want:
+            # remove surplus slots, preferring those not yet running
+            # (reference: services.go scale-down preferences)
+            def sort_key(item):
+                slot_num, slot_tasks = item
+                running = any(t.status.state == TaskState.RUNNING
+                              for t in slot_tasks)
+                return (running, slot_num)
+            surplus = sorted(slots.items(), key=sort_key)[:have - want]
+
+            def txn(tx):
+                for _, slot_tasks in surplus:
+                    for t in slot_tasks:
+                        cur = tx.get("task", t.id)
+                        if cur is None:
+                            continue
+                        cur.desired_state = int(TaskState.REMOVE)
+                        tx.update(cur)
+            await self.store.update(txn)
+
+        # dirty slots go to the rolling updater
+        live_slots = [s for s in slots.values() if s]
+        if any(common.is_task_dirty(service, t)
+               for s in live_slots for t in s):
+            self.updater.update(self._cluster(), service, live_slots)

@@ -1,0 +1,133 @@
+"""Restart supervisor: applies restart policies when tasks fail.
+
+Reference: manager/orchestrator/restart/restart.go — Restart (:103) shuts
+down the failed task and, when shouldRestart (:195) allows (condition,
+max-attempts within window), creates a replacement in the same slot with
+desired_state READY, then DelayStart (:395) flips it to RUNNING after the
+policy delay.  Restart history is tracked per slot (restartedInstances ring).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections import deque
+from dataclasses import dataclass
+from typing import Optional
+
+from swarmkit_tpu.api import RestartCondition, TaskState
+from swarmkit_tpu.manager.orchestrator import common
+from swarmkit_tpu.store.memory import MemoryStore
+from swarmkit_tpu.utils.clock import Clock, SystemClock
+
+log = logging.getLogger("swarmkit_tpu.orchestrator.restart")
+
+
+@dataclass
+class _Instance:
+    timestamp: float
+
+
+class RestartSupervisor:
+    def __init__(self, store: MemoryStore, clock: Optional[Clock] = None
+                 ) -> None:
+        self.store = store
+        self.clock = clock or SystemClock()
+        # slot tuple -> deque of restart timestamps (restart.go history)
+        self._history: dict[tuple, deque] = {}
+        self._delays: dict[str, asyncio.Task] = {}  # new task id -> timer
+
+    async def stop(self) -> None:
+        for t in self._delays.values():
+            t.cancel()
+        for t in list(self._delays.values()):
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._delays = {}
+
+    # ------------------------------------------------------------------
+    def should_restart(self, task, service) -> bool:
+        """reference: shouldRestart restart.go:195."""
+        cond = common.restart_condition(task)
+        if cond == RestartCondition.NONE:
+            return False
+        if cond == RestartCondition.ON_FAILURE \
+                and task.status.state == TaskState.COMPLETE:
+            return False
+        policy = common.restart_policy(task)
+        if policy.max_attempts == 0:
+            return True
+        slot = common.slot_tuple(task)
+        history = self._history.get(slot, deque())
+        now = self.clock.now()
+        if policy.window > 0:
+            recent = sum(1 for inst in history
+                         if now - inst.timestamp <= policy.window)
+        else:
+            recent = len(history)
+        return recent < policy.max_attempts
+
+    def restart(self, tx, cluster, service, task) -> None:
+        """Shut down `task`; maybe create its replacement.  Runs inside a
+        store transaction (synchronous — only the delayed-start timer is
+        async; reference: Restart restart.go:103)."""
+        t = tx.get("task", task.id)
+        if t is None:
+            return
+        if t.desired_state > TaskState.RUNNING:
+            return  # already being shut down
+        t.desired_state = int(TaskState.SHUTDOWN)
+        tx.update(t)
+
+        if not self.should_restart(task, service):
+            return
+
+        policy = common.restart_policy(task)
+        new = common.new_task(cluster, service, slot=task.slot,
+                              node_id="" if task.slot else task.node_id)
+        # replacement waits in READY until the restart delay elapses
+        new.desired_state = int(TaskState.READY)
+        tx.create(new)
+
+        slot = common.slot_tuple(task)
+        self._history.setdefault(slot, deque(maxlen=256)).append(
+            _Instance(timestamp=self.clock.now()))
+        self.delay_start(new.id, policy.delay)
+
+    # ------------------------------------------------------------------
+    def delay_start(self, task_id: str, delay: float) -> None:
+        """reference: DelayStart restart.go:395."""
+        if task_id in self._delays:
+            return
+
+        async def _timer():
+            try:
+                if delay > 0:
+                    await self.clock.sleep(delay)
+                await self.store.update(lambda tx: self._promote(tx, task_id))
+            except asyncio.CancelledError:
+                pass
+            except Exception:
+                log.exception("delayed start of %s failed", task_id)
+            finally:
+                self._delays.pop(task_id, None)
+
+        self._delays[task_id] = asyncio.get_running_loop().create_task(_timer())
+
+    @staticmethod
+    def _promote(tx, task_id: str) -> None:
+        t = tx.get("task", task_id)
+        if t is None or t.desired_state != TaskState.READY:
+            return
+        t.desired_state = int(TaskState.RUNNING)
+        tx.update(t)
+
+    def cancel_delay(self, task_id: str) -> None:
+        timer = self._delays.pop(task_id, None)
+        if timer is not None:
+            timer.cancel()
+
+    def pending_delays(self) -> int:
+        return len(self._delays)

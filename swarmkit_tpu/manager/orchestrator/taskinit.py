@@ -1,0 +1,38 @@
+"""Startup task fixing shared by orchestrators.
+
+Reference: manager/orchestrator/taskinit/init.go CheckTasks — after a leader
+change, re-arm delayed restarts for tasks parked in READY and restart tasks
+that died while no orchestrator was watching.
+"""
+
+from __future__ import annotations
+
+from swarmkit_tpu.api import Mode, TaskState
+from swarmkit_tpu.manager.orchestrator import common
+
+
+async def check_tasks(store, restart_supervisor, mode: Mode) -> None:
+    dead: list = []
+    parked: list = []
+    for t in store.find("task"):
+        if not t.service_id:
+            continue
+        service = store.get("service", t.service_id)
+        if service is None or service.spec.mode != mode:
+            continue
+        if common.in_terminal_state(t) \
+                and t.desired_state <= TaskState.RUNNING:
+            dead.append((service, t))
+        elif t.desired_state == TaskState.READY \
+                and t.status.state < TaskState.RUNNING:
+            parked.append(t)
+
+    clusters = store.find("cluster")
+    cluster = clusters[0] if clusters else None
+    for service, task in dead:
+        await store.update(
+            lambda tx, s=service, t=task:
+            restart_supervisor.restart(tx, cluster, s, t))
+    for t in parked:
+        policy = common.restart_policy(t)
+        restart_supervisor.delay_start(t.id, policy.delay)

@@ -1,0 +1,122 @@
+"""Task reaper: garbage-collects dead and REMOVE-desired tasks.
+
+Reference: manager/orchestrator/taskreaper/task_reaper.go — keeps at most
+TaskHistoryRetentionLimit dead tasks per slot (tick :234), deletes tasks with
+desired_state REMOVE once they reach a terminal state, and cleans up tasks
+orphaned for too long.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from swarmkit_tpu.api import TaskState
+from swarmkit_tpu.manager.orchestrator import common
+from swarmkit_tpu.store.by import BySlot
+from swarmkit_tpu.store.memory import Event, EventCommit, MemoryStore, match, match_commit
+from swarmkit_tpu.utils.clock import Clock, SystemClock
+
+log = logging.getLogger("swarmkit_tpu.orchestrator.taskreaper")
+
+DEFAULT_RETENTION = 5  # reference: defaults.Service TaskHistoryRetentionLimit
+
+
+class TaskReaper:
+    def __init__(self, store: MemoryStore, clock: Optional[Clock] = None
+                 ) -> None:
+        self.store = store
+        self.clock = clock or SystemClock()
+        self._dirty_slots: set[tuple] = set()
+        self._cleanup: set[str] = set()
+        self._task: Optional[asyncio.Task] = None
+        self._running = False
+
+    def _retention(self) -> int:
+        clusters = self.store.find("cluster")
+        if clusters:
+            orch = clusters[0].spec.orchestration
+            if orch is not None and orch.task_history_retention_limit:
+                return orch.task_history_retention_limit
+        return DEFAULT_RETENTION
+
+    async def start(self) -> None:
+        watcher = self.store.watch(match(kind="task"), match_commit)
+        # startup scan (reference: taskReaper.Run initial pass)
+        for t in self.store.find("task"):
+            if t.desired_state == TaskState.REMOVE \
+                    and common.in_terminal_state(t):
+                self._cleanup.add(t.id)
+            elif common.in_terminal_state(t):
+                self._dirty_slots.add(common.slot_tuple(t))
+        self._running = True
+        self._task = asyncio.get_running_loop().create_task(self._run(watcher))
+
+    async def stop(self) -> None:
+        self._running = False
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+
+    async def _run(self, watcher) -> None:
+        try:
+            if self._cleanup or self._dirty_slots:
+                await self.tick()
+            while self._running:
+                ev = await watcher.get()
+                if isinstance(ev, Event):
+                    t = ev.object
+                    if ev.action == "remove":
+                        continue
+                    if t.desired_state == TaskState.REMOVE \
+                            and common.in_terminal_state(t):
+                        self._cleanup.add(t.id)
+                    elif common.in_terminal_state(t):
+                        self._dirty_slots.add(common.slot_tuple(t))
+                elif isinstance(ev, EventCommit) \
+                        and (self._cleanup or self._dirty_slots):
+                    await self.tick()
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.exception("task reaper crashed")
+
+    async def tick(self) -> None:
+        """reference: tick task_reaper.go:234."""
+        cleanup, self._cleanup = self._cleanup, set()
+        dirty, self._dirty_slots = self._dirty_slots, set()
+        retention = self._retention()
+
+        to_delete = set(cleanup)
+        for slot in dirty:
+            kind, service_id, key = slot
+            if kind == "slot":
+                tasks = self.store.find("task", BySlot(service_id, key))
+            else:
+                from swarmkit_tpu.store.by import ByService
+                tasks = [t for t in self.store.find(
+                    "task", ByService(service_id)) if t.node_id == key
+                    and not t.slot]
+            dead = sorted(
+                (t for t in tasks if common.in_terminal_state(t)
+                 and t.desired_state > TaskState.RUNNING),
+                key=lambda t: t.status.timestamp)
+            excess = len(dead) - retention
+            for t in dead[:max(0, excess)]:
+                to_delete.add(t.id)
+
+        if not to_delete:
+            return
+
+        batch = self.store.batch()
+        for tid in to_delete:
+            def txn(tx, tid=tid):
+                if tx.get("task", tid) is not None:
+                    tx.delete("task", tid)
+            await batch.update(txn)
+        await batch.commit()

@@ -1,0 +1,10 @@
+from swarmkit_tpu.manager.scheduler.scheduler import Scheduler
+from swarmkit_tpu.manager.scheduler.nodeinfo import NodeInfo
+from swarmkit_tpu.manager.scheduler.filters import (
+    Filter, Pipeline, ReadyFilter, ResourceFilter, ConstraintFilter,
+    PlatformFilter, HostPortFilter, MaxReplicasFilter,
+)
+
+__all__ = ["Scheduler", "NodeInfo", "Filter", "Pipeline", "ReadyFilter",
+           "ResourceFilter", "ConstraintFilter", "PlatformFilter",
+           "HostPortFilter", "MaxReplicasFilter"]

@@ -1,0 +1,181 @@
+"""Scheduler filter pipeline.
+
+Reference: manager/scheduler/filter.go (Ready/Resource/Plugin/Constraint/
+Platform/HostPort/MaxReplicas filters) and pipeline.go (Pipeline.Process:
+SetTask once per task, then Check per node, collecting failure explanations).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from swarmkit_tpu.api import NodeAvailability, NodeState
+from swarmkit_tpu.manager import constraint as constraint_mod
+from swarmkit_tpu.manager.scheduler.nodeinfo import NodeInfo, task_reserved
+
+
+class Filter:
+    name = "filter"
+
+    def set_task(self, task) -> bool:
+        """Return False if this filter is a no-op for the task."""
+        raise NotImplementedError
+
+    def check(self, info: NodeInfo) -> bool:
+        raise NotImplementedError
+
+
+class ReadyFilter(Filter):
+    """Node must be READY and ACTIVE (filter.go:31)."""
+
+    name = "ready"
+
+    def set_task(self, task) -> bool:
+        return True
+
+    def check(self, info: NodeInfo) -> bool:
+        return (info.node.status.state == NodeState.READY
+                and info.node.spec.availability == NodeAvailability.ACTIVE)
+
+
+class ResourceFilter(Filter):
+    """Reservations must fit remaining resources (filter.go:58)."""
+
+    name = "resource"
+
+    def __init__(self) -> None:
+        self._cpus = 0
+        self._mem = 0
+        self._generic: dict[str, int] = {}
+
+    def set_task(self, task) -> bool:
+        self._cpus, self._mem, self._generic = task_reserved(task)
+        return bool(self._cpus or self._mem or self._generic)
+
+    def check(self, info: NodeInfo) -> bool:
+        if self._cpus > info.available_cpus:
+            return False
+        if self._mem > info.available_memory:
+            return False
+        for k, v in self._generic.items():
+            if v > info.available_generic.get(k, 0):
+                return False
+        return True
+
+
+class ConstraintFilter(Filter):
+    """Placement constraint expressions (filter.go:153)."""
+
+    name = "constraint"
+
+    def __init__(self) -> None:
+        self._constraints: list = []
+
+    def set_task(self, task) -> bool:
+        p = task.spec.placement
+        if p is None or not p.constraints:
+            self._constraints = []
+            return False
+        self._constraints = constraint_mod.parse(p.constraints)
+        return True
+
+    def check(self, info: NodeInfo) -> bool:
+        return constraint_mod.node_matches(self._constraints, info.node)
+
+
+class PlatformFilter(Filter):
+    """Image/spec platform must match node platform (filter.go:250)."""
+
+    name = "platform"
+
+    def __init__(self) -> None:
+        self._platforms: list[str] = []
+
+    def set_task(self, task) -> bool:
+        p = task.spec.placement
+        self._platforms = list(p.platforms) if p is not None else []
+        return bool(self._platforms)
+
+    def check(self, info: NodeInfo) -> bool:
+        desc = info.node.description
+        plat = desc.platform if desc is not None else None
+        if plat is None:
+            return False
+        node_plat = f"{plat.os}/{plat.architecture}"
+        for want in self._platforms:
+            if "/" not in want:
+                want = f"{want}/{plat.architecture}"
+            w_os, w_arch = want.split("/", 1)
+            if (not w_os or w_os == plat.os) \
+                    and (not w_arch or w_arch == plat.architecture):
+                return True
+        return False
+
+
+class HostPortFilter(Filter):
+    """Host-mode published ports must be free on the node (filter.go:300)."""
+
+    name = "hostport"
+
+    def __init__(self) -> None:
+        self._ports: list[tuple[str, int]] = []
+
+    @staticmethod
+    def _host_ports(task) -> list[tuple[str, int]]:
+        ep = task.endpoint
+        if ep is None:
+            return []
+        return [(p.protocol, p.published_port) for p in ep.ports
+                if p.publish_mode == "host" and p.published_port]
+
+    def set_task(self, task) -> bool:
+        self._ports = self._host_ports(task)
+        return bool(self._ports)
+
+    def check(self, info: NodeInfo) -> bool:
+        used = set()
+        for t in info.tasks.values():
+            if info.counts_toward_load(t):
+                used.update(self._host_ports(t))
+        return not any(p in used for p in self._ports)
+
+
+class MaxReplicasFilter(Filter):
+    """placement.max_replicas per node (filter.go:356)."""
+
+    name = "maxreplicas"
+
+    def __init__(self) -> None:
+        self._max = 0
+        self._service = ""
+
+    def set_task(self, task) -> bool:
+        p = task.spec.placement
+        self._max = p.max_replicas if p is not None else 0
+        self._service = task.service_id
+        return self._max > 0
+
+    def check(self, info: NodeInfo) -> bool:
+        return info.count_for_service(self._service) < self._max
+
+
+DEFAULT_FILTERS = (ReadyFilter, ResourceFilter, ConstraintFilter,
+                   PlatformFilter, HostPortFilter, MaxReplicasFilter)
+
+
+class Pipeline:
+    """reference: pipeline.go:37."""
+
+    def __init__(self, filters=None) -> None:
+        self._all = [f() for f in (filters or DEFAULT_FILTERS)]
+        self._active: list[Filter] = []
+
+    def set_task(self, task) -> None:
+        self._active = [f for f in self._all if f.set_task(task)]
+
+    def process(self, info: NodeInfo) -> bool:
+        return all(f.check(info) for f in self._active)
+
+    def explain(self, info: NodeInfo) -> str:
+        failed = [f.name for f in self._active if not f.check(info)]
+        return "no suitable node (%s)" % ", ".join(failed) if failed else ""

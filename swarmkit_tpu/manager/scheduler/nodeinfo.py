@@ -1,0 +1,97 @@
+"""Scheduler's in-memory view of one node.
+
+Reference: manager/scheduler/nodeinfo.go — NodeInfo wraps the store Node with
+its task set, per-service active counts, and remaining resources, maintained
+incrementally as tasks come and go.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from swarmkit_tpu.api import TaskState
+from swarmkit_tpu.api.types import TERMINAL_STATES
+
+
+def task_reserved(task) -> tuple[int, int, dict]:
+    res = task.spec.resources
+    if res is None or res.reservations is None:
+        return 0, 0, {}
+    r = res.reservations
+    return r.nano_cpus, r.memory_bytes, dict(r.generic)
+
+
+class NodeInfo:
+    def __init__(self, node, tasks: Optional[dict] = None) -> None:
+        self.node = node
+        self.tasks: dict[str, object] = {}
+        # ACTIVE (non-terminal desired) tasks per service
+        self.active_tasks_per_service: dict[str, int] = {}
+        self.available_cpus = 0
+        self.available_memory = 0
+        self.available_generic: dict[str, int] = {}
+        desc = node.description
+        if desc is not None and desc.resources is not None:
+            self.available_cpus = desc.resources.nano_cpus
+            self.available_memory = desc.resources.memory_bytes
+            self.available_generic = dict(desc.resources.generic)
+        self.recent_failures: list[float] = []
+        for t in (tasks or {}).values():
+            self.add_task(t)
+
+    @property
+    def id(self) -> str:
+        return self.node.id
+
+    def counts_toward_load(self, task) -> bool:
+        return task.desired_state <= TaskState.RUNNING \
+            and task.status.state <= TaskState.RUNNING
+
+    def add_task(self, task) -> bool:
+        """reference: nodeinfo.go addTask."""
+        if task.id in self.tasks:
+            return False
+        self.tasks[task.id] = task
+        if self.counts_toward_load(task):
+            cpus, mem, gen = task_reserved(task)
+            self.available_cpus -= cpus
+            self.available_memory -= mem
+            for k, v in gen.items():
+                self.available_generic[k] = self.available_generic.get(k, 0) - v
+            if task.service_id:
+                self.active_tasks_per_service[task.service_id] = \
+                    self.active_tasks_per_service.get(task.service_id, 0) + 1
+        return True
+
+    def remove_task(self, task) -> bool:
+        old = self.tasks.pop(task.id, None)
+        if old is None:
+            return False
+        if self.counts_toward_load(old):
+            cpus, mem, gen = task_reserved(old)
+            self.available_cpus += cpus
+            self.available_memory += mem
+            for k, v in gen.items():
+                self.available_generic[k] = self.available_generic.get(k, 0) + v
+            if old.service_id:
+                n = self.active_tasks_per_service.get(old.service_id, 1) - 1
+                if n <= 0:
+                    self.active_tasks_per_service.pop(old.service_id, None)
+                else:
+                    self.active_tasks_per_service[old.service_id] = n
+        return True
+
+    def active_task_count(self) -> int:
+        return sum(1 for t in self.tasks.values()
+                   if self.counts_toward_load(t))
+
+    def count_for_service(self, service_id: str) -> int:
+        return self.active_tasks_per_service.get(service_id, 0)
+
+    def taint(self, now: float, window: float = 300.0, limit: int = 5) -> bool:
+        """True when this node has failed this kind of task too often lately
+        (reference: nodeinfo.go countRecentFailures + scheduler backoff)."""
+        self.recent_failures = [t for t in self.recent_failures
+                                if now - t < window]
+        return len(self.recent_failures) >= limit

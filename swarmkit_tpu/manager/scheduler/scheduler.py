@@ -1,0 +1,223 @@
+"""Event-driven task scheduler.
+
+Reference: manager/scheduler/scheduler.go — watches the store, keeps an
+in-memory mirror of nodes + tasks, debounces commits (50 ms, max latency 1 s,
+scheduler.go:123-128), groups unassigned tasks by common spec key
+(commonSpecKey, :376), runs the filter pipeline once per group, and picks
+least-loaded nodes with spread preferences (scheduleTaskGroup :533).
+Decisions are applied in a store batch with retry when the task changed
+underneath (applySchedulingDecisions :432).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from swarmkit_tpu.api import TaskState
+from swarmkit_tpu.manager.scheduler.filters import Pipeline
+from swarmkit_tpu.manager.scheduler.nodeinfo import NodeInfo
+from swarmkit_tpu.manager.scheduler.nodeset import NodeSet
+from swarmkit_tpu.store.by import ByTaskState
+from swarmkit_tpu.store.memory import Event, EventCommit, MemoryStore, match, match_commit
+from swarmkit_tpu.utils.clock import Clock, SystemClock
+
+log = logging.getLogger("swarmkit_tpu.scheduler")
+
+COMMIT_DEBOUNCE = 0.050   # reference: scheduler.go:126
+MAX_LATENCY = 1.0         # reference: scheduler.go:124
+
+
+class Scheduler:
+    def __init__(self, store: MemoryStore, clock: Optional[Clock] = None
+                 ) -> None:
+        self.store = store
+        self.clock = clock or SystemClock()
+        self.node_set = NodeSet()
+        self.unassigned: dict[str, object] = {}  # taskid -> task
+        self.all_tasks: dict[str, object] = {}
+        self.pipeline = Pipeline()
+        self._task: Optional[asyncio.Task] = None
+        self._running = False
+        self.pending_preassigned: dict[str, object] = {}
+
+    # ------------------------------------------------------------------
+    async def start(self) -> None:
+        # initial state (reference: Run :105 buildNodeSet under view)
+        watcher = self.store.watch(match(kind="task"), match(kind="node"),
+                                   match_commit)
+        for t in self.store.find("task"):
+            if t.status.state < TaskState.ASSIGNED:
+                if t.status.state == TaskState.PENDING:
+                    self.unassigned[t.id] = t
+            self.all_tasks[t.id] = t
+        for n in self.store.find("node"):
+            self.node_set.add_or_update(self._node_info(n))
+        self._running = True
+        self._task = asyncio.get_running_loop().create_task(
+            self._run(watcher))
+
+    async def stop(self) -> None:
+        self._running = False
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+
+    def _node_info(self, node) -> NodeInfo:
+        tasks = {t.id: t for t in self.all_tasks.values()
+                 if t.node_id == node.id}
+        return NodeInfo(node, tasks)
+
+    # ------------------------------------------------------------------
+    async def _run(self, watcher) -> None:
+        try:
+            while self._running:
+                ev = await watcher.get()
+                dirty = self._handle(ev)
+                # debounce: wait for a quiet 50 ms window (or 1 s max)
+                start = self.clock.now()
+                while self._running:
+                    try:
+                        nxt = watcher.try_get()
+                        if nxt is None:
+                            await self.clock.sleep(COMMIT_DEBOUNCE)
+                            nxt = watcher.try_get()
+                            if nxt is None:
+                                break
+                        dirty = self._handle(nxt) or dirty
+                    except Exception:
+                        raise
+                    if self.clock.now() - start > MAX_LATENCY:
+                        break
+                if dirty and self._running:
+                    await self.tick()
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.exception("scheduler loop crashed")
+
+    def _handle(self, ev) -> bool:
+        """Update mirrors; return True when a tick might make progress."""
+        if isinstance(ev, EventCommit):
+            return bool(self.unassigned)
+        if not isinstance(ev, Event):
+            return False
+        if ev.kind == "node":
+            if ev.action == "remove":
+                self.node_set.remove(ev.object.id)
+            else:
+                # rebuild NodeInfo so available_* reflect a changed
+                # description (resources can grow/shrink on re-register)
+                self.node_set.add_or_update(self._node_info(ev.object))
+            return True
+        if ev.kind == "task":
+            t = ev.object
+            if ev.action == "remove":
+                self.all_tasks.pop(t.id, None)
+                self.unassigned.pop(t.id, None)
+                if t.node_id:
+                    info = self.node_set.get(t.node_id)
+                    if info is not None:
+                        info.remove_task(t)
+                return False
+            prev = self.all_tasks.get(t.id)
+            self.all_tasks[t.id] = t
+            if prev is not None and prev.node_id:
+                info = self.node_set.get(prev.node_id)
+                if info is not None:
+                    info.remove_task(prev)
+            if t.node_id:
+                info = self.node_set.get(t.node_id)
+                if info is not None:
+                    info.add_task(t)
+            if t.status.state == TaskState.PENDING and not t.node_id \
+                    and t.desired_state <= TaskState.RUNNING:
+                self.unassigned[t.id] = t
+                return True
+            self.unassigned.pop(t.id, None)
+            return False
+        return False
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _common_spec_key(task) -> tuple:
+        """Group tasks that can share one scheduling decision pipeline run
+        (reference: commonSpecKey scheduler.go:376)."""
+        return (task.service_id,
+                task.spec.encode() if hasattr(task.spec, "encode")
+                else repr(task.spec))
+
+    async def tick(self) -> None:
+        """Schedule everything currently unassigned."""
+        groups: dict[tuple, list] = {}
+        for t in list(self.unassigned.values()):
+            groups.setdefault(self._common_spec_key(t), []).append(t)
+
+        decisions: list[tuple[object, str]] = []  # (task, node_id)
+        for group in groups.values():
+            decisions.extend(self._schedule_group(group))
+        if decisions:
+            await self._apply(decisions)
+
+    def _schedule_group(self, tasks: list) -> list[tuple[object, str]]:
+        """reference: scheduleTaskGroup :533."""
+        sample = tasks[0]
+        self.pipeline.set_task(sample)
+        prefs = []
+        if sample.spec.placement is not None:
+            prefs = list(sample.spec.placement.preferences)
+        service_id = sample.service_id
+
+        def better(a: NodeInfo, b: NodeInfo) -> bool:
+            ca, cb = a.count_for_service(service_id), b.count_for_service(service_id)
+            if ca != cb:
+                return ca < cb
+            return a.active_task_count() < b.active_task_count()
+
+        out = []
+        for task in tasks:
+            candidates = self.node_set.find_best_nodes(
+                1, self.pipeline.process, prefs, better,
+                load=lambda i: i.count_for_service(service_id))
+            if not candidates:
+                continue
+            info = candidates[0]
+            # mirror the assignment so the next pick sees updated load
+            assigned = task.copy()
+            assigned.node_id = info.id
+            info.add_task(assigned)
+            out.append((task, info.id))
+        return out
+
+    async def _apply(self, decisions: list[tuple[object, str]]) -> None:
+        """reference: applySchedulingDecisions :432."""
+        from swarmkit_tpu.store.errors import ErrSequenceConflict
+
+        batch = self.store.batch()
+        for task, node_id in decisions:
+            def txn(tx, task=task, node_id=node_id):
+                current = tx.get("task", task.id)
+                if current is None:
+                    return
+                if current.status.state != TaskState.PENDING \
+                        or current.node_id \
+                        or current.desired_state > TaskState.RUNNING:
+                    return  # changed underneath; event flow will retry
+                current.status.state = TaskState.ASSIGNED
+                current.status.message = "scheduler assigned task"
+                current.status.timestamp = self.clock.now()
+                current.node_id = node_id
+                tx.update(current)
+
+            try:
+                await batch.update(txn)
+            except ErrSequenceConflict:
+                continue
+        await batch.commit()
+        for task, _ in decisions:
+            self.unassigned.pop(task.id, None)

@@ -1,0 +1,121 @@
+"""Allocator tests (reference: manager/allocator/allocator_test.go)."""
+
+import asyncio
+
+from swarmkit_tpu.api import (
+    Annotations, ContainerSpec, Network, NetworkSpec, ReplicatedService,
+    Service, ServiceSpec, TaskSpec, TaskState,
+)
+from swarmkit_tpu.api.types import EndpointSpecRef, PortConfig
+from swarmkit_tpu.manager.allocator import Allocator, DYNAMIC_PORT_START
+from swarmkit_tpu.manager.orchestrator import common
+from swarmkit_tpu.store.memory import MemoryStore
+from swarmkit_tpu.utils.clock import FakeClock
+from tests.conftest import async_test
+
+
+async def pump(clock, steps=12):
+    for _ in range(steps):
+        await asyncio.sleep(0)
+    await clock.advance(0.1)
+    for _ in range(steps):
+        await asyncio.sleep(0)
+
+
+def make_service(name="web", ports=None, networks=None):
+    spec = ServiceSpec(
+        annotations=Annotations(name=name),
+        task=TaskSpec(container=ContainerSpec(image="img")),
+        replicated=ReplicatedService(replicas=1),
+        networks=networks or [])
+    if ports:
+        spec.endpoint = EndpointSpecRef(ports=ports)
+    return Service(id=f"svc-{name}", spec=spec)
+
+
+@async_test
+async def test_network_subnet_allocation():
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    alloc = Allocator(store, clock=clock)
+    await alloc.start()
+    net = Network(id="net1",
+                  spec=NetworkSpec(annotations=Annotations(name="overlay1")))
+    await store.update(lambda tx: tx.create(net))
+    await pump(clock)
+    n = store.get("network", "net1")
+    assert n.ipam is not None and n.ipam.configs[0].subnet == "10.1.0.0/24"
+    assert n.ipam.configs[0].gateway == "10.1.0.1"
+    await alloc.stop()
+
+
+@async_test
+async def test_service_port_and_vip_allocation():
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    alloc = Allocator(store, clock=clock)
+    await alloc.start()
+    net = Network(id="net1",
+                  spec=NetworkSpec(annotations=Annotations(name="overlay1")))
+    svc = make_service(ports=[
+        PortConfig(protocol="tcp", target_port=80, published_port=8080),
+        PortConfig(protocol="tcp", target_port=443)],  # dynamic
+        networks=["net1"])
+    await store.update(lambda tx: (tx.create(net), tx.create(svc)))
+    await pump(clock)
+    s = store.get("service", svc.id)
+    assert s.endpoint is not None
+    ports = {p.target_port: p.published_port for p in s.endpoint.ports}
+    assert ports[80] == 8080
+    assert ports[443] >= DYNAMIC_PORT_START
+    vips = [v for v in s.endpoint.virtual_ips if v.network_id == "net1"]
+    assert len(vips) == 1 and vips[0].addr.startswith("10.1.0.")
+    await alloc.stop()
+
+
+@async_test
+async def test_task_new_to_pending_with_attachments():
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    alloc = Allocator(store, clock=clock)
+    await alloc.start()
+    net = Network(id="net1",
+                  spec=NetworkSpec(annotations=Annotations(name="overlay1")))
+    svc = make_service(networks=["net1"])
+    task = common.new_task(None, svc, slot=1)
+    await store.update(lambda tx: (tx.create(net), tx.create(svc),
+                                   tx.create(task)))
+    await pump(clock)
+    await pump(clock)
+    t = store.get("task", task.id)
+    assert t.status.state == TaskState.PENDING
+    assert len(t.networks) == 1 and t.networks[0].network_id == "net1"
+    assert t.networks[0].addresses[0].startswith("10.1.0.")
+    # distinct address from the service VIP
+    await alloc.stop()
+
+
+@async_test
+async def test_restore_does_not_double_allocate():
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    alloc = Allocator(store, clock=clock)
+    await alloc.start()
+    svc = make_service(ports=[PortConfig(protocol="tcp", target_port=80)])
+    await store.update(lambda tx: tx.create(svc))
+    await pump(clock)
+    first = store.get("service", svc.id).endpoint.ports[0].published_port
+    await alloc.stop()
+
+    # a fresh allocator over the same store must keep the allocation
+    alloc2 = Allocator(store, clock=clock)
+    await alloc2.start()
+    svc2 = make_service(name="other",
+                        ports=[PortConfig(protocol="tcp", target_port=80)])
+    await store.update(lambda tx: tx.create(svc2))
+    await pump(clock)
+    second = store.get("service", svc2.id).endpoint.ports[0].published_port
+    assert store.get("service", svc.id).endpoint.ports[0].published_port \
+        == first
+    assert second != first
+    await alloc2.stop()

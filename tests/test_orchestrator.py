@@ -1,0 +1,360 @@
+"""Orchestrator suite: replicated/global reconciliation, restart policy,
+rolling updates, task reaper, constraint enforcer.
+
+Reference scenarios: manager/orchestrator/replicated/*_test.go,
+restart/restart_test.go, update/updater_test.go, global/global_test.go,
+taskreaper/task_reaper_test.go, constraintenforcer/constraint_enforcer_test.go.
+"""
+
+import asyncio
+
+from swarmkit_tpu.api import (
+    Annotations, Mode, Node, NodeAvailability, NodeDescription, NodeSpec,
+    NodeState, Placement, ReplicatedService, RestartCondition, RestartPolicy,
+    Service, ServiceSpec, TaskSpec, TaskState, UpdateConfig, ContainerSpec,
+    GlobalService,
+)
+from swarmkit_tpu.api.objects import NodeStatus
+from swarmkit_tpu.manager.orchestrator import common
+from swarmkit_tpu.manager.orchestrator.constraintenforcer import ConstraintEnforcer
+from swarmkit_tpu.manager.orchestrator.global_ import GlobalOrchestrator
+from swarmkit_tpu.manager.orchestrator.replicated import ReplicatedOrchestrator
+from swarmkit_tpu.manager.orchestrator.restart import RestartSupervisor
+from swarmkit_tpu.manager.orchestrator.taskreaper import TaskReaper
+from swarmkit_tpu.store.by import ByService
+from swarmkit_tpu.store.memory import MemoryStore
+from swarmkit_tpu.utils.clock import FakeClock
+from tests.conftest import async_test
+
+
+def make_service(name="web", replicas=3, image="nginx:1", mode=Mode.REPLICATED,
+                 restart=None, update=None, constraints=None):
+    spec = ServiceSpec(
+        annotations=Annotations(name=name),
+        task=TaskSpec(container=ContainerSpec(image=image), restart=restart,
+                      placement=Placement(constraints=constraints or [])),
+        mode=mode,
+        update=update,
+    )
+    if mode == Mode.REPLICATED:
+        spec.replicated = ReplicatedService(replicas=replicas)
+    else:
+        spec.global_ = GlobalService()
+    return Service(id=f"svc-{name}", spec=spec)
+
+
+def make_node(i):
+    return Node(id=f"node{i}",
+                spec=NodeSpec(annotations=Annotations(name=f"node{i}")),
+                description=NodeDescription(hostname=f"host{i}"),
+                status=NodeStatus(state=NodeState.READY))
+
+
+async def pump(clock, seconds=0.0, steps=12):
+    for _ in range(steps):
+        await asyncio.sleep(0)
+    if seconds:
+        await clock.advance(seconds)
+        for _ in range(steps):
+            await asyncio.sleep(0)
+
+
+def live_tasks(store, sid):
+    return [t for t in store.find("task", ByService(sid))
+            if t.desired_state <= TaskState.RUNNING
+            and not common.in_terminal_state(t)]
+
+
+@async_test
+async def test_replicated_scale_up_and_down():
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    orch = ReplicatedOrchestrator(store, clock=clock)
+    await orch.start()
+    svc = make_service(replicas=3)
+    await store.update(lambda tx: tx.create(svc))
+    await pump(clock)
+    tasks = live_tasks(store, svc.id)
+    assert len(tasks) == 3
+    assert sorted(t.slot for t in tasks) == [1, 2, 3]
+
+    # scale up
+    svc2 = store.get("service", svc.id)
+    svc2.spec.replicated.replicas = 5
+    await store.update(lambda tx: tx.update(svc2))
+    await pump(clock)
+    assert len(live_tasks(store, svc.id)) == 5
+
+    # scale down
+    svc3 = store.get("service", svc.id)
+    svc3.spec.replicated.replicas = 2
+    await store.update(lambda tx: tx.update(svc3))
+    await pump(clock)
+    assert len(live_tasks(store, svc.id)) == 2
+    await orch.stop()
+
+
+@async_test
+async def test_replicated_service_delete_removes_tasks():
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    orch = ReplicatedOrchestrator(store, clock=clock)
+    await orch.start()
+    svc = make_service(replicas=2)
+    await store.update(lambda tx: tx.create(svc))
+    await pump(clock)
+    assert len(store.find("task", ByService(svc.id))) == 2
+    await store.update(lambda tx: tx.delete("service", svc.id))
+    await pump(clock)
+    assert store.find("task", ByService(svc.id)) == []
+    await orch.stop()
+
+
+@async_test
+async def test_restart_on_failure_with_delay():
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    orch = ReplicatedOrchestrator(store, clock=clock)
+    await orch.start()
+    svc = make_service(replicas=1, restart=RestartPolicy(
+        condition=RestartCondition.ANY, delay=3.0))
+    await store.update(lambda tx: tx.create(svc))
+    await pump(clock)
+    (task,) = live_tasks(store, svc.id)
+
+    # simulate failure
+    def fail(tx):
+        t = tx.get("task", task.id)
+        t.status.state = TaskState.FAILED
+        tx.update(t)
+    await store.update(fail)
+    await pump(clock)
+    # replacement parked in READY until the delay elapses
+    live = live_tasks(store, svc.id)
+    assert len(live) == 1 and live[0].id != task.id
+    assert live[0].desired_state == TaskState.READY
+    old = store.get("task", task.id)
+    assert old.desired_state == TaskState.SHUTDOWN
+    # delay elapses -> promoted to RUNNING
+    await pump(clock, seconds=3.5)
+    assert store.get("task", live[0].id).desired_state == TaskState.RUNNING
+    await orch.stop()
+
+
+@async_test
+async def test_restart_condition_none_does_not_restart():
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    orch = ReplicatedOrchestrator(store, clock=clock)
+    await orch.start()
+    svc = make_service(replicas=1, restart=RestartPolicy(
+        condition=RestartCondition.NONE))
+    await store.update(lambda tx: tx.create(svc))
+    await pump(clock)
+    (task,) = live_tasks(store, svc.id)
+
+    def complete(tx):
+        t = tx.get("task", task.id)
+        t.status.state = TaskState.COMPLETE
+        tx.update(t)
+    await store.update(complete)
+    await pump(clock)
+    assert live_tasks(store, svc.id) == []
+    await orch.stop()
+
+
+@async_test
+async def test_restart_max_attempts():
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    orch = ReplicatedOrchestrator(store, clock=clock)
+    await orch.start()
+    svc = make_service(replicas=1, restart=RestartPolicy(
+        condition=RestartCondition.ANY, delay=0.0, max_attempts=2))
+    await store.update(lambda tx: tx.create(svc))
+    await pump(clock)
+
+    for round_ in range(3):
+        live = live_tasks(store, svc.id)
+        if not live:
+            break
+        def fail(tx, tid=live[0].id):
+            t = tx.get("task", tid)
+            if t is not None and not common.in_terminal_state(t):
+                t.status.state = TaskState.FAILED
+                tx.update(t)
+        await store.update(fail)
+        await pump(clock, seconds=0.1)
+        await pump(clock, seconds=0.1)
+    # two restarts allowed, third failure leaves nothing live
+    assert live_tasks(store, svc.id) == []
+    await orch.stop()
+
+
+@async_test
+async def test_rolling_update_stop_first():
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    orch = ReplicatedOrchestrator(store, clock=clock)
+    await orch.start()
+    svc = make_service(replicas=3, update=UpdateConfig(parallelism=1,
+                                                       monitor=0.2))
+    await store.update(lambda tx: tx.create(svc))
+    await pump(clock)
+    old_ids = {t.id for t in live_tasks(store, svc.id)}
+
+    # mark tasks running (simulated agents)
+    def run_all(tx):
+        for t in store.find("task", ByService(svc.id)):
+            cur = tx.get("task", t.id)
+            cur.status.state = TaskState.RUNNING
+            tx.update(cur)
+    await store.update(run_all)
+    await pump(clock)
+
+    # change the image -> dirty slots -> rolling update
+    svc2 = store.get("service", svc.id)
+    svc2.spec.task.container.image = "nginx:2"
+    await store.update(lambda tx: tx.update(svc2))
+    await pump(clock)
+
+    # drive: as updater shuts down old tasks, "agents" report them shutdown;
+    # new tasks get reported running
+    for _ in range(60):
+        def agent_sim(tx):
+            for t in store.find("task", ByService(svc.id)):
+                cur = tx.get("task", t.id)
+                if cur is None:
+                    continue
+                if cur.desired_state == TaskState.SHUTDOWN \
+                        and cur.status.state < TaskState.SHUTDOWN:
+                    cur.status.state = TaskState.SHUTDOWN
+                    tx.update(cur)
+                elif cur.desired_state == TaskState.RUNNING \
+                        and cur.status.state < TaskState.RUNNING:
+                    cur.status.state = TaskState.RUNNING
+                    tx.update(cur)
+        await store.update(agent_sim)
+        await pump(clock, seconds=0.1)
+        new_live = live_tasks(store, svc.id)
+        s = store.get("service", svc.id)
+        if len(new_live) == 3 and all(
+                t.spec.container.image == "nginx:2" for t in new_live
+                ) and all(t.id not in old_ids for t in new_live) \
+                and s.update_status is not None \
+                and s.update_status.state == "completed":
+            break
+    else:
+        s = store.get("service", svc.id)
+        raise AssertionError(
+            f"update did not converge (status="
+            f"{s.update_status and s.update_status.state}): "
+            f"{[(t.id, t.spec.container.image, int(t.status.state)) for t in live_tasks(store, svc.id)]}")
+    await orch.stop()
+
+
+@async_test
+async def test_global_orchestrator_one_task_per_node():
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    orch = GlobalOrchestrator(store, clock=clock)
+    await store.update(lambda tx: [tx.create(make_node(1)),
+                                   tx.create(make_node(2))])
+    await orch.start()
+    svc = make_service(name="mon", mode=Mode.GLOBAL)
+    await store.update(lambda tx: tx.create(svc))
+    await pump(clock)
+    tasks = live_tasks(store, svc.id)
+    assert sorted(t.node_id for t in tasks) == ["node1", "node2"]
+
+    # new node joins -> new task
+    await store.update(lambda tx: tx.create(make_node(3)))
+    await pump(clock)
+    assert sorted(t.node_id for t in live_tasks(store, svc.id)) == \
+        ["node1", "node2", "node3"]
+
+    # node drained -> task shut down
+    n3 = store.get("node", "node3")
+    n3.spec.availability = NodeAvailability.DRAIN
+    await store.update(lambda tx: tx.update(n3))
+    await pump(clock)
+    assert sorted(t.node_id for t in live_tasks(store, svc.id)) == \
+        ["node1", "node2"]
+    await orch.stop()
+
+
+@async_test
+async def test_task_reaper_retention():
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    reaper = TaskReaper(store, clock=clock)
+    await reaper.start()
+    svc = make_service(replicas=1)
+    await store.update(lambda tx: tx.create(svc))
+    # create 8 dead tasks in the same slot (history) + 1 live
+    def seed(tx):
+        for i in range(8):
+            t = common.new_task(None, svc, slot=1)
+            t.status.state = TaskState.FAILED
+            t.status.timestamp = float(i)
+            t.desired_state = int(TaskState.SHUTDOWN)
+            tx.create(t)
+        live = common.new_task(None, svc, slot=1)
+        tx.create(live)
+    await store.update(seed)
+    await pump(clock)
+    remaining = store.find("task", ByService(svc.id))
+    dead = [t for t in remaining if common.in_terminal_state(t)]
+    assert len(dead) == 5  # default retention
+    # oldest were deleted first
+    assert sorted(t.status.timestamp for t in dead) == [3.0, 4.0, 5.0, 6.0, 7.0]
+    await reaper.stop()
+
+
+@async_test
+async def test_task_reaper_remove_desired():
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    reaper = TaskReaper(store, clock=clock)
+    await reaper.start()
+    svc = make_service(replicas=1)
+    t = common.new_task(None, svc, slot=1)
+    t.desired_state = int(TaskState.REMOVE)
+    await store.update(lambda tx: (tx.create(svc), tx.create(t)))
+    await pump(clock)
+    assert store.get("task", t.id) is not None  # not terminal yet
+
+    def shutdown(tx):
+        cur = tx.get("task", t.id)
+        cur.status.state = TaskState.SHUTDOWN
+        tx.update(cur)
+    await store.update(shutdown)
+    await pump(clock)
+    assert store.get("task", t.id) is None
+    await reaper.stop()
+
+
+@async_test
+async def test_constraint_enforcer_evicts_on_label_change():
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    enforcer = ConstraintEnforcer(store, clock=clock)
+    await enforcer.start()
+    node = make_node(1)
+    node.spec.annotations.labels["zone"] = "a"
+    svc = make_service(replicas=1, constraints=["node.labels.zone==a"])
+    task = common.new_task(None, svc, slot=1, node_id="node1")
+    task.node_id = "node1"
+    task.status.state = TaskState.RUNNING
+    await store.update(lambda tx: (tx.create(node), tx.create(svc),
+                                   tx.create(task)))
+    await pump(clock)
+    assert store.get("task", task.id).desired_state == TaskState.RUNNING
+
+    # label changes -> constraint violated -> evicted
+    n = store.get("node", "node1")
+    n.spec.annotations.labels["zone"] = "b"
+    await store.update(lambda tx: tx.update(n))
+    await pump(clock)
+    assert store.get("task", task.id).desired_state == TaskState.SHUTDOWN
+    await enforcer.stop()

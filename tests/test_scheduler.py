@@ -1,0 +1,186 @@
+"""Scheduler tests (reference: manager/scheduler/scheduler_test.go)."""
+
+import asyncio
+
+import pytest
+
+from swarmkit_tpu.api import (
+    Annotations, Node, NodeAvailability, NodeDescription, NodeSpec, NodeState,
+    Resources, ResourceRequirements, Task, TaskSpec, TaskState, TaskStatus,
+    Placement,
+)
+from swarmkit_tpu.api.objects import NodeStatus
+from swarmkit_tpu.api.types import NodeResources, Platform
+from swarmkit_tpu.manager.scheduler import Scheduler
+from swarmkit_tpu.store.memory import MemoryStore
+from swarmkit_tpu.utils.clock import FakeClock
+from tests.conftest import async_test
+
+
+def make_node(i, cpus=4_000_000_000, mem=8 << 30, labels=None, os="linux"):
+    return Node(
+        id=f"node{i}",
+        spec=NodeSpec(annotations=Annotations(name=f"node{i}",
+                                              labels=labels or {}),
+                      availability=NodeAvailability.ACTIVE),
+        description=NodeDescription(
+            hostname=f"host{i}",
+            platform=Platform(architecture="x86_64", os=os),
+            resources=NodeResources(nano_cpus=cpus, memory_bytes=mem)),
+        status=NodeStatus(state=NodeState.READY),
+    )
+
+
+def make_task(i, service="svc", cpus=0, mem=0, constraints=None, prefs=None):
+    spec = TaskSpec()
+    if cpus or mem:
+        spec.resources = ResourceRequirements(
+            reservations=Resources(nano_cpus=cpus, memory_bytes=mem))
+    if constraints or prefs:
+        spec.placement = Placement(constraints=constraints or [],
+                                   preferences=prefs or [])
+    return Task(id=f"task{i}", service_id=service, slot=i, spec=spec,
+                status=TaskStatus(state=TaskState.PENDING),
+                desired_state=int(TaskState.RUNNING))
+
+
+async def pump(clock, seconds=1.0, steps=8):
+    for _ in range(steps):
+        await asyncio.sleep(0)
+    await clock.advance(seconds)
+    for _ in range(steps):
+        await asyncio.sleep(0)
+
+
+@async_test
+async def test_basic_assignment_spreads_least_loaded():
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    sched = Scheduler(store, clock=clock)
+    await store.update(lambda tx: [tx.create(make_node(i))
+                                   for i in range(3)])
+    await sched.start()
+    await store.update(lambda tx: [tx.create(make_task(i))
+                                   for i in range(6)])
+    await pump(clock)
+    await pump(clock)
+    tasks = store.find("task")
+    assert all(t.status.state == TaskState.ASSIGNED for t in tasks), \
+        [(t.id, t.status.state) for t in tasks]
+    per_node = {}
+    for t in tasks:
+        per_node[t.node_id] = per_node.get(t.node_id, 0) + 1
+    assert all(c == 2 for c in per_node.values()), per_node
+    await sched.stop()
+
+
+@async_test
+async def test_resource_filter_blocks_oversubscription():
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    sched = Scheduler(store, clock=clock)
+    # one tiny node: 1 cpu
+    await store.update(lambda tx: tx.create(make_node(1, cpus=1_000_000_000)))
+    await sched.start()
+    # two tasks each wanting the full cpu: only one fits
+    await store.update(lambda tx: [
+        tx.create(make_task(1, cpus=1_000_000_000)),
+        tx.create(make_task(2, cpus=1_000_000_000))])
+    await pump(clock)
+    await pump(clock)
+    tasks = store.find("task")
+    assigned = [t for t in tasks if t.status.state == TaskState.ASSIGNED]
+    pending = [t for t in tasks if t.status.state == TaskState.PENDING]
+    assert len(assigned) == 1 and len(pending) == 1
+    # free the node: delete the assigned task -> pending one gets scheduled
+    await store.update(lambda tx: tx.delete("task", assigned[0].id))
+    await pump(clock)
+    await pump(clock)
+    t2 = store.get("task", pending[0].id)
+    assert t2.status.state == TaskState.ASSIGNED
+    await sched.stop()
+
+
+@async_test
+async def test_constraint_filter():
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    sched = Scheduler(store, clock=clock)
+    await store.update(lambda tx: [
+        tx.create(make_node(1, labels={"zone": "a"})),
+        tx.create(make_node(2, labels={"zone": "b"}))])
+    await sched.start()
+    await store.update(lambda tx: [
+        tx.create(make_task(1, constraints=["node.labels.zone==b"]))])
+    await pump(clock)
+    t = store.get("task", "task1")
+    assert t.status.state == TaskState.ASSIGNED and t.node_id == "node2"
+    await sched.stop()
+
+
+@async_test
+async def test_unready_and_drained_nodes_excluded():
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    sched = Scheduler(store, clock=clock)
+    down = make_node(1)
+    down.status.state = NodeState.DOWN
+    drained = make_node(2)
+    drained.spec.availability = NodeAvailability.DRAIN
+    ok = make_node(3)
+    await store.update(lambda tx: [tx.create(down), tx.create(drained),
+                                   tx.create(ok)])
+    await sched.start()
+    await store.update(lambda tx: [tx.create(make_task(i))
+                                   for i in range(4)])
+    await pump(clock)
+    tasks = store.find("task")
+    assert all(t.node_id == "node3" for t in tasks
+               if t.status.state == TaskState.ASSIGNED)
+    assert sum(1 for t in tasks
+               if t.status.state == TaskState.ASSIGNED) == 4
+    await sched.stop()
+
+
+@async_test
+async def test_spread_preference_over_zones():
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    sched = Scheduler(store, clock=clock)
+    # 2 zones, 2 nodes each
+    await store.update(lambda tx: [
+        tx.create(make_node(1, labels={"zone": "a"})),
+        tx.create(make_node(2, labels={"zone": "a"})),
+        tx.create(make_node(3, labels={"zone": "b"})),
+        tx.create(make_node(4, labels={"zone": "b"}))])
+    await sched.start()
+    await store.update(lambda tx: [
+        tx.create(make_task(i, prefs=["spread=node.labels.zone"]))
+        for i in range(4)])
+    await pump(clock)
+    await pump(clock)
+    tasks = store.find("task")
+    zones = {"a": 0, "b": 0}
+    for t in tasks:
+        assert t.status.state == TaskState.ASSIGNED
+        zones["a" if t.node_id in ("node1", "node2") else "b"] += 1
+    assert zones == {"a": 2, "b": 2}, zones
+    await sched.stop()
+
+
+@async_test
+async def test_node_removal_frees_nothing_but_new_node_triggers_tick():
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    sched = Scheduler(store, clock=clock)
+    await sched.start()
+    # no nodes: task stays pending
+    await store.update(lambda tx: tx.create(make_task(1)))
+    await pump(clock)
+    assert store.get("task", "task1").status.state == TaskState.PENDING
+    # add a node: pending task gets scheduled
+    await store.update(lambda tx: tx.create(make_node(1)))
+    await pump(clock)
+    await pump(clock)
+    assert store.get("task", "task1").status.state == TaskState.ASSIGNED
+    await sched.stop()
