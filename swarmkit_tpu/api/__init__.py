@@ -2,7 +2,7 @@ from swarmkit_tpu.api.types import (
     TaskState, NodeRole, NodeState, NodeAvailability, Meta, Version,
     Annotations, TaskStatus, NodeDescription, NodeResources, Platform,
     EngineDescription, Endpoint, EndpointVIP, PortConfig, NetworkAttachment,
-    Driver, Peer, IPAMConfig, IPAMOptions,
+    Driver, Peer, WeightedPeer, IPAMConfig, IPAMOptions, MembershipState,
 )
 from swarmkit_tpu.api.specs import (
     NodeSpec, ServiceSpec, TaskSpec, ClusterSpec, NetworkSpec, SecretSpec,

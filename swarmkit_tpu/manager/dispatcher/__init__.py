@@ -1,0 +1,9 @@
+from swarmkit_tpu.manager.dispatcher.dispatcher import (
+    Dispatcher, DispatcherConfigDefaults, ErrNodeNotRegistered,
+    ErrSessionInvalid, ErrNodeNotFound,
+)
+
+__all__ = [
+    "Dispatcher", "DispatcherConfigDefaults", "ErrNodeNotRegistered",
+    "ErrSessionInvalid", "ErrNodeNotFound",
+]

@@ -1,0 +1,317 @@
+"""Dispatcher tests (reference: manager/dispatcher/dispatcher_test.go)."""
+
+import asyncio
+import random
+
+import pytest
+
+from swarmkit_tpu.api import (
+    Annotations, Cluster, ClusterSpec, Config, ConfigSpec, Node, NodeSpec,
+    NodeState, Secret, SecretSpec, Task, TaskSpec, TaskState, TaskStatus,
+)
+from swarmkit_tpu.api.dispatcher_msgs import (
+    AssignmentAction, AssignmentsType,
+)
+from swarmkit_tpu.api.objects import NodeStatus
+from swarmkit_tpu.api.specs import ContainerSpec, SecretReference, ConfigReference
+from swarmkit_tpu.manager.dispatcher import Dispatcher, ErrNodeNotFound
+from swarmkit_tpu.manager.dispatcher.nodes import (
+    ErrNodeNotRegistered, ErrSessionInvalid,
+)
+from swarmkit_tpu.store.memory import MemoryStore
+from swarmkit_tpu.utils.clock import FakeClock
+from tests.conftest import async_test
+
+
+def make_node(i):
+    return Node(id=f"node{i}",
+                spec=NodeSpec(annotations=Annotations(name=f"node{i}")),
+                status=NodeStatus(state=NodeState.UNKNOWN))
+
+
+def make_task(i, node="node1", state=TaskState.ASSIGNED, secrets=(),
+              configs=()):
+    spec = TaskSpec(container=ContainerSpec(
+        secrets=[SecretReference(secret_id=s) for s in secrets],
+        configs=[ConfigReference(config_id=c) for c in configs]))
+    return Task(id=f"task{i}", node_id=node, spec=spec,
+                status=TaskStatus(state=state),
+                desired_state=int(TaskState.RUNNING))
+
+
+async def eventually(pred, clock=None, ticks=400):
+    """Pump the event loop (and the fake clock a hair) until pred() holds."""
+    for _ in range(ticks):
+        if pred():
+            return
+        await asyncio.sleep(0)
+        if clock is not None:
+            await clock.advance(0.001)
+    assert pred(), "condition not met"
+
+
+async def pump(steps=8):
+    for _ in range(steps):
+        await asyncio.sleep(0)
+
+
+async def setup(n_nodes=1):
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    d = Dispatcher(store, clock=clock, rng=random.Random(0))
+    for i in range(1, n_nodes + 1):
+        await store.update(lambda tx, i=i: tx.create(make_node(i)))
+    await d.start(mark_unknown=False)
+    return clock, store, d
+
+
+@async_test
+async def test_register_requires_node_record():
+    clock, store, d = await setup(0)
+    with pytest.raises(ErrNodeNotFound):
+        await d.register("nodeX")
+    await d.stop()
+
+
+@async_test
+async def test_register_marks_ready_and_heartbeat_keeps_alive():
+    clock, store, d = await setup()
+    sid = await d.register("node1", addr="10.0.0.1:999")
+    node = store.get("node", "node1")
+    assert node.status.state == NodeState.READY
+    assert node.status.addr == "10.0.0.1:999"
+
+    # heartbeats inside the TTL keep the node READY
+    for _ in range(5):
+        resp = await d.heartbeat("node1", sid)
+        assert 4.5 <= resp.period <= 5.5
+        await clock.advance(resp.period)
+    assert store.get("node", "node1").status.state == NodeState.READY
+
+    # missing ~3 periods marks it DOWN (grace multiplier 3)
+    await clock.advance(20.0)
+    await pump()
+    assert store.get("node", "node1").status.state == NodeState.DOWN
+    with pytest.raises(ErrNodeNotRegistered):
+        await d.heartbeat("node1", sid)
+    await d.stop()
+
+
+@async_test
+async def test_heartbeat_wrong_session_rejected():
+    clock, store, d = await setup()
+    await d.register("node1")
+    with pytest.raises(ErrSessionInvalid):
+        await d.heartbeat("node1", "bogus")
+    await d.stop()
+
+
+@async_test
+async def test_reregistration_supersedes_session():
+    clock, store, d = await setup()
+    sid1 = await d.register("node1")
+    sid2 = await d.register("node1")
+    assert sid1 != sid2
+    with pytest.raises(ErrSessionInvalid):
+        await d.heartbeat("node1", sid1)
+    await d.heartbeat("node1", sid2)
+    await d.stop()
+
+
+@async_test
+async def test_update_task_status_batches_and_drops_regressions():
+    clock, store, d = await setup()
+    sid = await d.register("node1")
+    await store.update(lambda tx: [tx.create(make_task(i)) for i in (1, 2)])
+
+    await d.update_task_status("node1", sid, [
+        ("task1", TaskStatus(state=TaskState.RUNNING)),
+        ("task2", TaskStatus(state=TaskState.FAILED, message="boom")),
+    ])
+    await eventually(lambda: store.get("task", "task1").status.state
+                   == TaskState.RUNNING, clock)
+    assert store.get("task", "task2").status.state == TaskState.FAILED
+    assert store.get("task", "task2").status.message == "boom"
+
+    # regression RUNNING -> PREPARING is dropped
+    await d.update_task_status("node1", sid, [
+        ("task1", TaskStatus(state=TaskState.PREPARING))])
+    await pump()
+    assert store.get("task", "task1").status.state == TaskState.RUNNING
+    await d.stop()
+
+
+@async_test
+async def test_update_task_status_foreign_node_rejected():
+    clock, store, d = await setup(2)
+    sid = await d.register("node2")
+    await store.update(lambda tx: tx.create(make_task(1, node="node1")))
+    with pytest.raises(PermissionError):
+        await d.update_task_status("node2", sid, [
+            ("task1", TaskStatus(state=TaskState.RUNNING))])
+    await d.stop()
+
+
+@async_test
+async def test_assignments_complete_then_incremental():
+    clock, store, d = await setup()
+    await store.update(lambda tx: [
+        tx.create(Secret(id="sec1", spec=SecretSpec(
+            annotations=Annotations(name="sec1"), data=b"s3cret"))),
+        tx.create(Config(id="cfg1", spec=ConfigSpec(
+            annotations=Annotations(name="cfg1"), data=b"conf"))),
+        tx.create(make_task(1, secrets=["sec1"], configs=["cfg1"])),
+    ])
+    sid = await d.register("node1")
+
+    stream = d.assignments("node1", sid)
+    msgs = []
+
+    async def consume():
+        async for m in stream:
+            msgs.append(m)
+
+    consumer = asyncio.get_running_loop().create_task(consume())
+    await eventually(lambda: len(msgs) >= 1, clock)
+    first = msgs[0]
+    assert first.type == AssignmentsType.COMPLETE
+    kinds = sorted(
+        "task" if c.assignment.task is not None else
+        ("secret" if c.assignment.secret is not None else "config")
+        for c in first.changes)
+    assert kinds == ["config", "secret", "task"]
+    sec = next(c.assignment.secret for c in first.changes
+               if c.assignment.secret is not None)
+    assert sec.spec.data == b"s3cret"
+
+    # new task assigned to this node -> INCREMENTAL update
+    await store.update(lambda tx: tx.create(make_task(2)))
+    await clock.advance(0.2)
+    await eventually(lambda: len(msgs) >= 2, clock)
+    inc = msgs[1]
+    assert inc.type == AssignmentsType.INCREMENTAL
+    assert [c.assignment.task.id for c in inc.changes] == ["task2"]
+    assert inc.changes[0].action == AssignmentAction.UPDATE
+
+    # task deleted -> REMOVE, and the secret/config are released with it
+    await store.update(lambda tx: tx.delete("task", "task1"))
+    await clock.advance(0.2)
+    await eventually(lambda: len(msgs) >= 3, clock)
+    rem = msgs[2]
+    actions = {(("task" if c.assignment.task is not None else
+                 ("secret" if c.assignment.secret is not None else "config")),
+                c.action) for c in rem.changes}
+    assert (("task", AssignmentAction.REMOVE)) in actions
+    assert (("secret", AssignmentAction.REMOVE)) in actions
+    assert (("config", AssignmentAction.REMOVE)) in actions
+
+    consumer.cancel()
+    await d.stop()
+
+
+@async_test
+async def test_assignments_ignores_foreign_and_preassigned_tasks():
+    clock, store, d = await setup(2)
+    sid = await d.register("node1")
+    stream = d.assignments("node1", sid)
+    msgs = []
+
+    async def consume():
+        async for m in stream:
+            msgs.append(m)
+
+    consumer = asyncio.get_running_loop().create_task(consume())
+    await eventually(lambda: len(msgs) >= 1, clock)
+    assert msgs[0].changes == []
+
+    # a task on another node and a not-yet-assigned task produce nothing
+    await store.update(lambda tx: [
+        tx.create(make_task(1, node="node2")),
+        tx.create(make_task(2, node="node1", state=TaskState.PENDING)),
+    ])
+    await clock.advance(0.5)
+    await pump()
+    assert len(msgs) == 1
+
+    # scheduler moves task2 to ASSIGNED -> it flows out
+    def assign(tx):
+        t = tx.get("task", "task2").copy()
+        t.status.state = TaskState.ASSIGNED
+        tx.update(t)
+    await store.update(assign)
+    await clock.advance(0.2)
+    await eventually(lambda: len(msgs) >= 2, clock)
+    assert [c.assignment.task.id for c in msgs[1].changes] == ["task2"]
+    consumer.cancel()
+    await d.stop()
+
+
+@async_test
+async def test_session_stream_and_supersede():
+    clock, store, d = await setup()
+    await store.update(lambda tx: tx.create(
+        Cluster(id="cl1", spec=ClusterSpec(
+            annotations=Annotations(name="default")))))
+    msgs = []
+
+    async def consume():
+        async for m in d.session("node1"):
+            msgs.append(m)
+
+    consumer = asyncio.get_running_loop().create_task(consume())
+    await eventually(lambda: len(msgs) >= 1, clock)
+    sid = msgs[0].session_id
+    assert msgs[0].node.id == "node1"
+
+    # re-registering closes the old session stream
+    await d.register("node1")
+    await eventually(lambda: consumer.done(), clock)
+    await d.stop()
+
+
+@async_test
+async def test_mark_nodes_unknown_on_leader_start_then_down():
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    n = make_node(1)
+    n.status.state = NodeState.READY
+    await store.update(lambda tx: tx.create(n))
+    d = Dispatcher(store, clock=clock, rng=random.Random(0))
+    await d.start(mark_unknown=True)
+    assert store.get("node", "node1").status.state == NodeState.UNKNOWN
+
+    # without re-registration within grace the node goes DOWN
+    await clock.advance(30.0)
+    await pump()
+    assert store.get("node", "node1").status.state == NodeState.DOWN
+    await d.stop()
+
+
+@async_test
+async def test_down_node_tasks_orphaned_after_24h():
+    clock, store, d = await setup()
+    sid = await d.register("node1")
+    await store.update(lambda tx: tx.create(
+        make_task(1, state=TaskState.RUNNING)))
+    # node misses heartbeats -> DOWN
+    await clock.advance(20.0)
+    await pump()
+    assert store.get("node", "node1").status.state == NodeState.DOWN
+    # 24h later its tasks are ORPHANED
+    await clock.advance(24 * 3600.0 + 1)
+    await pump()
+    assert (store.get("task", "task1").status.state == TaskState.ORPHANED)
+    await d.stop()
+
+
+@async_test
+async def test_rate_limit_reregistrations():
+    clock, store, d = await setup()
+    for _ in range(3):
+        await d.register("node1")
+    with pytest.raises(RuntimeError):
+        await d.register("node1")
+    # after the rate-limit window, registration works again
+    await clock.advance(10.0)
+    await d.register("node1")
+    await d.stop()
