@@ -147,7 +147,7 @@ class Allocator:
                 for vip in ep.virtual_ips:
                     self.ipam.restore_address(vip.network_id, vip.addr)
                 for p in ep.ports:
-                    if p.published_port:
+                    if p.published_port and p.publish_mode == "ingress":
                         self.ports.restore(p.protocol, p.published_port)
             if not self._service_allocated(svc):
                 self._pending_services.add(svc.id)
@@ -192,7 +192,7 @@ class Allocator:
         if ev.action == "remove":
             if ev.kind == "service" and ev.object.endpoint is not None:
                 for p in ev.object.endpoint.ports:
-                    if p.published_port:
+                    if p.published_port and p.publish_mode == "ingress":
                         self.ports.release(p.protocol, p.published_port)
             return
         if ev.kind == "network":
@@ -258,10 +258,34 @@ class Allocator:
             ep = svc.endpoint or Endpoint()
             ep.spec = spec_ep.copy()
             existing = {(p.protocol, p.target_port): p for p in ep.ports}
-            ports = []
+            # decide which current allocations survive the new spec: same
+            # mode and either dynamic or the same explicit published port
+            reused: set[tuple[str, int]] = set()
+            plan: list[tuple] = []  # (spec port, reuse cur | None)
             for p in spec_ep.ports:
                 cur = existing.get((p.protocol, p.target_port))
-                if cur is not None and cur.published_port:
+                if (cur is not None and cur.published_port
+                        and cur.publish_mode == p.publish_mode
+                        and p.published_port in (0, cur.published_port)):
+                    plan.append((p, cur))
+                    reused.add((cur.protocol, cur.published_port))
+                else:
+                    plan.append((p, None))
+            # release ports the new spec dropped or changed BEFORE
+            # allocating, so swapping a port within one update works
+            # (reference: portallocator serviceDeallocatePorts on update).
+            # Only ingress ports live in the allocator's books — host-mode
+            # ports are per-node and never tracked.
+            released = [(c.protocol, c.published_port)
+                        for c in existing.values()
+                        if c.published_port and c.publish_mode == "ingress"
+                        and (c.protocol, c.published_port) not in reused]
+            for proto, port in released:
+                self.ports.release(proto, port)
+            ports = []
+            fresh: list[tuple[str, int]] = []
+            for p, cur in plan:
+                if cur is not None:
                     ports.append(cur)
                     continue
                 try:
@@ -269,11 +293,17 @@ class Allocator:
                         p.protocol, p.published_port) \
                         if p.publish_mode == "ingress" else p.published_port
                 except PortConflict as e:
-                    # leave the service unallocated; a later spec update
-                    # re-triggers allocation (reference: allocator records
-                    # the error on the service and retries on update)
+                    # leave the service unallocated; roll back this pass so
+                    # the allocator's books match the (unchanged) store
+                    # (reference: allocator records the error and retries)
+                    for proto, port in fresh:
+                        self.ports.release(proto, port)
+                    for proto, port in released:
+                        self.ports.restore(proto, port)
                     log.warning("service %s: %s", service_id, e)
                     return
+                if published and p.publish_mode == "ingress":
+                    fresh.append((p.protocol, published))
                 ports.append(PortConfig(
                     name=p.name, protocol=p.protocol,
                     target_port=p.target_port, published_port=published,

@@ -69,10 +69,15 @@ class Dispatcher:
     def __init__(self, store: MemoryStore,
                  managers_fn: Optional[Callable[[], list[WeightedPeer]]] = None,
                  clock: Optional[Clock] = None,
+                 peers_queue=None,
                  rng: Optional[random.Random] = None) -> None:
         self.store = store
         self.clock = clock or SystemClock()
         self.managers_fn = managers_fn or (lambda: [])
+        # raft membership broadcast (membership.Cluster.broadcast /
+        # PeersBroadcast cluster.go:38): wakes session streams so agents
+        # learn manager-list changes that write no store object
+        self.peers_queue = peers_queue
         self.nodes = NodeStore(self.clock, rng=rng)
         # node_id -> timer task orphaning its tasks after 24 h down
         self._down_nodes: dict[str, asyncio.Task] = {}
@@ -246,6 +251,10 @@ class Dispatcher:
         """Reference: UpdateTaskStatus dispatcher.go:596."""
         self._check_running()
         self.nodes.get_with_session(node_id, session_id)
+        # validate the whole batch before enqueuing anything, so a bad
+        # entry can't strand earlier valid updates unflushed
+        # (reference: validTaskUpdates collected first, dispatcher.go:624)
+        valid = []
         for task_id, status in updates:
             t = self.store.get("task", task_id)
             if t is None:
@@ -253,6 +262,8 @@ class Dispatcher:
             if t.node_id != node_id:
                 raise PermissionError(
                     "cannot update a task not assigned this node")
+            valid.append((task_id, status))
+        for task_id, status in valid:
             self._task_updates[task_id] = status
         if self._task_updates:
             self._updates_ready.set()
@@ -317,6 +328,8 @@ class Dispatcher:
         rn = self.nodes.get_with_session(node_id, session_id)
 
         watcher = self.store.watch(match(kind="node"), match(kind="cluster"))
+        peers_w = (self.peers_queue.watch()
+                   if self.peers_queue is not None else None)
         try:
             msg = self._session_message(node_id, session_id)
             if msg is not None:
@@ -325,16 +338,21 @@ class Dispatcher:
             while self._running and not rn.disconnect.is_set():
                 get_ev = asyncio.ensure_future(watcher.get())
                 disc = asyncio.ensure_future(rn.disconnect.wait())
+                waiters = {get_ev, disc}
+                peers_ev = None
+                if peers_w is not None:
+                    peers_ev = asyncio.ensure_future(peers_w.get())
+                    waiters.add(peers_ev)
                 done, pending = await asyncio.wait(
-                    {get_ev, disc}, return_when=asyncio.FIRST_COMPLETED)
+                    waiters, return_when=asyncio.FIRST_COMPLETED)
                 for p in pending:
                     p.cancel()
                 if disc in done:
-                    get_ev.cancel()
                     break
-                ev = get_ev.result()
-                if ev.kind == "node" and ev.object.id != node_id:
-                    continue
+                if get_ev in done:
+                    ev = get_ev.result()
+                    if ev.kind == "node" and ev.object.id != node_id:
+                        continue
                 msg = self._session_message(node_id, session_id)
                 if msg is None:  # node deleted
                     break
@@ -343,6 +361,8 @@ class Dispatcher:
                     last = msg
         finally:
             watcher.close()
+            if peers_w is not None:
+                peers_w.close()
 
     # ------------------------------------------------------------------
     async def assignments(self, node_id: str, session_id: str

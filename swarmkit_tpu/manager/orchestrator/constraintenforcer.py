@@ -61,7 +61,17 @@ class ConstraintEnforcer:
         tasks = self.store.find("task", ByNode(node.id))
         to_shutdown = []
         drained = node.spec.availability == NodeAvailability.DRAIN
-        for t in tasks:
+        # remaining capacity for the resource-fit pass (the reference
+        # recomputes available resources and evicts tasks whose
+        # reservations no longer fit a shrunk node)
+        cpus = mem = 0
+        generic: dict[str, int] = {}
+        if node.description is not None \
+                and node.description.resources is not None:
+            cpus = node.description.resources.nano_cpus
+            mem = node.description.resources.memory_bytes
+            generic = dict(node.description.resources.generic)
+        for t in sorted(tasks, key=lambda t: t.id):
             if t.desired_state > TaskState.RUNNING \
                     or common.in_terminal_state(t):
                 continue
@@ -76,6 +86,20 @@ class ConstraintEnforcer:
                     continue
                 if not constraint_mod.node_matches(cons, node):
                     to_shutdown.append(t)
+                    continue
+            res = t.spec.resources
+            reserved = res.reservations if res is not None else None
+            if reserved is not None:
+                over_generic = any(generic.get(k, 0) < v
+                                   for k, v in reserved.generic.items())
+                if reserved.nano_cpus > cpus or reserved.memory_bytes > mem \
+                        or over_generic:
+                    to_shutdown.append(t)
+                    continue
+                cpus -= reserved.nano_cpus
+                mem -= reserved.memory_bytes
+                for k, v in reserved.generic.items():
+                    generic[k] = generic.get(k, 0) - v
         if not to_shutdown:
             return
 

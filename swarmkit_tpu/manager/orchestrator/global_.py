@@ -16,6 +16,8 @@ from swarmkit_tpu.api import Mode, NodeAvailability, NodeState, TaskState
 from swarmkit_tpu.manager import constraint as constraint_mod
 from swarmkit_tpu.manager.orchestrator import common
 from swarmkit_tpu.manager.orchestrator.restart import RestartSupervisor
+from swarmkit_tpu.manager.orchestrator.taskinit import check_tasks
+from swarmkit_tpu.manager.orchestrator.update import UpdateSupervisor
 from swarmkit_tpu.store.by import ByService
 from swarmkit_tpu.store.memory import Event, EventCommit, MemoryStore, match, match_commit
 from swarmkit_tpu.utils.clock import Clock, SystemClock
@@ -41,10 +43,13 @@ def _node_eligible(service, node) -> bool:
 
 class GlobalOrchestrator:
     def __init__(self, store: MemoryStore, clock: Optional[Clock] = None,
-                 restart: Optional[RestartSupervisor] = None) -> None:
+                 restart: Optional[RestartSupervisor] = None,
+                 updater: Optional[UpdateSupervisor] = None) -> None:
         self.store = store
         self.clock = clock or SystemClock()
         self.restart = restart or RestartSupervisor(store, clock=self.clock)
+        self.updater = updater or UpdateSupervisor(store, self.restart,
+                                                   clock=self.clock)
         self._dirty: set[str] = set()
         self._deleted: dict[str, object] = {}
         self._restart_queue: list = []
@@ -58,6 +63,9 @@ class GlobalOrchestrator:
         for s in self.store.find("service"):
             if s.spec.mode == Mode.GLOBAL:
                 self._dirty.add(s.id)
+        # fix stale tasks from before this orchestrator existed
+        # (reference: taskinit.CheckTasks via global.go Run)
+        await check_tasks(self.store, self.restart, Mode.GLOBAL)
         self._running = True
         self._task = asyncio.get_running_loop().create_task(self._run(watcher))
 
@@ -70,6 +78,7 @@ class GlobalOrchestrator:
             except (asyncio.CancelledError, Exception):
                 pass
             self._task = None
+        await self.updater.stop()
         await self.restart.stop()
 
     async def _run(self, watcher) -> None:
@@ -157,17 +166,22 @@ class GlobalOrchestrator:
         to_create = [nid for nid in eligible if nid not in by_node]
         to_shutdown = [t for nid, ts in by_node.items()
                        if nid not in eligible for t in ts]
-        if not to_create and not to_shutdown:
-            return
+        if to_create or to_shutdown:
+            def txn(tx):
+                for nid in to_create:
+                    tx.create(common.new_task(cluster, service, slot=0,
+                                              node_id=nid))
+                for t in to_shutdown:
+                    cur = tx.get("task", t.id)
+                    if cur is not None \
+                            and cur.desired_state <= TaskState.RUNNING:
+                        cur.desired_state = int(TaskState.SHUTDOWN)
+                        tx.update(cur)
+            await self.store.update(txn)
 
-        def txn(tx):
-            for nid in to_create:
-                tx.create(common.new_task(cluster, service, slot=0,
-                                          node_id=nid))
-            for t in to_shutdown:
-                cur = tx.get("task", t.id)
-                if cur is not None \
-                        and cur.desired_state <= TaskState.RUNNING:
-                    cur.desired_state = int(TaskState.SHUTDOWN)
-                    tx.update(cur)
-        await self.store.update(txn)
+        # spec changes roll out via the update supervisor, one "slot" per
+        # node (reference: global.go reconcileServices → g.updater.Update)
+        node_slots = [ts for nid, ts in by_node.items() if nid in eligible]
+        if any(common.is_task_dirty(service, t)
+               for ts in node_slots for t in ts):
+            self.updater.update(cluster, service, node_slots)

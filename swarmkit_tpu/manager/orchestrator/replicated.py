@@ -16,6 +16,7 @@ from typing import Optional
 from swarmkit_tpu.api import Mode, TaskState
 from swarmkit_tpu.manager.orchestrator import common
 from swarmkit_tpu.manager.orchestrator.restart import RestartSupervisor
+from swarmkit_tpu.manager.orchestrator.taskinit import check_tasks
 from swarmkit_tpu.manager.orchestrator.update import UpdateSupervisor
 from swarmkit_tpu.store.by import ByService
 from swarmkit_tpu.store.memory import Event, EventCommit, MemoryStore, match, match_commit
@@ -46,6 +47,10 @@ class ReplicatedOrchestrator:
         for s in self.store.find("service"):
             if s.spec.mode == Mode.REPLICATED:
                 self._dirty_services.add(s.id)
+        # fix stale tasks from before this orchestrator existed: re-arm
+        # parked restart delays, restart tasks that died unwatched
+        # (reference: taskinit.CheckTasks via replicated.go Run)
+        await check_tasks(self.store, self.restart, Mode.REPLICATED)
         self._running = True
         self._task = asyncio.get_running_loop().create_task(self._run(watcher))
 

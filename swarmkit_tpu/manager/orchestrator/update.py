@@ -85,7 +85,12 @@ class UpdateSupervisor:
         except Exception:
             log.exception("update of service %s crashed", service.id)
         finally:
-            self._updates.pop(service.id, None)
+            # Only clear our own registration: a cancelled updater must not
+            # pop the successor that replaced it (Supervisor.Update :50
+            # replaces the map entry before the old goroutine winds down).
+            if self._updates.get(service.id) is asyncio.current_task():
+                self._updates.pop(service.id, None)
+                self._update_specs.pop(service.id, None)
 
     def _config(self, service, rollback: bool) -> UpdateConfig:
         cfg = service.spec.rollback if rollback else service.spec.update

@@ -40,7 +40,7 @@ class Scheduler:
         self.pipeline = Pipeline()
         self._task: Optional[asyncio.Task] = None
         self._running = False
-        self.pending_preassigned: dict[str, object] = {}
+        self._changed_since_tick = True
 
     # ------------------------------------------------------------------
     async def start(self) -> None:
@@ -104,18 +104,29 @@ class Scheduler:
     def _handle(self, ev) -> bool:
         """Update mirrors; return True when a tick might make progress."""
         if isinstance(ev, EventCommit):
-            return bool(self.unassigned)
+            # only retry unassigned work when something actually changed
+            # since the last tick — a commit alone can't make progress
+            fire = self._changed_since_tick and bool(self.unassigned)
+            return fire
         if not isinstance(ev, Event):
             return False
         if ev.kind == "node":
+            self._changed_since_tick = True
             if ev.action == "remove":
                 self.node_set.remove(ev.object.id)
             else:
                 # rebuild NodeInfo so available_* reflect a changed
-                # description (resources can grow/shrink on re-register)
-                self.node_set.add_or_update(self._node_info(ev.object))
+                # description (resources can grow/shrink on re-register) —
+                # but carry the failure history forward: node status churn
+                # (READY/DOWN flaps) must not reset the taint backoff
+                old = self.node_set.get(ev.object.id)
+                info = self._node_info(ev.object)
+                if old is not None:
+                    info.recent_failures = old.recent_failures
+                self.node_set.add_or_update(info)
             return True
         if ev.kind == "task":
+            self._changed_since_tick = True
             t = ev.object
             if ev.action == "remove":
                 self.all_tasks.pop(t.id, None)
@@ -135,6 +146,16 @@ class Scheduler:
                 info = self.node_set.get(t.node_id)
                 if info is not None:
                     info.add_task(t)
+            # remember nodes that keep failing tasks so placement backs off
+            # (reference: scheduler.go recording task failures per node)
+            if ev.action == "update" and t.node_id \
+                    and t.status.state in (TaskState.FAILED,
+                                           TaskState.REJECTED) \
+                    and (prev is None
+                         or prev.status.state != t.status.state):
+                info = self.node_set.get(t.node_id)
+                if info is not None:
+                    info.recent_failures.append(self.clock.now())
             if t.status.state == TaskState.PENDING and not t.node_id \
                     and t.desired_state <= TaskState.RUNNING:
                 self.unassigned[t.id] = t
@@ -154,18 +175,47 @@ class Scheduler:
 
     async def tick(self) -> None:
         """Schedule everything currently unassigned."""
+        self._changed_since_tick = False
         groups: dict[tuple, list] = {}
         for t in list(self.unassigned.values()):
             groups.setdefault(self._common_spec_key(t), []).append(t)
 
-        decisions: list[tuple[object, str]] = []  # (task, node_id)
+        decisions = []  # (task, node_id, mirrored copy)
         for group in groups.values():
             decisions.extend(self._schedule_group(group))
+        placed = {t.id for t, _, _ in decisions}
         if decisions:
             await self._apply(decisions)
+        # annotate tasks no filter would place so operators can see why
+        # (reference: noSuitableNode scheduler.go — sets task status message)
+        await self._explain_unplaced(
+            [t for t in self.unassigned.values() if t.id not in placed])
 
-    def _schedule_group(self, tasks: list) -> list[tuple[object, str]]:
-        """reference: scheduleTaskGroup :533."""
+    async def _explain_unplaced(self, tasks: list) -> None:
+        updates = []
+        for t in tasks:
+            self.pipeline.set_task(t)
+            reasons = {self.pipeline.explain(i)
+                       for i in self.node_set.nodes.values()} or {"no nodes"}
+            msg = "; ".join(sorted(r for r in reasons if r)) or \
+                "no suitable node"
+            if msg != t.status.message:
+                updates.append((t.id, msg))
+        if not updates:
+            return
+
+        def txn(tx):
+            for tid, msg in updates:
+                cur = tx.get("task", tid)
+                if cur is not None and cur.status.message != msg:
+                    cur.status.message = msg
+                    tx.update(cur)
+        await self.store.update(txn)
+
+    def _schedule_group(self, tasks: list
+                        ) -> list[tuple[object, str, object]]:
+        """Returns (task, node_id, mirrored-assigned-copy) triples
+        (reference: scheduleTaskGroup :533)."""
         sample = tasks[0]
         self.pipeline.set_task(sample)
         prefs = []
@@ -179,10 +229,20 @@ class Scheduler:
                 return ca < cb
             return a.active_task_count() < b.active_task_count()
 
+        now = self.clock.now()
+
+        def best(a: NodeInfo, b: NodeInfo) -> bool:
+            # nodes that keep failing this service's tasks lose ties
+            # (reference: nodeLess + countRecentFailures backoff)
+            ta, tb = a.taint(now), b.taint(now)
+            if ta != tb:
+                return tb
+            return better(a, b)
+
         out = []
         for task in tasks:
             candidates = self.node_set.find_best_nodes(
-                1, self.pipeline.process, prefs, better,
+                1, self.pipeline.process, prefs, best,
                 load=lambda i: i.count_for_service(service_id))
             if not candidates:
                 continue
@@ -191,33 +251,41 @@ class Scheduler:
             assigned = task.copy()
             assigned.node_id = info.id
             info.add_task(assigned)
-            out.append((task, info.id))
+            out.append((task, info.id, assigned))
         return out
 
-    async def _apply(self, decisions: list[tuple[object, str]]) -> None:
+    async def _apply(self, decisions: list[tuple[object, str, object]]) -> None:
         """reference: applySchedulingDecisions :432."""
         from swarmkit_tpu.store.errors import ErrSequenceConflict
 
         batch = self.store.batch()
-        for task, node_id in decisions:
+        applied: dict[str, bool] = {}
+        for task, node_id, _assigned in decisions:
             def txn(tx, task=task, node_id=node_id):
                 current = tx.get("task", task.id)
                 if current is None:
-                    return
+                    return False
                 if current.status.state != TaskState.PENDING \
                         or current.node_id \
                         or current.desired_state > TaskState.RUNNING:
-                    return  # changed underneath; event flow will retry
+                    return False  # changed underneath; event flow will retry
                 current.status.state = TaskState.ASSIGNED
                 current.status.message = "scheduler assigned task"
                 current.status.timestamp = self.clock.now()
                 current.node_id = node_id
                 tx.update(current)
+                return True
 
             try:
-                await batch.update(txn)
+                applied[task.id] = await batch.update(txn)
             except ErrSequenceConflict:
-                continue
+                applied[task.id] = False
         await batch.commit()
-        for task, _ in decisions:
+        for task, node_id, assigned in decisions:
             self.unassigned.pop(task.id, None)
+            if not applied.get(task.id):
+                # roll the phantom copy back out of the node mirror
+                # (reference: applySchedulingDecisions failure path)
+                info = self.node_set.get(node_id)
+                if info is not None:
+                    info.remove_task(assigned)

@@ -119,3 +119,63 @@ async def test_restore_does_not_double_allocate():
         == first
     assert second != first
     await alloc2.stop()
+
+
+@async_test
+async def test_endpoint_update_releases_and_swaps_ports():
+    """Regression: ports dropped/changed by a spec update must be released
+    so another service (or the same one) can claim them."""
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    alloc = Allocator(store, clock=clock)
+    await alloc.start()
+    svc = make_service(ports=[PortConfig(protocol="tcp", target_port=80,
+                                         published_port=8080,
+                                         publish_mode="ingress")])
+    await store.update(lambda tx: tx.create(svc))
+    await pump(clock)
+    assert store.get("service", svc.id).endpoint.ports[0].published_port == 8080
+
+    # swap 8080 -> 9090
+    s = store.get("service", svc.id)
+    s.spec.endpoint = EndpointSpecRef(ports=[
+        PortConfig(protocol="tcp", target_port=80, published_port=9090,
+                   publish_mode="ingress")])
+    await store.update(lambda tx: tx.update(s))
+    await pump(clock)
+    assert store.get("service", svc.id).endpoint.ports[0].published_port == 9090
+
+    # 8080 must be claimable again by a second service
+    svc2 = make_service(name="web2",
+                        ports=[PortConfig(protocol="tcp", target_port=81,
+                                          published_port=8080,
+                                          publish_mode="ingress")])
+    await store.update(lambda tx: tx.create(svc2))
+    await pump(clock)
+    assert store.get("service", svc2.id).endpoint.ports[0].published_port == 8080
+    await alloc.stop()
+
+
+@async_test
+async def test_endpoint_dynamic_to_explicit_port_change():
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    alloc = Allocator(store, clock=clock)
+    await alloc.start()
+    svc = make_service(ports=[PortConfig(protocol="tcp", target_port=80,
+                                         publish_mode="ingress")])
+    await store.update(lambda tx: tx.create(svc))
+    await pump(clock)
+    dyn = store.get("service", svc.id).endpoint.ports[0].published_port
+    assert dyn >= DYNAMIC_PORT_START
+
+    s = store.get("service", svc.id)
+    s.spec.endpoint = EndpointSpecRef(ports=[
+        PortConfig(protocol="tcp", target_port=80, published_port=7777,
+                   publish_mode="ingress")])
+    await store.update(lambda tx: tx.update(s))
+    await pump(clock)
+    assert store.get("service", svc.id).endpoint.ports[0].published_port == 7777
+    # the old dynamic port is free again
+    assert (("tcp", dyn)) not in alloc.ports._allocated
+    await alloc.stop()

@@ -315,3 +315,60 @@ async def test_rate_limit_reregistrations():
     await clock.advance(10.0)
     await d.register("node1")
     await d.stop()
+
+
+@async_test
+async def test_update_task_status_partial_batch_not_stranded():
+    """Regression: a foreign-node entry must not strand valid updates."""
+    clock, store, d = await setup(2)
+    sid = await d.register("node1")
+    await store.update(lambda tx: [tx.create(make_task(1)),
+                                   tx.create(make_task(2, node="node2"))])
+    with pytest.raises(PermissionError):
+        await d.update_task_status("node1", sid, [
+            ("task1", TaskStatus(state=TaskState.RUNNING)),
+            ("task2", TaskStatus(state=TaskState.RUNNING)),
+        ])
+    # nothing should have been enqueued from the rejected batch
+    await pump()
+    assert store.get("task", "task1").status.state == TaskState.ASSIGNED
+
+    # a clean batch flows normally
+    await d.update_task_status("node1", sid, [
+        ("task1", TaskStatus(state=TaskState.RUNNING))])
+    await eventually(lambda: store.get("task", "task1").status.state
+                     == TaskState.RUNNING, clock)
+    await d.stop()
+
+
+@async_test
+async def test_session_wakes_on_peer_broadcast():
+    from swarmkit_tpu.api import Peer, WeightedPeer
+    from swarmkit_tpu.watch.queue import Queue
+
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    peers_queue = Queue()
+    managers = [WeightedPeer(peer=Peer(node_id="m1", addr="1.1.1.1:4242"))]
+    d = Dispatcher(store, managers_fn=lambda: list(managers), clock=clock,
+                   peers_queue=peers_queue, rng=random.Random(0))
+    await store.update(lambda tx: tx.create(make_node(1)))
+    await d.start(mark_unknown=False)
+
+    msgs = []
+
+    async def consume():
+        async for m in d.session("node1"):
+            msgs.append(m)
+
+    consumer = asyncio.get_running_loop().create_task(consume())
+    await eventually(lambda: len(msgs) >= 1, clock)
+    assert [w.peer.node_id for w in msgs[0].managers] == ["m1"]
+
+    # raft membership change (no store write) must reach the stream
+    managers.append(WeightedPeer(peer=Peer(node_id="m2", addr="2.2.2.2:4242")))
+    peers_queue.publish(object())
+    await eventually(lambda: len(msgs) >= 2, clock)
+    assert [w.peer.node_id for w in msgs[1].managers] == ["m1", "m2"]
+    consumer.cancel()
+    await d.stop()

@@ -358,3 +358,96 @@ async def test_constraint_enforcer_evicts_on_label_change():
     await pump(clock)
     assert store.get("task", task.id).desired_state == TaskState.SHUTDOWN
     await enforcer.stop()
+
+
+@async_test
+async def test_global_service_spec_update_rolls_out():
+    """Regression: a global service image change must reach every node."""
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    orch = GlobalOrchestrator(store, clock=clock)
+    await store.update(lambda tx: [tx.create(make_node(1)),
+                                   tx.create(make_node(2))])
+    await orch.start()
+    svc = make_service(name="mon", mode=Mode.GLOBAL,
+                       update=UpdateConfig(parallelism=2, monitor=0.2))
+    await store.update(lambda tx: tx.create(svc))
+    await pump(clock)
+
+    def run_all(tx):
+        for t in store.find("task", ByService(svc.id)):
+            cur = tx.get("task", t.id)
+            cur.status.state = TaskState.RUNNING
+            tx.update(cur)
+    await store.update(run_all)
+    await pump(clock)
+
+    svc2 = store.get("service", svc.id)
+    svc2.spec.task.container.image = "nginx:2"
+    await store.update(lambda tx: tx.update(svc2))
+    await pump(clock)
+
+    for _ in range(60):
+        def agent_sim(tx):
+            for t in store.find("task", ByService(svc.id)):
+                cur = tx.get("task", t.id)
+                if cur is None:
+                    continue
+                if cur.desired_state == TaskState.SHUTDOWN \
+                        and cur.status.state < TaskState.SHUTDOWN:
+                    cur.status.state = TaskState.SHUTDOWN
+                    tx.update(cur)
+                elif cur.desired_state == TaskState.RUNNING \
+                        and cur.status.state < TaskState.RUNNING:
+                    cur.status.state = TaskState.RUNNING
+                    tx.update(cur)
+        await store.update(agent_sim)
+        await pump(clock, seconds=0.1)
+        live = live_tasks(store, svc.id)
+        if len(live) == 2 and all(
+                t.spec.container.image == "nginx:2" for t in live):
+            break
+    else:
+        raise AssertionError(
+            f"global update did not roll out: "
+            f"{[(t.node_id, t.spec.container.image) for t in live_tasks(store, svc.id)]}")
+    await orch.stop()
+
+
+@async_test
+async def test_constraint_enforcer_evicts_on_shrunk_resources():
+    from swarmkit_tpu.api import Resources, ResourceRequirements
+    from swarmkit_tpu.api.types import NodeResources
+
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    enforcer = ConstraintEnforcer(store, clock=clock)
+
+    node = make_node(1)
+    node.description.resources = NodeResources(nano_cpus=4_000_000_000,
+                                               memory_bytes=8 << 30)
+    await store.update(lambda tx: tx.create(node))
+    await enforcer.start()
+
+    from swarmkit_tpu.api import Task, TaskStatus
+    def mk(i):
+        return Task(id=f"t{i}", service_id="s", slot=i, node_id="node1",
+                    spec=TaskSpec(resources=ResourceRequirements(
+                        reservations=Resources(nano_cpus=1_500_000_000,
+                                               memory_bytes=3 << 30))),
+                    status=TaskStatus(state=TaskState.RUNNING),
+                    desired_state=int(TaskState.RUNNING))
+    await store.update(lambda tx: [tx.create(mk(1)), tx.create(mk(2))])
+
+    # node re-registers with half the memory -> one task no longer fits
+    n = store.get("node", "node1")
+    n.description.resources = NodeResources(nano_cpus=4_000_000_000,
+                                            memory_bytes=4 << 30)
+    await store.update(lambda tx: tx.update(n))
+    await pump(clock)
+    shutdown = [t for t in store.find("task")
+                if t.desired_state == TaskState.SHUTDOWN]
+    live = [t for t in store.find("task")
+            if t.desired_state == TaskState.RUNNING]
+    assert len(shutdown) == 1 and len(live) == 1
+    await enforcer.stop()
