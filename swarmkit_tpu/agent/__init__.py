@@ -1,0 +1,6 @@
+from swarmkit_tpu.agent.agent import Agent, AgentConfig
+from swarmkit_tpu.agent.exec import Controller, Executor, do_task_state
+from swarmkit_tpu.agent.worker import Worker
+
+__all__ = ["Agent", "AgentConfig", "Controller", "Executor", "Worker",
+           "do_task_state"]

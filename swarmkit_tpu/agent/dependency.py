@@ -1,0 +1,48 @@
+"""Worker-side secret/config stores.
+
+Reference: agent/secrets/secrets.go, agent/configs/configs.go,
+agent/dependency.go — in-memory maps fed by assignment changes, read by
+controllers when materializing task filesystems/env.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class _DepStore:
+    def __init__(self) -> None:
+        self._items: dict[str, object] = {}
+
+    def get(self, dep_id: str) -> Optional[object]:
+        return self._items.get(dep_id)
+
+    def add(self, *items) -> None:
+        for it in items:
+            self._items[it.id] = it
+
+    def remove(self, ids) -> None:
+        for dep_id in ids:
+            self._items.pop(dep_id, None)
+
+    def reset(self) -> None:
+        self._items = {}
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+
+class Secrets(_DepStore):
+    """reference: agent/secrets/secrets.go:18."""
+
+
+class Configs(_DepStore):
+    """reference: agent/configs/configs.go:18."""
+
+
+class Dependencies:
+    """reference: agent/dependency.go dependencyManager."""
+
+    def __init__(self) -> None:
+        self.secrets = Secrets()
+        self.configs = Configs()

@@ -1,0 +1,80 @@
+"""Fake runtime for tests and the in-process integration harness.
+
+Reference: agent/testutils/fakes.go — TestExecutor (:24) instantly "runs"
+tasks; its controllers succeed at every step and block in Wait until shut
+down, so orchestration logic can be exercised with no real containers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from swarmkit_tpu.agent.exec import Controller, Executor, TaskError
+from swarmkit_tpu.api.types import NodeDescription, NodeResources, Platform
+
+
+class TestController(Controller):
+    def __init__(self, task, executor: "TestExecutor") -> None:
+        self.task = task
+        self.executor = executor
+        self.exit_evt = asyncio.Event()
+        self.fail_msg: Optional[str] = None
+
+    async def prepare(self) -> None:
+        if self.executor.fail_prepare:
+            raise TaskError("prepare failed (test)")
+
+    async def start(self) -> None:
+        if self.executor.fail_start:
+            raise TaskError("start failed (test)")
+
+    async def wait(self) -> None:
+        await self.exit_evt.wait()
+        if self.fail_msg:
+            raise TaskError(self.fail_msg)
+
+    async def shutdown(self) -> None:
+        self.exit_evt.set()
+
+    async def terminate(self) -> None:
+        self.exit_evt.set()
+
+    # test hooks ---------------------------------------------------------
+    def exit(self, fail: Optional[str] = None) -> None:
+        """Make the fake workload exit (cleanly or with an error)."""
+        self.fail_msg = fail
+        self.exit_evt.set()
+
+
+class TestExecutor(Executor):
+    __test__ = False  # not a pytest class despite the name
+
+    def __init__(self, hostname: str = "testhost",
+                 cpus: int = 4_000_000_000, memory: int = 8 << 30) -> None:
+        self.hostname = hostname
+        self.cpus = cpus
+        self.memory = memory
+        self.controllers: dict[str, TestController] = {}
+        self.fail_prepare = False
+        self.fail_start = False
+        self.configured_nodes: list = []
+        self.bootstrap_keys: list = []
+
+    async def describe(self) -> NodeDescription:
+        return NodeDescription(
+            hostname=self.hostname,
+            platform=Platform(architecture="x86_64", os="linux"),
+            resources=NodeResources(nano_cpus=self.cpus,
+                                    memory_bytes=self.memory))
+
+    async def configure(self, node) -> None:
+        self.configured_nodes.append(node)
+
+    async def controller(self, task) -> Controller:
+        c = TestController(task, self)
+        self.controllers[task.id] = c
+        return c
+
+    async def set_network_bootstrap_keys(self, keys) -> None:
+        self.bootstrap_keys = list(keys)

@@ -268,7 +268,11 @@ class Allocator:
                         and cur.publish_mode == p.publish_mode
                         and p.published_port in (0, cur.published_port)):
                     plan.append((p, cur))
-                    reused.add((cur.protocol, cur.published_port))
+                    # only ingress ports live in the allocator's books; a
+                    # reused host-mode port must not shield a dropped
+                    # ingress port with the same number from release
+                    if cur.publish_mode == "ingress":
+                        reused.add((cur.protocol, cur.published_port))
                 else:
                     plan.append((p, None))
             # release ports the new spec dropped or changed BEFORE

@@ -330,29 +330,45 @@ class Dispatcher:
         watcher = self.store.watch(match(kind="node"), match(kind="cluster"))
         peers_w = (self.peers_queue.watch()
                    if self.peers_queue is not None else None)
+        # persistent waiters: only a consumed future is re-created, so an
+        # event completing in a round won by another waiter is never lost
+        get_ev = asyncio.ensure_future(watcher.get())
+        disc = asyncio.ensure_future(rn.disconnect.wait())
+        peers_ev = (asyncio.ensure_future(peers_w.get())
+                    if peers_w is not None else None)
+
+        def reap():
+            _cancel_quietly(get_ev, disc,
+                            *((peers_ev,) if peers_ev is not None else ()))
         try:
             msg = self._session_message(node_id, session_id)
             if msg is not None:
                 yield msg
             last = msg
             while self._running and not rn.disconnect.is_set():
-                get_ev = asyncio.ensure_future(watcher.get())
-                disc = asyncio.ensure_future(rn.disconnect.wait())
                 waiters = {get_ev, disc}
-                peers_ev = None
-                if peers_w is not None:
-                    peers_ev = asyncio.ensure_future(peers_w.get())
+                if peers_ev is not None:
                     waiters.add(peers_ev)
-                done, pending = await asyncio.wait(
-                    waiters, return_when=asyncio.FIRST_COMPLETED)
-                for p in pending:
-                    p.cancel()
+                try:
+                    done, _ = await asyncio.wait(
+                        waiters, return_when=asyncio.FIRST_COMPLETED)
+                except BaseException:
+                    # generator closed/cancelled mid-wait: reap the waiters
+                    reap()
+                    raise
                 if disc in done:
                     break
+                relevant = False
                 if get_ev in done:
                     ev = get_ev.result()
-                    if ev.kind == "node" and ev.object.id != node_id:
-                        continue
+                    get_ev = asyncio.ensure_future(watcher.get())
+                    if not (ev.kind == "node" and ev.object.id != node_id):
+                        relevant = True
+                if peers_ev is not None and peers_ev in done:
+                    peers_ev = asyncio.ensure_future(peers_w.get())
+                    relevant = True
+                if not relevant:
+                    continue
                 msg = self._session_message(node_id, session_id)
                 if msg is None:  # node deleted
                     break
@@ -360,6 +376,7 @@ class Dispatcher:
                     yield msg
                     last = msg
         finally:
+            reap()
             watcher.close()
             if peers_w is not None:
                 peers_w.close()
@@ -421,12 +438,17 @@ class Dispatcher:
         if timeout is not None:
             timer = asyncio.ensure_future(self.clock.sleep(timeout))
             waiters[timer] = "timeout"
-        done, pending = await asyncio.wait(
-            set(waiters), return_when=asyncio.FIRST_COMPLETED)
-        for p in pending:
-            p.cancel()
+        try:
+            done, pending = await asyncio.wait(
+                set(waiters), return_when=asyncio.FIRST_COMPLETED)
+        except BaseException:
+            _cancel_quietly(*waiters)
+            raise
+        _cancel_quietly(*pending)
         if get_ev in done:
+            _cancel_quietly(*(done - {get_ev}))
             return get_ev.result()
+        _cancel_quietly(*(done - {disc}))
         if disc in done:
             return _DISCONNECTED
         return _TIMEOUT
@@ -434,3 +456,13 @@ class Dispatcher:
 
 _DISCONNECTED = object()
 _TIMEOUT = object()
+
+
+def _cancel_quietly(*futs) -> None:
+    """Cancel pending waiters, swallowing late exceptions (a watcher closed
+    during teardown completes its pending get() with WatcherClosed after the
+    cancel — retrieve it so asyncio doesn't log 'never retrieved')."""
+    for f in futs:
+        f.cancel()
+        f.add_done_callback(
+            lambda fut: fut.exception() if not fut.cancelled() else None)

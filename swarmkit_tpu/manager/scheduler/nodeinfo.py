@@ -36,7 +36,8 @@ class NodeInfo:
             self.available_cpus = desc.resources.nano_cpus
             self.available_memory = desc.resources.memory_bytes
             self.available_generic = dict(desc.resources.generic)
-        self.recent_failures: list[float] = []
+        # service id -> timestamps of recent task failures on this node
+        self.recent_failures: dict[str, list[float]] = {}
         for t in (tasks or {}).values():
             self.add_task(t)
 
@@ -89,9 +90,18 @@ class NodeInfo:
     def count_for_service(self, service_id: str) -> int:
         return self.active_tasks_per_service.get(service_id, 0)
 
-    def taint(self, now: float, window: float = 300.0, limit: int = 5) -> bool:
-        """True when this node has failed this kind of task too often lately
-        (reference: nodeinfo.go countRecentFailures + scheduler backoff)."""
-        self.recent_failures = [t for t in self.recent_failures
-                                if now - t < window]
-        return len(self.recent_failures) >= limit
+    def record_failure(self, service_id: str, now: float) -> None:
+        """reference: nodeinfo.go taskFailed — failures keyed by service."""
+        self.recent_failures.setdefault(service_id, []).append(now)
+
+    def taint(self, service_id: str, now: float, window: float = 300.0,
+              limit: int = 5) -> bool:
+        """True when this node has failed THIS service's tasks too often
+        lately (reference: nodeinfo.go countRecentFailures + backoff)."""
+        hist = [t for t in self.recent_failures.get(service_id, ())
+                if now - t < window]
+        if hist:
+            self.recent_failures[service_id] = hist
+        else:
+            self.recent_failures.pop(service_id, None)
+        return len(hist) >= limit

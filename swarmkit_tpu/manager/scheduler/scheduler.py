@@ -155,7 +155,7 @@ class Scheduler:
                          or prev.status.state != t.status.state):
                 info = self.node_set.get(t.node_id)
                 if info is not None:
-                    info.recent_failures.append(self.clock.now())
+                    info.record_failure(t.service_id, self.clock.now())
             if t.status.state == TaskState.PENDING and not t.node_id \
                     and t.desired_state <= TaskState.RUNNING:
                 self.unassigned[t.id] = t
@@ -234,7 +234,8 @@ class Scheduler:
         def best(a: NodeInfo, b: NodeInfo) -> bool:
             # nodes that keep failing this service's tasks lose ties
             # (reference: nodeLess + countRecentFailures backoff)
-            ta, tb = a.taint(now), b.taint(now)
+            ta = a.taint(service_id, now)
+            tb = b.taint(service_id, now)
             if ta != tb:
                 return tb
             return better(a, b)

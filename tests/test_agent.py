@@ -1,0 +1,263 @@
+"""Agent suite: task FSM, worker reconcile, full agent↔dispatcher loop.
+
+Reference scenarios: agent/agent_test.go, agent/worker_test.go,
+agent/task_test.go, agent/exec/controller_test.go.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from swarmkit_tpu.agent import Agent, AgentConfig, Worker, do_task_state
+from swarmkit_tpu.agent.storage import TaskDB
+from swarmkit_tpu.agent.testutils import TestExecutor
+from swarmkit_tpu.api import (
+    Annotations, Node, NodeSpec, NodeState, Secret, SecretSpec, Task,
+    TaskSpec, TaskState, TaskStatus,
+)
+from swarmkit_tpu.api.dispatcher_msgs import (
+    Assignment, AssignmentAction, AssignmentChange, AssignmentsMessage,
+    AssignmentsType,
+)
+from swarmkit_tpu.api.objects import NodeStatus
+from swarmkit_tpu.manager.dispatcher import Dispatcher
+from swarmkit_tpu.store.memory import MemoryStore
+from swarmkit_tpu.utils.clock import FakeClock, SystemClock
+from tests.conftest import async_test
+
+
+def make_task(i, state=TaskState.ASSIGNED, desired=TaskState.RUNNING):
+    return Task(id=f"task{i}", node_id="node1", spec=TaskSpec(),
+                status=TaskStatus(state=state), desired_state=int(desired))
+
+
+async def eventually(pred, ticks=600, clock=None):
+    for _ in range(ticks):
+        if pred():
+            return
+        if clock is not None:
+            await asyncio.sleep(0)
+            await clock.advance(0.01)
+        else:
+            # real-clock components (dispatcher debounce) need wall time
+            await asyncio.sleep(0.005)
+    assert pred(), "condition not met"
+
+
+# ---------------------------------------------------------------------------
+# exec FSM
+
+@async_test
+async def test_do_task_state_walks_the_fsm():
+    ex = TestExecutor()
+    task = make_task(1)
+    ctl = await ex.controller(task)
+    seen = []
+    while True:
+        st = await do_task_state(task, ctl, 0.0)
+        if st is None or st.state == TaskState.RUNNING:
+            if st is not None:
+                seen.append(st.state)
+            break
+        task = task.copy()
+        task.status = st
+        seen.append(st.state)
+    assert seen == [TaskState.ACCEPTED, TaskState.PREPARING, TaskState.READY,
+                    TaskState.STARTING, TaskState.RUNNING]
+
+
+@async_test
+async def test_do_task_state_shutdown_short_circuits():
+    ex = TestExecutor()
+    task = make_task(1, state=TaskState.RUNNING,
+                     desired=TaskState.SHUTDOWN)
+    ctl = await ex.controller(task)
+    st = await do_task_state(task, ctl, 0.0)
+    assert st.state == TaskState.SHUTDOWN
+
+
+@async_test
+async def test_do_task_state_failure():
+    ex = TestExecutor()
+    ex.fail_start = True
+    task = make_task(1, state=TaskState.STARTING)
+    ctl = await ex.controller(task)
+    st = await do_task_state(task, ctl, 0.0)
+    assert st.state == TaskState.FAILED
+    assert "start failed" in st.err
+
+
+# ---------------------------------------------------------------------------
+# worker
+
+def complete_msg(*tasks, secrets=()):
+    changes = [AssignmentChange(assignment=Assignment(task=t))
+               for t in tasks]
+    changes += [AssignmentChange(assignment=Assignment(secret=s))
+                for s in secrets]
+    return AssignmentsMessage(type=AssignmentsType.COMPLETE, changes=changes)
+
+
+@async_test
+async def test_worker_runs_assigned_task_to_running():
+    ex = TestExecutor()
+    w = Worker(ex)
+    statuses = []
+    w.set_reporter(lambda tid, st: statuses.append((tid, st.state)))
+    await w.assign(complete_msg(make_task(1)))
+    await eventually(lambda: ("task1", TaskState.RUNNING) in statuses)
+    assert w.statuses["task1"].state == TaskState.RUNNING
+    await w.close()
+
+
+@async_test
+async def test_worker_complete_set_removes_unassigned():
+    ex = TestExecutor()
+    w = Worker(ex)
+    w.set_reporter(lambda tid, st: None)
+    await w.assign(complete_msg(make_task(1), make_task(2)))
+    await eventually(lambda: len(w.task_managers) == 2)
+    # a new COMPLETE without task2 shuts it down and forgets it
+    await w.assign(complete_msg(make_task(1)))
+    await eventually(lambda: len(w.task_managers) == 1)
+    assert "task1" in w.task_managers
+    assert w.db.get_task("task2") is None
+    await w.close()
+
+
+@async_test
+async def test_worker_secret_store_follows_assignments():
+    ex = TestExecutor()
+    w = Worker(ex)
+    sec = Secret(id="s1", spec=SecretSpec(annotations=Annotations(name="s1"),
+                                          data=b"x"))
+    await w.assign(complete_msg(make_task(1), secrets=[sec]))
+    assert w.dependencies.secrets.get("s1") is not None
+    await w.assign(AssignmentsMessage(
+        type=AssignmentsType.INCREMENTAL,
+        changes=[AssignmentChange(assignment=Assignment(secret=sec),
+                                  action=AssignmentAction.REMOVE)]))
+    assert w.dependencies.secrets.get("s1") is None
+    await w.close()
+
+
+@async_test
+async def test_worker_resumes_from_db_after_restart():
+    db = TaskDB()
+    ex = TestExecutor()
+    w = Worker(ex, db=db)
+    await w.assign(complete_msg(make_task(1)))
+    await eventually(lambda: w.statuses.get("task1") is not None
+                     and w.statuses["task1"].state == TaskState.RUNNING)
+    await w.close()
+
+    # "restart": new worker over the same db resumes the task
+    ex2 = TestExecutor()
+    w2 = Worker(ex2, db=db)
+    await w2.init()
+    assert "task1" in w2.task_managers
+    # resumed from RUNNING, not from scratch
+    assert w2.task_managers["task1"].task.status.state == TaskState.RUNNING
+    await w2.close()
+
+
+# ---------------------------------------------------------------------------
+# full agent <-> dispatcher loop
+
+async def agent_setup():
+    store = MemoryStore()
+    d = Dispatcher(store, rng=random.Random(0))
+    await store.update(lambda tx: tx.create(
+        Node(id="node1", spec=NodeSpec(annotations=Annotations(name="node1")),
+             status=NodeStatus(state=NodeState.UNKNOWN))))
+    await d.start(mark_unknown=False)
+    ex = TestExecutor()
+    agent = Agent(AgentConfig(node_id="node1", executor=ex,
+                              connect=lambda: d))
+    await agent.start()
+    await agent.ready()
+    return store, d, ex, agent
+
+
+@async_test
+async def test_agent_end_to_end_task_lifecycle():
+    store, d, ex, agent = await agent_setup()
+    # node registered READY with the executor's description
+    await eventually(lambda: store.get("node", "node1").status.state
+                     == NodeState.READY)
+    assert store.get("node", "node1").description.hostname == "testhost"
+
+    # a task assigned in the store flows to the agent and comes back RUNNING
+    await store.update(lambda tx: tx.create(make_task(1)))
+    await eventually(lambda: store.get("task", "task1").status.state
+                     == TaskState.RUNNING)
+
+    # desired SHUTDOWN flows down; agent reports SHUTDOWN
+    def shut(tx):
+        t = tx.get("task", "task1").copy()
+        t.desired_state = int(TaskState.SHUTDOWN)
+        tx.update(t)
+    await store.update(shut)
+    await eventually(lambda: store.get("task", "task1").status.state
+                     == TaskState.SHUTDOWN)
+    await agent.stop()
+    await d.stop()
+
+
+@async_test
+async def test_agent_workload_failure_reported():
+    store, d, ex, agent = await agent_setup()
+    await store.update(lambda tx: tx.create(make_task(1)))
+    await eventually(lambda: store.get("task", "task1").status.state
+                     == TaskState.RUNNING)
+    # the fake workload dies
+    ex.controllers["task1"].exit(fail="boom")
+    await eventually(lambda: store.get("task", "task1").status.state
+                     == TaskState.FAILED)
+    assert "boom" in store.get("task", "task1").status.err
+    await agent.stop()
+    await d.stop()
+
+
+@async_test
+async def test_agent_survives_dispatcher_restart():
+    store, d, ex, agent = await agent_setup()
+    await store.update(lambda tx: tx.create(make_task(1)))
+    await eventually(lambda: store.get("task", "task1").status.state
+                     == TaskState.RUNNING)
+
+    # dispatcher restarts (leadership change)
+    await d.stop()
+    d2 = Dispatcher(store, rng=random.Random(1))
+    await d2.start(mark_unknown=True)
+    agent.config.connect = lambda: d2
+
+    # agent re-registers and the node comes back READY
+    await eventually(lambda: store.get("node", "node1").status.state
+                     == NodeState.READY, ticks=2000)
+    # the running task is still RUNNING (worker kept it; no restart)
+    assert store.get("task", "task1").status.state == TaskState.RUNNING
+    await agent.stop()
+    await d2.stop()
+
+
+@async_test
+async def test_do_task_state_parks_at_ready_until_promoted():
+    """Stop-first updates create replacements at desired READY; the agent
+    must not start them until promoted to RUNNING."""
+    ex = TestExecutor()
+    task = make_task(1, desired=TaskState.READY)
+    ctl = await ex.controller(task)
+    while True:
+        st = await do_task_state(task, ctl, 0.0)
+        if st is None:
+            break
+        task = task.copy()
+        task.status = st
+    assert task.status.state == TaskState.READY
+    # promotion unparks it
+    task = task.copy()
+    task.desired_state = int(TaskState.RUNNING)
+    st = await do_task_state(task, ctl, 0.0)
+    assert st.state == TaskState.STARTING
