@@ -9,10 +9,15 @@ use of filepath.Match-style patterns.
 from __future__ import annotations
 
 import fnmatch
+import re
 from dataclasses import dataclass
 
 EQ = "=="
 NEQ = "!="
+
+# reference: constraint.go alphaNumeric / valuePattern
+_KEY_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9\-_.]+$")
+_VALUE_RE = re.compile(r"^(?i:[a-z0-9:\-_\s.*()?+\[\]\\^$|/]+)$")
 
 
 class InvalidConstraint(ValueError):
@@ -47,6 +52,10 @@ def parse(expressions: list[str]) -> list[Constraint]:
         key, value = parts[0].strip(), parts[1].strip()
         if not key or not value:
             raise InvalidConstraint(f"invalid constraint {expr!r}")
+        if not _KEY_RE.match(key):
+            raise InvalidConstraint(f"invalid constraint key {key!r}")
+        if not _VALUE_RE.match(value):
+            raise InvalidConstraint(f"invalid constraint value {value!r}")
         out.append(Constraint(key=key, operator=op, value=value))
     return out
 

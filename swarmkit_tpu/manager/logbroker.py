@@ -1,0 +1,137 @@
+"""Cluster-wide service logs: subscription fan-out to agents, message relay
+back to API clients.
+
+Reference: manager/logbroker/broker.go (LogBroker :38, SubscribeLogs :224,
+ListenSubscriptions :306 — the agent side, PublishLogs :380) and
+subscription.go (task/node resolution from a LogSelector).  A client's
+SubscribeLogs creates a subscription; every agent whose node runs a matching
+task hears it via ListenSubscriptions, streams its workloads' output through
+PublishLogs, and the broker relays to the client queue.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import AsyncIterator, Optional
+
+from swarmkit_tpu.store.by import ByNode, ByService
+from swarmkit_tpu.store.memory import MemoryStore
+from swarmkit_tpu.utils.identity import new_id
+from swarmkit_tpu.watch.queue import Queue
+
+
+class LogStream(enum.IntEnum):
+    UNKNOWN = 0
+    STDOUT = 1
+    STDERR = 2
+
+
+@dataclass
+class LogContext:
+    service_id: str = ""
+    node_id: str = ""
+    task_id: str = ""
+
+
+@dataclass
+class LogMessage:
+    context: LogContext = field(default_factory=LogContext)
+    timestamp: float = 0.0
+    stream: LogStream = LogStream.STDOUT
+    data: bytes = b""
+
+
+@dataclass
+class LogSelector:
+    service_ids: list[str] = field(default_factory=list)
+    node_ids: list[str] = field(default_factory=list)
+    task_ids: list[str] = field(default_factory=list)
+
+
+@dataclass
+class SubscriptionMessage:
+    id: str = ""
+    selector: LogSelector = field(default_factory=LogSelector)
+    close: bool = False
+    options: dict = field(default_factory=dict)
+
+
+class Subscription:
+    def __init__(self, selector: LogSelector, store: MemoryStore) -> None:
+        self.id = new_id()
+        self.selector = selector
+        self.store = store
+        self.queue: Queue = Queue()
+        self.closed = False
+
+    def node_ids(self) -> set[str]:
+        """Nodes whose agents should feed this subscription
+        (reference: subscription.go match)."""
+        nodes = set(self.selector.node_ids)
+        for tid in self.selector.task_ids:
+            t = self.store.get("task", tid)
+            if t is not None and t.node_id:
+                nodes.add(t.node_id)
+        for sid in self.selector.service_ids:
+            for t in self.store.find("task", ByService(sid)):
+                if t.node_id:
+                    nodes.add(t.node_id)
+        return nodes
+
+    def message(self, close: bool = False) -> SubscriptionMessage:
+        return SubscriptionMessage(id=self.id, selector=self.selector,
+                                   close=close)
+
+
+class LogBroker:
+    def __init__(self, store: MemoryStore) -> None:
+        self.store = store
+        self.subscriptions: dict[str, Subscription] = {}
+        self.subscription_bus: Queue = Queue()  # SubscriptionMessage fan-out
+
+    # -- client side -----------------------------------------------------
+    async def subscribe_logs(self, selector: LogSelector
+                             ) -> AsyncIterator[LogMessage]:
+        """reference: SubscribeLogs broker.go:224."""
+        sub = Subscription(selector, self.store)
+        self.subscriptions[sub.id] = sub
+        watcher = sub.queue.watch()
+        self.subscription_bus.publish(sub.message())
+        try:
+            async for msg in watcher:
+                yield msg
+        finally:
+            watcher.close()
+            sub.closed = True
+            self.subscriptions.pop(sub.id, None)
+            self.subscription_bus.publish(sub.message(close=True))
+
+    # -- agent side ------------------------------------------------------
+    async def listen_subscriptions(self, node_id: str
+                                   ) -> AsyncIterator[SubscriptionMessage]:
+        """reference: ListenSubscriptions broker.go:306 — current matching
+        subscriptions first, then live updates."""
+        watcher = self.subscription_bus.watch()
+        try:
+            for sub in list(self.subscriptions.values()):
+                if node_id in sub.node_ids():
+                    yield sub.message()
+            async for msg in watcher:
+                sub = self.subscriptions.get(msg.id)
+                if msg.close:
+                    yield msg
+                    continue
+                if sub is not None and node_id in sub.node_ids():
+                    yield msg
+        finally:
+            watcher.close()
+
+    async def publish_logs(self, subscription_id: str,
+                           messages: list[LogMessage]) -> None:
+        """reference: PublishLogs broker.go:380."""
+        sub = self.subscriptions.get(subscription_id)
+        if sub is None or sub.closed:
+            return
+        for m in messages:
+            sub.queue.publish(m)

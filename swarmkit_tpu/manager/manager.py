@@ -1,0 +1,240 @@
+"""Manager: builds the raft node + every API service, and flips the
+leader-only control loops on leadership changes.
+
+Reference: manager/manager.go — New (:199) wires raft, store and services;
+Run (:427) registers them (:526-548) and starts raft; leadership events
+(handleLeadershipEvents :846) drive becomeLeader (:906: orchestrators,
+scheduler, allocator, task reaper, constraint enforcer, key manager, role
+manager, dispatcher; plus seeding the default cluster + own node objects
+:931-983) and becomeFollower (:1088).  The dirty-state check mirrors
+manager/dirty.go IsStateDirty.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from swarmkit_tpu.api import (
+    Annotations, Cluster, ClusterSpec, MembershipState, Node as ApiNode,
+    NodeRole, NodeSpec, Peer, WeightedPeer,
+)
+from swarmkit_tpu.api.objects import NodeStatus
+from swarmkit_tpu.manager.allocator import Allocator
+from swarmkit_tpu.manager.controlapi import ControlApi, generate_join_token
+from swarmkit_tpu.manager.dispatcher import Dispatcher
+from swarmkit_tpu.manager.health import HealthServer, HealthStatus
+from swarmkit_tpu.manager.keymanager import KeyManager
+from swarmkit_tpu.manager.logbroker import LogBroker
+from swarmkit_tpu.manager.metrics import Collector
+from swarmkit_tpu.manager.orchestrator.constraintenforcer import (
+    ConstraintEnforcer,
+)
+from swarmkit_tpu.manager.orchestrator.global_ import GlobalOrchestrator
+from swarmkit_tpu.manager.orchestrator.replicated import (
+    ReplicatedOrchestrator,
+)
+from swarmkit_tpu.manager.orchestrator.taskreaper import TaskReaper
+from swarmkit_tpu.manager.resourceapi import ResourceApi
+from swarmkit_tpu.manager.role_manager import RoleManager
+from swarmkit_tpu.manager.scheduler import Scheduler
+from swarmkit_tpu.manager.watchapi import WatchServer
+from swarmkit_tpu.raft.node import LeadershipState, Node as RaftNode, NodeOpts
+from swarmkit_tpu.store.memory import MemoryStore
+from swarmkit_tpu.utils.clock import Clock, SystemClock
+
+log = logging.getLogger("swarmkit_tpu.manager")
+
+DEFAULT_CLUSTER_NAME = "default"   # reference: store.DefaultClusterName
+
+
+class Manager:
+    def __init__(self, node_id: str, addr: str, network, state_dir: str,
+                 clock: Optional[Clock] = None, join_addr: str = "",
+                 force_new_cluster: bool = False,
+                 tick_interval: float = 1.0,
+                 election_tick: int = 10, heartbeat_tick: int = 1,
+                 seed: int = 0) -> None:
+        self.node_id = node_id
+        self.addr = addr
+        self.clock = clock or SystemClock()
+        self.raft = RaftNode(NodeOpts(
+            node_id=node_id, addr=addr, network=network,
+            state_dir=state_dir, clock=self.clock, join_addr=join_addr,
+            force_new_cluster=force_new_cluster,
+            tick_interval=tick_interval, election_tick=election_tick,
+            heartbeat_tick=heartbeat_tick, seed=seed))
+        self.store: MemoryStore = self.raft.store
+
+        # always-on services (reference: manager.go:526-548)
+        self.control_api = ControlApi(self.store, raft=self.raft,
+                                      on_remove_node=self._on_remove_node)
+        self.dispatcher = Dispatcher(
+            self.store, managers_fn=self._weighted_peers, clock=self.clock,
+            peers_queue=self.raft.cluster.broadcast)
+        self.logbroker = LogBroker(self.store)
+        self.watch_server = WatchServer(self.store, proposer=self.raft)
+        self.health = HealthServer()
+        self.metrics = Collector(self.store)
+        self.resource_api = ResourceApi(self.store, clock=self.clock)
+
+        # leader-only control loops, built on becomeLeader
+        self._leader_components: list = []
+        self.role_manager: Optional[RoleManager] = None
+        self._leadership_task: Optional[asyncio.Task] = None
+        self._running = False
+        self._is_leader = False
+
+    # ------------------------------------------------------------------
+    def _weighted_peers(self) -> list[WeightedPeer]:
+        return [WeightedPeer(peer=Peer(node_id=m.node_id, addr=m.addr))
+                for m in self.raft.cluster.members.values()]
+
+    async def _on_remove_node(self, node_id: str) -> None:
+        member = next((m for m in self.raft.cluster.members.values()
+                       if m.node_id == node_id), None)
+        if member is not None:
+            await self.raft.remove_member(member.raft_id)
+
+    def is_leader(self) -> bool:
+        return self.raft.is_leader()
+
+    @property
+    def leader_addr(self) -> str:
+        return self.raft.leader_addr()
+
+    def is_state_dirty(self) -> bool:
+        """reference: manager/dirty.go IsStateDirty — any object beyond the
+        cluster + own node means this store has real state."""
+        count = sum(len(self.store.find(k))
+                    for k in ("service", "task", "network", "secret",
+                              "config", "resource", "extension"))
+        nodes = self.store.find("node")
+        extra_nodes = [n for n in nodes if n.id != self.node_id]
+        return count > 0 or len(extra_nodes) > 0
+
+    # ------------------------------------------------------------------
+    async def start(self) -> None:
+        """reference: manager.Run manager.go:427."""
+        self._running = True
+        leadership = self.raft.leadership.watch()
+        await self.raft.start()
+        await self.metrics.start()
+        self.health.set_serving_status("Raft", HealthStatus.SERVING)
+        self.health.set_serving_status("ControlAPI", HealthStatus.SERVING)
+        self._leadership_task = asyncio.get_running_loop().create_task(
+            self._handle_leadership_events(leadership))
+        # we may already be the leader (single-node bootstrap elects fast)
+        if self.raft.is_leader() and not self._is_leader:
+            await self._become_leader()
+
+    async def stop(self) -> None:
+        self._running = False
+        self.health.shutdown()
+        if self._leadership_task is not None:
+            self._leadership_task.cancel()
+            try:
+                await self._leadership_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._leadership_task = None
+        await self._become_follower()
+        await self.metrics.stop()
+        await self.raft.stop()
+
+    async def _handle_leadership_events(self, watcher) -> None:
+        """reference: handleLeadershipEvents manager.go:846."""
+        try:
+            async for ev in watcher:
+                if not self._running:
+                    return
+                if not isinstance(ev, LeadershipState):
+                    continue
+                # one failed flip (e.g. leadership lost mid-seed, raising
+                # ErrLostLeadership from a proposal) must not kill the
+                # handler — roll back and keep listening
+                try:
+                    if ev.is_leader and not self._is_leader:
+                        await self._become_leader()
+                    elif not ev.is_leader and self._is_leader:
+                        await self._become_follower()
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    log.exception("leadership flip failed; demoting")
+                    try:
+                        await self._become_follower()
+                    except Exception:
+                        log.exception("follower rollback failed")
+        except asyncio.CancelledError:
+            pass
+        except Exception:
+            log.exception("leadership handler crashed")
+
+    # ------------------------------------------------------------------
+    async def _become_leader(self) -> None:
+        """reference: becomeLeader manager.go:906."""
+        log.info("manager %s became leader", self.node_id)
+        self._is_leader = True
+        self.metrics.set_leader(True)
+        await self._seed_defaults()
+
+        sched = Scheduler(self.store, clock=self.clock)
+        replicated = ReplicatedOrchestrator(self.store, clock=self.clock)
+        global_ = GlobalOrchestrator(self.store, clock=self.clock)
+        reaper = TaskReaper(self.store, clock=self.clock)
+        enforcer = ConstraintEnforcer(self.store, clock=self.clock)
+        allocator = Allocator(self.store, clock=self.clock)
+        keymanager = KeyManager(self.store, clock=self.clock)
+        self.role_manager = RoleManager(self.store, self.raft,
+                                        clock=self.clock)
+
+        # allocator first so tasks reach PENDING before scheduling
+        # (reference ordering in becomeLeader)
+        self._leader_components = [allocator, sched, replicated, global_,
+                                   reaper, enforcer, keymanager,
+                                   self.role_manager]
+        for c in self._leader_components:
+            await c.start()
+        await self.dispatcher.start(mark_unknown=True)
+
+    async def _become_follower(self) -> None:
+        """reference: becomeFollower manager.go:1088."""
+        if self._is_leader:
+            log.info("manager %s lost leadership", self.node_id)
+        self._is_leader = False
+        self.metrics.set_leader(False)
+        if self.dispatcher._running:
+            await self.dispatcher.stop()
+        for c in reversed(self._leader_components):
+            try:
+                await c.stop()
+            except Exception:
+                log.exception("stopping leader component %r failed", c)
+        self._leader_components = []
+        self.role_manager = None
+
+    async def _seed_defaults(self) -> None:
+        """Seed the default cluster object and our own node record
+        (reference: becomeLeader manager.go:931-983)."""
+        def txn(tx):
+            clusters = tx.find("cluster")
+            if not clusters:
+                cluster = Cluster(
+                    id="cluster-" + DEFAULT_CLUSTER_NAME,
+                    spec=ClusterSpec(
+                        annotations=Annotations(name=DEFAULT_CLUSTER_NAME)))
+                cluster.root_ca.join_token_worker = generate_join_token()
+                cluster.root_ca.join_token_manager = generate_join_token()
+                tx.create(cluster)
+            if tx.get("node", self.node_id) is None:
+                tx.create(ApiNode(
+                    id=self.node_id,
+                    spec=NodeSpec(
+                        annotations=Annotations(name=self.node_id),
+                        desired_role=NodeRole.MANAGER,
+                        membership=MembershipState.ACCEPTED),
+                    role=NodeRole.MANAGER,
+                    status=NodeStatus()))
+        await self.store.update(txn)

@@ -76,10 +76,19 @@ class ConstraintFilter(Filter):
         if p is None or not p.constraints:
             self._constraints = []
             return False
-        self._constraints = constraint_mod.parse(p.constraints)
+        try:
+            self._constraints = constraint_mod.parse(p.constraints)
+        except constraint_mod.InvalidConstraint:
+            # a stored task with an unparseable constraint (pre-validation
+            # data, WAL replay) must not crash the scheduler loop — stay
+            # active and reject every node so the task parks with an
+            # explanation instead
+            self._constraints = None
         return True
 
     def check(self, info: NodeInfo) -> bool:
+        if self._constraints is None:
+            return False
         return constraint_mod.node_matches(self._constraints, info.node)
 
 

@@ -1,0 +1,239 @@
+"""Control API tests (reference: manager/controlapi/*_test.go)."""
+
+import asyncio
+
+import pytest
+
+from swarmkit_tpu.api import (
+    Annotations, Cluster, ClusterSpec, ConfigSpec, ContainerSpec,
+    GlobalService, Mode, NetworkSpec, Node, NodeAvailability, NodeRole,
+    NodeSpec, NodeState, ReplicatedService, SecretSpec, ServiceSpec,
+    TaskSpec, TaskState,
+)
+from swarmkit_tpu.api.objects import NodeStatus
+from swarmkit_tpu.api.specs import SecretReference
+from swarmkit_tpu.api.types import EndpointSpecRef, PortConfig
+from swarmkit_tpu.manager.controlapi import (
+    AlreadyExists, ControlApi, FailedPrecondition, InvalidArgument, NotFound,
+)
+from swarmkit_tpu.store.memory import MemoryStore
+from tests.conftest import async_test
+
+
+def api():
+    return ControlApi(MemoryStore())
+
+
+def service_spec(name="web", image="nginx", replicas=2, **kw):
+    return ServiceSpec(
+        annotations=Annotations(name=name),
+        task=TaskSpec(container=ContainerSpec(image=image)),
+        replicated=ReplicatedService(replicas=replicas), **kw)
+
+
+@async_test
+async def test_create_service_validation():
+    c = api()
+    with pytest.raises(InvalidArgument):   # no name
+        await c.create_service(ServiceSpec(
+            task=TaskSpec(container=ContainerSpec(image="x"))))
+    with pytest.raises(InvalidArgument):   # bad name
+        await c.create_service(service_spec(name="-bad-"))
+    with pytest.raises(InvalidArgument):   # no image
+        await c.create_service(service_spec(image=""))
+    with pytest.raises(InvalidArgument):   # no container
+        await c.create_service(ServiceSpec(
+            annotations=Annotations(name="x"), task=TaskSpec()))
+    with pytest.raises(InvalidArgument):   # bad constraint
+        spec = service_spec()
+        from swarmkit_tpu.api import Placement
+        spec.task.placement = Placement(constraints=["node.id === x"])
+        await c.create_service(spec)
+    with pytest.raises(InvalidArgument):   # duplicate published port
+        await c.create_service(service_spec(endpoint=EndpointSpecRef(ports=[
+            PortConfig(protocol="tcp", target_port=80, published_port=8080),
+            PortConfig(protocol="tcp", target_port=81, published_port=8080),
+        ])))
+
+    svc = await c.create_service(service_spec())
+    assert c.get_service(svc.id).spec.annotations.name == "web"
+    with pytest.raises(AlreadyExists):     # duplicate name
+        await c.create_service(service_spec())
+
+
+@async_test
+async def test_create_service_unknown_secret_rejected():
+    c = api()
+    spec = service_spec()
+    spec.task.container.secrets = [SecretReference(secret_id="nope")]
+    with pytest.raises(InvalidArgument):
+        await c.create_service(spec)
+
+
+@async_test
+async def test_update_service_version_and_mode_gates():
+    c = api()
+    svc = await c.create_service(service_spec())
+    cur = c.get_service(svc.id)
+
+    spec2 = service_spec(replicas=5)
+    updated = await c.update_service(svc.id, spec2,
+                                     version=cur.meta.version.index)
+    assert updated.spec.replicated.replicas == 5
+    assert updated.previous_spec.replicated.replicas == 2
+
+    # stale version rejected
+    with pytest.raises(FailedPrecondition):
+        await c.update_service(svc.id, service_spec(replicas=7),
+                               version=cur.meta.version.index)
+    # mode change rejected
+    gspec = ServiceSpec(annotations=Annotations(name="web"),
+                        task=TaskSpec(container=ContainerSpec(image="x")),
+                        mode=Mode.GLOBAL, global_=GlobalService())
+    with pytest.raises(InvalidArgument):
+        await c.update_service(svc.id, gspec)
+    # rename rejected
+    with pytest.raises(InvalidArgument):
+        await c.update_service(svc.id, service_spec(name="web2"))
+
+
+@async_test
+async def test_remove_service():
+    c = api()
+    svc = await c.create_service(service_spec())
+    await c.remove_service(svc.id)
+    with pytest.raises(NotFound):
+        c.get_service(svc.id)
+    with pytest.raises(NotFound):
+        await c.remove_service(svc.id)
+
+
+@async_test
+async def test_node_remove_gates():
+    c = api()
+    store = c.store
+    mk = lambda i, role, state: Node(
+        id=f"n{i}", spec=NodeSpec(annotations=Annotations(name=f"n{i}"),
+                                  desired_role=role),
+        role=role, status=NodeStatus(state=state))
+    await store.update(lambda tx: [
+        tx.create(mk(1, NodeRole.MANAGER, NodeState.READY)),
+        tx.create(mk(2, NodeRole.WORKER, NodeState.READY)),
+        tx.create(mk(3, NodeRole.WORKER, NodeState.DOWN)),
+    ])
+    with pytest.raises(FailedPrecondition):   # manager can't be removed
+        await c.remove_node("n1")
+    with pytest.raises(FailedPrecondition):   # ready worker needs force
+        await c.remove_node("n2")
+    await c.remove_node("n2", force=True)
+    await c.remove_node("n3")                 # down worker is fine
+    assert [n.id for n in c.list_nodes()] == ["n1"]
+
+
+@async_test
+async def test_demote_last_manager_rejected():
+    c = api()
+    n = Node(id="n1", spec=NodeSpec(annotations=Annotations(name="n1"),
+                                    desired_role=NodeRole.MANAGER),
+             role=NodeRole.MANAGER, status=NodeStatus(state=NodeState.READY))
+    await c.store.update(lambda tx: tx.create(n))
+    spec = n.spec.copy()
+    spec.desired_role = NodeRole.WORKER
+    with pytest.raises(FailedPrecondition):
+        await c.update_node("n1", spec)
+
+
+@async_test
+async def test_network_remove_in_use_rejected():
+    c = api()
+    net = await c.create_network(NetworkSpec(
+        annotations=Annotations(name="overlay1")))
+    svc = await c.create_service(service_spec(networks=[net.id]))
+    with pytest.raises(FailedPrecondition):
+        await c.remove_network(net.id)
+    await c.remove_service(svc.id)
+    await c.remove_network(net.id)
+    with pytest.raises(NotFound):
+        c.get_network(net.id)
+
+
+@async_test
+async def test_secret_lifecycle_and_redaction():
+    c = api()
+    with pytest.raises(InvalidArgument):   # empty data
+        await c.create_secret(SecretSpec(annotations=Annotations(name="s")))
+    with pytest.raises(InvalidArgument):   # too big
+        await c.create_secret(SecretSpec(
+            annotations=Annotations(name="s"), data=b"x" * (501 * 1024)))
+    sec = await c.create_secret(SecretSpec(
+        annotations=Annotations(name="s"), data=b"payload"))
+    # reads redact the payload; the store keeps it
+    assert c.get_secret(sec.id).spec.data == b""
+    assert c.list_secrets()[0].spec.data == b""
+    assert c.store.get("secret", sec.id).spec.data == b"payload"
+
+    # only label updates allowed
+    with pytest.raises(InvalidArgument):
+        await c.update_secret(sec.id, SecretSpec(
+            annotations=Annotations(name="s"), data=b"other"))
+    upd = await c.update_secret(sec.id, SecretSpec(
+        annotations=Annotations(name="s", labels={"env": "prod"})))
+    assert upd.spec.annotations.labels == {"env": "prod"}
+
+    # in-use secrets cannot be removed
+    spec = service_spec()
+    spec.task.container.secrets = [SecretReference(secret_id=sec.id,
+                                                   secret_name="s")]
+    svc = await c.create_service(spec)
+    with pytest.raises(FailedPrecondition):
+        await c.remove_secret(sec.id)
+    await c.remove_service(svc.id)
+    await c.remove_secret(sec.id)
+
+
+@async_test
+async def test_cluster_update_and_token_rotation():
+    c = api()
+    cl = Cluster(id="c1", spec=ClusterSpec(
+        annotations=Annotations(name="default")))
+    cl.root_ca.join_token_worker = "SWMTKN-1-old-worker"
+    cl.root_ca.join_token_manager = "SWMTKN-1-old-manager"
+    await c.store.update(lambda tx: tx.create(cl))
+
+    got = c.get_cluster()
+    assert got.id == "c1"
+    spec = got.spec.copy()
+    spec.raft.snapshot_interval = 5000
+    updated = await c.update_cluster("c1", spec,
+                                     version=got.meta.version.index,
+                                     rotate_worker_token=True)
+    assert updated.spec.raft.snapshot_interval == 5000
+    assert updated.root_ca.join_token_worker != "SWMTKN-1-old-worker"
+    assert updated.root_ca.join_token_worker.startswith("SWMTKN-1-")
+    assert updated.root_ca.join_token_manager == "SWMTKN-1-old-manager"
+
+
+@async_test
+async def test_extension_resource_lifecycle():
+    c = api()
+    ext = await c.create_extension(Annotations(name="widgets"))
+    res = await c.create_resource(Annotations(name="w1"), "widgets",
+                                  payload=b"{}")
+    with pytest.raises(InvalidArgument):   # unknown kind
+        await c.create_resource(Annotations(name="w2"), "nope")
+    with pytest.raises(FailedPrecondition):  # in use
+        await c.remove_extension(ext.id)
+    await c.remove_resource(res.id)
+    await c.remove_extension(ext.id)
+
+
+@async_test
+async def test_list_filters():
+    c = api()
+    await c.create_service(service_spec(name="web-a"))
+    await c.create_service(service_spec(name="web-b"))
+    await c.create_service(service_spec(name="api"))
+    assert len(c.list_services()) == 3
+    assert len(c.list_services(name_prefixes=["web-"])) == 2
+    assert [s.spec.annotations.name
+            for s in c.list_services(names=["api"])] == ["api"]

@@ -1,0 +1,237 @@
+"""Small manager services: keymanager, role manager, watch API, log broker,
+metrics, resource API.
+
+Reference scenarios: manager/keymanager/keymanager_test.go,
+manager/role_manager_test.go, manager/watchapi/watch_test.go,
+manager/logbroker/broker_test.go.
+"""
+
+import asyncio
+
+import pytest
+
+from swarmkit_tpu.api import (
+    Annotations, Cluster, ClusterSpec, Network, NetworkSpec, Node, NodeRole,
+    NodeSpec, NodeState, Task, TaskSpec, TaskState, TaskStatus,
+)
+from swarmkit_tpu.api.objects import NodeStatus
+from swarmkit_tpu.manager.keymanager import KeyManager, KEYRING_SIZE
+from swarmkit_tpu.manager.logbroker import (
+    LogBroker, LogMessage, LogSelector, LogStream,
+)
+from swarmkit_tpu.manager.metrics import Collector
+from swarmkit_tpu.manager.resourceapi import ResourceApi, ResourceError
+from swarmkit_tpu.manager.watchapi import WatchSelector, WatchServer
+from swarmkit_tpu.store.memory import MemoryStore
+from swarmkit_tpu.utils.clock import FakeClock
+from tests.conftest import async_test
+
+
+async def pump(steps=10):
+    for _ in range(steps):
+        await asyncio.sleep(0)
+
+
+@async_test
+async def test_keymanager_seeds_and_rotates():
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    await store.update(lambda tx: tx.create(Cluster(
+        id="c1", spec=ClusterSpec(annotations=Annotations(name="default")))))
+    km = KeyManager(store, clock=clock, rotation_interval=10.0)
+    await km.start()
+    cl = store.get("cluster", "c1")
+    subsystems = {k.subsystem for k in cl.network_bootstrap_keys}
+    assert subsystems == {"networking:gossip", "networking:ipsec"}
+    lamport0 = cl.encryption_key_lamport_clock
+
+    # rotation adds new primaries and trims the ring
+    for _ in range(4):
+        await clock.advance(10.0)
+        await pump()
+    cl = store.get("cluster", "c1")
+    assert cl.encryption_key_lamport_clock > lamport0
+    per_subsys = {}
+    for k in cl.network_bootstrap_keys:
+        per_subsys.setdefault(k.subsystem, []).append(k)
+    for ring in per_subsys.values():
+        assert len(ring) <= KEYRING_SIZE
+    await km.stop()
+
+
+@async_test
+async def test_role_manager_promote_and_demote():
+    from swarmkit_tpu.manager.role_manager import RoleManager
+
+    class FakeMember:
+        def __init__(self, raft_id, node_id):
+            self.raft_id, self.node_id, self.addr = raft_id, node_id, ""
+
+    class FakeRaft:
+        def __init__(self):
+            self.raft_id = 1
+            self.removed = []
+            self.cluster = type("C", (), {})()
+            self.cluster.members = {1: FakeMember(1, "n1"),
+                                    2: FakeMember(2, "n2")}
+
+        def can_remove_member(self, raft_id):
+            return True
+
+        async def remove_member(self, raft_id):
+            self.removed.append(raft_id)
+            self.cluster.members.pop(raft_id, None)
+
+        async def transfer_leadership(self):
+            raise RuntimeError("no transfer in test")
+
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    raft = FakeRaft()
+    mk = lambda i, role, desired: Node(
+        id=f"n{i}", spec=NodeSpec(annotations=Annotations(name=f"n{i}"),
+                                  desired_role=desired),
+        role=role, status=NodeStatus(state=NodeState.READY))
+    await store.update(lambda tx: [
+        tx.create(mk(1, NodeRole.MANAGER, NodeRole.MANAGER)),
+        tx.create(mk(2, NodeRole.MANAGER, NodeRole.MANAGER)),
+        tx.create(mk(3, NodeRole.WORKER, NodeRole.WORKER)),
+    ])
+    rm = RoleManager(store, raft, clock=clock)
+    await rm.start()
+    await pump()
+
+    # promote n3
+    def promote(tx):
+        n = tx.get("node", "n3").copy()
+        n.spec.desired_role = NodeRole.MANAGER
+        tx.update(n)
+    await store.update(promote)
+    await clock.advance(17.0)
+    await pump()
+    assert store.get("node", "n3").role == NodeRole.MANAGER
+
+    # demote n2: first pass removes the raft member, next flips the role
+    def demote(tx):
+        n = tx.get("node", "n2").copy()
+        n.spec.desired_role = NodeRole.WORKER
+        tx.update(n)
+    await store.update(demote)
+    for _ in range(3):
+        await clock.advance(17.0)
+        await pump()
+    assert raft.removed == [2]
+    assert store.get("node", "n2").role == NodeRole.WORKER
+    await rm.stop()
+
+
+@async_test
+async def test_watchapi_filters_and_versions():
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    ws = WatchServer(store)
+    got = []
+
+    async def consume():
+        async for m in ws.watch([WatchSelector(kind="task")],
+                                include_old_object=True):
+            got.append(m)
+
+    c = asyncio.get_running_loop().create_task(consume())
+    await pump()
+    await store.update(lambda tx: tx.create(Task(
+        id="t1", spec=TaskSpec(), status=TaskStatus())))
+    await store.update(lambda tx: tx.create(Node(
+        id="n1", spec=NodeSpec(annotations=Annotations(name="n1")))))
+
+    def upd(tx):
+        t = tx.get("task", "t1").copy()
+        t.status.state = TaskState.RUNNING
+        tx.update(t)
+    await store.update(upd)
+    await pump()
+    assert [(m.action, m.kind) for m in got] == [
+        ("create", "task"), ("update", "task")]
+    assert got[1].old_object.status.state == TaskState.NEW
+    assert got[1].version > got[0].version > 0
+    c.cancel()
+
+
+@async_test
+async def test_logbroker_round_trip():
+    store = MemoryStore()
+    await store.update(lambda tx: [
+        tx.create(Task(id="t1", node_id="n1", service_id="svc1",
+                       spec=TaskSpec(),
+                       status=TaskStatus(state=TaskState.RUNNING))),
+    ])
+    lb = LogBroker(store)
+
+    client_msgs = []
+
+    async def client():
+        async for m in lb.subscribe_logs(LogSelector(service_ids=["svc1"])):
+            client_msgs.append(m)
+            if len(client_msgs) >= 2:
+                return
+
+    agent_subs = []
+
+    async def agent():
+        async for sub in lb.listen_subscriptions("n1"):
+            if sub.close:
+                continue
+            agent_subs.append(sub)
+            await lb.publish_logs(sub.id, [
+                LogMessage(stream=LogStream.STDOUT, data=b"hello"),
+                LogMessage(stream=LogStream.STDERR, data=b"world"),
+            ])
+
+    loop = asyncio.get_running_loop()
+    at = loop.create_task(agent())
+    await pump()
+    ct = loop.create_task(client())
+    await asyncio.wait_for(ct, timeout=5)
+    assert [m.data for m in client_msgs] == [b"hello", b"world"]
+    assert len(agent_subs) == 1
+    at.cancel()
+
+
+@async_test
+async def test_metrics_collector_counts():
+    store = MemoryStore()
+    coll = Collector(store)
+    await coll.start()
+    await store.update(lambda tx: [
+        tx.create(Node(id="n1", spec=NodeSpec(
+            annotations=Annotations(name="n1")),
+            status=NodeStatus(state=NodeState.READY))),
+        tx.create(Task(id="t1", spec=TaskSpec(),
+                       status=TaskStatus(state=TaskState.RUNNING))),
+    ])
+    await pump()
+    snap = coll.snapshot()
+    assert snap["swarm_node_ready"] == 1
+    assert snap["swarm_task_running"] == 1
+    coll.set_leader(True)
+    assert coll.snapshot()["swarm_manager_leader"] == 1.0
+    await coll.stop()
+
+
+@async_test
+async def test_resourceapi_attach_detach():
+    store = MemoryStore()
+    api = ResourceApi(store)
+    await store.update(lambda tx: [
+        tx.create(Network(id="net1", spec=NetworkSpec(
+            annotations=Annotations(name="overlay")))),
+        tx.create(Node(id="n1", spec=NodeSpec(
+            annotations=Annotations(name="n1")))),
+    ])
+    with pytest.raises(ResourceError):
+        await api.attach_network("n1", "missing")
+    tid = await api.attach_network("n1", "net1", container_id="abc")
+    t = store.get("task", tid)
+    assert t.node_id == "n1" and t.spec.networks == ["net1"]
+    await api.detach_network(tid)
+    assert store.get("task", tid) is None
