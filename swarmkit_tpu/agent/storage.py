@@ -17,6 +17,10 @@ from swarmkit_tpu.api import Task, TaskStatus
 
 class TaskDB:
     def __init__(self, path: str = ":memory:") -> None:
+        if path != ":memory:":
+            import os
+
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._db = sqlite3.connect(path)
         self._db.execute(
             "CREATE TABLE IF NOT EXISTS tasks ("

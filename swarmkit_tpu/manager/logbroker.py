@@ -94,18 +94,44 @@ class LogBroker:
     async def subscribe_logs(self, selector: LogSelector
                              ) -> AsyncIterator[LogMessage]:
         """reference: SubscribeLogs broker.go:224."""
+        import asyncio
+
         sub = Subscription(selector, self.store)
         self.subscriptions[sub.id] = sub
         watcher = sub.queue.watch()
         self.subscription_bus.publish(sub.message())
+        # re-announce when the service's tasks land on new nodes, so agents
+        # that start matching after the subscribe pick it up
+        # (reference: subscription.Run watches task events)
+        refresher = asyncio.get_running_loop().create_task(
+            self._refresh_subscription(sub))
         try:
             async for msg in watcher:
                 yield msg
         finally:
+            refresher.cancel()
             watcher.close()
             sub.closed = True
             self.subscriptions.pop(sub.id, None)
             self.subscription_bus.publish(sub.message(close=True))
+
+    async def _refresh_subscription(self, sub: Subscription) -> None:
+        import asyncio
+
+        from swarmkit_tpu.store.memory import Event, match
+
+        known = sub.node_ids()
+        watcher = self.store.watch(match(kind="task"))
+        try:
+            async for ev in watcher:
+                now = sub.node_ids()
+                if now - known:
+                    self.subscription_bus.publish(sub.message())
+                known = now
+        except asyncio.CancelledError:
+            pass
+        finally:
+            watcher.close()
 
     # -- agent side ------------------------------------------------------
     async def listen_subscriptions(self, node_id: str

@@ -83,6 +83,7 @@ class Manager:
         self._leader_components: list = []
         self.role_manager: Optional[RoleManager] = None
         self._leadership_task: Optional[asyncio.Task] = None
+        self._members_task: Optional[asyncio.Task] = None
         self._running = False
         self._is_leader = False
 
@@ -198,6 +199,45 @@ class Manager:
         for c in self._leader_components:
             await c.start()
         await self.dispatcher.start(mark_unknown=True)
+        # node records for raft members: the reference's CA server creates
+        # these when issuing certs to joiners (ca/server.go
+        # IssueNodeCertificate); until a node-side CA join flow runs, the
+        # leader reconciles them from the member list.  Watch BEFORE the
+        # initial reconcile so a join during the first write isn't lost.
+        members_watcher = self.raft.cluster.broadcast.watch()
+        await self._ensure_member_node_records()
+        self._members_task = asyncio.get_running_loop().create_task(
+            self._watch_members(members_watcher))
+
+    async def _ensure_member_node_records(self) -> None:
+        members = list(self.raft.cluster.members.values())
+
+        def txn(tx):
+            for m in members:
+                if not m.node_id or tx.get("node", m.node_id) is not None:
+                    continue
+                tx.create(ApiNode(
+                    id=m.node_id,
+                    spec=NodeSpec(
+                        annotations=Annotations(name=m.node_id),
+                        desired_role=NodeRole.MANAGER,
+                        membership=MembershipState.ACCEPTED),
+                    role=NodeRole.MANAGER,
+                    status=NodeStatus()))
+        await self.store.update(txn)
+
+    async def _watch_members(self, watcher) -> None:
+        try:
+            async for _ in watcher:
+                if not self._is_leader:
+                    return
+                await self._ensure_member_node_records()
+        except asyncio.CancelledError:
+            pass
+        except Exception:
+            log.exception("member watch crashed")
+        finally:
+            watcher.close()
 
     async def _become_follower(self) -> None:
         """reference: becomeFollower manager.go:1088."""
@@ -205,6 +245,13 @@ class Manager:
             log.info("manager %s lost leadership", self.node_id)
         self._is_leader = False
         self.metrics.set_leader(False)
+        if self._members_task is not None:
+            self._members_task.cancel()
+            try:
+                await self._members_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._members_task = None
         if self.dispatcher._running:
             await self.dispatcher.stop()
         for c in reversed(self._leader_components):

@@ -24,11 +24,6 @@ class ResourceApi:
 
     async def attach_network(self, node_id: str, network_id: str,
                              container_id: str = "") -> str:
-        net = self.store.get("network", network_id)
-        if net is None:
-            raise ResourceError(f"network {network_id} not found")
-        if self.store.get("node", node_id) is None:
-            raise ResourceError(f"node {node_id} not found")
         task = Task(
             id=new_id(), node_id=node_id,
             spec=TaskSpec(networks=[network_id]),
@@ -36,7 +31,16 @@ class ResourceApi:
                               message="network attachment requested"),
             desired_state=int(TaskState.RUNNING))
         task.annotations.labels["attachment-container"] = container_id
-        await self.store.update(lambda tx: tx.create(task))
+
+        def txn(tx):
+            # existence checks inside the txn so a concurrent
+            # remove_network/remove_node cannot slip between check+commit
+            if tx.get("network", network_id) is None:
+                raise ResourceError(f"network {network_id} not found")
+            if tx.get("node", node_id) is None:
+                raise ResourceError(f"node {node_id} not found")
+            tx.create(task)
+        await self.store.update(txn)
         return task.id
 
     async def detach_network(self, attachment_id: str) -> None:

@@ -1,0 +1,4 @@
+from swarmkit_tpu.node.node import Node, NodeConfig
+from swarmkit_tpu.node.remotes import Remotes
+
+__all__ = ["Node", "NodeConfig", "Remotes"]

@@ -1,0 +1,80 @@
+"""Chooses which manager an agent-side RPC talks to.
+
+Reference: connectionbroker/broker.go (123 LoC) — prefer the local manager
+when this node runs one (the reference dials the local socket and lets the
+generated raft proxies forward to the leader); otherwise pick a remote from
+the weighted address book.  In this in-process build the "dial" is a
+``dialer(addr) -> Manager`` lookup, and instead of RPC-level proxying we
+resolve to the current LEADER's dispatcher directly (the proxy's net
+effect).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+from swarmkit_tpu.node.remotes import Remotes
+
+log = logging.getLogger("swarmkit_tpu.connectionbroker")
+
+
+class NoManagerError(Exception):
+    pass
+
+
+class ConnectionBroker:
+    def __init__(self, remotes: Remotes,
+                 dialer: Callable[[str], Optional[object]],
+                 local_manager: Callable[[], Optional[object]] = lambda: None
+                 ) -> None:
+        self.remotes = remotes
+        self.dialer = dialer
+        self.local_manager = local_manager
+
+    def _leader_of(self, manager) -> Optional[object]:
+        """Resolve a manager to the cluster leader's Manager object."""
+        if manager is None:
+            return None
+        try:
+            if manager.is_leader():
+                return manager
+            leader_addr = manager.leader_addr
+        except Exception:
+            return None
+        if not leader_addr:
+            return None
+        return self.dialer(leader_addr)
+
+    def select_dispatcher(self):
+        """The leader's dispatcher, preferring the local manager as the
+        route in (reference: broker.Select, local socket first)."""
+        candidates = []
+        local = self.local_manager()
+        if local is not None:
+            candidates.append(local)
+        tried = set()
+        for addr in sorted(self.remotes.weights(),
+                           key=lambda a: -self.remotes.weights()[a]):
+            m = self.dialer(addr)
+            if m is not None and id(m) not in tried:
+                candidates.append(m)
+                tried.add(id(m))
+        for m in candidates:
+            leader = self._leader_of(m)
+            if leader is not None:
+                return leader.dispatcher
+        raise NoManagerError("cannot locate the cluster leader")
+
+    def select_control(self):
+        """The leader's control API (for promotions, harness use)."""
+        local = self.local_manager()
+        for m in [local] if local is not None else []:
+            leader = self._leader_of(m)
+            if leader is not None:
+                return leader.control_api
+        for addr in self.remotes.weights():
+            leader = self._leader_of(self.dialer(addr))
+            if leader is not None:
+                return leader.control_api
+        raise NoManagerError("cannot locate the cluster leader")

@@ -1,0 +1,197 @@
+"""Node lifecycle: one process-level member running an agent and, when its
+role demands, a manager — with automatic promotion/demotion.
+
+Reference: node/node.go (1352 LoC) — New (:194), Start (:251), run (:272):
+load identity, start the agent (runAgent :559), supervise the manager
+(superviseManager :1080: waitRole("manager") → runManager), tear the
+manager down on demotion.  The reference learns its role from certificate
+renewals; here the role arrives on the dispatcher session's node object
+(the CA layer adds the certificate path on top of this seam).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from swarmkit_tpu.agent import Agent, AgentConfig
+from swarmkit_tpu.agent.exec import Executor
+from swarmkit_tpu.api import NodeRole, Peer
+from swarmkit_tpu.manager.manager import Manager
+from swarmkit_tpu.node.connectionbroker import ConnectionBroker
+from swarmkit_tpu.node.remotes import Remotes
+from swarmkit_tpu.utils.clock import Clock, SystemClock
+
+log = logging.getLogger("swarmkit_tpu.node")
+
+
+@dataclass
+class NodeConfig:
+    """reference: node.Config node/node.go:194."""
+
+    node_id: str
+    state_dir: str
+    executor: Executor
+    network: object                      # raft transport Network
+    dialer: Callable[[str], Optional[Manager]]   # addr -> Manager lookup
+    listen_addr: str = ""
+    join_addr: str = ""
+    join_token: str = ""
+    is_manager: bool = False             # initial role
+    force_new_cluster: bool = False
+    tick_interval: float = 1.0
+    election_tick: int = 10
+    heartbeat_tick: int = 1
+    clock: Optional[Clock] = None
+    seed: int = 0
+
+
+class Node:
+    def __init__(self, config: NodeConfig) -> None:
+        self.config = config
+        self.clock = config.clock or SystemClock()
+        self.node_id = config.node_id
+        self.addr = config.listen_addr or f"{config.node_id}:4242"
+        self.manager: Optional[Manager] = None
+        self.remotes = Remotes()
+        if config.join_addr:
+            self.remotes.observe(Peer(addr=config.join_addr))
+        self.broker = ConnectionBroker(
+            self.remotes, config.dialer, lambda: self._running_manager())
+        self.agent: Optional[Agent] = None
+        self._desired_manager = config.is_manager
+        self._role_evt = asyncio.Event()
+        self._supervisor: Optional[asyncio.Task] = None
+        self._running = False
+
+    # ------------------------------------------------------------------
+    def _running_manager(self) -> Optional[Manager]:
+        m = self.manager
+        return m if m is not None and m._running else None
+
+    def is_manager(self) -> bool:
+        return self._running_manager() is not None
+
+    def is_leader(self) -> bool:
+        m = self._running_manager()
+        return m is not None and m.is_leader()
+
+    # ------------------------------------------------------------------
+    async def start(self) -> None:
+        """reference: node.Start node/node.go:251 → run :272."""
+        self._running = True
+        if self.config.is_manager:
+            await self._start_manager()
+        self.agent = Agent(AgentConfig(
+            node_id=self.node_id,
+            executor=self.config.executor,
+            connect=self.broker.select_dispatcher,
+            addr=self.addr,
+            db_path=os.path.join(self.config.state_dir, "tasks.db")
+            if self.config.state_dir != ":memory:" else ":memory:",
+            clock=self.clock,
+            on_node_change=self._on_node_change,
+            on_managers_change=self._on_managers_change))
+        await self.agent.start()
+        self._supervisor = asyncio.get_running_loop().create_task(
+            self._supervise_manager())
+
+    async def stop(self) -> None:
+        self._running = False
+        if self._supervisor is not None:
+            self._supervisor.cancel()
+            try:
+                await self._supervisor
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._supervisor = None
+        if self.agent is not None:
+            await self.agent.stop()
+            self.agent = None
+        if self.manager is not None:
+            await self.manager.stop()
+            self.manager = None
+
+    # ------------------------------------------------------------------
+    def _on_node_change(self, node) -> None:
+        """Role flips observed via the session stream
+        (reference: the cert-renewal waitRole seam node/node.go:933)."""
+        want = node.role == NodeRole.MANAGER
+        if want != self._desired_manager:
+            self._desired_manager = want
+            self._role_evt.set()
+
+    def _on_managers_change(self, managers) -> None:
+        for wp in managers:
+            self.remotes.observe(wp.peer)
+
+    async def _supervise_manager(self) -> None:
+        """reference: superviseManager node/node.go:1080."""
+        try:
+            while self._running:
+                await self._role_evt.wait()
+                self._role_evt.clear()
+                if self._desired_manager and self.manager is None:
+                    log.info("node %s promoted; starting manager",
+                             self.node_id)
+                    try:
+                        await self._start_manager(join=True)
+                    except Exception:
+                        log.exception("manager start failed; will retry")
+                        if self.manager is not None:
+                            try:
+                                await self.manager.stop()
+                            except Exception:
+                                pass
+                            self.manager = None
+                        self._role_evt.set()
+                        await self.clock.sleep(1.0)
+                elif not self._desired_manager and self.manager is not None:
+                    log.info("node %s demoted; stopping manager",
+                             self.node_id)
+                    m, self.manager = self.manager, None
+                    await m.stop()
+        except asyncio.CancelledError:
+            pass
+
+    async def _start_manager(self, join: bool = False) -> None:
+        join_addr = self.config.join_addr
+        if join:
+            # join via the current leader if we know one
+            join_addr = self._leader_addr() or join_addr
+        state_dir = self.config.state_dir
+        if state_dir == ":memory:":
+            # raft storage is always file-backed; give ephemeral nodes a
+            # throwaway dir instead of a literal ":memory:" path in cwd
+            import tempfile
+
+            self._ephemeral_dir = tempfile.TemporaryDirectory(
+                prefix=f"swarmkit-{self.node_id}-")
+            state_dir = self._ephemeral_dir.name
+        # raft storage appends its own "raft" subdir (raft/storage.py)
+        self.manager = Manager(
+            node_id=self.node_id, addr=self.addr,
+            network=self.config.network, state_dir=state_dir,
+            clock=self.clock, join_addr=join_addr,
+            force_new_cluster=self.config.force_new_cluster,
+            tick_interval=self.config.tick_interval,
+            election_tick=self.config.election_tick,
+            heartbeat_tick=self.config.heartbeat_tick,
+            seed=self.config.seed)
+        await self.manager.start()
+
+    def _leader_addr(self) -> str:
+        for addr in self.remotes.weights():
+            m = self.config.dialer(addr)
+            if m is not None:
+                try:
+                    if m.is_leader():
+                        return m.addr
+                    if m.leader_addr:
+                        return m.leader_addr
+                except Exception:
+                    continue
+        return ""

@@ -1,0 +1,189 @@
+"""In-process integration suite: full Nodes (manager+agent) over a shared
+raft network.
+
+Reference scenarios: integration/integration_test.go (:183-908) — cluster
+create, service create, node ops, demote/promote matrices incl. demoting
+the leader, restart leader, force-new-cluster, node rejoin.
+"""
+
+import asyncio
+
+import pytest
+
+from swarmkit_tpu.api import NodeRole, NodeState, TaskState
+from swarmkit_tpu.store.by import ByService
+from tests.conftest import async_test
+from tests.integration_harness import TestCluster
+
+
+@async_test
+async def test_cluster_and_service_create():
+    """reference: TestClusterCreate + TestServiceCreate."""
+    c = TestCluster()
+    try:
+        await c.add_manager()
+        await c.add_agent()
+        await c.add_agent()
+        await c.poll_cluster_ready(managers=1, workers=2)
+
+        svc = await c.create_service(replicas=4)
+        await c.poll(lambda: len(c.running_tasks(svc.id)) == 4,
+                     "4 replicas running")
+        used = {t.node_id for t in c.running_tasks(svc.id)}
+        assert len(used) >= 2  # spread over the workers (manager also runs)
+    finally:
+        await c.stop_all()
+
+
+@async_test
+async def test_multi_manager_replication_and_leader_restart():
+    """reference: TestRestartLeader integration_test.go."""
+    c = TestCluster()
+    try:
+        await c.add_manager("m1")
+        await c.add_manager("m2")
+        await c.add_manager("m3")
+        lead = await c.wait_leader()
+        assert lead.node_id == "m1"
+        svc = await c.create_service(replicas=2)
+        await c.poll(lambda: len(c.running_tasks(svc.id)) == 2,
+                     "2 replicas running")
+
+        await c.stop_node(lead.node_id)
+        new_lead = await c.poll(
+            lambda: (l := c.leader()) is not None
+            and l.node_id != "m1" and l or None,
+            "failover leader", timeout=30)
+        # cluster still serves reads and writes
+        assert new_lead.store.get("service", svc.id) is not None
+        svc2 = await c.create_service(name="after-failover")
+        assert new_lead.store.get("service", svc2.id) is not None
+
+        # the old leader comes back as a follower and catches up
+        await c.restart_node("m1")
+        m1 = c.nodes["m1"]
+        await c.poll(
+            lambda: m1._running_manager() is not None
+            and m1._running_manager().store.get("service", svc2.id)
+            is not None,
+            "restarted leader caught up", timeout=30)
+    finally:
+        await c.stop_all()
+
+
+@async_test
+async def test_promote_agent_to_manager_and_demote():
+    """reference: TestDemotePromote / TestPromoteDemote."""
+    c = TestCluster()
+    try:
+        await c.add_manager("m1")
+        await c.add_agent("a1")
+        await c.poll_cluster_ready(managers=1, workers=1)
+
+        await c.set_node_role("a1", NodeRole.MANAGER)
+        # role manager flips role; node supervisor starts a manager
+        a1 = c.nodes["a1"]
+        await c.poll(lambda: a1.is_manager() or None,
+                     "a1 running a manager", timeout=30)
+        lead = c.leader()
+        await c.poll(lambda: len(lead.raft.cluster.members) == 2,
+                     "raft membership grew to 2", timeout=30)
+
+        # demote: raft member removed, manager stops
+        await c.set_node_role("a1", NodeRole.WORKER)
+        await c.poll(lambda: not a1.is_manager() or None,
+                     "a1 manager stopped", timeout=40)
+        await c.poll(lambda: len(c.leader().raft.cluster.members) == 1,
+                     "raft membership back to 1", timeout=30)
+        # still a functional worker
+        svc = await c.create_service(replicas=2)
+        await c.poll(lambda: len(c.running_tasks(svc.id)) == 2,
+                     "tasks running after demote")
+    finally:
+        await c.stop_all()
+
+
+@async_test
+async def test_demote_leader():
+    """reference: TestDemoteLeader — demoting the leader transfers
+    leadership and removes it from the member list."""
+    c = TestCluster()
+    try:
+        await c.add_manager("m1")
+        await c.add_manager("m2")
+        await c.add_manager("m3")
+        lead = await c.wait_leader()
+        assert lead.node_id == "m1"
+
+        await c.set_node_role("m1", NodeRole.WORKER)
+        new_lead = await c.poll(
+            lambda: (l := c.leader()) is not None and l.node_id != "m1"
+            and l or None,
+            "leadership moved off m1", timeout=40)
+        await c.poll(
+            lambda: len(new_lead.raft.cluster.members) == 2,
+            "m1 removed from raft members", timeout=40)
+        m1 = c.nodes["m1"]
+        await c.poll(lambda: not m1.is_manager() or None,
+                     "m1's manager stopped", timeout=40)
+    finally:
+        await c.stop_all()
+
+
+@async_test
+async def test_force_new_cluster_after_quorum_loss():
+    """reference: TestForceNewCluster integration_test.go."""
+    c = TestCluster()
+    try:
+        await c.add_manager("m1")
+        await c.add_manager("m2")
+        await c.add_manager("m3")
+        svc = await c.create_service(replicas=1)
+        lead = await c.wait_leader()
+        assert lead.node_id == "m1"
+
+        # lose quorum: kill two of three managers
+        await c.stop_node("m2")
+        await c.stop_node("m3")
+        await asyncio.sleep(1.0)
+
+        # recover the survivor as a single-member cluster
+        await c.stop_node("m1")
+        await c.restart_node("m1", force_new_cluster=True)
+        m1 = c.nodes["m1"]
+        new_lead = await c.poll(c.leader, "single-member leader", timeout=30)
+        assert new_lead.node_id == "m1"
+        assert len(new_lead.raft.cluster.members) == 1
+        # state survived
+        assert new_lead.store.get("service", svc.id) is not None
+        # and the cluster takes writes again
+        svc2 = await c.create_service(name="recovered")
+        assert new_lead.store.get("service", svc2.id) is not None
+    finally:
+        await c.stop_all()
+
+
+@async_test
+async def test_worker_restart_rejoins_and_resumes():
+    """reference: TestNodeRejoins — an agent restart re-registers and its
+    tasks survive."""
+    c = TestCluster()
+    try:
+        await c.add_manager("m1")
+        await c.add_agent("a1")
+        await c.poll_cluster_ready(managers=1, workers=1)
+        svc = await c.create_service(replicas=2)
+        await c.poll(lambda: len(c.running_tasks(svc.id)) == 2,
+                     "2 running before restart")
+
+        await c.stop_node("a1")
+        await c.restart_node("a1")
+        lead = c.leader()
+        await c.poll(
+            lambda: lead.store.get("node", "a1").status.state
+            == NodeState.READY or None,
+            "a1 re-registered", timeout=30)
+        await c.poll(lambda: len(c.running_tasks(svc.id)) == 2,
+                     "2 running after restart", timeout=30)
+    finally:
+        await c.stop_all()
