@@ -1,0 +1,99 @@
+"""At-rest storage for the node's TLS material, with optional KEK
+encryption of the private key (cluster autolock).
+
+Reference: ca/keyreadwriter.go (493 LoC) — cert.pem / key.pem under
+<state>/certificates/, the key optionally PEM-encrypted with the kek;
+headers on the key carry rotation state (here: a small JSON sidecar).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+from typing import Optional
+
+from cryptography.fernet import Fernet, InvalidToken
+
+
+class KeyReadWriter:
+    def __init__(self, cert_dir: str, kek: Optional[bytes] = None) -> None:
+        self.cert_dir = cert_dir
+        self._kek = kek
+        os.makedirs(cert_dir, exist_ok=True)
+
+    @property
+    def cert_path(self) -> str:
+        return os.path.join(self.cert_dir, "swarm-node.crt")
+
+    @property
+    def key_path(self) -> str:
+        return os.path.join(self.cert_dir, "swarm-node.key")
+
+    @property
+    def root_ca_path(self) -> str:
+        return os.path.join(self.cert_dir, "swarm-root-ca.crt")
+
+    # ------------------------------------------------------------------
+    def _fernet(self, kek: bytes) -> Fernet:
+        return Fernet(base64.urlsafe_b64encode(
+            hashlib.sha256(kek).digest()))
+
+    def set_kek(self, kek: Optional[bytes]) -> None:
+        """Re-encrypt the stored key under a new kek
+        (reference: RotateKEK keyreadwriter.go)."""
+        cert, key = self.read()
+        self._kek = kek
+        if key is not None:
+            self.write(cert or b"", key)
+
+    # ------------------------------------------------------------------
+    def write(self, cert_pem: bytes, key_pem: bytes) -> None:
+        payload = key_pem
+        meta = {"encrypted": False}
+        if self._kek:
+            payload = self._fernet(self._kek).encrypt(key_pem)
+            meta["encrypted"] = True
+        self._atomic(self.cert_path, cert_pem)
+        self._atomic(self.key_path, payload)
+        self._atomic(self.key_path + ".meta",
+                     json.dumps(meta).encode())
+        os.chmod(self.key_path, 0o600)
+
+    def read(self) -> tuple[Optional[bytes], Optional[bytes]]:
+        if not os.path.exists(self.cert_path) \
+                or not os.path.exists(self.key_path):
+            return None, None
+        cert = open(self.cert_path, "rb").read()
+        payload = open(self.key_path, "rb").read()
+        meta = {"encrypted": False}
+        if os.path.exists(self.key_path + ".meta"):
+            meta = json.loads(open(self.key_path + ".meta").read())
+        if meta.get("encrypted"):
+            if not self._kek:
+                raise PermissionError(
+                    "node key is locked; unlock key required")
+            try:
+                payload = self._fernet(self._kek).decrypt(payload)
+            except InvalidToken:
+                raise PermissionError("invalid unlock key")
+        return cert, payload
+
+    def write_root_ca(self, cert_pem: bytes) -> None:
+        self._atomic(self.root_ca_path, cert_pem)
+
+    def read_root_ca(self) -> Optional[bytes]:
+        if not os.path.exists(self.root_ca_path):
+            return None
+        return open(self.root_ca_path, "rb").read()
+
+    @staticmethod
+    def _atomic(path: str, data: bytes) -> None:
+        """reference: ioutils.AtomicWriteFile."""
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)

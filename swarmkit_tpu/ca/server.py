@@ -1,0 +1,218 @@
+"""CA server: join-token-gated certificate issuance + pending-cert
+reconciliation.
+
+Reference: ca/server.go (917 LoC) — IssueNodeCertificate (:236): a valid
+join token admits a new node (role = which token matched), creating its
+node record with the CSR PENDING; the signing loop (Run :422 +
+reconciler) signs PENDING certificates; renewals derive the role from
+Node.spec.desired_role so promotion/demotion flows through certificate
+renewal.  NodeCertificateStatus (:180) lets joiners poll for their signed
+certificate.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from swarmkit_tpu.api import MembershipState, NodeRole, NodeSpec, Annotations
+from swarmkit_tpu.api.objects import Node as ApiNode, NodeStatus
+from swarmkit_tpu.api.types import Certificate, IssuanceState
+from swarmkit_tpu.ca.certificates import (
+    MANAGER_ROLE_OU, WORKER_ROLE_OU, CertificateError, IssuedCertificate,
+    RootCA, parse_identity,
+)
+from swarmkit_tpu.ca.config import InvalidJoinToken, parse_join_token
+from swarmkit_tpu.store.memory import Event, MemoryStore, match
+from swarmkit_tpu.utils.clock import Clock, SystemClock
+from swarmkit_tpu.utils.identity import new_id
+
+log = logging.getLogger("swarmkit_tpu.ca.server")
+
+_ROLE_OU = {NodeRole.MANAGER: MANAGER_ROLE_OU, NodeRole.WORKER: WORKER_ROLE_OU}
+
+
+class CAServer:
+    def __init__(self, store: MemoryStore, root_ca: RootCA, org: str,
+                 clock: Optional[Clock] = None) -> None:
+        self.store = store
+        self.root_ca = root_ca
+        self.org = org
+        self.clock = clock or SystemClock()
+        self._task: Optional[asyncio.Task] = None
+        self._running = False
+
+    # ------------------------------------------------------------------
+    def _cluster(self):
+        clusters = self.store.find("cluster")
+        return clusters[0] if clusters else None
+
+    def _role_for_token(self, token: str) -> NodeRole:
+        """Which join token matched decides the role
+        (reference: server.go checkNodeCertificate / token switch)."""
+        parsed = parse_join_token(token)
+        if parsed.ca_digest != self.root_ca.digest():
+            raise InvalidJoinToken("join token CA digest mismatch")
+        cluster = self._cluster()
+        if cluster is None:
+            raise InvalidJoinToken("no cluster object")
+        if token == cluster.root_ca.join_token_manager:
+            return NodeRole.MANAGER
+        if token == cluster.root_ca.join_token_worker:
+            return NodeRole.WORKER
+        raise InvalidJoinToken("join token not recognized")
+
+    # ------------------------------------------------------------------
+    async def issue_node_certificate(self, csr_pem: bytes, token: str,
+                                     addr: str = "",
+                                     requested_node_id: str = ""
+                                     ) -> tuple[str, IssuedCertificate]:
+        """Admit a new node via join token (reference: server.go:236).
+        ``requested_node_id`` is honored only when vacant (test harnesses
+        want stable names; the reference always assigns a fresh id)."""
+        role = self._role_for_token(token)
+        node_id = new_id()
+        if requested_node_id \
+                and self.store.get("node", requested_node_id) is None:
+            node_id = requested_node_id
+        issued = self.root_ca.issue_node_certificate(
+            node_id, _ROLE_OU[role], self.org, csr_pem=csr_pem,
+            expiry=self._cert_expiry())
+        node = ApiNode(
+            id=node_id,
+            spec=NodeSpec(annotations=Annotations(name=node_id),
+                          desired_role=role,
+                          membership=MembershipState.ACCEPTED),
+            role=role,
+            certificate=Certificate(
+                role=role, csr=csr_pem,
+                status_state=int(IssuanceState.ISSUED),
+                certificate=issued.cert_pem, cn=node_id),
+            status=NodeStatus(addr=addr))
+        await self.store.update(lambda tx: tx.create(node))
+        return node_id, issued
+
+    async def renew_node_certificate(self, node_id: str,
+                                     old_cert_pem: bytes,
+                                     csr_pem: bytes) -> IssuedCertificate:
+        """Renewal: identity proven by the old cert AND a CSR signed with
+        the certificate's own key (possession proof — the reference proves
+        possession via the mutual-TLS channel); role comes from
+        Node.spec.desired_role (reference: issueRenewCertificate)."""
+        from cryptography import x509 as _x509
+        from cryptography.hazmat.primitives import serialization as _ser
+
+        cn, _, org = parse_identity(old_cert_pem)
+        old_cert = self.root_ca.validate_cert_chain(old_cert_pem)
+        if cn != node_id or org != self.org:
+            raise CertificateError("certificate identity mismatch")
+        csr = _x509.load_pem_x509_csr(csr_pem)
+        if not csr.is_signature_valid:
+            raise CertificateError("renewal CSR signature invalid")
+        pub = lambda k: k.public_bytes(
+            _ser.Encoding.PEM, _ser.PublicFormat.SubjectPublicKeyInfo)
+        if pub(csr.public_key()) != pub(old_cert.public_key()):
+            raise CertificateError(
+                "renewal CSR key does not match the certificate key")
+        node = self.store.get("node", node_id)
+        if node is None:
+            raise CertificateError(f"node {node_id} not registered")
+        role = NodeRole(node.spec.desired_role)
+        issued = self.root_ca.issue_node_certificate(
+            node_id, _ROLE_OU[role], self.org, csr_pem=csr_pem,
+            expiry=self._cert_expiry())
+
+        def txn(tx):
+            cur = tx.get("node", node_id)
+            if cur is None:
+                return
+            cur = cur.copy()
+            cur.role = role
+            cur.certificate = Certificate(
+                role=role, status_state=int(IssuanceState.ISSUED),
+                certificate=issued.cert_pem, cn=node_id)
+            tx.update(cur)
+        await self.store.update(txn)
+        return issued
+
+    def node_certificate_status(self, node_id: str
+                                ) -> tuple[IssuanceState, bytes]:
+        """reference: NodeCertificateStatus server.go:180."""
+        node = self.store.get("node", node_id)
+        if node is None:
+            raise CertificateError(f"node {node_id} not found")
+        return (IssuanceState(node.certificate.status_state),
+                node.certificate.certificate)
+
+    def get_root_ca_certificate(self) -> bytes:
+        """reference: GetRootCACertificate ca.proto."""
+        return self.root_ca.cert_pem
+
+    def _cert_expiry(self) -> float:
+        cluster = self._cluster()
+        if cluster is not None:
+            return cluster.spec.ca_config.node_cert_expiry
+        from swarmkit_tpu.ca.certificates import DEFAULT_NODE_CERT_EXPIRATION
+
+        return DEFAULT_NODE_CERT_EXPIRATION
+
+    # ------------------------------------------------------------------
+    async def start(self) -> None:
+        """Pending-cert reconciliation loop (reference: Run server.go:422
+        + ca/reconciler.go)."""
+        self._watcher = self.store.watch(match(kind="node"))
+        await self._sign_pending()
+        self._running = True
+        self._task = asyncio.get_running_loop().create_task(
+            self._run(self._watcher))
+
+    async def stop(self) -> None:
+        self._running = False
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+        if getattr(self, "_watcher", None) is not None:
+            self._watcher.close()
+            self._watcher = None
+
+    async def _run(self, watcher) -> None:
+        try:
+            async for ev in watcher:
+                if not self._running:
+                    return
+                if isinstance(ev, Event) and ev.action != "remove" \
+                        and ev.object.certificate.status_state \
+                        == IssuanceState.PENDING:
+                    await self._sign_pending()
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.exception("CA server loop crashed")
+
+    async def _sign_pending(self) -> None:
+        pending = [n for n in self.store.find("node")
+                   if n.certificate.status_state == IssuanceState.PENDING
+                   and n.certificate.csr]
+        for n in pending:
+            try:
+                issued = self.root_ca.issue_node_certificate(
+                    n.id, _ROLE_OU[NodeRole(n.spec.desired_role)], self.org,
+                    csr_pem=n.certificate.csr, expiry=self._cert_expiry())
+            except CertificateError as e:
+                log.warning("cannot sign CSR for %s: %s", n.id, e)
+                continue
+
+            def txn(tx, nid=n.id, cert=issued.cert_pem):
+                cur = tx.get("node", nid)
+                if cur is None:
+                    return
+                cur = cur.copy()
+                cur.certificate.certificate = cert
+                cur.certificate.status_state = int(IssuanceState.ISSUED)
+                tx.update(cur)
+            await self.store.update(txn)

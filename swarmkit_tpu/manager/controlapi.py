@@ -394,9 +394,11 @@ class ControlApi:
             cl = cl.copy()
             cl.spec = spec.copy()
             if rotate_worker_token:
-                cl.root_ca.join_token_worker = generate_join_token()
+                cl.root_ca.join_token_worker = generate_join_token(
+                    ca_cert=cl.root_ca.ca_cert)
             if rotate_manager_token:
-                cl.root_ca.join_token_manager = generate_join_token()
+                cl.root_ca.join_token_manager = generate_join_token(
+                    ca_cert=cl.root_ca.ca_cert)
             tx.update(cl)
             return cl
         try:
@@ -596,11 +598,16 @@ class ControlApi:
         return objs
 
 
-def generate_join_token(secret: Optional[str] = None) -> str:
-    """``SWMTKN-1-<secret>-<check>`` (reference: ca/config.go
-    GenerateJoinToken; format preserved, crypto simplified until the CA
-    layer lands)."""
+def generate_join_token(secret: Optional[str] = None,
+                        ca_cert: bytes = b"") -> str:
+    """``SWMTKN-1-<ca digest>-<secret>`` (reference: ca/config.go
+    GenerateJoinToken)."""
     import secrets as pysecrets
 
-    body = secret or pysecrets.token_hex(25)
-    return f"SWMTKN-1-{body}"
+    if ca_cert:
+        from swarmkit_tpu.ca import RootCA
+        from swarmkit_tpu.ca import generate_join_token as ca_generate
+
+        return ca_generate(RootCA(ca_cert), secret)
+    body = secret or pysecrets.token_hex(16)
+    return f"SWMTKN-1-none-{body}"

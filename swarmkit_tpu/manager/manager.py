@@ -22,7 +22,7 @@ from swarmkit_tpu.api import (
 )
 from swarmkit_tpu.api.objects import NodeStatus
 from swarmkit_tpu.manager.allocator import Allocator
-from swarmkit_tpu.manager.controlapi import ControlApi, generate_join_token
+from swarmkit_tpu.manager.controlapi import ControlApi
 from swarmkit_tpu.manager.dispatcher import Dispatcher
 from swarmkit_tpu.manager.health import HealthServer, HealthStatus
 from swarmkit_tpu.manager.keymanager import KeyManager
@@ -40,6 +40,7 @@ from swarmkit_tpu.manager.resourceapi import ResourceApi
 from swarmkit_tpu.manager.role_manager import RoleManager
 from swarmkit_tpu.manager.scheduler import Scheduler
 from swarmkit_tpu.manager.watchapi import WatchServer
+from swarmkit_tpu.ca import CAServer, RootCA, generate_join_token as ca_token
 from swarmkit_tpu.raft.node import LeadershipState, Node as RaftNode, NodeOpts
 from swarmkit_tpu.store.memory import MemoryStore
 from swarmkit_tpu.utils.clock import Clock, SystemClock
@@ -55,10 +56,14 @@ class Manager:
                  force_new_cluster: bool = False,
                  tick_interval: float = 1.0,
                  election_tick: int = 10, heartbeat_tick: int = 1,
-                 seed: int = 0) -> None:
+                 seed: int = 0, security=None) -> None:
         self.node_id = node_id
         self.addr = addr
         self.clock = clock or SystemClock()
+        # node-provided TLS identity; its root CA seeds the cluster's CA on
+        # bootstrap (reference: manager.go uses SecurityConfig's RootCA)
+        self.security = security
+        self.ca_server: Optional[CAServer] = None
         self.raft = RaftNode(NodeOpts(
             node_id=node_id, addr=addr, network=network,
             state_dir=state_dir, clock=self.clock, join_addr=join_addr,
@@ -181,6 +186,15 @@ class Manager:
         self.metrics.set_leader(True)
         await self._seed_defaults()
 
+        # the CA signing service, loaded from the replicated cluster object
+        # (reference: ca.Server started in becomeLeader manager.go:906)
+        cluster = self.store.find("cluster")[0]
+        if cluster.root_ca.ca_cert and cluster.root_ca.ca_key:
+            self.ca_server = CAServer(
+                self.store,
+                RootCA(cluster.root_ca.ca_cert, cluster.root_ca.ca_key),
+                org=cluster.id, clock=self.clock)
+
         sched = Scheduler(self.store, clock=self.clock)
         replicated = ReplicatedOrchestrator(self.store, clock=self.clock)
         global_ = GlobalOrchestrator(self.store, clock=self.clock)
@@ -188,14 +202,19 @@ class Manager:
         enforcer = ConstraintEnforcer(self.store, clock=self.clock)
         allocator = Allocator(self.store, clock=self.clock)
         keymanager = KeyManager(self.store, clock=self.clock)
-        self.role_manager = RoleManager(self.store, self.raft,
-                                        clock=self.clock)
+        # reconciliation retries scale with the raft tick so fast-tick test
+        # clusters retry fast too (production: 1 s ticks → 16 s interval)
+        self.role_manager = RoleManager(
+            self.store, self.raft, clock=self.clock,
+            reconcile_interval=16.0 * self.raft.opts.tick_interval)
 
         # allocator first so tasks reach PENDING before scheduling
         # (reference ordering in becomeLeader)
         self._leader_components = [allocator, sched, replicated, global_,
                                    reaper, enforcer, keymanager,
                                    self.role_manager]
+        if self.ca_server is not None:
+            self._leader_components.append(self.ca_server)
         for c in self._leader_components:
             await c.start()
         await self.dispatcher.start(mark_unknown=True)
@@ -261,19 +280,37 @@ class Manager:
                 log.exception("stopping leader component %r failed", c)
         self._leader_components = []
         self.role_manager = None
+        self.ca_server = None
+
+    def _bootstrap_root_ca(self) -> RootCA:
+        if self.security is not None and self.security.root_ca.can_sign:
+            return self.security.root_ca
+        return RootCA.create()
 
     async def _seed_defaults(self) -> None:
         """Seed the default cluster object and our own node record
         (reference: becomeLeader manager.go:931-983)."""
+        root_ca = None
+        if not self.store.find("cluster"):
+            root_ca = self._bootstrap_root_ca()
+
+        # bootstrap cluster id = the certificate org (reference:
+        # manager.go uses securityConfig's Organization as the cluster id)
+        cluster_id = (self.security.org if self.security is not None
+                      else "cluster-" + DEFAULT_CLUSTER_NAME)
+
         def txn(tx):
             clusters = tx.find("cluster")
-            if not clusters:
+            if not clusters and root_ca is not None:
                 cluster = Cluster(
-                    id="cluster-" + DEFAULT_CLUSTER_NAME,
+                    id=cluster_id,
                     spec=ClusterSpec(
                         annotations=Annotations(name=DEFAULT_CLUSTER_NAME)))
-                cluster.root_ca.join_token_worker = generate_join_token()
-                cluster.root_ca.join_token_manager = generate_join_token()
+                cluster.root_ca.ca_cert = root_ca.cert_pem
+                cluster.root_ca.ca_key = root_ca.key_pem or b""
+                cluster.root_ca.ca_cert_hash = root_ca.digest()
+                cluster.root_ca.join_token_worker = ca_token(root_ca)
+                cluster.root_ca.join_token_manager = ca_token(root_ca)
                 tx.create(cluster)
             if tx.get("node", self.node_id) is None:
                 tx.create(ApiNode(

@@ -25,11 +25,12 @@ RECONCILIATION_INTERVAL = 16.0   # reference: role_manager.go roleReconcileInter
 
 
 class RoleManager:
-    def __init__(self, store: MemoryStore, raft, clock: Optional[Clock] = None
-                 ) -> None:
+    def __init__(self, store: MemoryStore, raft, clock: Optional[Clock] = None,
+                 reconcile_interval: float = RECONCILIATION_INTERVAL) -> None:
         self.store = store
         self.raft = raft
         self.clock = clock or SystemClock()
+        self.reconcile_interval = reconcile_interval
         self.pending: dict[str, object] = {}
         self.pending_removal: set[str] = set()
         self._task: Optional[asyncio.Task] = None
@@ -66,7 +67,7 @@ class RoleManager:
             while self._running:
                 get_ev = asyncio.ensure_future(watcher.get())
                 timer = asyncio.ensure_future(
-                    self.clock.sleep(RECONCILIATION_INTERVAL))
+                    self.clock.sleep(self.reconcile_interval))
                 done, pending = await asyncio.wait(
                     {get_ev, timer}, return_when=asyncio.FIRST_COMPLETED)
                 for p in pending:

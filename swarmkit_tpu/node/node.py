@@ -20,12 +20,38 @@ from typing import Callable, Optional
 from swarmkit_tpu.agent import Agent, AgentConfig
 from swarmkit_tpu.agent.exec import Executor
 from swarmkit_tpu.api import NodeRole, Peer
+from swarmkit_tpu.ca import (
+    MANAGER_ROLE_OU, KeyReadWriter, RootCA, SecurityConfig, TLSRenewer,
+    create_csr, parse_identity,
+)
 from swarmkit_tpu.manager.manager import Manager
 from swarmkit_tpu.node.connectionbroker import ConnectionBroker
 from swarmkit_tpu.node.remotes import Remotes
 from swarmkit_tpu.utils.clock import Clock, SystemClock
+from swarmkit_tpu.utils.identity import new_id
 
 log = logging.getLogger("swarmkit_tpu.node")
+
+
+class _RenewClient:
+    """Renews via the cluster CA and persists the result
+    (reference: agent-side CA client in ca/renewer.go)."""
+
+    def __init__(self, node: "Node") -> None:
+        self.node = node
+
+    async def renew_node_certificate(self, node_id: str, cert_pem: bytes):
+        from swarmkit_tpu.ca import create_csr_from_key
+
+        ca = self.node._ca_client()
+        if ca is None:
+            raise RuntimeError("no CA reachable for renewal")
+        csr = create_csr_from_key(self.node.security.key_pem, node_id)
+        issued = await ca.renew_node_certificate(node_id, cert_pem, csr)
+        if self.node.keyrw is not None:
+            self.node.keyrw.write(issued.cert_pem,
+                                  self.node.security.key_pem)
+        return issued
 
 
 @dataclass
@@ -42,6 +68,7 @@ class NodeConfig:
     join_token: str = ""
     is_manager: bool = False             # initial role
     force_new_cluster: bool = False
+    unlock_key: Optional[bytes] = None   # autolock KEK for the node key
     tick_interval: float = 1.0
     election_tick: int = 10
     heartbeat_tick: int = 1
@@ -56,12 +83,15 @@ class Node:
         self.node_id = config.node_id
         self.addr = config.listen_addr or f"{config.node_id}:4242"
         self.manager: Optional[Manager] = None
+        self.security: Optional[SecurityConfig] = None
+        self.keyrw: Optional[KeyReadWriter] = None
         self.remotes = Remotes()
         if config.join_addr:
             self.remotes.observe(Peer(addr=config.join_addr))
         self.broker = ConnectionBroker(
             self.remotes, config.dialer, lambda: self._running_manager())
         self.agent: Optional[Agent] = None
+        self._renewer: Optional[TLSRenewer] = None
         self._desired_manager = config.is_manager
         self._role_evt = asyncio.Event()
         self._supervisor: Optional[asyncio.Task] = None
@@ -80,11 +110,108 @@ class Node:
         return m is not None and m.is_leader()
 
     # ------------------------------------------------------------------
+    # ------------------------------------------------------------------
+    def _ca_client(self):
+        """The leader's CA server, resolved like any agent-side RPC."""
+        local = self._running_manager()
+        candidates = [local] if local is not None else []
+        for addr in self.remotes.weights():
+            m = self.config.dialer(addr)
+            if m is not None:
+                candidates.append(m)
+        for m in candidates:
+            leader = self.broker._leader_of(m)
+            if leader is not None and leader.ca_server is not None:
+                return leader.ca_server
+        return None
+
+    async def _load_security_config(self) -> None:
+        """Obtain (or restore) this node's TLS identity
+        (reference: loadSecurityConfig node/node.go:305 — may block on the
+        CA join; sets node id + role from the certificate)."""
+        state_dir = self.config.state_dir
+        if state_dir == ":memory:":
+            import tempfile
+
+            self._cert_tmp = tempfile.TemporaryDirectory(
+                prefix=f"swarmkit-certs-{self.node_id}-")
+            cert_dir = self._cert_tmp.name
+        else:
+            cert_dir = os.path.join(state_dir, "certificates")
+        self.keyrw = KeyReadWriter(cert_dir, kek=self.config.unlock_key)
+
+        cert, key = self.keyrw.read()
+        root_pem = self.keyrw.read_root_ca()
+        if cert and key and root_pem:
+            node_id, role_ou, org = parse_identity(cert)
+            self.node_id = node_id
+            self.security = SecurityConfig(RootCA(root_pem), node_id,
+                                           role_ou, org, cert, key)
+            self._desired_manager = role_ou == MANAGER_ROLE_OU
+            return
+
+        if self.config.join_token and self.config.join_addr:
+            # remote CA join (reference: RequestAndSaveNewCertificates)
+            csr_pem, key_pem = create_csr()
+            ca = None
+            for _ in range(200):
+                ca = self._ca_client()
+                if ca is not None:
+                    break
+                await self.clock.sleep(0.05)
+            if ca is None:
+                raise RuntimeError("cannot reach a CA to join the cluster")
+            node_id, issued = await ca.issue_node_certificate(
+                csr_pem, self.config.join_token, addr=self.addr,
+                requested_node_id=self.node_id)
+            root_pem = ca.get_root_ca_certificate()
+            self.keyrw.write_root_ca(root_pem)
+            self.keyrw.write(issued.cert_pem, key_pem)
+            self.node_id = node_id
+            _, role_ou, org = parse_identity(issued.cert_pem)
+            self.security = SecurityConfig(RootCA(root_pem), node_id,
+                                           role_ou, org, issued.cert_pem,
+                                           key_pem)
+            self._desired_manager = role_ou == MANAGER_ROLE_OU
+            return
+
+        if self.config.is_manager and self.config.join_addr:
+            # a manager joining an existing cluster without a token gets no
+            # identity here (legacy/test path) — minting an unrelated root
+            # CA would break the org == cluster-id invariant
+            log.warning("manager %s joining without a join token; running "
+                        "without a certificate identity", self.node_id)
+            return
+
+        if self.config.is_manager:
+            # bootstrap: self-signed root CA; the manager seeds the cluster
+            # from it and the org becomes the cluster id (reference:
+            # node.go bootstrap path in loadSecurityConfig)
+            root = RootCA.create()
+            org = "cluster-" + new_id()
+            issued = root.issue_node_certificate(
+                self.node_id, MANAGER_ROLE_OU, org)
+            self.keyrw.write_root_ca(root.cert_pem)
+            self.keyrw.write(issued.cert_pem, issued.key_pem)
+            self.security = SecurityConfig(
+                root, self.node_id, MANAGER_ROLE_OU, org,
+                issued.cert_pem, issued.key_pem)
+        # else: no token, not a manager — legacy identityless worker; the
+        # harness (or operator) must have pre-created the node record
+
     async def start(self) -> None:
         """reference: node.Start node/node.go:251 → run :272."""
         self._running = True
-        if self.config.is_manager:
+        await self._load_security_config()
+        # the restored certificate's role wins over the configured one
+        # (reference: role is derived from the cert, node.go:305)
+        if self._desired_manager:
             await self._start_manager()
+        if self.security is not None:
+            self._renewer = TLSRenewer(self.security,
+                                       _RenewClient(self),
+                                       clock=self.clock)
+            self._renewer.start()
         self.agent = Agent(AgentConfig(
             node_id=self.node_id,
             executor=self.config.executor,
@@ -101,6 +228,9 @@ class Node:
 
     async def stop(self) -> None:
         self._running = False
+        if getattr(self, "_renewer", None) is not None:
+            await self._renewer.stop()
+            self._renewer = None
         if self._supervisor is not None:
             self._supervisor.cancel()
             try:
@@ -180,7 +310,7 @@ class Node:
             tick_interval=self.config.tick_interval,
             election_tick=self.config.election_tick,
             heartbeat_tick=self.config.heartbeat_tick,
-            seed=self.config.seed)
+            seed=self.config.seed, security=self.security)
         await self.manager.start()
 
     def _leader_addr(self) -> str:

@@ -1,0 +1,167 @@
+"""CA/security suite (reference: ca/certificates_test.go, ca/server_test.go,
+ca/config_test.go, ca/keyreadwriter_test.go)."""
+
+import asyncio
+import os
+import tempfile
+
+import pytest
+
+from swarmkit_tpu.api import (
+    Annotations, Cluster, ClusterSpec, NodeRole,
+)
+from swarmkit_tpu.ca import (
+    CAServer, CertificateError, InvalidJoinToken, KeyReadWriter,
+    MANAGER_ROLE_OU, WORKER_ROLE_OU, RootCA, SecurityConfig, TLSRenewer,
+    authorize_org_and_role, create_csr, generate_join_token, parse_identity,
+    parse_join_token, PermissionDenied,
+)
+from swarmkit_tpu.api.types import IssuanceState
+from swarmkit_tpu.store.memory import MemoryStore
+from swarmkit_tpu.utils.clock import FakeClock
+from tests.conftest import async_test
+
+
+def test_root_ca_create_and_issue():
+    root = RootCA.create()
+    assert root.can_sign
+    issued = root.issue_node_certificate("node1", WORKER_ROLE_OU, "org1")
+    assert issued.key_pem is not None
+    node_id, role, org = parse_identity(issued.cert_pem)
+    assert (node_id, role, org) == ("node1", WORKER_ROLE_OU, "org1")
+    root.validate_cert_chain(issued.cert_pem)
+
+    # a cert from a different CA is rejected
+    other = RootCA.create()
+    foreign = other.issue_node_certificate("evil", WORKER_ROLE_OU, "org1")
+    with pytest.raises(CertificateError):
+        root.validate_cert_chain(foreign.cert_pem)
+
+
+def test_csr_signing_round_trip():
+    root = RootCA.create()
+    csr_pem, key_pem = create_csr("node9")
+    issued = root.issue_node_certificate("node9", MANAGER_ROLE_OU, "orgX",
+                                         csr_pem=csr_pem)
+    assert issued.key_pem is None  # key stays with the requester
+    root.validate_cert_chain(issued.cert_pem)
+    assert parse_identity(issued.cert_pem)[0] == "node9"
+
+
+def test_join_token_format_and_parse():
+    root = RootCA.create()
+    token = generate_join_token(root)
+    parsed = parse_join_token(token)
+    assert parsed.version == 1
+    assert parsed.ca_digest == root.digest()
+    with pytest.raises(InvalidJoinToken):
+        parse_join_token("SWMTKN-2-x-y")
+    with pytest.raises(InvalidJoinToken):
+        parse_join_token("garbage")
+
+
+def test_authorization():
+    root = RootCA.create()
+    mgr = root.issue_node_certificate("m1", MANAGER_ROLE_OU, "org1")
+    wrk = root.issue_node_certificate("w1", WORKER_ROLE_OU, "org1")
+    info = authorize_org_and_role(mgr.cert_pem, root, "org1",
+                                  MANAGER_ROLE_OU)
+    assert info.node_id == "m1"
+    with pytest.raises(PermissionDenied):   # worker can't act as manager
+        authorize_org_and_role(wrk.cert_pem, root, "org1", MANAGER_ROLE_OU)
+    with pytest.raises(PermissionDenied):   # wrong org
+        authorize_org_and_role(mgr.cert_pem, root, "org2", MANAGER_ROLE_OU)
+
+
+def test_keyreadwriter_kek_lock():
+    tmp = tempfile.TemporaryDirectory()
+    krw = KeyReadWriter(tmp.name, kek=b"passw0rd")
+    krw.write(b"CERT", b"KEY")
+    # raw file must not contain the plaintext key
+    raw = open(krw.key_path, "rb").read()
+    assert b"KEY" not in raw
+    cert, key = krw.read()
+    assert (cert, key) == (b"CERT", b"KEY")
+
+    # without the kek the key is locked
+    locked = KeyReadWriter(tmp.name)
+    with pytest.raises(PermissionError):
+        locked.read()
+    with pytest.raises(PermissionError):
+        KeyReadWriter(tmp.name, kek=b"wrong").read()
+
+    # kek rotation to unencrypted
+    krw.set_kek(None)
+    cert, key = KeyReadWriter(tmp.name).read()
+    assert key == b"KEY"
+
+
+@async_test
+async def test_ca_server_token_join_and_renewal():
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    root = RootCA.create()
+    cluster = Cluster(id="org1", spec=ClusterSpec(
+        annotations=Annotations(name="default")))
+    cluster.root_ca.ca_cert = root.cert_pem
+    cluster.root_ca.join_token_worker = generate_join_token(root)
+    cluster.root_ca.join_token_manager = generate_join_token(root)
+    await store.update(lambda tx: tx.create(cluster))
+    ca = CAServer(store, root, org="org1", clock=clock)
+
+    # worker token -> worker role + node record
+    csr, key = create_csr()
+    node_id, issued = await ca.issue_node_certificate(
+        csr, cluster.root_ca.join_token_worker, addr="1.2.3.4")
+    node = store.get("node", node_id)
+    assert node.role == NodeRole.WORKER
+    assert parse_identity(issued.cert_pem)[1] == WORKER_ROLE_OU
+    state, cert = ca.node_certificate_status(node_id)
+    assert state == IssuanceState.ISSUED and cert == issued.cert_pem
+
+    # manager token -> manager role
+    csr2, _ = create_csr()
+    m_id, m_issued = await ca.issue_node_certificate(
+        csr2, cluster.root_ca.join_token_manager)
+    assert store.get("node", m_id).role == NodeRole.MANAGER
+
+    # garbage and foreign tokens rejected
+    with pytest.raises(InvalidJoinToken):
+        await ca.issue_node_certificate(csr, "SWMTKN-1-beef-dead")
+    foreign = generate_join_token(RootCA.create())
+    with pytest.raises(InvalidJoinToken):
+        await ca.issue_node_certificate(csr, foreign)
+
+    # renewal follows desired_role (promotion via cert renewal); the CSR
+    # must prove possession of the certificate's key
+    from swarmkit_tpu.ca import create_csr_from_key
+
+    def promote(tx):
+        n = tx.get("node", node_id).copy()
+        n.spec.desired_role = NodeRole.MANAGER
+        tx.update(n)
+    await store.update(promote)
+    renew_csr = create_csr_from_key(key, node_id)
+    renewed = await ca.renew_node_certificate(node_id, issued.cert_pem,
+                                              renew_csr)
+    assert parse_identity(renewed.cert_pem)[1] == MANAGER_ROLE_OU
+    assert store.get("node", node_id).role == NodeRole.MANAGER
+
+    # a CSR over a DIFFERENT key is rejected (identity theft guard)
+    evil_csr, _ = create_csr(node_id)
+    with pytest.raises(CertificateError):
+        await ca.renew_node_certificate(node_id, renewed.cert_pem, evil_csr)
+
+
+@async_test
+async def test_security_config_role_change_event():
+    root = RootCA.create()
+    issued = root.issue_node_certificate("n1", WORKER_ROLE_OU, "org1")
+    sec = SecurityConfig(root, "n1", WORKER_ROLE_OU, "org1",
+                         issued.cert_pem, issued.key_pem)
+    watcher = sec.updates.watch()
+    promoted = root.issue_node_certificate("n1", MANAGER_ROLE_OU, "org1")
+    sec.update_cert(promoted.cert_pem, promoted.key_pem)
+    assert sec.is_manager
+    ev = watcher.try_get()
+    assert ev is not None and ev.role == MANAGER_ROLE_OU
