@@ -105,7 +105,11 @@ class TLSRenewer:
                  clock: Optional[Clock] = None,
                  rng: Optional[random.Random] = None) -> None:
         self.security = security
-        self.ca_client = ca_client   # CA server (or remote client)
+        # renewal client protocol: ``await renew_node_certificate(node_id,
+        # cert_pem) -> IssuedCertificate`` — a wrapper owning CSR creation
+        # and persistence (see node._RenewClient), NOT the raw CAServer
+        # (whose renew takes an explicit CSR)
+        self.ca_client = ca_client
         self.clock = clock or SystemClock()
         self._rng = rng or random.Random()
         self._task: Optional[asyncio.Task] = None
@@ -131,17 +135,21 @@ class TLSRenewer:
         return remaining * self._rng.uniform(0.5, 0.8)
 
     async def _run(self) -> None:
-        backoff = 1.0
         try:
             while self._running:
                 await self.clock.sleep(self._next_delay())
-                try:
-                    await self.renew()
-                    backoff = 1.0
-                except Exception as e:
-                    log.info("certificate renewal failed: %s", e)
-                    await self.clock.sleep(backoff)
-                    backoff = min(30.0, backoff * 2)
+                # retry on the short backoff until the renewal lands —
+                # re-entering _next_delay() here would push each retry
+                # 50-80% of the remaining validity into the future
+                backoff = 1.0
+                while self._running:
+                    try:
+                        await self.renew()
+                        break
+                    except Exception as e:
+                        log.info("certificate renewal failed: %s", e)
+                        await self.clock.sleep(backoff)
+                        backoff = min(30.0, backoff * 2)
         except asyncio.CancelledError:
             pass
 

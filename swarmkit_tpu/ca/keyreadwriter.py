@@ -56,10 +56,9 @@ class KeyReadWriter:
             payload = self._fernet(self._kek).encrypt(key_pem)
             meta["encrypted"] = True
         self._atomic(self.cert_path, cert_pem)
-        self._atomic(self.key_path, payload)
+        self._atomic(self.key_path, payload, mode=0o600)
         self._atomic(self.key_path + ".meta",
                      json.dumps(meta).encode())
-        os.chmod(self.key_path, 0o600)
 
     def read(self) -> tuple[Optional[bytes], Optional[bytes]]:
         if not os.path.exists(self.cert_path) \
@@ -89,10 +88,12 @@ class KeyReadWriter:
         return open(self.root_ca_path, "rb").read()
 
     @staticmethod
-    def _atomic(path: str, data: bytes) -> None:
-        """reference: ioutils.AtomicWriteFile."""
+    def _atomic(path: str, data: bytes, mode: int = 0o644) -> None:
+        """reference: ioutils.AtomicWriteFile.  ``mode`` applies from the
+        first byte (keys must never exist world-readable, even as .tmp)."""
         tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, mode)
+        with os.fdopen(fd, "wb") as f:
             f.write(data)
             f.flush()
             os.fsync(f.fileno())

@@ -207,12 +207,17 @@ class CAServer:
                 log.warning("cannot sign CSR for %s: %s", n.id, e)
                 continue
 
-            def txn(tx, nid=n.id, cert=issued.cert_pem):
+            role = NodeRole(n.spec.desired_role)
+
+            def txn(tx, nid=n.id, cert=issued.cert_pem, role=role):
                 cur = tx.get("node", nid)
                 if cur is None:
                     return
                 cur = cur.copy()
+                cur.role = role
                 cur.certificate.certificate = cert
                 cur.certificate.status_state = int(IssuanceState.ISSUED)
+                cur.certificate.role = role
+                cur.certificate.cn = nid
                 tx.update(cur)
             await self.store.update(txn)

@@ -1,0 +1,193 @@
+"""Control-API-over-unix-socket: the swarmd ↔ swarmctl wire.
+
+Reference: swarmd's ``--listen-control-api`` unix socket serving the
+Control gRPC service (cmd/swarmd/main.go:255-273, manager.go:526) and
+swarmctl dialing it (cmd/swarmctl).  Here the wire is newline-delimited
+JSON ``{"method": ..., "params": {...}}`` → ``{"result": ...}`` /
+``{"error": ..., "code": ...}`` — the gRPC semantics (method-per-RPC,
+typed errors) without protobuf.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Optional
+
+from swarmkit_tpu.api import (
+    Annotations, ConfigSpec, NetworkSpec, NodeAvailability, NodeRole,
+    SecretSpec, ServiceSpec, TaskState,
+)
+from swarmkit_tpu.manager.controlapi import ControlError
+
+log = logging.getLogger("swarmkit_tpu.ctl")
+
+
+class CtlError(Exception):
+    def __init__(self, message: str, code: str = "unknown") -> None:
+        super().__init__(message)
+        self.code = code
+
+
+class ControlSocketServer:
+    """Serves a Node's control API on a unix socket."""
+
+    def __init__(self, node, path: str) -> None:
+        self.node = node
+        self.path = path
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    def _control(self):
+        from swarmkit_tpu.node.connectionbroker import NoManagerError
+
+        if self.node._running_manager() is None:
+            raise CtlError("this node is not a manager", "unavailable")
+        try:
+            # follower sockets forward to the leader (the raftproxy analog)
+            return self.node.broker.select_control()
+        except NoManagerError:
+            raise CtlError("no leader available", "unavailable")
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_unix_server(
+            self._handle, path=self.path)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                try:
+                    req = json.loads(line)
+                    result = await self._dispatch(req.get("method", ""),
+                                                  req.get("params", {}))
+                    resp = {"result": result}
+                except ControlError as e:
+                    resp = {"error": str(e), "code": e.code}
+                except CtlError as e:
+                    resp = {"error": str(e), "code": e.code}
+                except Exception as e:
+                    log.exception("ctl request failed")
+                    resp = {"error": str(e), "code": "internal"}
+                writer.write(json.dumps(resp).encode() + b"\n")
+                await writer.drain()
+        except (asyncio.CancelledError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+    # ------------------------------------------------------------------
+    async def _dispatch(self, method: str, p: dict):
+        c = self._control()
+        if method == "cluster.inspect":
+            return c.get_cluster().to_dict()
+        if method == "cluster.unlock-key":
+            cl = c.get_cluster()
+            return {"worker": cl.root_ca.join_token_worker,
+                    "manager": cl.root_ca.join_token_manager}
+        if method == "node.ls":
+            return [n.to_dict() for n in c.list_nodes()]
+        if method == "node.inspect":
+            return c.get_node(p["id"]).to_dict()
+        if method == "node.rm":
+            await c.remove_node(p["id"], force=p.get("force", False))
+            return {}
+        if method in ("node.promote", "node.demote", "node.update"):
+            node = c.get_node(p["id"])
+            spec = node.spec.copy()
+            if method == "node.promote":
+                spec.desired_role = NodeRole.MANAGER
+            elif method == "node.demote":
+                spec.desired_role = NodeRole.WORKER
+            if "availability" in p:
+                spec.availability = NodeAvailability(p["availability"])
+            node2 = await c.update_node(p["id"], spec,
+                                        version=node.meta.version.index)
+            return node2.to_dict()
+        if method == "service.create":
+            spec = ServiceSpec.from_dict(p["spec"])
+            return (await c.create_service(spec)).to_dict()
+        if method == "service.ls":
+            return [s.to_dict() for s in c.list_services()]
+        if method == "service.inspect":
+            return c.get_service(p["id"]).to_dict()
+        if method == "service.update":
+            spec = ServiceSpec.from_dict(p["spec"])
+            return (await c.update_service(
+                p["id"], spec, version=p.get("version"))).to_dict()
+        if method == "service.rm":
+            await c.remove_service(p["id"])
+            return {}
+        if method == "task.ls":
+            return [t.to_dict() for t in c.list_tasks(
+                service_ids=p.get("service_ids"),
+                node_ids=p.get("node_ids"))]
+        if method == "task.inspect":
+            return c.get_task(p["id"]).to_dict()
+        if method == "network.create":
+            spec = NetworkSpec.from_dict(p["spec"])
+            return (await c.create_network(spec)).to_dict()
+        if method == "network.ls":
+            return [n.to_dict() for n in c.list_networks()]
+        if method == "network.rm":
+            await c.remove_network(p["id"])
+            return {}
+        if method == "secret.create":
+            spec = SecretSpec.from_dict(p["spec"])
+            return (await c.create_secret(spec)).to_dict()
+        if method == "secret.ls":
+            return [s.to_dict() for s in c.list_secrets()]
+        if method == "secret.rm":
+            await c.remove_secret(p["id"])
+            return {}
+        if method == "config.create":
+            spec = ConfigSpec.from_dict(p["spec"])
+            return (await c.create_config(spec)).to_dict()
+        if method == "config.ls":
+            return [s.to_dict() for s in c.list_configs()]
+        if method == "config.rm":
+            await c.remove_config(p["id"])
+            return {}
+        raise CtlError(f"unknown method {method!r}", "unimplemented")
+
+
+class ControlSocketClient:
+    """swarmctl's side of the socket."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+
+    async def connect(self) -> None:
+        self._reader, self._writer = await asyncio.open_unix_connection(
+            self.path)
+
+    async def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+            self._reader = None
+
+    async def call(self, method: str, **params):
+        if self._writer is None:
+            await self.connect()
+        self._writer.write(json.dumps(
+            {"method": method, "params": params}).encode() + b"\n")
+        await self._writer.drain()
+        line = await self._reader.readline()
+        if not line:
+            raise CtlError("connection closed", "unavailable")
+        resp = json.loads(line)
+        if "error" in resp:
+            raise CtlError(resp["error"], resp.get("code", "unknown"))
+        return resp["result"]

@@ -1,0 +1,124 @@
+"""swarm-bench: time-to-N-running-tasks for the full control plane.
+
+Reference: cmd/swarm-bench — creates a replicated service of N tasks that
+"phone home" and measures time until all N connect (Benchmark.Run
+benchmark.go:38, Collector percentiles).  Here the phone-home is the task
+status write-back through the real dispatcher/agent loop; the measurement
+is time from CreateService until N tasks report RUNNING, with per-task
+latency percentiles.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+
+from swarmkit_tpu.agent import Agent, AgentConfig
+from swarmkit_tpu.agent.testutils import TestExecutor
+from swarmkit_tpu.api import (
+    Annotations, ContainerSpec, MembershipState, NodeSpec, ReplicatedService,
+    ServiceSpec, TaskSpec, TaskState,
+)
+from swarmkit_tpu.api.objects import Node as ApiNode, NodeStatus
+from swarmkit_tpu.manager.manager import Manager
+from swarmkit_tpu.raft.transport import Network
+from swarmkit_tpu.store.by import ByService
+from swarmkit_tpu.store.memory import Event, match
+
+
+async def bench(replicas: int, workers: int, managers: int = 1
+                ) -> dict:
+    import tempfile
+
+    net = Network(seed=1)
+    tmp = tempfile.TemporaryDirectory(prefix="swarm-bench-")
+    mgrs: list[Manager] = []
+    for i in range(managers):
+        m = Manager(node_id=f"m{i}", addr=f"m{i}:4242", network=net,
+                    state_dir=f"{tmp.name}/m{i}",
+                    join_addr=mgrs[0].addr if mgrs else "",
+                    tick_interval=0.05, election_tick=4, seed=i)
+        await m.start()
+        mgrs.append(m)
+        if i == 0:
+            while not m.is_leader():
+                await asyncio.sleep(0.02)
+
+    lead = mgrs[0]
+
+    def connect():
+        for m in mgrs:
+            if m.is_leader():
+                return m.dispatcher
+        return lead.dispatcher
+
+    agents = []
+    for i in range(workers):
+        await lead.store.update(lambda tx, i=i: tx.create(ApiNode(
+            id=f"w{i}", spec=NodeSpec(annotations=Annotations(name=f"w{i}"),
+                                      membership=MembershipState.ACCEPTED),
+            status=NodeStatus())))
+        a = Agent(AgentConfig(node_id=f"w{i}",
+                              executor=TestExecutor(hostname=f"w{i}"),
+                              connect=connect))
+        await a.start()
+        agents.append(a)
+    for a in agents:
+        await a.ready()
+
+    # measure: create service -> all replicas RUNNING
+    latencies: dict[str, float] = {}
+    start = time.perf_counter()
+    svc = await lead.control_api.create_service(ServiceSpec(
+        annotations=Annotations(name="bench"),
+        task=TaskSpec(container=ContainerSpec(image="img")),
+        replicated=ReplicatedService(replicas=replicas)))
+    watcher = lead.store.watch(match(kind="task", action="update"))
+    running = set()
+    async for ev in watcher:
+        t = ev.object
+        if t.service_id == svc.id and t.status.state == TaskState.RUNNING \
+                and t.id not in running:
+            running.add(t.id)
+            latencies[t.id] = time.perf_counter() - start
+            if len(running) >= replicas:
+                break
+    watcher.close()
+    total = time.perf_counter() - start
+
+    lat = sorted(latencies.values())
+
+    def pct(p):
+        return lat[min(len(lat) - 1, int(p * len(lat)))]
+
+    for a in agents:
+        await a.stop()
+    for m in mgrs:
+        await m.stop()
+    return {
+        "replicas": replicas, "workers": workers,
+        "time_to_all_running_s": round(total, 4),
+        "tasks_per_s": round(replicas / total, 2),
+        "p50_s": round(pct(0.50), 4),
+        "p90_s": round(pct(0.90), 4),
+        "p99_s": round(pct(0.99), 4),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="swarm-bench")
+    p.add_argument("--replicas", type=int, default=100)
+    p.add_argument("--workers", type=int, default=10)
+    p.add_argument("--managers", type=int, default=1)
+    args = p.parse_args(argv)
+    result = asyncio.run(bench(args.replicas, args.workers, args.managers))
+    json.dump(result, sys.stdout)
+    sys.stdout.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

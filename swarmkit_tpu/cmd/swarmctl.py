@@ -1,0 +1,176 @@
+"""swarmctl: CLI over the control socket.
+
+Reference: cmd/swarmctl — cluster/node/service/task/network/secret/config
+subcommands against the Control API unix socket.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from swarmkit_tpu.api import TaskState
+from swarmkit_tpu.cmd.ctl import ControlSocketClient, CtlError
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="swarmctl")
+    p.add_argument("--socket", "-s", default="./swarmkitstate/swarmd.sock")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("cluster-inspect")
+    sub.add_parser("cluster-tokens")
+
+    sub.add_parser("node-ls")
+    for name in ("node-inspect", "node-rm", "node-promote", "node-demote"):
+        sp = sub.add_parser(name)
+        sp.add_argument("id")
+        if name == "node-rm":
+            sp.add_argument("--force", action="store_true")
+
+    sp = sub.add_parser("service-create")
+    sp.add_argument("--name", required=True)
+    sp.add_argument("--image", required=True)
+    sp.add_argument("--replicas", type=int, default=1)
+    sp.add_argument("--env", action="append", default=[])
+    sp.add_argument("--constraint", action="append", default=[])
+    sp.add_argument("--publish", action="append", default=[],
+                    help="published:target port, e.g. 8080:80")
+    sub.add_parser("service-ls")
+    for name in ("service-inspect", "service-rm"):
+        sub.add_parser(name).add_argument("id")
+    sp = sub.add_parser("service-scale")
+    sp.add_argument("id")
+    sp.add_argument("replicas", type=int)
+
+    sp = sub.add_parser("task-ls")
+    sp.add_argument("--service", default=None)
+
+    sp = sub.add_parser("network-create")
+    sp.add_argument("--name", required=True)
+    sub.add_parser("network-ls")
+    sub.add_parser("network-rm").add_argument("id")
+
+    for kind in ("secret", "config"):
+        sp = sub.add_parser(f"{kind}-create")
+        sp.add_argument("name")
+        sp.add_argument("--data", required=True)
+        sub.add_parser(f"{kind}-ls")
+        sub.add_parser(f"{kind}-rm").add_argument("id")
+    return p
+
+
+def _service_spec(args) -> dict:
+    spec = {
+        "annotations": {"name": args.name},
+        "task": {"container": {"image": args.image, "env": args.env},
+                 "placement": {"constraints": args.constraint}},
+        "replicated": {"replicas": args.replicas},
+    }
+    if args.publish:
+        ports = []
+        for spec_str in args.publish:
+            pub, _, tgt = spec_str.partition(":")
+            ports.append({"protocol": "tcp", "published_port": int(pub),
+                          "target_port": int(tgt or pub),
+                          "publish_mode": "ingress"})
+        spec["endpoint"] = {"ports": ports}
+    return spec
+
+
+async def run(args, out=None) -> int:
+    out = out or sys.stdout
+    client = ControlSocketClient(args.socket)
+
+    def show(obj):
+        json.dump(obj, out, indent=2, default=str)
+        out.write("\n")
+
+    try:
+        c = args.cmd
+        if c == "cluster-inspect":
+            show(await client.call("cluster.inspect"))
+        elif c == "cluster-tokens":
+            show(await client.call("cluster.unlock-key"))
+        elif c == "node-ls":
+            for n in await client.call("node.ls"):
+                role = "manager" if n.get("role") else "worker"
+                state = {0: "unknown", 1: "down", 2: "ready",
+                         3: "disconnected"}.get(
+                    n.get("status", {}).get("state", 0), "?")
+                out.write(f"{n['id']}\t{role}\t{state}\n")
+        elif c == "node-inspect":
+            show(await client.call("node.inspect", id=args.id))
+        elif c == "node-rm":
+            await client.call("node.rm", id=args.id, force=args.force)
+        elif c == "node-promote":
+            await client.call("node.promote", id=args.id)
+        elif c == "node-demote":
+            await client.call("node.demote", id=args.id)
+        elif c == "service-create":
+            show(await client.call("service.create",
+                                   spec=_service_spec(args)))
+        elif c == "service-ls":
+            for s in await client.call("service.ls"):
+                name = s["spec"]["annotations"]["name"]
+                replicas = s["spec"].get("replicated", {}).get("replicas", "")
+                out.write(f"{s['id']}\t{name}\t{replicas}\n")
+        elif c == "service-inspect":
+            show(await client.call("service.inspect", id=args.id))
+        elif c == "service-scale":
+            svc = await client.call("service.inspect", id=args.id)
+            svc["spec"]["replicated"]["replicas"] = args.replicas
+            show(await client.call(
+                "service.update", id=args.id, spec=svc["spec"],
+                version=svc["meta"]["version"]["index"]))
+        elif c == "service-rm":
+            await client.call("service.rm", id=args.id)
+        elif c == "task-ls":
+            ids = [args.service] if args.service else None
+            for t in await client.call("task.ls", service_ids=ids):
+                state = TaskState(t.get("status", {}).get("state", 0)).name
+                out.write(f"{t['id']}\t{t.get('node_id','')}\t{state}\n")
+        elif c == "network-create":
+            show(await client.call("network.create",
+                                   spec={"annotations": {"name": args.name}}))
+        elif c == "network-ls":
+            for n in await client.call("network.ls"):
+                out.write(f"{n['id']}\t{n['spec']['annotations']['name']}\n")
+        elif c == "network-rm":
+            await client.call("network.rm", id=args.id)
+        elif c.endswith("-create") and c.split("-")[0] in ("secret",
+                                                          "config"):
+            kind = c.split("-")[0]
+            import base64
+
+            show(await client.call(
+                f"{kind}.create",
+                spec={"annotations": {"name": args.name},
+                      "data": {"__b64__": base64.b64encode(
+                          args.data.encode()).decode()}}))
+        elif c in ("secret-ls", "config-ls"):
+            kind = c.split("-")[0]
+            for s in await client.call(f"{kind}.ls"):
+                out.write(f"{s['id']}\t{s['spec']['annotations']['name']}\n")
+        elif c in ("secret-rm", "config-rm"):
+            await client.call(f"{c.split('-')[0]}.rm", id=args.id)
+        else:
+            out.write(f"unknown command {c}\n")
+            return 2
+        return 0
+    except CtlError as e:
+        print(f"error ({e.code}): {e}", file=sys.stderr)
+        return 1
+    finally:
+        await client.close()
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return asyncio.run(run(args))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,112 @@
+"""swarmd: the node daemon.
+
+Reference: cmd/swarmd/main.go (flags at :255-273 — --state-dir,
+--join-addr, --join-token, --listen-control-api, --hostname,
+--heartbeat-tick, --election-tick, --manager).  Runs one
+``swarmkit_tpu.node.Node``; the control API is served on a unix socket for
+swarmctl.  Single-process transport today (in-proc Network); the gRPC
+transport slots in via --backend once cross-host raft lands.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import signal
+
+from swarmkit_tpu.agent.testutils import TestExecutor
+from swarmkit_tpu.cmd.ctl import ControlSocketServer
+from swarmkit_tpu.node import Node, NodeConfig
+from swarmkit_tpu.raft.transport import Network
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="swarmd", description="swarmkit-tpu node daemon")
+    p.add_argument("--state-dir", default="./swarmkitstate",
+                   help="state directory (reference default: "
+                        "$HOME/.swarmkit)")
+    p.add_argument("--hostname", default="",
+                   help="override reported hostname")
+    p.add_argument("--node-id", default="", help="node id (default: random)")
+    p.add_argument("--join-addr", default="",
+                   help="address of a manager to join")
+    p.add_argument("--join-token", default="", help="cluster join token")
+    p.add_argument("--listen-remote-api", default="0.0.0.0:4242",
+                   help="listen address for raft/dispatcher traffic")
+    p.add_argument("--listen-control-api", default="./swarmkitstate/swarmd.sock",
+                   help="control API unix socket for swarmctl")
+    p.add_argument("--manager", action="store_true",
+                   help="start as a manager (bootstrap if no join-addr)")
+    p.add_argument("--force-new-cluster", action="store_true")
+    p.add_argument("--heartbeat-tick", type=int, default=1)
+    p.add_argument("--election-tick", type=int, default=10)
+    p.add_argument("--unlock-key", default="")
+    return p
+
+
+async def run(args, network=None, executor=None) -> Node:
+    """Build + start the node; returns it (caller owns shutdown)."""
+    from swarmkit_tpu.utils.identity import new_id
+
+    network = network or Network()
+    node_id = args.node_id or new_id()
+    executor = executor or TestExecutor(hostname=args.hostname or node_id)
+    nodes = {}
+
+    def dialer(addr):
+        for n in nodes.values():
+            m = n._running_manager()
+            if m is not None and m.addr == addr:
+                return m
+        return None
+
+    node = Node(NodeConfig(
+        node_id=node_id,
+        state_dir=args.state_dir,
+        executor=executor,
+        network=network,
+        dialer=dialer,
+        listen_addr=args.listen_remote_api,
+        join_addr=args.join_addr,
+        join_token=args.join_token,
+        is_manager=args.manager,
+        force_new_cluster=args.force_new_cluster,
+        election_tick=args.election_tick,
+        heartbeat_tick=args.heartbeat_tick,
+        unlock_key=args.unlock_key.encode() if args.unlock_key else None))
+    nodes[node_id] = node
+    await node.start()
+
+    os.makedirs(os.path.dirname(args.listen_control_api) or ".",
+                exist_ok=True)
+    if os.path.exists(args.listen_control_api):
+        os.unlink(args.listen_control_api)
+    ctl = ControlSocketServer(node, args.listen_control_api)
+    await ctl.start()
+    node._ctl_server = ctl
+    return node
+
+
+async def main_async(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+    node = await run(args)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await node._ctl_server.stop()
+    await node.stop()
+
+
+def main(argv=None) -> None:
+    asyncio.run(main_async(argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -367,16 +367,27 @@ class ControlApi:
         await self.store.update(txn)
 
     # -- cluster ---------------------------------------------------------
+    @staticmethod
+    def _redact_cluster(cl: Cluster) -> Cluster:
+        """Strip private material before returning cluster objects
+        (reference: controlapi/cluster.go redactClusters — CA keys and
+        unlock keys never leave the manager)."""
+        cl = cl.copy()
+        cl.root_ca.ca_key = b""
+        cl.unlock_keys = []
+        return cl
+
     def get_cluster(self, cluster_id: str = "") -> Cluster:
         if cluster_id:
-            return self._get("cluster", cluster_id)
+            return self._redact_cluster(self._get("cluster", cluster_id))
         clusters = self.store.find("cluster")
         if not clusters:
             raise NotFound("cluster not found")
-        return clusters[0]
+        return self._redact_cluster(clusters[0])
 
     def list_clusters(self, **kw) -> list[Cluster]:
-        return self.store.find("cluster")
+        return [self._redact_cluster(c)
+                for c in self.store.find("cluster")]
 
     async def update_cluster(self, cluster_id: str, spec,
                              version: Optional[int] = None,
@@ -393,6 +404,12 @@ class ControlApi:
             self._check_version(cl, version)
             cl = cl.copy()
             cl.spec = spec.copy()
+            if (rotate_worker_token or rotate_manager_token) \
+                    and not cl.root_ca.ca_cert:
+                # a token without the CA digest could never be accepted by
+                # the CA server — refuse loudly instead of minting it
+                raise FailedPrecondition(
+                    "cluster has no root CA; cannot rotate join tokens")
             if rotate_worker_token:
                 cl.root_ca.join_token_worker = generate_join_token(
                     ca_cert=cl.root_ca.ca_cert)
@@ -601,13 +618,11 @@ class ControlApi:
 def generate_join_token(secret: Optional[str] = None,
                         ca_cert: bytes = b"") -> str:
     """``SWMTKN-1-<ca digest>-<secret>`` (reference: ca/config.go
-    GenerateJoinToken)."""
-    import secrets as pysecrets
+    GenerateJoinToken).  A CA certificate is required — a digest-less
+    token would be unjoinable."""
+    if not ca_cert:
+        raise ValueError("cannot generate a join token without a root CA")
+    from swarmkit_tpu.ca import RootCA
+    from swarmkit_tpu.ca import generate_join_token as ca_generate
 
-    if ca_cert:
-        from swarmkit_tpu.ca import RootCA
-        from swarmkit_tpu.ca import generate_join_token as ca_generate
-
-        return ca_generate(RootCA(ca_cert), secret)
-    body = secret or pysecrets.token_hex(16)
-    return f"SWMTKN-1-none-{body}"
+    return ca_generate(RootCA(ca_cert), secret)

@@ -46,14 +46,15 @@ class ConnectionBroker:
             return None
         return self.dialer(leader_addr)
 
-    def select_dispatcher(self):
-        """The leader's dispatcher, preferring the local manager as the
-        route in (reference: broker.Select, local socket first)."""
+    def select_leader(self):
+        """Resolve the cluster leader's Manager, preferring the local
+        manager as the route in (reference: broker.Select, local socket
+        first).  Raises NoManagerError when unreachable."""
         candidates = []
         local = self.local_manager()
         if local is not None:
             candidates.append(local)
-        tried = set()
+        tried = {id(local)} if local is not None else set()
         for addr in sorted(self.remotes.weights(),
                            key=lambda a: -self.remotes.weights()[a]):
             m = self.dialer(addr)
@@ -63,18 +64,17 @@ class ConnectionBroker:
         for m in candidates:
             leader = self._leader_of(m)
             if leader is not None:
-                return leader.dispatcher
+                return leader
         raise NoManagerError("cannot locate the cluster leader")
 
+    def select_dispatcher(self):
+        return self.select_leader().dispatcher
+
     def select_control(self):
-        """The leader's control API (for promotions, harness use)."""
-        local = self.local_manager()
-        for m in [local] if local is not None else []:
-            leader = self._leader_of(m)
-            if leader is not None:
-                return leader.control_api
-        for addr in self.remotes.weights():
-            leader = self._leader_of(self.dialer(addr))
-            if leader is not None:
-                return leader.control_api
-        raise NoManagerError("cannot locate the cluster leader")
+        return self.select_leader().control_api
+
+    def select_ca(self):
+        ca = self.select_leader().ca_server
+        if ca is None:
+            raise NoManagerError("leader has no CA server")
+        return ca

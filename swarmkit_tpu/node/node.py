@@ -113,17 +113,12 @@ class Node:
     # ------------------------------------------------------------------
     def _ca_client(self):
         """The leader's CA server, resolved like any agent-side RPC."""
-        local = self._running_manager()
-        candidates = [local] if local is not None else []
-        for addr in self.remotes.weights():
-            m = self.config.dialer(addr)
-            if m is not None:
-                candidates.append(m)
-        for m in candidates:
-            leader = self.broker._leader_of(m)
-            if leader is not None and leader.ca_server is not None:
-                return leader.ca_server
-        return None
+        from swarmkit_tpu.node.connectionbroker import NoManagerError
+
+        try:
+            return self.broker.select_ca()
+        except NoManagerError:
+            return None
 
     async def _load_security_config(self) -> None:
         """Obtain (or restore) this node's TLS identity

@@ -1,0 +1,66 @@
+"""Go-template-style expansion in container specs.
+
+Reference: template/ (513 LoC) — expands ``{{.Service.Name}}``,
+``{{.Task.Slot}}``, ``{{.Node.Hostname}}`` … in env vars, hostname and
+mount sources of a task's container spec, with the per-task context built
+from the task + node objects (template/context.go NewContext).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+_VAR_RE = re.compile(r"\{\{\s*\.([A-Za-z.]+)\s*\}\}")
+
+
+class TemplateError(Exception):
+    pass
+
+
+def task_context(task, node=None) -> dict[str, str]:
+    """reference: template/context.go Context fields."""
+    service_name = task.service_annotations.name
+    slot = str(task.slot) if task.slot else task.node_id
+    ctx = {
+        "Service.ID": task.service_id,
+        "Service.Name": service_name,
+        "Task.ID": task.id,
+        "Task.Name": f"{service_name}.{slot}.{task.id}" if service_name
+                     else task.id,
+        "Task.Slot": str(task.slot),
+    }
+    for k, v in task.service_annotations.labels.items():
+        ctx[f"Service.Labels.{k}"] = v
+    if node is not None:
+        ctx["Node.ID"] = node.id
+        hostname = node.description.hostname if node.description else ""
+        ctx["Node.Hostname"] = hostname
+        plat = node.description.platform if node.description else None
+        ctx["Node.Platform.OS"] = plat.os if plat else ""
+        ctx["Node.Platform.Architecture"] = plat.architecture if plat else ""
+    return ctx
+
+
+def expand(text: str, ctx: dict[str, str]) -> str:
+    def sub(m: re.Match) -> str:
+        key = m.group(1)
+        if key not in ctx:
+            raise TemplateError(f"unknown template variable .{key}")
+        return ctx[key]
+
+    return _VAR_RE.sub(sub, text)
+
+
+def expand_container_spec(task, node=None):
+    """Return a task copy with its container spec expanded
+    (reference: template/expand.go ExpandContainerSpec)."""
+    if task.spec.container is None:
+        return task
+    ctx = task_context(task, node)
+    t = task.copy()
+    c = t.spec.container
+    c.env = [expand(e, ctx) for e in c.env]
+    if c.hostname:
+        c.hostname = expand(c.hostname, ctx)
+    return t

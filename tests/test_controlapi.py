@@ -193,9 +193,12 @@ async def test_secret_lifecycle_and_redaction():
 
 @async_test
 async def test_cluster_update_and_token_rotation():
+    from swarmkit_tpu.ca import RootCA
+
     c = api()
     cl = Cluster(id="c1", spec=ClusterSpec(
         annotations=Annotations(name="default")))
+    cl.root_ca.ca_cert = RootCA.create().cert_pem
     cl.root_ca.join_token_worker = "SWMTKN-1-old-worker"
     cl.root_ca.join_token_manager = "SWMTKN-1-old-manager"
     await c.store.update(lambda tx: tx.create(cl))
