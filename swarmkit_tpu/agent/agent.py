@@ -141,6 +141,7 @@ class Agent:
     async def _handle_session_message(self, msg) -> None:
         """reference: handleSessionMessage agent.go:393."""
         if msg.node is not None:
+            self.worker.node = msg.node   # template-expansion context
             try:
                 await self.config.executor.configure(msg.node)
             except Exception:

@@ -35,6 +35,7 @@ class Worker:
         self.db = db or TaskDB()
         self.clock = clock or SystemClock()
         self.dependencies = Dependencies()
+        self.node = None   # latest node object from the session stream
         self.task_managers: dict[str, TaskManager] = {}
         # freshest status per task, for re-reporting on reconnection
         self.statuses: dict[str, TaskStatus] = {}
@@ -137,8 +138,21 @@ class Worker:
         if task.status.state >= TaskState.COMPLETE:
             self.statuses[task.id] = task.status
             return  # nothing to drive
+        # expand {{.Service.Name}}-style templates against this node
+        # (reference: dockerapi controller runs ExpandContainerSpec)
         try:
-            controller = await self.executor.controller(task)
+            from swarmkit_tpu.template import expand_container_spec
+
+            expanded = expand_container_spec(task, self.node)
+        except Exception as e:
+            status = task.status.copy()
+            status.state = TaskState.REJECTED
+            status.err = f"template expansion failed: {e}"
+            status.timestamp = self.clock.now()
+            await self._report(task.id, status)
+            return
+        try:
+            controller = await self.executor.controller(expanded)
         except Exception as e:
             status = task.status.copy()
             status.state = TaskState.REJECTED

@@ -93,6 +93,7 @@ class KeyReadWriter:
         first byte (keys must never exist world-readable, even as .tmp)."""
         tmp = path + ".tmp"
         fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, mode)
+        os.fchmod(fd, mode)  # O_CREAT mode is skipped if tmp pre-exists
         with os.fdopen(fd, "wb") as f:
             f.write(data)
             f.flush()

@@ -16,8 +16,8 @@ import logging
 from typing import Optional
 
 from swarmkit_tpu.api import (
-    Annotations, ConfigSpec, NetworkSpec, NodeAvailability, NodeRole,
-    SecretSpec, ServiceSpec, TaskState,
+    ConfigSpec, NetworkSpec, NodeAvailability, NodeRole, SecretSpec,
+    ServiceSpec,
 )
 from swarmkit_tpu.manager.controlapi import ControlError
 

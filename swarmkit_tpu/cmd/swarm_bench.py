@@ -69,14 +69,15 @@ async def bench(replicas: int, workers: int, managers: int = 1
     for a in agents:
         await a.ready()
 
-    # measure: create service -> all replicas RUNNING
+    # measure: create service -> all replicas RUNNING.  Subscribe BEFORE
+    # creating so instantly-running tasks can't slip past the watcher.
     latencies: dict[str, float] = {}
+    watcher = lead.store.watch(match(kind="task", action="update"))
     start = time.perf_counter()
     svc = await lead.control_api.create_service(ServiceSpec(
         annotations=Annotations(name="bench"),
         task=TaskSpec(container=ContainerSpec(image="img")),
         replicated=ReplicatedService(replicas=replicas)))
-    watcher = lead.store.watch(match(kind="task", action="update"))
     running = set()
     async for ev in watcher:
         t = ev.object

@@ -121,6 +121,10 @@ async def run(args, out=None) -> int:
             show(await client.call("service.inspect", id=args.id))
         elif c == "service-scale":
             svc = await client.call("service.inspect", id=args.id)
+            if not svc["spec"].get("replicated"):
+                print("error: only replicated services can be scaled",
+                      file=sys.stderr)
+                return 1
             svc["spec"]["replicated"]["replicas"] = args.replicas
             show(await client.call(
                 "service.update", id=args.id, spec=svc["spec"],

@@ -47,14 +47,20 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-async def run(args, network=None, executor=None) -> Node:
-    """Build + start the node; returns it (caller owns shutdown)."""
+async def run(args, network=None, executor=None, registry=None) -> Node:
+    """Build + start the node; returns it (caller owns shutdown).
+
+    ``registry`` is the node directory the dialer resolves addresses
+    against — share one dict (and one Network) across run() calls to host
+    several joined nodes in one process.  Cross-process joins ride the gRPC
+    transport once wired; a lone daemon resolves only itself.
+    """
     from swarmkit_tpu.utils.identity import new_id
 
     network = network or Network()
     node_id = args.node_id or new_id()
     executor = executor or TestExecutor(hostname=args.hostname or node_id)
-    nodes = {}
+    nodes = registry if registry is not None else {}
 
     def dialer(addr):
         for n in nodes.values():

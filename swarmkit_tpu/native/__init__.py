@@ -1,0 +1,147 @@
+"""Native (C++) runtime components, loaded via ctypes with a pure-Python
+fallback.
+
+The reference's WAL hot path lives in compiled Go (coreos/etcd/wal); here
+the equivalent is wal_codec.cpp, compiled on first use with g++ into a
+cached shared object.  ``wal_codec()`` returns the module-level codec —
+native when the toolchain is available, Python otherwise — with one
+interface:
+
+    frame(bodies: list[bytes]) -> bytes         # batch-frame records
+    scan(blob: bytes) -> (list[bytes], status)  # validated record bodies
+        status: 0 clean, 1 torn tail dropped, 2 corrupt mid-stream
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import struct
+import subprocess
+import tempfile
+import zlib
+from typing import Optional
+
+log = logging.getLogger("swarmkit_tpu.native")
+
+_FRAME = struct.Struct("<II")
+
+STATUS_OK = 0
+STATUS_TORN_TAIL = 1
+STATUS_CORRUPT = 2
+
+
+class PyWalCodec:
+    """Pure-Python fallback; semantics identical to wal_codec.cpp."""
+
+    name = "python"
+
+    def frame(self, bodies: list[bytes]) -> bytes:
+        out = bytearray()
+        for body in bodies:
+            out += _FRAME.pack(len(body), zlib.crc32(body)) + body
+        return bytes(out)
+
+    def scan(self, blob: bytes) -> tuple[list[bytes], int]:
+        records: list[bytes] = []
+        off = 0
+        n = len(blob)
+        while off < n:
+            if off + _FRAME.size > n:
+                return records, STATUS_TORN_TAIL
+            length, crc = _FRAME.unpack_from(blob, off)
+            body = blob[off + _FRAME.size: off + _FRAME.size + length]
+            if len(body) < length:
+                return records, STATUS_TORN_TAIL
+            if zlib.crc32(body) != crc:
+                if off + _FRAME.size + length >= n:
+                    return records, STATUS_TORN_TAIL
+                return records, STATUS_CORRUPT
+            records.append(body)
+            off += _FRAME.size + length
+        return records, STATUS_OK
+
+
+class NativeWalCodec:
+    name = "native"
+
+    def __init__(self, lib: ctypes.CDLL) -> None:
+        self._lib = lib
+        lib.wal_frame_size.restype = ctypes.c_uint64
+        lib.wal_frame_size.argtypes = [ctypes.POINTER(ctypes.c_uint64),
+                                       ctypes.c_uint64]
+        lib.wal_frame.restype = ctypes.c_uint64
+        lib.wal_frame.argtypes = [ctypes.c_char_p,
+                                  ctypes.POINTER(ctypes.c_uint64),
+                                  ctypes.c_uint64, ctypes.c_char_p]
+        lib.wal_scan.restype = ctypes.c_uint64
+        lib.wal_scan.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                 ctypes.POINTER(ctypes.c_uint64),
+                                 ctypes.POINTER(ctypes.c_uint64),
+                                 ctypes.c_uint64]
+        lib.wal_scan_status.restype = ctypes.c_int
+
+    def frame(self, bodies: list[bytes]) -> bytes:
+        n = len(bodies)
+        lens = (ctypes.c_uint64 * n)(*[len(b) for b in bodies])
+        concat = b"".join(bodies)
+        total = self._lib.wal_frame_size(lens, n)
+        out = ctypes.create_string_buffer(total)
+        written = self._lib.wal_frame(concat, lens, n, out)
+        return out.raw[:written]
+
+    def scan(self, blob: bytes) -> tuple[list[bytes], int]:
+        # worst case: every record is empty -> len/8 records
+        max_records = max(1, len(blob) // _FRAME.size)
+        offs = (ctypes.c_uint64 * max_records)()
+        lens = (ctypes.c_uint64 * max_records)()
+        count = self._lib.wal_scan(blob, len(blob), offs, lens, max_records)
+        status = self._lib.wal_scan_status()
+        return ([blob[offs[i]: offs[i] + lens[i]] for i in range(count)],
+                status)
+
+
+_codec = None
+_codec_lock = __import__("threading").Lock()
+
+
+def prebuild_in_background() -> None:
+    """Kick the (one-time, up to ~1 s) g++ compile off the event loop —
+    called at storage-module import so the first WAL write never blocks a
+    raft tick on a cold cache."""
+    import threading
+
+    threading.Thread(target=wal_codec, daemon=True).start()
+
+
+def _build_native() -> Optional[NativeWalCodec]:
+    src = os.path.join(os.path.dirname(__file__), "wal_codec.cpp")
+    cache_dir = os.path.join(tempfile.gettempdir(), "swarmkit_tpu_native")
+    os.makedirs(cache_dir, exist_ok=True)
+    so_path = os.path.join(cache_dir, "wal_codec.so")
+    try:
+        if not os.path.exists(so_path) \
+                or os.path.getmtime(so_path) < os.path.getmtime(src):
+            tmp_so = so_path + f".build-{os.getpid()}"
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-o", tmp_so, src],
+                check=True, capture_output=True, timeout=120)
+            os.replace(tmp_so, so_path)
+        return NativeWalCodec(ctypes.CDLL(so_path))
+    except Exception as e:
+        log.info("native wal codec unavailable (%s); using python", e)
+        return None
+
+
+def wal_codec():
+    """The process-wide codec (native if buildable); thread-safe."""
+    global _codec
+    if _codec is None:
+        with _codec_lock:
+            if _codec is None:
+                if os.environ.get("SWARMKIT_TPU_NO_NATIVE"):
+                    _codec = PyWalCodec()
+                else:
+                    _codec = _build_native() or PyWalCodec()
+    return _codec

@@ -17,8 +17,6 @@ corrupt record mid-file raises.
 from __future__ import annotations
 
 import os
-import struct
-import zlib
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
@@ -33,7 +31,11 @@ from swarmkit_tpu.raft.messages import Entry, EntryType, HardState, Snapshot, Sn
 _REC_HARDSTATE = 1
 _REC_ENTRY = 2
 
-_FRAME = struct.Struct("<II")  # length, crc32
+# frame layout lives in swarmkit_tpu/native (wal_codec.cpp): u32 len,
+# u32 crc32, body
+from swarmkit_tpu.native import prebuild_in_background as _prebuild
+
+_prebuild()
 
 
 class DataCorrupt(Exception):
@@ -85,9 +87,16 @@ class _Segment:
         self._f = open(path, "ab")
 
     def append(self, rec_type: int, payload: bytes) -> None:
-        env = self.encrypter.encrypt(msgpack.packb((rec_type, payload)))
-        body = env.encode()
-        self._f.write(_FRAME.pack(len(body), zlib.crc32(body)) + body)
+        self.append_many([(rec_type, payload)])
+
+    def append_many(self, records: list[tuple[int, bytes]]) -> None:
+        """Batch-frame records in one native call (native/wal_codec.cpp —
+        the analog of etcd/wal's compiled encoder)."""
+        from swarmkit_tpu.native import wal_codec
+
+        bodies = [self.encrypter.encrypt(
+            msgpack.packb((rt, pl))).encode() for rt, pl in records]
+        self._f.write(wal_codec().frame(bodies))
 
     def sync(self) -> None:
         self._f.flush()
@@ -102,25 +111,20 @@ class _Segment:
 
 
 def _read_segment(path: str, decrypter: Decrypter) -> list[tuple[int, bytes]]:
-    records = []
+    """Validated scan via the native codec (torn tails dropped, mid-WAL
+    corruption fatal — matching etcd/wal semantics)."""
+    from swarmkit_tpu.native import STATUS_CORRUPT, wal_codec
+
     with open(path, "rb") as f:
         blob = f.read()
-    off = 0
-    while off < len(blob):
-        if off + _FRAME.size > len(blob):
-            break  # torn frame header at tail: drop
-        length, crc = _FRAME.unpack_from(blob, off)
-        body = blob[off + _FRAME.size: off + _FRAME.size + length]
-        if len(body) < length:
-            break  # torn record at tail: drop
-        if zlib.crc32(body) != crc:
-            if off + _FRAME.size + length >= len(blob):
-                break  # corrupt tail record: treat as torn
-            raise DataCorrupt(f"crc mismatch mid-WAL in {path}")
+    bodies, status = wal_codec().scan(blob)
+    if status == STATUS_CORRUPT:
+        raise DataCorrupt(f"crc mismatch mid-WAL in {path}")
+    records = []
+    for body in bodies:
         raw = decrypter.decrypt(MaybeEncryptedRecord.decode(body))
         rec_type, payload = msgpack.unpackb(raw)
         records.append((rec_type, payload))
-        off += _FRAME.size + length
     return records
 
 
@@ -220,11 +224,12 @@ class EncryptedRaftLogger:
         single fsync per batch, like wal.Save."""
         if self._segment is None:
             raise RuntimeError("logger not bootstrapped")
+        records: list[tuple[int, bytes]] = []
         if hard_state is not None:
-            self._segment.append(_REC_HARDSTATE, _pack_hardstate(hard_state))
-        for e in entries:
-            self._segment.append(_REC_ENTRY, _pack_entry(e))
-        if hard_state is not None or entries:
+            records.append((_REC_HARDSTATE, _pack_hardstate(hard_state)))
+        records.extend((_REC_ENTRY, _pack_entry(e)) for e in entries)
+        if records:
+            self._segment.append_many(records)
             self._segment.sync()
 
     def save_snapshot(self, snapshot: Snapshot,

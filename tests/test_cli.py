@@ -160,3 +160,48 @@ async def test_rafttool_dump():
     dump = out.getvalue()
     assert "NORMAL" in dump
     assert "web" in dump  # the create-service request decoded
+
+
+@async_test
+async def test_template_expansion_through_agent():
+    """A templated env var reaches the executor expanded (reference:
+    dockerapi controller + template.ExpandContainerSpec)."""
+    import random
+
+    from swarmkit_tpu.agent import Agent, AgentConfig
+    from swarmkit_tpu.agent.testutils import TestExecutor
+    from swarmkit_tpu.api import (
+        Node, NodeSpec, NodeState, Task as ApiTask, TaskStatus,
+    )
+    from swarmkit_tpu.api.objects import NodeStatus
+    from swarmkit_tpu.manager.dispatcher import Dispatcher
+    from swarmkit_tpu.store.memory import MemoryStore
+
+    store = MemoryStore()
+    d = Dispatcher(store, rng=random.Random(0))
+    await store.update(lambda tx: tx.create(Node(
+        id="n1", spec=NodeSpec(annotations=Annotations(name="n1")),
+        description=NodeDescription(hostname="realhost"),
+        status=NodeStatus(state=NodeState.UNKNOWN))))
+    await d.start(mark_unknown=False)
+    ex = TestExecutor(hostname="realhost")
+    agent = Agent(AgentConfig(node_id="n1", executor=ex,
+                              connect=lambda: d))
+    await agent.start()
+    await agent.ready()
+
+    t = ApiTask(id="t1", node_id="n1", service_id="s1",
+                spec=TaskSpec(container=ContainerSpec(
+                    image="img", env=["WHERE={{.Node.Hostname}}"])),
+                status=TaskStatus(state=TaskState.ASSIGNED),
+                desired_state=int(TaskState.RUNNING))
+    t.service_annotations = Annotations(name="websvc")
+    await store.update(lambda tx: tx.create(t))
+    for _ in range(400):
+        if "t1" in ex.controllers:
+            break
+        await asyncio.sleep(0.005)
+    assert "t1" in ex.controllers
+    assert ex.controllers["t1"].task.spec.container.env == ["WHERE=realhost"]
+    await agent.stop()
+    await d.stop()
