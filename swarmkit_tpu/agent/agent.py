@@ -119,6 +119,12 @@ class Agent:
         self._established = True
         self._ready.set()
 
+        # absorb the registration message (node object = template context)
+        # BEFORE any assignment can race it
+        if not session.session_msgs.empty():
+            await self._handle_session_message(
+                session.session_msgs.get_nowait())
+
         smsg = asyncio.ensure_future(session.session_msgs.get())
         amsg = asyncio.ensure_future(session.assignments.get())
         emsg = asyncio.ensure_future(session.errs.get())
@@ -141,7 +147,7 @@ class Agent:
     async def _handle_session_message(self, msg) -> None:
         """reference: handleSessionMessage agent.go:393."""
         if msg.node is not None:
-            self.worker.node = msg.node   # template-expansion context
+            self.worker.set_node(msg.node)   # template-expansion context
             try:
                 await self.config.executor.configure(msg.node)
             except Exception:

@@ -28,6 +28,9 @@ class TaskDB:
             " data TEXT NOT NULL,"
             " status TEXT,"
             " assigned INTEGER NOT NULL DEFAULT 0)")
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS meta ("
+            " key TEXT PRIMARY KEY, value TEXT NOT NULL)")
         self._db.commit()
 
     def close(self) -> None:
@@ -76,6 +79,24 @@ class TaskDB:
         row = self._db.execute(
             "SELECT assigned FROM tasks WHERE id = ?", (task_id,)).fetchone()
         return bool(row and row[0])
+
+    def put_node(self, node) -> None:
+        """Persist the last-known node object so a restarted worker can
+        expand task templates before the first session message arrives."""
+        self._db.execute(
+            "INSERT INTO meta (key, value) VALUES ('node', ?)"
+            " ON CONFLICT(key) DO UPDATE SET value = excluded.value",
+            (json.dumps(node.to_dict()),))
+        self._db.commit()
+
+    def get_node(self):
+        from swarmkit_tpu.api import Node
+
+        row = self._db.execute(
+            "SELECT value FROM meta WHERE key = 'node'").fetchone()
+        if row is None:
+            return None
+        return Node.from_dict(json.loads(row[0]))
 
     def walk(self) -> Iterable[tuple[Task, Optional[TaskStatus], bool]]:
         for tid, data, status, assigned in self._db.execute(

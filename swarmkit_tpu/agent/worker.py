@@ -42,9 +42,20 @@ class Worker:
         self._reporter: Optional[Callable[[str, TaskStatus], None]] = None
 
     # ------------------------------------------------------------------
+    def set_node(self, node) -> None:
+        """Latest node object (template-expansion context), persisted so a
+        restart can restore templated tasks before the session opens."""
+        self.node = node
+        try:
+            self.db.put_node(node)
+        except Exception:
+            pass
+
     async def init(self) -> None:
         """Resume tasks recorded in the local DB (reference: worker.Init —
         restores accepted tasks after an agent restart)."""
+        if self.node is None:
+            self.node = self.db.get_node()
         for task, status, assigned in list(self.db.walk()):
             if not assigned:
                 self.db.delete_task(task.id)

@@ -12,6 +12,7 @@ import enum
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
+from swarmkit_tpu.api.objects import Config, EncryptionKey, Node, Secret, Task
 from swarmkit_tpu.api.serde import Message
 from swarmkit_tpu.api.types import TaskStatus, WeightedPeer
 
@@ -21,9 +22,9 @@ class SessionMessage(Message):
     """Reference: api/dispatcher.proto SessionMessage."""
 
     session_id: str = ""
-    node: Any = None                       # api.Node snapshot
+    node: Optional[Node] = None
     managers: list[WeightedPeer] = field(default_factory=list)
-    network_bootstrap_keys: list = field(default_factory=list)
+    network_bootstrap_keys: list[EncryptionKey] = field(default_factory=list)
     root_ca: bytes = b""
 
 
@@ -50,9 +51,9 @@ class AssignmentAction(enum.IntEnum):
 class Assignment(Message):
     """One of task / secret / config (reference: Assignment oneof)."""
 
-    task: Any = None
-    secret: Any = None
-    config: Any = None
+    task: Optional[Task] = None
+    secret: Optional[Secret] = None
+    config: Optional[Config] = None
 
     @property
     def item(self) -> Any:

@@ -44,10 +44,12 @@ class ControlSocketServer:
         if self.node._running_manager() is None:
             raise CtlError("this node is not a manager", "unavailable")
         try:
-            # follower sockets forward to the leader (the raftproxy analog)
-            return self.node.broker.select_control()
+            # follower sockets forward to the leader (the raftproxy analog);
+            # a remote leader is driven via its Control.Call gRPC
+            leader = self.node.broker.select_leader()
         except NoManagerError:
             raise CtlError("no leader available", "unavailable")
+        return leader
 
     async def start(self) -> None:
         self._server = await asyncio.start_unix_server(
@@ -87,77 +89,85 @@ class ControlSocketServer:
 
     # ------------------------------------------------------------------
     async def _dispatch(self, method: str, p: dict):
-        c = self._control()
-        if method == "cluster.inspect":
-            return c.get_cluster().to_dict()
-        if method == "cluster.unlock-key":
-            cl = c.get_cluster()
-            return {"worker": cl.root_ca.join_token_worker,
-                    "manager": cl.root_ca.join_token_manager}
-        if method == "node.ls":
-            return [n.to_dict() for n in c.list_nodes()]
-        if method == "node.inspect":
-            return c.get_node(p["id"]).to_dict()
-        if method == "node.rm":
-            await c.remove_node(p["id"], force=p.get("force", False))
-            return {}
-        if method in ("node.promote", "node.demote", "node.update"):
-            node = c.get_node(p["id"])
-            spec = node.spec.copy()
-            if method == "node.promote":
-                spec.desired_role = NodeRole.MANAGER
-            elif method == "node.demote":
-                spec.desired_role = NodeRole.WORKER
-            if "availability" in p:
-                spec.availability = NodeAvailability(p["availability"])
-            node2 = await c.update_node(p["id"], spec,
-                                        version=node.meta.version.index)
-            return node2.to_dict()
-        if method == "service.create":
-            spec = ServiceSpec.from_dict(p["spec"])
-            return (await c.create_service(spec)).to_dict()
-        if method == "service.ls":
-            return [s.to_dict() for s in c.list_services()]
-        if method == "service.inspect":
-            return c.get_service(p["id"]).to_dict()
-        if method == "service.update":
-            spec = ServiceSpec.from_dict(p["spec"])
-            return (await c.update_service(
-                p["id"], spec, version=p.get("version"))).to_dict()
-        if method == "service.rm":
-            await c.remove_service(p["id"])
-            return {}
-        if method == "task.ls":
-            return [t.to_dict() for t in c.list_tasks(
-                service_ids=p.get("service_ids"),
-                node_ids=p.get("node_ids"))]
-        if method == "task.inspect":
-            return c.get_task(p["id"]).to_dict()
-        if method == "network.create":
-            spec = NetworkSpec.from_dict(p["spec"])
-            return (await c.create_network(spec)).to_dict()
-        if method == "network.ls":
-            return [n.to_dict() for n in c.list_networks()]
-        if method == "network.rm":
-            await c.remove_network(p["id"])
-            return {}
-        if method == "secret.create":
-            spec = SecretSpec.from_dict(p["spec"])
-            return (await c.create_secret(spec)).to_dict()
-        if method == "secret.ls":
-            return [s.to_dict() for s in c.list_secrets()]
-        if method == "secret.rm":
-            await c.remove_secret(p["id"])
-            return {}
-        if method == "config.create":
-            spec = ConfigSpec.from_dict(p["spec"])
-            return (await c.create_config(spec)).to_dict()
-        if method == "config.ls":
-            return [s.to_dict() for s in c.list_configs()]
-        if method == "config.rm":
-            await c.remove_config(p["id"])
-            return {}
-        raise CtlError(f"unknown method {method!r}", "unimplemented")
+        leader = self._control()
+        if hasattr(leader, "control_call"):
+            # remote leader (gRPC): forward the raw JSON request
+            return await leader.control_call(method, p)
+        return await dispatch_control(leader.control_api, method, p)
+
+
+async def dispatch_control(c, method: str, p: dict):
+    """Shared control-API JSON dispatch (unix socket + gRPC Control.Call)."""
+    if method == "cluster.inspect":
+        return c.get_cluster().to_dict()
+    if method == "cluster.unlock-key":
+        cl = c.get_cluster()
+        return {"worker": cl.root_ca.join_token_worker,
+                "manager": cl.root_ca.join_token_manager}
+    if method == "node.ls":
+        return [n.to_dict() for n in c.list_nodes()]
+    if method == "node.inspect":
+        return c.get_node(p["id"]).to_dict()
+    if method == "node.rm":
+        await c.remove_node(p["id"], force=p.get("force", False))
+        return {}
+    if method in ("node.promote", "node.demote", "node.update"):
+        node = c.get_node(p["id"])
+        spec = node.spec.copy()
+        if method == "node.promote":
+            spec.desired_role = NodeRole.MANAGER
+        elif method == "node.demote":
+            spec.desired_role = NodeRole.WORKER
+        if "availability" in p:
+            spec.availability = NodeAvailability(p["availability"])
+        node2 = await c.update_node(p["id"], spec,
+                                    version=node.meta.version.index)
+        return node2.to_dict()
+    if method == "service.create":
+        spec = ServiceSpec.from_dict(p["spec"])
+        return (await c.create_service(spec)).to_dict()
+    if method == "service.ls":
+        return [s.to_dict() for s in c.list_services()]
+    if method == "service.inspect":
+        return c.get_service(p["id"]).to_dict()
+    if method == "service.update":
+        spec = ServiceSpec.from_dict(p["spec"])
+        return (await c.update_service(
+            p["id"], spec, version=p.get("version"))).to_dict()
+    if method == "service.rm":
+        await c.remove_service(p["id"])
+        return {}
+    if method == "task.ls":
+        return [t.to_dict() for t in c.list_tasks(
+            service_ids=p.get("service_ids"),
+            node_ids=p.get("node_ids"))]
+    if method == "task.inspect":
+        return c.get_task(p["id"]).to_dict()
+    if method == "network.create":
+        spec = NetworkSpec.from_dict(p["spec"])
+        return (await c.create_network(spec)).to_dict()
+    if method == "network.ls":
+        return [n.to_dict() for n in c.list_networks()]
+    if method == "network.rm":
+        await c.remove_network(p["id"])
+        return {}
+    if method == "secret.create":
+        spec = SecretSpec.from_dict(p["spec"])
+        return (await c.create_secret(spec)).to_dict()
+    if method == "secret.ls":
+        return [s.to_dict() for s in c.list_secrets()]
+    if method == "secret.rm":
+        await c.remove_secret(p["id"])
+        return {}
+    if method == "config.create":
+        spec = ConfigSpec.from_dict(p["spec"])
+        return (await c.create_config(spec)).to_dict()
+    if method == "config.ls":
+        return [s.to_dict() for s in c.list_configs()]
+    if method == "config.rm":
+        await c.remove_config(p["id"])
+        return {}
+    raise CtlError(f"unknown method {method!r}", "unimplemented")
 
 
 class ControlSocketClient:

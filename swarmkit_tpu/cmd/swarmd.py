@@ -44,6 +44,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--heartbeat-tick", type=int, default=1)
     p.add_argument("--election-tick", type=int, default=10)
     p.add_argument("--unlock-key", default="")
+    p.add_argument("--backend", choices=["grpc", "inproc"], default="grpc",
+                   help="raft/cluster wire: real gRPC sockets (default) or "
+                        "in-process (single-node/testing)")
     return p
 
 
@@ -57,17 +60,44 @@ async def run(args, network=None, executor=None, registry=None) -> Node:
     """
     from swarmkit_tpu.utils.identity import new_id
 
+    use_grpc = getattr(args, "backend", "inproc") == "grpc" \
+        and network is None
+    if use_grpc:
+        from swarmkit_tpu.raft.grpc_transport import GrpcNetwork
+
+        network = GrpcNetwork()
     network = network or Network()
     node_id = args.node_id or new_id()
     executor = executor or TestExecutor(hostname=args.hostname or node_id)
     nodes = registry if registry is not None else {}
+    remote_managers: dict[str, object] = {}
 
     def dialer(addr):
         for n in nodes.values():
             m = n._running_manager()
             if m is not None and m.addr == addr:
                 return m
+        if use_grpc and addr:
+            from swarmkit_tpu.rpc import RemoteManager
+
+            rm = remote_managers.get(addr)
+            if rm is None:
+                rm = RemoteManager(addr)
+                rm.start()
+                remote_managers[addr] = rm
+            return rm
         return None
+
+    node_box: list = []
+    if use_grpc:
+        # serve dispatcher/CA/control alongside raft on the same port
+        # (reference: manager.go:526-548 service registrations)
+        from swarmkit_tpu.rpc import ClusterService
+
+        network.add_service(
+            args.listen_remote_api,
+            ClusterService(lambda: node_box[0] if node_box else None)
+            .handlers())
 
     node = Node(NodeConfig(
         node_id=node_id,
@@ -83,8 +113,10 @@ async def run(args, network=None, executor=None, registry=None) -> Node:
         election_tick=args.election_tick,
         heartbeat_tick=args.heartbeat_tick,
         unlock_key=args.unlock_key.encode() if args.unlock_key else None))
+    node_box.append(node)
     nodes[node_id] = node
     await node.start()
+    node._remote_managers = remote_managers
 
     os.makedirs(os.path.dirname(args.listen_control_api) or ".",
                 exist_ok=True)

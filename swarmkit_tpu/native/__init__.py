@@ -81,6 +81,7 @@ class NativeWalCodec:
                                  ctypes.POINTER(ctypes.c_uint64),
                                  ctypes.c_uint64]
         lib.wal_scan_status.restype = ctypes.c_int
+        lib.wal_scan_consumed.restype = ctypes.c_uint64
 
     def frame(self, bodies: list[bytes]) -> bytes:
         n = len(bodies)
@@ -91,15 +92,27 @@ class NativeWalCodec:
         written = self._lib.wal_frame(concat, lens, n, out)
         return out.raw[:written]
 
+    # bounded per-pass offset buffers; chunked resume via wal_scan_consumed
+    # avoids worst-case (len/8) allocations on huge segments
+    _SCAN_BATCH = 1 << 16
+
     def scan(self, blob: bytes) -> tuple[list[bytes], int]:
-        # worst case: every record is empty -> len/8 records
-        max_records = max(1, len(blob) // _FRAME.size)
-        offs = (ctypes.c_uint64 * max_records)()
-        lens = (ctypes.c_uint64 * max_records)()
-        count = self._lib.wal_scan(blob, len(blob), offs, lens, max_records)
-        status = self._lib.wal_scan_status()
-        return ([blob[offs[i]: offs[i] + lens[i]] for i in range(count)],
-                status)
+        batch = min(self._SCAN_BATCH, max(1, len(blob) // _FRAME.size))
+        offs = (ctypes.c_uint64 * batch)()
+        lens = (ctypes.c_uint64 * batch)()
+        records: list[bytes] = []
+        base = 0
+        view = blob
+        while True:
+            count = self._lib.wal_scan(view, len(view), offs, lens, batch)
+            status = self._lib.wal_scan_status()
+            records.extend(view[offs[i]: offs[i] + lens[i]]
+                           for i in range(count))
+            consumed = self._lib.wal_scan_consumed()
+            if status != STATUS_OK or consumed >= len(view) or count == 0:
+                return records, status
+            base += consumed
+            view = blob[base:]
 
 
 _codec = None

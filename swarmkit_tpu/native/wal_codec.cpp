@@ -69,6 +69,7 @@ uint32_t get_u32(const uint8_t* p) {
 }
 
 thread_local int g_scan_status = 0;
+thread_local uint64_t g_scan_consumed = 0;
 
 }  // namespace
 
@@ -97,6 +98,10 @@ uint64_t wal_frame(const uint8_t* bodies, const uint64_t* lens, uint64_t n,
 
 int wal_scan_status() { return g_scan_status; }
 
+// Bytes consumed by the last wal_scan — lets callers resume a chunked scan
+// without pre-allocating worst-case offset arrays.
+uint64_t wal_scan_consumed() { return g_scan_consumed; }
+
 // Scans blob, validating CRCs.  Fills offs/lens with body positions.
 // Torn frames at the tail are dropped (status 1); a CRC mismatch that is
 // NOT the final record is corruption (status 2, scan stops there).
@@ -104,6 +109,7 @@ uint64_t wal_scan(const uint8_t* blob, uint64_t len,
                   uint64_t* offs, uint64_t* lens, uint64_t max_records) {
     uint64_t off = 0, count = 0;
     g_scan_status = 0;
+    g_scan_consumed = 0;
     while (off < len && count < max_records) {
         if (off + HDR > len) { g_scan_status = 1; break; }
         uint32_t body_len = get_u32(blob + off);
@@ -119,6 +125,7 @@ uint64_t wal_scan(const uint8_t* blob, uint64_t len,
         count++;
         off += HDR + body_len;
     }
+    g_scan_consumed = off;
     return count;
 }
 

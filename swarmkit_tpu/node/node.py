@@ -239,6 +239,13 @@ class Node:
         if self.manager is not None:
             await self.manager.stop()
             self.manager = None
+        # close any gRPC RemoteManager clients the dialer created
+        for rm in getattr(self, "_remote_managers", {}).values():
+            try:
+                await rm.close()
+            except Exception:
+                pass
+        self._remote_managers = {}
 
     # ------------------------------------------------------------------
     def _on_node_change(self, node) -> None:

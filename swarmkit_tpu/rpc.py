@@ -1,0 +1,409 @@
+"""Cluster RPC services: dispatcher sessions, CA joins and control-API
+forwarding over the same gRPC server the raft transport uses.
+
+Reference: the manager's service registrations at manager/manager.go:526-548
+(Dispatcher, CA/NodeCA, Control + the generated RaftProxy wrappers that
+forward follower requests to the leader) and the agent's gRPC session
+(api/dispatcher.proto).  With this module, swarmd's --join-addr/--join-token
+work across real processes: workers join by token, open session/assignment
+streams, and report statuses over sockets; control requests hitting a
+follower are forwarded to the leader (the raftproxy analog).
+
+Server side: ``add_cluster_services(net, addr, node_ref)`` queues generic
+handlers on the GrpcNetwork before the raft server starts.  Client side:
+``RemoteManager`` implements the Manager duck type the connection broker
+needs (cached is_leader/leader_addr + remote dispatcher/CA/control).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, Callable, Optional
+
+import grpc
+import msgpack
+
+from swarmkit_tpu.api import TaskStatus, WeightedPeer
+from swarmkit_tpu.api.dispatcher_msgs import (
+    AssignmentsMessage, HeartbeatResponse, SessionMessage,
+)
+from swarmkit_tpu.api.types import NodeDescription
+
+log = logging.getLogger("swarmkit_tpu.rpc")
+
+_DISP = "swarmkit.Dispatcher"
+_CA = "swarmkit.CA"
+_CTL = "swarmkit.Control"
+_INFO = "swarmkit.Manager"
+
+_IDENT = lambda b: b
+
+
+class RpcError(Exception):
+    pass
+
+
+# --------------------------------------------------------------------------
+# server
+
+class ClusterService:
+    """Hosts the manager-side services for one swarmd process.
+
+    ``node_ref()`` returns the local swarmkit_tpu.node.Node (its running
+    manager may come and go with promotions).
+    """
+
+    def __init__(self, node_ref: Callable[[], Any]) -> None:
+        self.node_ref = node_ref
+
+    # -- helpers ---------------------------------------------------------
+    def _manager(self):
+        node = self.node_ref()
+        m = node._running_manager() if node is not None else None
+        if m is None:
+            raise RpcError("this node is not a manager")
+        return m
+
+    def _leader_manager(self):
+        m = self._manager()
+        if m.is_leader():
+            return m
+        raise RpcError(f"not-leader:{m.leader_addr}")
+
+    async def _abort(self, context, e: Exception):
+        msg = str(e)
+        if msg.startswith("not-leader:"):
+            await context.abort(grpc.StatusCode.FAILED_PRECONDITION, msg)
+        await context.abort(grpc.StatusCode.UNAVAILABLE, msg)
+
+    # -- Manager info ----------------------------------------------------
+    async def info(self, request: bytes, context) -> bytes:
+        node = self.node_ref()
+        m = node._running_manager() if node is not None else None
+        if m is None:
+            return msgpack.packb((False, "", False))
+        return msgpack.packb((m.is_leader(), m.leader_addr, True))
+
+    # -- Dispatcher ------------------------------------------------------
+    async def session(self, request: bytes, context):
+        node_id, desc_json, session_id, addr = msgpack.unpackb(request)
+        description = (NodeDescription.decode(desc_json)
+                       if desc_json else None)
+        try:
+            d = self._leader_manager().dispatcher
+            async for msg in d.session(node_id, description,
+                                       session_id=session_id, addr=addr):
+                yield msg.encode()
+        except RpcError as e:
+            await self._abort(context, e)
+        except Exception as e:
+            await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+
+    async def assignments(self, request: bytes, context):
+        node_id, session_id = msgpack.unpackb(request)
+        try:
+            d = self._leader_manager().dispatcher
+            async for msg in d.assignments(node_id, session_id):
+                yield msg.encode()
+        except RpcError as e:
+            await self._abort(context, e)
+        except Exception as e:
+            await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+
+    async def heartbeat(self, request: bytes, context) -> bytes:
+        node_id, session_id = msgpack.unpackb(request)
+        try:
+            resp = await self._leader_manager().dispatcher.heartbeat(
+                node_id, session_id)
+            return msgpack.packb(resp.period)
+        except RpcError as e:
+            await self._abort(context, e)
+        except Exception as e:
+            await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+
+    async def update_task_status(self, request: bytes, context) -> bytes:
+        node_id, session_id, updates = msgpack.unpackb(request)
+        try:
+            d = self._leader_manager().dispatcher
+            await d.update_task_status(
+                node_id, session_id,
+                [(tid, TaskStatus.decode(st)) for tid, st in updates])
+            return b""
+        except RpcError as e:
+            await self._abort(context, e)
+        except PermissionError as e:
+            await context.abort(grpc.StatusCode.PERMISSION_DENIED, str(e))
+        except Exception as e:
+            await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+
+    # -- CA --------------------------------------------------------------
+    def _ca(self):
+        ca = self._leader_manager().ca_server
+        if ca is None:
+            raise RpcError("leader has no CA")
+        return ca
+
+    async def issue_certificate(self, request: bytes, context) -> bytes:
+        csr, token, addr, requested_id = msgpack.unpackb(request)
+        try:
+            node_id, issued = await self._ca().issue_node_certificate(
+                csr, token, addr=addr, requested_node_id=requested_id)
+            return msgpack.packb((node_id, issued.cert_pem, issued.key_pem,
+                                  self._ca().get_root_ca_certificate()))
+        except RpcError as e:
+            await self._abort(context, e)
+        except Exception as e:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+
+    async def renew_certificate(self, request: bytes, context) -> bytes:
+        node_id, old_cert, csr = msgpack.unpackb(request)
+        try:
+            issued = await self._ca().renew_node_certificate(
+                node_id, old_cert, csr)
+            return msgpack.packb((issued.cert_pem, issued.key_pem))
+        except RpcError as e:
+            await self._abort(context, e)
+        except Exception as e:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+
+    # -- Control (JSON dispatch, shared with the unix socket) ------------
+    async def control(self, request: bytes, context) -> bytes:
+        from swarmkit_tpu.cmd.ctl import CtlError, dispatch_control
+        from swarmkit_tpu.manager.controlapi import ControlError
+
+        req = json.loads(request)
+        try:
+            c = self._leader_manager().control_api
+            result = await dispatch_control(c, req.get("method", ""),
+                                            req.get("params", {}))
+            return json.dumps({"result": result}).encode()
+        except RpcError as e:
+            await self._abort(context, e)
+        except (ControlError, CtlError) as e:
+            # typed errors keep their code so remote == local behavior
+            return json.dumps({"error": str(e), "code": e.code}).encode()
+        except Exception as e:
+            return json.dumps({"error": str(e),
+                               "code": "internal"}).encode()
+
+    # -- registration ----------------------------------------------------
+    def handlers(self) -> list:
+        u = grpc.unary_unary_rpc_method_handler
+        s = grpc.unary_stream_rpc_method_handler
+        return [
+            grpc.method_handlers_generic_handler(_INFO, {
+                "Info": u(self.info, request_deserializer=_IDENT,
+                          response_serializer=_IDENT)}),
+            grpc.method_handlers_generic_handler(_DISP, {
+                "Session": s(self.session, request_deserializer=_IDENT,
+                             response_serializer=_IDENT),
+                "Assignments": s(self.assignments,
+                                 request_deserializer=_IDENT,
+                                 response_serializer=_IDENT),
+                "Heartbeat": u(self.heartbeat, request_deserializer=_IDENT,
+                               response_serializer=_IDENT),
+                "UpdateTaskStatus": u(self.update_task_status,
+                                      request_deserializer=_IDENT,
+                                      response_serializer=_IDENT)}),
+            grpc.method_handlers_generic_handler(_CA, {
+                "IssueNodeCertificate": u(self.issue_certificate,
+                                          request_deserializer=_IDENT,
+                                          response_serializer=_IDENT),
+                "RenewNodeCertificate": u(self.renew_certificate,
+                                          request_deserializer=_IDENT,
+                                          response_serializer=_IDENT)}),
+            grpc.method_handlers_generic_handler(_CTL, {
+                "Call": u(self.control, request_deserializer=_IDENT,
+                          response_serializer=_IDENT)}),
+        ]
+
+
+# --------------------------------------------------------------------------
+# client
+
+def _redirectable(e: grpc.aio.AioRpcError) -> Exception:
+    details = e.details() or ""
+    if details.startswith("not-leader:"):
+        return NotLeader(details.split(":", 1)[1])
+    return RpcError(f"{e.code().name}: {details}")
+
+
+class NotLeader(Exception):
+    def __init__(self, leader_addr: str) -> None:
+        super().__init__(f"not the leader (leader at {leader_addr})")
+        self.leader_addr = leader_addr
+
+
+class RemoteDispatcher:
+    """Dispatcher duck type over gRPC (matches manager.dispatcher's
+    surface used by agent/session.py)."""
+
+    def __init__(self, channel: grpc.aio.Channel) -> None:
+        self._session = channel.unary_stream(
+            f"/{_DISP}/Session", request_serializer=_IDENT,
+            response_deserializer=_IDENT)
+        self._assignments = channel.unary_stream(
+            f"/{_DISP}/Assignments", request_serializer=_IDENT,
+            response_deserializer=_IDENT)
+        self._heartbeat = channel.unary_unary(
+            f"/{_DISP}/Heartbeat", request_serializer=_IDENT,
+            response_deserializer=_IDENT)
+        self._uts = channel.unary_unary(
+            f"/{_DISP}/UpdateTaskStatus", request_serializer=_IDENT,
+            response_deserializer=_IDENT)
+
+    async def session(self, node_id, description=None, session_id="",
+                      addr=""):
+        req = msgpack.packb((node_id,
+                             description.encode() if description else b"",
+                             session_id, addr))
+        try:
+            async for raw in self._session(req):
+                yield SessionMessage.decode(raw)
+        except grpc.aio.AioRpcError as e:
+            raise _redirectable(e)
+
+    async def assignments(self, node_id, session_id):
+        req = msgpack.packb((node_id, session_id))
+        try:
+            async for raw in self._assignments(req):
+                yield AssignmentsMessage.decode(raw)
+        except grpc.aio.AioRpcError as e:
+            raise _redirectable(e)
+
+    async def heartbeat(self, node_id, session_id) -> HeartbeatResponse:
+        try:
+            raw = await self._heartbeat(msgpack.packb((node_id, session_id)))
+        except grpc.aio.AioRpcError as e:
+            raise _redirectable(e)
+        return HeartbeatResponse(period=msgpack.unpackb(raw))
+
+    async def update_task_status(self, node_id, session_id, updates) -> None:
+        req = msgpack.packb((node_id, session_id,
+                             [(tid, st.encode()) for tid, st in updates]))
+        try:
+            await self._uts(req)
+        except grpc.aio.AioRpcError as e:
+            if e.code() == grpc.StatusCode.PERMISSION_DENIED:
+                raise PermissionError(e.details())
+            raise _redirectable(e)
+
+
+class RemoteCA:
+    """CAServer duck type over gRPC (surface used by node.py)."""
+
+    def __init__(self, channel: grpc.aio.Channel) -> None:
+        self._issue = channel.unary_unary(
+            f"/{_CA}/IssueNodeCertificate", request_serializer=_IDENT,
+            response_deserializer=_IDENT)
+        self._renew = channel.unary_unary(
+            f"/{_CA}/RenewNodeCertificate", request_serializer=_IDENT,
+            response_deserializer=_IDENT)
+        self._root_ca_pem: bytes = b""
+
+    async def issue_node_certificate(self, csr_pem, token, addr="",
+                                     requested_node_id=""):
+        from swarmkit_tpu.ca import IssuedCertificate
+
+        try:
+            raw = await self._issue(msgpack.packb(
+                (csr_pem, token, addr, requested_node_id)))
+        except grpc.aio.AioRpcError as e:
+            raise _redirectable(e)
+        node_id, cert_pem, key_pem, root_pem = msgpack.unpackb(raw)
+        self._root_ca_pem = root_pem
+        return node_id, IssuedCertificate(cert_pem=cert_pem,
+                                          key_pem=key_pem)
+
+    async def renew_node_certificate(self, node_id, old_cert_pem, csr_pem):
+        from swarmkit_tpu.ca import IssuedCertificate
+
+        try:
+            raw = await self._renew(msgpack.packb(
+                (node_id, old_cert_pem, csr_pem)))
+        except grpc.aio.AioRpcError as e:
+            raise _redirectable(e)
+        cert_pem, key_pem = msgpack.unpackb(raw)
+        return IssuedCertificate(cert_pem=cert_pem, key_pem=key_pem)
+
+    def get_root_ca_certificate(self) -> bytes:
+        return self._root_ca_pem
+
+
+class RemoteManager:
+    """Manager duck type over gRPC for the connection broker: cached
+    is_leader/leader_addr (refreshed on use) + remote services."""
+
+    def __init__(self, addr: str, refresh_interval: float = 1.0) -> None:
+        self.addr = addr
+        self._channel = grpc.aio.insecure_channel(addr)
+        self._info = self._channel.unary_unary(
+            f"/{_INFO}/Info", request_serializer=_IDENT,
+            response_deserializer=_IDENT)
+        self._ctl = self._channel.unary_unary(
+            f"/{_CTL}/Call", request_serializer=_IDENT,
+            response_deserializer=_IDENT)
+        self.dispatcher = RemoteDispatcher(self._channel)
+        self.ca_server = RemoteCA(self._channel)
+        self._is_leader = False
+        self._leader_addr = ""
+        self._has_manager = False
+        self._refresh_interval = refresh_interval
+        self._last_refresh = 0.0
+        self._refresher: Optional[asyncio.Task] = None
+        self._running = True
+
+    def start(self) -> None:
+        self._refresher = asyncio.get_running_loop().create_task(
+            self._refresh_loop())
+
+    async def close(self) -> None:
+        self._running = False
+        if self._refresher is not None:
+            self._refresher.cancel()
+            try:
+                await self._refresher
+            except (asyncio.CancelledError, Exception):
+                pass
+        await self._channel.close()
+
+    async def refresh(self) -> None:
+        try:
+            raw = await asyncio.wait_for(self._info(b""), timeout=2.0)
+            self._is_leader, self._leader_addr, self._has_manager = \
+                msgpack.unpackb(raw)
+        except Exception:
+            self._is_leader, self._has_manager = False, False
+
+    async def _refresh_loop(self) -> None:
+        while self._running:
+            await self.refresh()
+            await asyncio.sleep(self._refresh_interval)
+
+    # Manager duck type (sync; served from the refreshed cache)
+    def is_leader(self) -> bool:
+        return self._is_leader
+
+    @property
+    def leader_addr(self) -> str:
+        return self._leader_addr
+
+    @property
+    def _running_(self) -> bool:   # parity only
+        return self._has_manager
+
+    async def control_call(self, method: str, params: dict):
+        """Raw control dispatch (same JSON protocol as the unix socket)."""
+        try:
+            raw = await self._ctl(json.dumps(
+                {"method": method, "params": params}).encode())
+        except grpc.aio.AioRpcError as e:
+            raise _redirectable(e)
+        resp = json.loads(raw)
+        if "error" in resp:
+            from swarmkit_tpu.cmd.ctl import CtlError
+
+            raise CtlError(resp["error"], resp.get("code", "unknown"))
+        return resp["result"]

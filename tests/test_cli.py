@@ -57,7 +57,7 @@ async def test_swarmd_swarmctl_round_trip():
         "--state-dir", os.path.join(tmp.name, "state"),
         "--listen-control-api", sock,
         "--node-id", "m1", "--manager",
-        "--election-tick", "4",
+        "--election-tick", "4", "--backend", "inproc",
     ])
     # fast ticks for tests
     node = await swarmd.run(args)
