@@ -2,8 +2,10 @@
 simulated managers on one chip (BASELINE.json north star: election + 1M
 committed entries @ 4096 managers in < 60 s on v5e-8).
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Prints ONE JSON line on stdout, ALWAYS — on failure the line carries an
+"error" field with whatever partial results exist and the process exits
+nonzero. All progress goes to stderr. Reference harness analogue:
+cmd/swarm-bench/benchmark.go:38.
 
 vs_baseline is measured committed-entries/sec divided by the north-star rate
 (1M entries / 60 s = 16667 entries/s).
@@ -12,80 +14,290 @@ vs_baseline is measured committed-entries/sec divided by the north-star rate
 from __future__ import annotations
 
 import json
+import math
 import os
 import sys
 import time
+import traceback
 
 
 def log(*a):  # all progress goes to stderr; stdout carries only the JSON line
     print(*a, file=sys.stderr, flush=True)
 
 
-def main() -> None:
-    n = int(os.environ.get("BENCH_N", "4096"))
-    target_entries = int(os.environ.get("BENCH_ENTRIES", "1000000"))
+RESULT: dict = {
+    "metric": "committed-log-entries/sec @ N simulated managers",
+    "value": 0.0,
+    "unit": "entries/s",
+    "vs_baseline": 0.0,
+}
+BASELINE_RATE = 1_000_000 / 60.0
+
+
+def _emit(error: str | None = None, hard: bool = False) -> None:
+    """Single exit point: print the one JSON line and leave. A run whose
+    headline number already exists stays a success even if an error arrives
+    later (e.g. SIGTERM during the secondary configs)."""
+    if error is not None:
+        if RESULT.get("value"):
+            RESULT.setdefault("note", error)
+        else:
+            RESULT.setdefault("error", error)
+    print(json.dumps(RESULT), flush=True)
+    code = 1 if "error" in RESULT else 0
+    if hard:
+        os._exit(code)
+    sys.exit(code)
+
+
+def emit_and_exit() -> None:
+    _emit()
+
+
+def _install_signal_handlers() -> None:
+    """The driver kills over-budget benches with SIGTERM; emit the JSON line
+    (with whatever partial results exist) before dying so the gate still
+    records a parseable result."""
+    import signal
+
+    def _die(signum, frame):
+        _emit(error=f"killed by signal {signum}", hard=True)
+
+    signal.signal(signal.SIGTERM, _die)
+    signal.signal(signal.SIGINT, _die)
+
+
+def init_backend():
+    """Initialize the JAX backend, probing first in a SUBPROCESS with a hard
+    timeout — backend init can hang inside C++ (not raise) when the TPU
+    tunnel is down, and a hang in-process would kill the whole bench. If the
+    probe fails twice, pin CPU before importing jax here so a number is
+    always produced."""
+    import subprocess
+
+    init_timeout = int(os.environ.get("BENCH_INIT_TIMEOUT_S", "120"))
+    probe = "import jax; d = jax.devices(); print(d[0].platform, len(d))"
+    platform = None
+    for attempt in (1, 2):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", probe], capture_output=True,
+                text=True, timeout=init_timeout)
+            if out.returncode == 0:
+                platform = out.stdout.split()[0]
+                break
+            log(f"backend probe attempt {attempt} rc={out.returncode}: "
+                f"{out.stderr.strip()[-300:]}")
+        except subprocess.TimeoutExpired:
+            log(f"backend probe attempt {attempt} timed out "
+                f"({init_timeout}s)")
+        if attempt == 1:
+            time.sleep(10)
+
+    if platform is None:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        platform = "cpu-fallback"
+
+    import threading
 
     import jax
-    import numpy as np
 
+    if platform == "cpu-fallback":
+        jax.config.update("jax_platforms", "cpu")
+        devs = jax.devices("cpu")
+        return jax, devs, platform
+
+    # The probe succeeded, but the tunnel can flap between probe and the real
+    # init (TOCTOU) and the hang is inside C++ — a watchdog thread emits the
+    # JSON line and hard-exits if init doesn't finish in time.
+    done = threading.Event()
+
+    def watchdog():
+        if not done.wait(init_timeout + 30):
+            _emit(error="in-process backend init hung after probe ok",
+                  hard=True)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+    try:
+        devs = jax.devices()
+    finally:
+        done.set()
+    return jax, devs, platform
+
+
+def election_tick_for(n: int) -> int:
+    """Randomized election timeouts live in [T, 2T); with thousands of rows
+    a 10-tick window guarantees candidate collisions, so widen with log2(N)
+    (reference keeps T=10 because real clusters have <=7 managers,
+    raft.go:484-488)."""
+    return max(10, round(2 * math.log2(max(n, 2))))
+
+
+class MeasureError(Exception):
+    pass
+
+
+def measure(jax, n: int, entries: int, seed: int, election_tick: int,
+            **run_kw):
+    """Elect a leader, then time one compiled steady-state replication run of
+    ~`entries` committed entries. Returns a dict of measurements; raises
+    MeasureError if no leader emerges.
+
+    Used identically by the headline bench and the secondary BASELINE
+    configs so both measure the same flow.
+    """
     from swarmkit_tpu.raft.sim import (
-        SimConfig, committed_entries, init_state, run_ticks, run_until_leader,
+        SimConfig, committed_entries, has_leader, init_state, run_ticks,
+        run_until_leader,
     )
 
     cfg = SimConfig(n=n, log_len=8192, window=2048, apply_batch=2048,
-                    max_props=2048, keep=500, seed=42)
-    ticks_needed = (target_entries + cfg.max_props - 1) // cfg.max_props
-    log(f"devices: {jax.devices()}  n={n} ticks={ticks_needed}")
+                    max_props=2048, keep=500, seed=seed,
+                    election_tick=election_tick)
+    ticks_needed = max(1, (entries + cfg.max_props - 1) // cfg.max_props)
 
+    max_elect_ticks = 2000
     state = init_state(cfg)
-
-    # --- election latency --------------------------------------------------
     t0 = time.perf_counter()
-    state, ticks = run_until_leader(state, cfg, max_ticks=500)
+    state, ticks = run_until_leader(state, cfg, max_ticks=max_elect_ticks)
     jax.block_until_ready(state.term)
     t_elect = time.perf_counter() - t0
-    assert int(ticks) < 500, "no leader elected within 500 ticks — kernel broken"
-    log(f"leader elected in {int(ticks)} ticks ({t_elect:.2f}s incl compile)")
+    ticks = int(ticks)
+    if not bool(has_leader(state)):
+        raise MeasureError(
+            f"no leader elected within {max_elect_ticks} ticks "
+            f"(n={n}, T={election_tick})")
 
-    # --- warmup: compile the full-length scan once -------------------------
     t0 = time.perf_counter()
-    wu, _ = run_ticks(state, cfg, ticks_needed, prop_count=cfg.max_props)
-    jax.block_until_ready(wu.commit)
-    log(f"first (compile+run) pass: {time.perf_counter() - t0:.2f}s, "
-        f"committed {int(committed_entries(wu))}")
+    warm, _ = run_ticks(state, cfg, ticks_needed, prop_count=cfg.max_props,
+                        **run_kw)
+    jax.block_until_ready(warm.commit)
+    t_compile = time.perf_counter() - t0
 
-    # --- timed steady-state replication (compiled) -------------------------
     base = int(committed_entries(state))
     t0 = time.perf_counter()
-    final, trace = run_ticks(state, cfg, ticks_needed,
-                             prop_count=cfg.max_props)
+    final, _ = run_ticks(state, cfg, ticks_needed, prop_count=cfg.max_props,
+                         **run_kw)
     jax.block_until_ready(final.commit)
     dt = time.perf_counter() - t0
-
     committed = int(committed_entries(final)) - base
+
+    return {
+        "cfg": cfg, "final": final, "committed": committed, "dt": dt,
+        "rate": committed / dt, "election_ticks": ticks,
+        "t_elect": t_elect, "t_compile": t_compile,
+    }
+
+
+def main() -> None:
+    n = int(os.environ.get("BENCH_N", "4096"))
+    target_entries = int(os.environ.get("BENCH_ENTRIES", "1000000"))
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", "600"))
+    t_start = time.perf_counter()
+
+    _install_signal_handlers()
+    jax, devices, platform = init_backend()
+    import numpy as np
+
+    RESULT["platform"] = platform
+    if platform == "cpu-fallback":
+        # A single CPU device cannot finish the n=4096 / 1M-entry north-star
+        # run inside any driver budget ([N,N] progress is O(N^2) per tick);
+        # shrink so a real number is still produced and flagged as reduced.
+        if "BENCH_N" not in os.environ:
+            n = 256
+        if "BENCH_ENTRIES" not in os.environ:
+            target_entries = 100_000
+        RESULT["reduced_for_cpu_fallback"] = True
+    log(f"devices: {devices}  n={n}")
+
+    election_tick = int(os.environ.get(
+        "BENCH_ELECTION_TICK", election_tick_for(n)))
+
+    try:
+        m = measure(jax, n, target_entries, seed=42,
+                    election_tick=election_tick)
+    except MeasureError as e:
+        RESULT["error"] = str(e)
+        emit_and_exit()
+        return
+
+    RESULT["election_ticks"] = m["election_ticks"]
+    RESULT["election_s_incl_compile"] = round(m["t_elect"], 2)
+    log(f"leader elected in {m['election_ticks']} ticks "
+        f"({m['t_elect']:.2f}s incl compile), election_tick={election_tick}; "
+        f"compile pass {m['t_compile']:.2f}s")
+
+    final, cfg = m["final"], m["cfg"]
     commit = np.asarray(final.commit)
     applied = np.asarray(final.applied)
     chk = np.asarray(final.apply_chk)
     # safety verification: equal applied => equal state-machine checksum
-    by = {}
+    by: dict = {}
+    safety_ok = True
     for a, c in zip(applied.tolist(), chk.tolist()):
-        assert by.setdefault(a, c) == c, f"checksum divergence at applied={a}"
+        if by.setdefault(a, c) != c:
+            safety_ok = False
+            log(f"SAFETY VIOLATION: checksum divergence at applied={a}")
     n_quorum = int((commit >= commit.max() - cfg.max_props).sum())
-    assert n_quorum >= n // 2 + 1, f"only {n_quorum} replicas near tip"
+    RESULT["safety_ok"] = safety_ok
+    RESULT["replicas_near_tip"] = n_quorum
+    if not safety_ok:
+        RESULT["error"] = "state-machine checksum divergence"
+    elif n_quorum < n // 2 + 1:
+        RESULT["error"] = f"only {n_quorum}/{n} replicas near commit tip"
 
-    rate = committed / dt
-    log(f"committed {committed} entries across {n} managers in {dt:.2f}s "
-        f"({rate:,.0f} entries/s); total wall incl election {dt + t_elect:.2f}s")
+    log(f"committed {m['committed']} entries across {n} managers in "
+        f"{m['dt']:.2f}s ({m['rate']:,.0f} entries/s); total wall incl "
+        f"election {m['dt'] + m['t_elect']:.2f}s")
 
-    baseline_rate = 1_000_000 / 60.0
-    print(json.dumps({
+    RESULT.update({
         "metric": f"committed-log-entries/sec @ {n} simulated managers "
-                  f"(election {int(ticks)} ticks in {t_elect:.2f}s)",
-        "value": round(rate, 1),
-        "unit": "entries/s",
-        "vs_baseline": round(rate / baseline_rate, 3),
-    }))
+                  f"(election {m['election_ticks']} ticks in "
+                  f"{m['t_elect']:.2f}s)",
+        "value": round(m["rate"], 1),
+        "vs_baseline": round(m["rate"] / BASELINE_RATE, 3),
+    })
+    # Free the headline state before the secondary configs allocate theirs
+    # (at n=4096 it holds ~550MB of log + progress arrays).
+    del m, final, commit, applied, chk
+
+    # --- BASELINE.json configs 3-5 (logged, secondary) ----------------------
+    if os.environ.get("BENCH_CONFIGS", "1") != "0":
+        extra: dict = {}
+        RESULT["configs_entries_per_s"] = extra  # by reference: partial
+        # results survive a SIGTERM mid-loop
+        for name, cn, kw in (
+            ("64-steady", 64, {}),
+            ("1024-crash-every-100", 1024, {"crash_every": 100, "down_for": 5}),
+            ("4096-drop-5pct", 4096, {"drop_rate": 0.05}),
+        ):
+            if platform == "cpu-fallback" and cn > 256:
+                extra[name] = "skipped (cpu-fallback)"
+                continue
+            if time.perf_counter() - t_start > budget_s:
+                log(f"budget exhausted; skipping config {name}")
+                extra[name] = "skipped (budget)"
+                continue
+            try:
+                cm = measure(jax, cn, target_entries, seed=7,
+                             election_tick=election_tick_for(cn), **kw)
+                extra[name] = round(cm["rate"], 1)
+                log(f"config {name}: {cm['rate']:,.0f} entries/s "
+                    f"(election {cm['election_ticks']} ticks)")
+            except Exception as e:  # secondary configs must not kill the run
+                log(f"config {name} failed: {e}")
+                extra[name] = f"failed: {e}"
+
+    emit_and_exit()
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except SystemExit:
+        raise
+    except BaseException as e:  # always emit the JSON line
+        traceback.print_exc(file=sys.stderr)
+        RESULT["error"] = f"{type(e).__name__}: {e}"
+        emit_and_exit()
