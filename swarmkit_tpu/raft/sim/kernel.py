@@ -229,7 +229,15 @@ def step(state: SimState, cfg: SimConfig,
                        commit)
 
     # -- snapshot receive: jump to the sender's compaction watermark.
-    do_restore = got_snap & (snap_idx[src] > commit)
+    # If our log already contains the snapshot point (same term), only
+    # fast-forward commit — never wipe acked-but-uncommitted suffix entries
+    # (core.py _restore / etcd raft.go restore semantics).
+    snap_pt = jnp.minimum(snap_idx[src], last)
+    have_term = _term_own(cfg, log_term, snap_idx, snap_term, last, snap_pt)
+    already = (snap_idx[src] <= last) & (have_term == snap_term[src])
+    advance = got_snap & (snap_idx[src] > commit)
+    do_restore = advance & ~already
+    commit = jnp.where(advance & already, snap_idx[src], commit)
     r_src = src
     last = jnp.where(do_restore, snap_idx[r_src], last)
     commit = jnp.where(do_restore, snap_idx[r_src], commit)

@@ -1,0 +1,351 @@
+"""Differential-test oracle: the host golden raft core (swarmkit_tpu.raft
+.core, a semantically-exact re-expression of vendored etcd/raft — see
+/root/reference/vendor/github.com/coreos/etcd/raft/raft.go:679-1060) driven
+through a scheduler that reproduces the device kernel's tick-synchronous
+phase order exactly, so kernel state and oracle state can be compared
+per-tick, field by field.
+
+The kernel (swarmkit_tpu.raft.sim.kernel.step) advances all N managers one
+tick as: A) timers/campaign, B) vote request+response exchange, C) append/
+snapshot fan-out + responses, D) leader quorum-commit, E) apply batch,
+F) ring compaction — with requests and responses completing within one tick
+unless masked by the drop matrix. This module replays those phases against
+real `core.Raft` nodes.
+
+INTENTIONAL DIVERGENCES between the kernel and stock etcd/raft semantics,
+all masked here (this is the single list the differential gate maintains;
+each knob names the kernel simplification it mirrors):
+
+ D1 no-vote-rejections: the kernel never delivers vote rejections, so a
+    losing candidate stands until its next timeout instead of stepping down
+    on a rejection quorum. Mask: reject VOTE_RESPs are dropped.
+ D2 appends-as-heartbeats: the kernel has no heartbeat messages; every
+    leader sends an append (possibly empty) to every peer every tick.
+    Mask: the scheduler calls _bcast_append each tick and never fires BEAT.
+ D3 no PreVote / CheckQuorum / leader transfer: kernel.py:19-23. Mask:
+    oracle Config(pre_vote=False, check_quorum=False); transfer untested
+    here (covered by host-level tests).
+ D4 no flow control: the kernel re-sends the window from next_ every tick
+    and advances next_ only on acks — no probe pausing, no optimistic
+    updates, no inflight windows. Mask: SyncRaft._send_append is a
+    side-effect-free windowed send.
+ D5 synchronous cascades: the kernel does exactly one append round per
+    tick; etcd re-sends immediately on commit advance / rejection. Mask:
+    sends are suppressed while responses are being stepped (the next tick's
+    bcast supersedes them).
+ D6 timer scope: kernel election timers reset on (a) own campaign,
+    (b) granting a vote, (c) receiving a current-term leader message, and
+    re-randomize only at campaign time. Mask: the scheduler keeps its own
+    elapsed/timeout arrays with exactly those rules (the oracle's internal
+    tick()/randomized timeout machinery is never used).
+ D7 proposals go to every node claiming leadership (even a crashed one —
+    kernel propose() masks on role/active only), and apply/compaction run
+    on crashed rows too (kernel phases E/F have no alive mask).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from swarmkit_tpu.raft import core
+from swarmkit_tpu.raft.log import CompactedError, RaftLog, UnavailableError
+from swarmkit_tpu.raft.messages import (
+    Entry, EntryType, Message, MsgType, Snapshot, SnapshotMeta,
+)
+from swarmkit_tpu.raft.sim.state import SimConfig
+
+M32 = 0xFFFFFFFF
+
+
+def hash32_py(x: int) -> int:
+    """Python mirror of state.hash32 (splitmix32-style uint32 mix)."""
+    x &= M32
+    x ^= x >> 16
+    x = (x * 0x7FEB352D) & M32
+    x ^= x >> 15
+    x = (x * 0x846CA68B) & M32
+    x ^= x >> 16
+    return x
+
+
+def rand_timeout_py(cfg: SimConfig, node: int, term: int) -> int:
+    """Python mirror of state.rand_timeout."""
+    h = hash32_py(((node * 0x9E3779B1) & M32)
+                  ^ ((term * 0x85EBCA77) & M32)
+                  ^ (cfg.seed & M32))
+    return cfg.election_tick + (h % cfg.election_tick)
+
+
+def entry_chk_py(idx: int, data: int) -> int:
+    """Python mirror of kernel._entry_chk."""
+    return hash32_py(((idx * 0x01000193) & M32) ^ (data & M32))
+
+
+def _data_u32(e: Entry) -> int:
+    return int.from_bytes(e.data, "big") if e.data else 0
+
+
+class SyncRaft(core.Raft):
+    """core.Raft with the kernel's send discipline (divergences D4/D5):
+    windowed side-effect-free appends, and a suppress flag that swallows
+    sends triggered while responses are being stepped."""
+
+    def __init__(self, cfg: core.Config, window: int):
+        super().__init__(cfg)
+        self.window = window
+        self.suppress = False
+
+    def _send_append(self, to: int) -> None:
+        if self.suppress:
+            return
+        pr = self.prs[to]
+        prev = pr.next - 1
+        try:
+            prev_term = self.log.term(prev)
+            ents = self.log.slice(pr.next, self.log.last_index() + 1,
+                                  self.window)
+        except (CompactedError, UnavailableError):
+            meta = SnapshotMeta(index=self.log.offset,
+                                term=self.log.offset_term,
+                                voters=self.voter_ids())
+            self._send(Message(type=MsgType.SNAP, to=to,
+                               snapshot=Snapshot(meta=meta)))
+            return
+        self._send(Message(type=MsgType.APP, to=to, index=prev,
+                           log_term=prev_term, entries=tuple(ents),
+                           commit=self.log.committed))
+
+    def _bcast_append(self) -> None:
+        if self.suppress:
+            return
+        super()._bcast_append()
+
+    def take_msgs(self) -> list[Message]:
+        out, self.msgs = self.msgs, []
+        return out
+
+
+ROLE_INT = {core.FOLLOWER: 0, core.CANDIDATE: 1, core.PRE_CANDIDATE: 1,
+            core.LEADER: 2}
+
+
+@dataclass
+class OracleView:
+    """Per-tick comparable state, kernel conventions (0-based ids, -1=none)."""
+
+    term: np.ndarray
+    vote: np.ndarray
+    role: np.ndarray
+    lead: np.ndarray
+    last: np.ndarray
+    commit: np.ndarray
+    applied: np.ndarray
+    apply_chk: np.ndarray
+
+    FIELDS = ("term", "vote", "role", "lead", "last", "commit", "applied",
+              "apply_chk")
+
+
+class OracleCluster:
+    """N core.Raft nodes stepped with the kernel's phase schedule."""
+
+    def __init__(self, cfg: SimConfig):
+        self.cfg = cfg
+        n = cfg.n
+        peers = tuple(range(1, n + 1))  # core ids are 1-based (NONE=0)
+        self.nodes = [
+            SyncRaft(core.Config(id=i + 1, peers=peers,
+                                 election_tick=cfg.election_tick,
+                                 heartbeat_tick=cfg.heartbeat_tick,
+                                 max_size_per_msg=cfg.window,
+                                 max_inflight_msgs=1 << 30,
+                                 check_quorum=False, pre_vote=False,
+                                 seed=cfg.seed),
+                     window=cfg.window)
+            for i in range(n)
+        ]
+        self.elapsed = [0] * n
+        self.timeout = [rand_timeout_py(cfg, i, 0) for i in range(n)]
+        self.applied = [0] * n
+        self.apply_chk = [0] * n
+        # Canonical applied-log content (safety cross-check): idx ->
+        # (term, data); chk_at[idx] = cumulative checksum through idx.
+        self.canon: dict[int, tuple[int, int]] = {}
+        self.chk_at: dict[int, int] = {0: 0}
+
+    # -- canonical applied-log bookkeeping --------------------------------
+    def _canon_note(self, idx: int, term: int, data: int) -> None:
+        prev = self.canon.get(idx)
+        if prev is not None and prev != (term, data):
+            raise AssertionError(
+                f"state-machine divergence at index {idx}: "
+                f"{prev} vs {(term, data)}")
+        self.canon[idx] = (term, data)
+        if idx - 1 in self.chk_at and idx not in self.chk_at:
+            self.chk_at[idx] = (self.chk_at[idx - 1]
+                                + entry_chk_py(idx, data)) & M32
+
+    # -- one kernel-schedule tick -----------------------------------------
+    def tick(self, alive, drop, payloads=(), prop_count: int = 0) -> None:
+        cfg, n = self.cfg, self.cfg.n
+        nodes = self.nodes
+        up = [bool(alive[i]) for i in range(n)]
+
+        # Phase 0: propose (run_ticks calls propose() before step(); D7:
+        # alive is not consulted, room mirrors kernel propose()).
+        if prop_count:
+            ents = tuple(
+                Entry(type=EntryType.NORMAL,
+                      data=int(payloads[k]).to_bytes(4, "big"))
+                for k in range(prop_count))
+            for i, nd in enumerate(nodes):
+                if nd.state != core.LEADER:
+                    continue
+                room = (nd.log.last_index() + cfg.max_props
+                        - nd.log.offset) <= cfg.log_len
+                if not room:
+                    continue
+                nd.suppress = True
+                try:
+                    nd.step(Message(type=MsgType.PROP, frm=nd.id,
+                                    entries=ents))
+                except core.ProposalDropped:
+                    pass
+                nd.suppress = False
+                nd.take_msgs()
+
+        # Phase A: timers + campaign.
+        for i, nd in enumerate(nodes):
+            if not up[i]:
+                continue
+            self.elapsed[i] += 1
+            if nd.state != core.LEADER and self.elapsed[i] >= self.timeout[i]:
+                self.elapsed[i] = 0
+                nd.step(Message(type=MsgType.HUP, frm=nd.id))
+                nd.take_msgs()  # Phase B re-emits vote requests uniformly
+                self.timeout[i] = rand_timeout_py(cfg, i, nd.term)
+
+        # Phase B: vote exchange. Candidates re-request every tick (the
+        # kernel's req matrix); delivery order (term desc, candidate asc)
+        # reproduces the kernel's max-term catch-up + lowest-index grant.
+        requests: list[tuple[int, int, Message]] = []  # (cand, to, msg)
+        for i, nd in enumerate(nodes):
+            if not up[i] or nd.state != core.CANDIDATE:
+                continue
+            for j in range(n):
+                if j == i or not up[j] or drop[i][j]:
+                    continue
+                requests.append((i, j, Message(
+                    type=MsgType.VOTE, to=j + 1, frm=nd.id, term=nd.term,
+                    index=nd.log.last_index(),
+                    log_term=nd.log.last_term())))
+        requests.sort(key=lambda r: (-r[2].term, r[0]))
+        grants: list[tuple[int, int, Message]] = []  # (voter, cand, resp)
+        for i, j, msg in requests:
+            nodes[j].step(msg)
+            for resp in nodes[j].take_msgs():
+                if resp.type == MsgType.VOTE_RESP and not resp.reject:
+                    self.elapsed[j] = 0
+                    grants.append((j, i, resp))
+                # D1: rejections are dropped.
+        new_leader_msgs: list[Message] = []
+        for j, i, resp in grants:
+            if drop[j][i]:
+                continue
+            was_leader = nodes[i].state == core.LEADER
+            nodes[i].step(resp)
+            msgs = nodes[i].take_msgs()
+            if not was_leader and nodes[i].state == core.LEADER:
+                self.elapsed[i] = 0
+                new_leader_msgs.extend(msgs)  # win-cascade appends (Phase C)
+
+        # Phase C: append/snapshot fan-out from every standing leader.
+        out: list[Message] = list(new_leader_msgs)
+        already_sent = {m.frm for m in new_leader_msgs}
+        for i, nd in enumerate(nodes):
+            if up[i] and nd.state == core.LEADER and nd.id not in already_sent:
+                nd._bcast_append()
+                out.extend(nd.take_msgs())
+        by_rcpt: dict[int, list[Message]] = {}
+        for m in out:
+            if m.type not in (MsgType.APP, MsgType.SNAP):
+                continue
+            i, j = m.frm - 1, m.to - 1
+            if not up[j] or drop[i][j]:
+                continue
+            by_rcpt.setdefault(j, []).append(m)
+        responses: list[tuple[int, int, Message]] = []
+        for j, msgs in by_rcpt.items():
+            msgs.sort(key=lambda m: (-m.term, m.frm))
+            for m in msgs:
+                nodes[j].step(m)
+                for resp in nodes[j].take_msgs():
+                    if resp.type == MsgType.APP_RESP:
+                        responses.append((j, m.frm - 1, resp))
+                if m.term == nodes[j].term:
+                    self.elapsed[j] = 0
+        for j, i, resp in responses:
+            if drop[j][i] or not up[i]:
+                continue
+            nodes[i].suppress = True
+            nodes[i].step(resp)
+            nodes[i].suppress = False
+            nodes[i].take_msgs()
+
+        # Phase D: leader quorum-commit (no-ack ticks still re-check, as the
+        # kernel's median does; sends stay suppressed).
+        for i, nd in enumerate(nodes):
+            if up[i] and nd.state == core.LEADER:
+                nd.suppress = True
+                nd._maybe_commit()
+                nd.suppress = False
+                nd.take_msgs()
+
+        # Phase E: apply batch (D7: no alive mask) + checksum bookkeeping.
+        for i, nd in enumerate(nodes):
+            if nd.log.applied > self.applied[i]:  # snapshot restore jumped
+                self.applied[i] = nd.log.applied
+                base = self.chk_at.get(self.applied[i])
+                if base is None:
+                    raise AssertionError(
+                        f"restore to unapplied index {self.applied[i]}")
+                self.apply_chk[i] = base
+            new_applied = min(nd.log.committed,
+                              self.applied[i] + cfg.apply_batch)
+            for idx in range(self.applied[i] + 1, new_applied + 1):
+                e = nd.log.entries[idx - nd.log.offset - 1]
+                d = _data_u32(e)
+                self._canon_note(idx, e.term, d)
+                self.apply_chk[i] = (self.apply_chk[i]
+                                     + entry_chk_py(idx, d)) & M32
+            self.applied[i] = new_applied
+            nd.log.applied_to(new_applied)
+
+        # Phase F: ring-pressure compaction (D7: no alive mask).
+        for i, nd in enumerate(nodes):
+            last, off = nd.log.last_index(), nd.log.offset
+            pressure = (last - off) > (cfg.log_len - 2 * cfg.max_props - 1)
+            new_snap = max(off, self.applied[i] - cfg.keep)
+            if pressure and new_snap > off:
+                nd.log.compact(new_snap)
+
+    # -- comparable view ---------------------------------------------------
+    def view(self) -> OracleView:
+        n = self.cfg.n
+        nodes = self.nodes
+
+        def arr(f, dtype=np.int32):
+            return np.array([f(nodes[i], i) for i in range(n)], dtype=dtype)
+
+        return OracleView(
+            term=arr(lambda nd, i: nd.term),
+            vote=arr(lambda nd, i: nd.vote - 1),     # core NONE=0 -> -1
+            role=arr(lambda nd, i: ROLE_INT[nd.state]),
+            lead=arr(lambda nd, i: nd.lead - 1),
+            last=arr(lambda nd, i: nd.log.last_index()),
+            commit=arr(lambda nd, i: nd.log.committed),
+            applied=arr(lambda nd, i: self.applied[i]),
+            apply_chk=arr(lambda nd, i: self.apply_chk[i], np.uint32),
+        )
