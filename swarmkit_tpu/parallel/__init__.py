@@ -1,0 +1,53 @@
+"""Mesh + sharding helpers for the device-resident subsystems.
+
+The framework's device programs are all SPMD over a 1-D mesh along the
+simulated-manager (row) axis: per-node scalars are [N] sharded on the axis,
+pairwise progress/mailboxes are [N, N, ...] sharded on the first (row) axis,
+and log rings are [N, L] sharded on rows. These helpers centralize the mesh
+construction and the pytree→sharding mapping used by the sim kernel, the
+device-mesh transport and the multichip dry-run (previously inlined in
+__graft_entry__.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MANAGER_AXIS = "managers"
+
+
+def row_mesh(rows: int, devices: Optional[Sequence] = None,
+             axis: str = MANAGER_AXIS) -> Mesh:
+    """1-D mesh over the largest device prefix that divides `rows`.
+
+    rows=4096 on 8 devices -> all 8; rows=6 on 8 devices -> 6's largest
+    divisor <= 8 is 6... devices don't subdivide, so we take the largest
+    d <= len(devices) with rows % d == 0 (worst case d=1: still a valid
+    mesh, just unsharded).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    d = len(devices)
+    while d > 1 and rows % d != 0:
+        d -= 1
+    return Mesh(devices[:d], axis_names=(axis,))
+
+
+def row_spec(ndim: int, axis: str = MANAGER_AXIS) -> P:
+    """PartitionSpec sharding the leading (row) axis, replicating the rest."""
+    if ndim == 0:
+        return P()
+    return P(axis, *([None] * (ndim - 1)))
+
+
+def state_shardings(mesh: Mesh, tree, axis: str = MANAGER_AXIS):
+    """Per-leaf NamedSharding tree: leading axis on the mesh axis."""
+    return jax.tree.map(
+        lambda leaf: NamedSharding(mesh, row_spec(leaf.ndim, axis)), tree)
+
+
+def shard_rows(tree, mesh: Mesh, axis: str = MANAGER_AXIS):
+    """device_put a pytree with row-major sharding over the mesh."""
+    return jax.device_put(tree, state_shardings(mesh, tree, axis))

@@ -6,10 +6,21 @@ Node.Run and vendor etcd raft Step/stepLeader/stepCandidate/stepFollower)
 with branchless masked array ops:
 
 - elections      = masked one-hot grant matrices + row reductions (poll)
-- append fan-out = per-receiver chosen-sender gathers from the ring buffers
-- commit         = per-leader quorum-median of the match row, exactly the
-                   sort-and-take rule of vendor raft.go:478-486 maybeCommit
+- append fan-out = contiguous row-broadcast of the chosen sender's ring +
+                   elementwise masked copies (see "slot alignment" below)
+- commit         = per-leader quorum threshold located by a fixed-depth
+                   binary search over the match row — decision-equivalent to
+                   the sort-and-take rule of vendor raft.go:478-486
+                   maybeCommit, but O(N log L) instead of an [N, N] sort
 - network faults = per-edge boolean drop/partition masks; crashes = alive mask
+
+TPU-first data movement: ring slot (idx-1) % L is index-determined and
+identical on EVERY row, so "copy entries (p, p+W] from the sender" is a
+row-gather (contiguous, bandwidth-bound) followed by elementwise masked
+writes at the very same slot positions — the kernel contains no per-element
+cross-row gathers and no sorts on its hot path. State-machine checksums are
+order-independent sums of per-entry hashes, computed on the fly from
+(index, payload); there is no checksum ring.
 
 The network model is tick-synchronous: requests and their responses complete
 within one tick unless masked out. Control flow divergence (leader vs
@@ -20,13 +31,14 @@ Semantics deliberately simplified vs the host golden core (swarmkit_tpu.raft
 .core): no PreVote, no CheckQuorum lease, no leader transfer, and rejection
 hints are coarse (hint = follower last index). Safety properties (election
 safety, log matching, leader completeness) are preserved and asserted by
-tests/test_raft_sim.py invariant checks.
+tests/test_raft_sim.py invariant checks and the per-tick differential gate
+(tests/test_raft_sim_differential.py against the golden core).
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -45,18 +57,22 @@ def _slot(cfg: SimConfig, idx):
     return (jnp.maximum(idx, 1) - 1) % cfg.log_len
 
 
+def _idx_at_slots(cfg: SimConfig, last):
+    """[*, L] log index stored at each ring slot, anchored at `last` [*]:
+    the unique idx in (last - L, last] with (idx-1) % L == slot. Slots
+    holding indexes <= snap_idx (or <= 0) are invalid — callers mask."""
+    L = cfg.log_len
+    s = jnp.arange(L, dtype=I32)[None, :]
+    last = last[:, None]
+    return last - ((last - (s + 1)) % L)
+
+
 def _term_own(cfg, log_term, snap_idx, snap_term, last, idx):
-    """Per-node own-log term lookup. idx may be [N] or [N, K]."""
-    if idx.ndim == 1:
-        sidx, sterm, slast = snap_idx, snap_term, last
-        ring = jnp.take_along_axis(log_term, _slot(cfg, idx)[:, None],
-                                   axis=1)[:, 0]
-    else:
-        sidx, sterm, slast = (snap_idx[:, None], snap_term[:, None],
-                              last[:, None])
-        ring = jnp.take_along_axis(log_term, _slot(cfg, idx), axis=1)
-    in_ring = (idx > sidx) & (idx <= slast)
-    return jnp.where(idx == sidx, sterm, jnp.where(in_ring, ring, 0))
+    """Per-node own-log term lookup for [N] idx (single element per row)."""
+    ring = jnp.take_along_axis(log_term, _slot(cfg, idx)[:, None],
+                               axis=1)[:, 0]
+    in_ring = (idx > snap_idx) & (idx <= last)
+    return jnp.where(idx == snap_idx, snap_term, jnp.where(in_ring, ring, 0))
 
 
 def _entry_chk(idx, data):
@@ -86,7 +102,7 @@ def step(state: SimState, cfg: SimConfig,
     last, commit, applied = state.last, state.commit, state.applied
     snap_idx, snap_term = state.snap_idx, state.snap_term
     snap_chk, apply_chk = state.snap_chk, state.apply_chk
-    log_term, log_data, log_chk = state.log_term, state.log_data, state.log_chk
+    log_term, log_data = state.log_term, state.log_data
     match, next_, granted = state.match, state.next_, state.granted
     active = state.active
 
@@ -185,21 +201,27 @@ def step(state: SimState, cfg: SimConfig,
     got_app = has_lmsg & send_app[src, node]
     got_snap = has_lmsg & send_snap[src, node]
 
-    # -- append receive: window gather from the chosen sender's ring.
-    # NOTE all sender-side log reads use the POST-noop local arrays so a
-    # just-elected leader replicates its no-op entry in the same tick.
+    # -- append receive. All sender-side log reads use the POST-noop local
+    # arrays so a just-elected leader replicates its no-op in the same tick.
+    #
+    # Slot alignment: slot(idx) = (idx-1) % L on every row, so entry idx
+    # lives at the SAME slot on sender and receiver. The window copy is a
+    # contiguous row-gather of the chosen sender's ring (log_*[src]) plus
+    # elementwise masks over [N, L] — no per-element gathers.
+    lead_term_row = log_term[src]                                # [N, L]
+    lead_data_row = log_data[src]                                # [N, L]
+    last_src, snap_src = last[src], snap_idx[src]
+    lead_idx = _idx_at_slots(cfg, last_src)                      # [N, L]
+
     p = prev[src, node]                                          # [j]
+    p_slot = _slot(cfg, p)
+    p_ring_term = jnp.take_along_axis(lead_term_row, p_slot[:, None],
+                                      axis=1)[:, 0]
     p_term_sent = jnp.where(
-        p == snap_idx[src], snap_term[src],
-        jnp.where((p > snap_idx[src]) & (p <= last[src]),
-                  log_term[src, _slot(cfg, p)], 0))
-    n_avail = jnp.clip(last[src] - p, 0, cfg.window)
-    k = jnp.arange(cfg.window, dtype=I32)                        # [W]
-    ent_idx = p[:, None] + 1 + k[None, :]                        # [j, W]
-    ent_valid = (k[None, :] < n_avail[:, None]) & got_app[:, None]
-    ent_slot = _slot(cfg, ent_idx)
-    e_term = jnp.where(ent_valid, log_term[src[:, None], ent_slot], 0)
-    e_data = jnp.where(ent_valid, log_data[src[:, None], ent_slot], U32(0))
+        p == snap_src, snap_term[src],
+        jnp.where((p > snap_src) & (p <= last_src), p_ring_term, 0))
+    n_avail = jnp.clip(last_src - p, 0, cfg.window)
+    hi = p + n_avail                                             # lastnewi
 
     commit0 = commit  # pre-append commit (handleAppendEntries fast path)
     local_p_term = _term_own(cfg, log_term, snap_idx, snap_term, last,
@@ -208,18 +230,19 @@ def step(state: SimState, cfg: SimConfig,
     stale = p < commit0
     accept = got_app & prev_ok & ~stale
 
-    # find_conflict: first incoming entry missing or with mismatched term.
-    own_term_at = _term_own(cfg, log_term, snap_idx, snap_term, last, ent_idx)
-    exists = ent_idx <= last[:, None]
-    mism = ent_valid & (~exists | (own_term_at != e_term))
+    # find_conflict: first incoming entry missing locally or with a
+    # mismatched term, located by index (min over the masked index map).
+    in_win = got_app[:, None] & (lead_idx > p[:, None]) \
+        & (lead_idx <= hi[:, None])
+    exists = (lead_idx <= last[:, None]) & (lead_idx > snap_idx[:, None])
+    mism = in_win & (~exists | (log_term != lead_term_row))
     any_mism = jnp.any(mism, axis=1)
-    ci = jnp.where(any_mism, jnp.argmax(mism, axis=1).astype(I32), cfg.window)
-    write_mask = ent_valid & accept[:, None] & (k[None, :] >= ci[:, None])
-    log_term = log_term.at[node[:, None], ent_slot].set(
-        jnp.where(write_mask, e_term, log_term[node[:, None], ent_slot]))
-    log_data = log_data.at[node[:, None], ent_slot].set(
-        jnp.where(write_mask, e_data, log_data[node[:, None], ent_slot]))
-    lastnewi = p + n_avail
+    big = jnp.iinfo(jnp.int32).max
+    ci_idx = jnp.min(jnp.where(mism, lead_idx, big), axis=1)     # [j]
+    write = in_win & accept[:, None] & (lead_idx >= ci_idx[:, None])
+    log_term = jnp.where(write, lead_term_row, log_term)
+    log_data = jnp.where(write, lead_data_row, log_data)
+    lastnewi = hi
     last = jnp.where(accept,
                      jnp.where(any_mism, lastnewi, jnp.maximum(last, lastnewi)),
                      last)
@@ -272,38 +295,54 @@ def step(state: SimState, cfg: SimConfig,
         jnp.maximum(1, jnp.minimum(next_ - 1, reject_hint[None, :] + 1)),
         next_)
 
-    # ---- Phase D: leader commit (quorum median of match row) -------------
+    # ---- Phase D: leader commit (quorum threshold on the match row) ------
+    # maybeCommit (vendor raft.go:478-486) takes the quorum-th largest match
+    # index. Equivalent decision, computed as the largest X in (commit, last]
+    # acked by a quorum — a fixed-depth binary search (range <= log_len, so
+    # ceil(log2(L))+1 rounds of [N, N] compares) instead of sorting [N, N]
+    # every tick.
     match = jnp.where(is_leader[:, None] & eye, last[:, None], match)
-    masked = jnp.where(active[None, :], match, -1)
-    sorted_desc = -jnp.sort(-masked, axis=1)
-    mci = jnp.take_along_axis(
-        sorted_desc, jnp.full((n, 1), 1, I32) * (quorum - 1), axis=1)[:, 0]
+    match_eff = jnp.where(active[None, :], match, -1)
+
+    def _bisect(_, lo_hi):
+        lo, hi_b = lo_hi
+        mid = (lo + hi_b + 1) >> 1
+        cnt = jnp.sum((match_eff >= mid[:, None]).astype(I32), axis=1)
+        ok = (cnt >= quorum) & (hi_b >= mid) & (mid > lo)
+        lo = jnp.where(ok, mid, lo)
+        hi_b = jnp.where(ok, hi_b, mid - 1)
+        return lo, hi_b
+
+    iters = max(1, (cfg.log_len).bit_length() + 1)
+    mci, _ = jax.lax.fori_loop(0, iters, _bisect, (commit, last))
     mci_term = _term_own(cfg, log_term, snap_idx, snap_term, last, mci)
     can_commit = is_leader & (mci > commit) & (mci_term == term)
     commit = jnp.where(can_commit, mci, commit)
 
-    # ---- Phase E: apply + per-entry checksum ring ------------------------
-    ka = jnp.arange(cfg.apply_batch, dtype=I32)
-    app_idx = applied[:, None] + 1 + ka[None, :]
-    app_valid = app_idx <= commit[:, None]
-    app_slot = _slot(cfg, app_idx)
-    app_data = jnp.take_along_axis(log_data, app_slot, axis=1)
-    contrib = jnp.where(app_valid, _entry_chk(app_idx, app_data), U32(0))
-    cum = apply_chk[:, None] + jnp.cumsum(contrib, axis=1, dtype=U32)
-    log_chk = log_chk.at[node[:, None], app_slot].set(
-        jnp.where(app_valid, cum, log_chk[node[:, None], app_slot]))
+    # ---- Phase E: apply + checksum accumulation --------------------------
+    # Entries (applied, new_applied] are summed in place via the slot->index
+    # map of the OWN ring; _entry_chk is order-independent so no cumsum ring
+    # is needed.
+    own_idx = _idx_at_slots(cfg, last)                           # [N, L]
+    new_applied = jnp.minimum(commit, applied + cfg.apply_batch)
+    app_mask = (own_idx > applied[:, None]) & (own_idx <= new_applied[:, None])
+    contrib = jnp.where(app_mask, _entry_chk(own_idx, log_data), U32(0))
     apply_chk = apply_chk + jnp.sum(contrib, axis=1, dtype=U32)
-    applied = jnp.minimum(commit, applied + cfg.apply_batch)
+    applied = new_applied
 
     # ---- Phase F: compaction (ring-pressure driven) ----------------------
     # Compact to applied-keep (mirroring LogEntriesForSlowFollowers=500)
-    # when the ring is running out of writable headroom.
+    # when the ring is running out of writable headroom. The checksum at the
+    # new watermark is apply_chk minus the contributions of the entries
+    # still ahead of it (uint32 wrap-safe).
     pressure = (last - snap_idx) > (cfg.log_len - 2 * cfg.max_props - 1)
     new_snap = jnp.maximum(snap_idx, applied - cfg.keep)
     do_compact = pressure & (new_snap > snap_idx)
     nst = _term_own(cfg, log_term, snap_idx, snap_term, last, new_snap)
-    nsc = jnp.take_along_axis(log_chk, _slot(cfg, new_snap)[:, None],
-                              axis=1)[:, 0]
+    ahead = (own_idx > new_snap[:, None]) & (own_idx <= applied[:, None])
+    ahead_sum = jnp.sum(jnp.where(ahead, _entry_chk(own_idx, log_data),
+                                  U32(0)), axis=1, dtype=U32)
+    nsc = apply_chk - ahead_sum
     snap_term = jnp.where(do_compact, nst, snap_term)
     snap_chk = jnp.where(do_compact, nsc, snap_chk)
     snap_idx = jnp.where(do_compact, new_snap, snap_idx)
@@ -315,7 +354,7 @@ def step(state: SimState, cfg: SimConfig,
         last=last, commit=commit, applied=applied,
         snap_idx=snap_idx, snap_term=snap_term,
         snap_chk=snap_chk, apply_chk=apply_chk,
-        log_term=log_term, log_data=log_data, log_chk=log_chk,
+        log_term=log_term, log_data=log_data,
         match=match, next_=next_, granted=granted,
         tick=state.tick + 1,
     )
@@ -340,6 +379,34 @@ def propose(state: SimState, cfg: SimConfig, payloads: jax.Array,
         jnp.where(valid, state.term[:, None], state.log_term[node[:, None], slot]))
     log_data = state.log_data.at[node[:, None], slot].set(
         jnp.where(valid, pl, state.log_data[node[:, None], slot]))
+    new_last = state.last + jnp.where(ok, count, 0).astype(I32)
+    eye = jnp.eye(n, dtype=bool)
+    match = jnp.where(ok[:, None] & eye, new_last[:, None], state.match)
+    return dataclasses.replace(state, log_term=log_term, log_data=log_data,
+                               last=new_last, match=match)
+
+
+def propose_dense(state: SimState, cfg: SimConfig,
+                  payload_fn: Callable[[jax.Array, jax.Array], jax.Array],
+                  count) -> SimState:
+    """Gather/scatter-free propose for the benchmark hot path: payloads are
+    generated ON DEVICE as payload_fn(tick, k) (k = 0..count-1, uint32
+    result), written via the slot->index map as elementwise [N, L] masked
+    stores. Decision-equivalent to propose(state, cfg, payloads, count) with
+    payloads[k] = payload_fn(tick, k) — asserted by tests/test_raft_sim.py.
+    """
+    n = cfg.n
+    is_leader = (state.role == LEADER) & state.active
+    room = (state.last + cfg.max_props - state.snap_idx) <= cfg.log_len
+    ok = is_leader & room
+    count = jnp.asarray(count, I32)
+    # slot -> new index map anchored one batch ahead of last
+    new_idx = _idx_at_slots(cfg, state.last + count)             # [N, L]
+    k_of = new_idx - state.last[:, None] - 1                     # [N, L]
+    valid = ok[:, None] & (k_of >= 0) & (k_of < count)
+    pl = payload_fn(state.tick, jnp.maximum(k_of, 0).astype(U32))
+    log_term = jnp.where(valid, state.term[:, None], state.log_term)
+    log_data = jnp.where(valid, pl, state.log_data)
     new_last = state.last + jnp.where(ok, count, 0).astype(I32)
     eye = jnp.eye(n, dtype=bool)
     match = jnp.where(ok[:, None] & eye, new_last[:, None], state.match)

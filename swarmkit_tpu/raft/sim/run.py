@@ -15,7 +15,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from swarmkit_tpu.raft.sim.kernel import propose, step
+from swarmkit_tpu.raft.sim.kernel import propose, propose_dense, step
 from swarmkit_tpu.raft.sim.state import (
     LEADER, SimConfig, SimState, drop_matrix, hash32, init_state,
 )
@@ -32,11 +32,17 @@ def has_leader(state: SimState) -> jax.Array:
     return jnp.any(leader_mask(state))
 
 
+def _payload_at(tick, k) -> jax.Array:
+    """Deterministic device-generated payload id for proposal k of `tick`:
+    encodes the (tick, k) origin so the applied-checksum detects
+    loss/reorder. k may be any uint32 array shape."""
+    return tick.astype(U32) * U32(1 << 16) + k.astype(U32) + U32(1)
+
+
 def _payloads(cfg: SimConfig, tick, count) -> jax.Array:
-    """Deterministic device-generated payload batch: payload ids encode the
-    (tick, k) origin so the applied-checksum detects loss/reorder."""
-    k = jnp.arange(cfg.max_props, dtype=I32)
-    return (tick.astype(U32) * U32(1 << 16) + k.astype(U32) + U32(1))
+    """Batch form of _payload_at for the host propose() API."""
+    k = jnp.arange(cfg.max_props, dtype=U32)
+    return _payload_at(tick, k)
 
 
 @partial(jax.jit, static_argnames=("cfg", "n_ticks", "prop_count",
@@ -69,8 +75,8 @@ def run_ticks(state: SimState, cfg: SimConfig, n_ticks: int,
             alive = alive & ~((jnp.arange(n, dtype=I32) == downed)
                               & (down_left > 0))
         if prop_count:
-            st = propose(st, cfg, _payloads(cfg, tick, prop_count),
-                         jnp.asarray(prop_count, I32))
+            st = propose_dense(st, cfg, _payload_at,
+                               jnp.asarray(prop_count, I32))
         drop = drop_matrix(cfg, tick, drop_rate) if drop_rate else None
         st = step(st, cfg, alive=alive, drop=drop)
         row = jnp.stack([jnp.sum(leader_mask(st).astype(I32)),

@@ -68,11 +68,13 @@ class SimState:
     snap_term: jax.Array
     snap_chk: jax.Array    # state-machine checksum at snap_idx (uint32)
     apply_chk: jax.Array   # state-machine checksum at applied (uint32)
-    # log ring buffers [N, L]; slot of index i (1-based) = (i-1) % L
+    # log ring buffers [N, L]; slot of index i (1-based) = (i-1) % L.
+    # Slots are INDEX-DETERMINED and therefore identical across rows — the
+    # kernel's append path exploits this to replace per-entry gathers with
+    # elementwise masked copies (kernel.py Phase C). State-machine checksums
+    # are derived on the fly from (index, data), so no checksum ring exists.
     log_term: jax.Array
     log_data: jax.Array    # uint32 payload ids
-    log_chk: jax.Array     # uint32 state-machine checksum AFTER applying idx
-                           # (written during apply; read at compaction)
     # leader-view progress [N, N]: row i = node i's view as (potential) leader
     match: jax.Array
     next_: jax.Array
@@ -101,7 +103,6 @@ def init_state(cfg: SimConfig) -> SimState:
         apply_chk=jnp.zeros((n,), jnp.uint32),
         log_term=z(n, L),
         log_data=jnp.zeros((n, L), jnp.uint32),
-        log_chk=jnp.zeros((n, L), jnp.uint32),
         match=z(n, n),
         next_=jnp.ones((n, n), i32),
         granted=jnp.zeros((n, n), jnp.bool_),

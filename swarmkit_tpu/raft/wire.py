@@ -1,0 +1,51 @@
+"""Binary wire codec for raft protocol messages.
+
+The device-mesh transport moves raft messages through fixed-width uint32
+mailbox arrays, and the gRPC transport moves them between processes; both
+need a compact, versioned, code-free encoding (the reference wire format is
+protobuf raftpb.Message — vendor/github.com/coreos/etcd/raft/raftpb).
+msgpack of positional tuples: no pickle, no class names on the wire.
+"""
+
+from __future__ import annotations
+
+import msgpack
+
+from swarmkit_tpu.raft.messages import (
+    Entry, EntryType, Message, MsgType, Snapshot, SnapshotMeta,
+)
+
+WIRE_VERSION = 1
+
+
+def encode_message(m: Message) -> bytes:
+    ents = [(e.index, e.term, int(e.type), e.data) for e in m.entries]
+    snap = None
+    if m.snapshot is not None:
+        meta = m.snapshot.meta
+        snap = (meta.index, meta.term, list(meta.voters), m.snapshot.data)
+    return msgpack.packb((
+        WIRE_VERSION, int(m.type), m.to, m.frm, m.term, m.log_term, m.index,
+        ents, m.commit, m.reject, m.reject_hint, snap, m.context,
+    ))
+
+
+def decode_message(raw: bytes) -> Message:
+    (ver, mtype, to, frm, term, log_term, index, ents, commit, reject,
+     reject_hint, snap, context) = msgpack.unpackb(raw)
+    if ver != WIRE_VERSION:
+        raise ValueError(f"unsupported raft wire version {ver}")
+    snapshot = None
+    if snap is not None:
+        sidx, sterm, voters, data = snap
+        snapshot = Snapshot(meta=SnapshotMeta(index=sidx, term=sterm,
+                                              voters=tuple(voters)),
+                            data=data)
+    return Message(
+        type=MsgType(mtype), to=to, frm=frm, term=term, log_term=log_term,
+        index=index,
+        entries=tuple(Entry(index=i, term=t, type=EntryType(ty), data=d)
+                      for i, t, ty, d in ents),
+        commit=commit, reject=reject, reject_hint=reject_hint,
+        snapshot=snapshot, context=context,
+    )

@@ -1,0 +1,66 @@
+"""Bisection harness for the n=4096 steady-state replication fault seen in
+BENCH_r02 (fault at the first compiled run_ticks after a successful
+election). Runs each suspect stage separately and prints PASS/FAIL per
+stage so the faulting op can be localized. All output to stderr-style
+stdout lines; safe to rerun (each stage independent).
+
+Usage: python tools/tpu_repro.py [stage ...]
+Stages: elect step1 props step10 step100 full
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from swarmkit_tpu.raft.sim import (
+    SimConfig, committed_entries, init_state, run_ticks, run_until_leader,
+)
+
+
+def log(*a):
+    print(*a, flush=True)
+
+
+def main():
+    stages = sys.argv[1:] or ["elect", "step1", "props", "step10", "step100",
+                              "full"]
+    n = 4096
+    cfg = SimConfig(n=n, log_len=8192, window=2048, apply_batch=2048,
+                    max_props=2048, keep=500, seed=42, election_tick=24)
+    log(f"platform={jax.devices()[0].platform} cfg n={n}")
+
+    state = init_state(cfg)
+    if "elect" in stages:
+        t0 = time.perf_counter()
+        state, ticks = run_until_leader(state, cfg, max_ticks=2000)
+        jax.block_until_ready(state.term)
+        log(f"PASS elect: leader in {int(ticks)} ticks "
+            f"({time.perf_counter()-t0:.1f}s)")
+
+    for name, n_ticks, props in (
+        ("step1", 1, 0),
+        ("props", 1, 2048),
+        ("step10", 10, 2048),
+        ("step100", 100, 2048),
+        ("full", 489, 2048),
+    ):
+        if name not in stages:
+            continue
+        t0 = time.perf_counter()
+        try:
+            out, _ = run_ticks(state, cfg, n_ticks, prop_count=props)
+            jax.block_until_ready(out.commit)
+            log(f"PASS {name}: commit={int(committed_entries(out))} "
+                f"({time.perf_counter()-t0:.1f}s)")
+        except Exception as e:
+            log(f"FAIL {name}: {type(e).__name__}: {str(e)[:500]}")
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
