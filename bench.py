@@ -237,19 +237,35 @@ def main() -> None:
 
     # Reduced-scale retry ladder: a mid-run device fault at the headline
     # scale must still produce SOME nonzero on-device number (r02 recorded
-    # 0.0 because the only fallback was at backend-init time).
-    ladder = [(n, target_entries)]
+    # 0.0 because the only fallback was at backend-init time). A faulted
+    # PJRT client usually stays wedged, so the backend is torn down and
+    # rebuilt between rungs; the last rung runs on CPU.
+    ladder = [("dev", n, target_entries)]
     if "BENCH_N" not in os.environ and not on_cpu:
-        ladder += [(1024, 250_000), (256, 100_000)]
+        ladder += [("dev", 1024, 250_000), ("dev", 256, 100_000),
+                   ("cpu", 256, 100_000)]
+
+    def _rebuild_backend(pin_cpu: bool) -> None:
+        import jax.extend.backend
+        if pin_cpu:
+            jax.config.update("jax_platforms", "cpu")
+        jax.extend.backend.clear_backends()
+        jax.devices()
+
     m = None
-    for attempt, (ln, lentries) in enumerate(ladder):
+    for attempt, (plat, ln, lentries) in enumerate(ladder):
         try:
+            if attempt > 0:
+                _rebuild_backend(pin_cpu=(plat == "cpu"))
             m = measure(jax, ln, lentries, seed=42,
                         election_tick=int(os.environ.get(
                             "BENCH_ELECTION_TICK", election_tick_for(ln))))
             n = ln
             if attempt > 0:
-                RESULT["reduced_after_fault"] = f"n={ln}"
+                RESULT["reduced_after_fault"] = f"n={ln} on {plat}"
+                if plat == "cpu":
+                    RESULT["platform"] = "cpu-after-fault"
+                    on_cpu = True  # keep secondary configs CPU-sized
             break
         except MeasureError as e:
             RESULT.setdefault("errors", []).append(str(e))

@@ -19,8 +19,9 @@ Layout:
     api/        data model: objects, specs, task states, store actions
     watch/      event bus (reference: watch/watch.go)
     store/      transactional in-memory object store (manager/state/store)
-    raft/       golden model, JAX tick kernel, Node shell, storage
-    transport/  Transport seam: in-process, device-mesh (+ gRPC bridge)
+    raft/       golden model, JAX tick kernel (sim/), Node shell, storage,
+                in-process + gRPC transports, binary wire codec
+    transport/  device-mesh mailbox transport behind the Transport seam
     parallel/   mesh + sharding helpers for the batched raft state
     manager/    control plane services and leader loops
     agent/      worker/executor side

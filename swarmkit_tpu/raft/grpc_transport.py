@@ -40,36 +40,10 @@ _MEM = "swarmkit.RaftMembership"
 
 
 # --------------------------------------------------------------------------
-# codec
+# codec: the shared versioned raft wire format (one codec for every
+# transport — device-mesh mailboxes and this gRPC bridge must interoperate)
 
-def encode_message(m: Message) -> bytes:
-    snap = None
-    if m.snapshot is not None:
-        snap = (m.snapshot.meta.index, m.snapshot.meta.term,
-                list(m.snapshot.meta.voters), m.snapshot.data)
-    return msgpack.packb((
-        int(m.type), m.to, m.frm, m.term, m.log_term, m.index,
-        [(e.index, e.term, int(e.type), e.data) for e in m.entries],
-        m.commit, m.reject, m.reject_hint, snap, m.context))
-
-
-def decode_message(raw: bytes) -> Message:
-    (typ, to, frm, term, log_term, index, entries, commit, reject,
-     reject_hint, snap, context) = msgpack.unpackb(raw)
-    snapshot = None
-    if snap is not None:
-        si, st, voters, data = snap
-        snapshot = Snapshot(meta=SnapshotMeta(index=si, term=st,
-                                              voters=tuple(voters)),
-                            data=data)
-    return Message(
-        type=MsgType(typ), to=to, frm=frm, term=term, log_term=log_term,
-        index=index,
-        entries=tuple(Entry(index=ei, term=et, type=EntryType(ety), data=ed)
-                      for ei, et, ety, ed in entries),
-        commit=commit, reject=reject, reject_hint=reject_hint,
-        snapshot=snapshot, context=context)
-
+from swarmkit_tpu.raft.wire import decode_message, encode_message  # noqa: E402,F401
 
 _IDENT = lambda b: b
 

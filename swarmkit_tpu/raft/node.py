@@ -116,6 +116,11 @@ class NodeOpts:
     seed: int = 0
     # proposal size cap; reference MaxTransactionBytes enforced raft.go:1809
     max_proposal_bytes: int = int(1.5 * 1024 * 1024)
+    # Transport impl selector (the seam from transport.go:26): receives
+    # (network, handlers, local_addr, clock). None = in-process Transport;
+    # pass swarmkit_tpu.transport.DeviceMeshTransport (with a DeviceMeshNet
+    # network) to exchange raft messages through the device mailbox.
+    transport_factory: object = None
 
 
 class Node(Proposer):
@@ -170,7 +175,8 @@ class Node(Proposer):
         else:
             self._bootstrap_new_cluster(cfg_kwargs)
 
-        self.transport = Transport(opts.network, self, self.addr, self.clock)
+        factory = opts.transport_factory or Transport
+        self.transport = factory(opts.network, self, self.addr, self.clock)
         for m in self.cluster.members.values():
             if m.raft_id != self.raft_id:
                 self.transport.add_peer(m.raft_id, m.addr)

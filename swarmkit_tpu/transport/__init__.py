@@ -1,0 +1,10 @@
+"""Transport implementations behind the raft Transport seam.
+
+Impl #1 (in-process asyncio wire) lives in swarmkit_tpu.raft.transport;
+impl #2 (cross-process gRPC) in swarmkit_tpu.raft.grpc_transport; impl #3
+(device-mesh mailbox exchange) here.
+"""
+
+from swarmkit_tpu.transport.device_mesh import (  # noqa: F401
+    DeviceMeshNet, DeviceMeshTransport,
+)

@@ -1,0 +1,202 @@
+"""Raft Node suite against the device-mesh Transport (BASELINE acceptance
+gate: the raft scenarios run with messages exchanged through sharded device
+mailbox arrays instead of the in-process wire).
+
+Reference bar: the same scenarios as tests/test_raft_node.py
+(manager/state/raft/raft_test.go:63-1025), with the Transport seam
+(transport/transport.go:26) bound to swarmkit_tpu.transport
+.DeviceMeshTransport over the 8-virtual-device CPU mesh (tests/conftest.py).
+"""
+
+import pytest
+
+from swarmkit_tpu.api import Annotations, Node as ApiNode, NodeSpec
+from swarmkit_tpu.raft.node import ErrLostLeadership
+from swarmkit_tpu.transport import DeviceMeshNet, DeviceMeshTransport
+from tests.conftest import async_test
+from tests.node_harness import RaftHarness
+
+
+class DeviceRaftHarness(RaftHarness):
+    """RaftHarness with the device-mesh wire + transport selected."""
+
+    def __init__(self, seed: int = 7) -> None:
+        super().__init__(seed=seed)
+        self.network = DeviceMeshNet(seed=seed, rows=8)
+
+    def _opts(self, node_id, **kw):
+        opts = super()._opts(node_id, **kw)
+        opts.transport_factory = DeviceMeshTransport
+        return opts
+
+    async def close(self) -> None:
+        await super().close()
+        self.network.close()
+
+
+def _obj(i):
+    return ApiNode(id=f"id{i}",
+                   spec=NodeSpec(annotations=Annotations(name=f"obj{i}")))
+
+
+async def propose(node, i):
+    await node.store.update(lambda tx: tx.create(_obj(i)))
+
+
+def has_obj(node, i):
+    return node.store.get("node", f"id{i}") is not None
+
+
+@async_test
+async def test_three_node_bootstrap_and_replication():
+    h = DeviceRaftHarness()
+    try:
+        n1 = await h.add_node()
+        await h.wait_for_leader()
+        n2 = await h.add_node(join_from=n1)
+        n3 = await h.add_node(join_from=n1)
+        await h.wait_for_cluster()
+        assert len(n1.cluster.members) == 3
+        await propose(n1, 1)
+        await h.wait_for(lambda: has_obj(n2, 1) and has_obj(n3, 1))
+        # messages really moved through the device exchange
+        assert h.network.device_flushes > 0
+        assert h.network.device_messages > 0
+    finally:
+        await h.close()
+
+
+@async_test
+async def test_leader_down_reelection_and_continued_replication():
+    h = DeviceRaftHarness()
+    try:
+        n1 = await h.add_node()
+        await h.wait_for_leader()
+        n2 = await h.add_node(join_from=n1)
+        n3 = await h.add_node(join_from=n1)
+        await h.wait_for_cluster()
+        await h.shutdown_node(n1)
+        lead = await h.wait_for_leader()
+        assert lead in (n2, n3)
+        await propose(lead, 5)
+        others = [n for n in (n2, n3) if n is not lead]
+        await h.wait_for(lambda: all(has_obj(n, 5) for n in others))
+    finally:
+        await h.close()
+
+
+@async_test
+async def test_five_node_replication_and_quorum():
+    """5-node scenario: replication to all; quorum loss blocks commits;
+    healing recovers (raft_test.go TestRaftQuorumFailure/Recovery)."""
+    import asyncio
+
+    h = DeviceRaftHarness()
+    try:
+        n1 = await h.add_node()
+        await h.wait_for_leader()
+        rest = [await h.add_node(join_from=n1) for _ in range(4)]
+        await h.wait_for_cluster()
+        nodes = [n1, *rest]
+        await propose(n1, 1)
+        await h.wait_for(lambda: all(has_obj(n, 1) for n in nodes))
+
+        # cut the leader + one follower off from the other three
+        lead = h.leader()
+        others = [n for n in nodes if n is not lead]
+        h.network.partition({lead.addr, others[0].addr},
+                            {n.addr for n in others[1:]})
+        task = asyncio.ensure_future(propose(lead, 2))
+        for _ in range(40):
+            if task.done():
+                break
+            await h.tick()
+        assert task.done(), "proposal neither committed nor timed out"
+        with pytest.raises((TimeoutError, ErrLostLeadership)):
+            task.result()
+
+        h.network.heal()
+        lead = await h.wait_for_cluster()
+        await propose(lead, 3)
+        await h.wait_for(lambda: all(has_obj(n, 3) for n in nodes
+                                     if n.running))
+    finally:
+        await h.close()
+
+
+@async_test
+async def test_snapshot_catch_up_through_device_mailbox():
+    """Snapshot messages (the largest payloads) survive the mailbox
+    word-packing round trip (raft_test.go TestRaftSnapshot)."""
+    h = DeviceRaftHarness()
+    try:
+        n1 = await h.add_node(snapshot_interval=10,
+                              log_entries_for_slow_followers=2)
+        await h.wait_for_leader()
+        for i in range(15):
+            await propose(n1, i)
+        assert n1.status()["snapshot_index"] > 0
+        n2 = await h.add_node(join_from=n1)
+        await h.wait_for(lambda: all(has_obj(n2, i) for i in range(15)))
+        assert len(n2.cluster.members) == 2
+    finally:
+        await h.close()
+
+
+@async_test
+async def test_message_drop_still_converges_on_device_wire():
+    """20% per-edge loss applied ON DEVICE as mailbox masks; raft retries
+    mask it (BASELINE churn analog)."""
+    h = DeviceRaftHarness()
+    try:
+        n1 = await h.add_node()
+        await h.wait_for_leader()
+        n2 = await h.add_node(join_from=n1)
+        n3 = await h.add_node(join_from=n1)
+        await h.wait_for_cluster()
+        for a in (n1, n2, n3):
+            for b in (n1, n2, n3):
+                if a is not b:
+                    h.network.set_drop(a.addr, b.addr, 0.2)
+        lead = h.leader()
+        await propose(lead, 1)
+        await h.wait_for(lambda: all(has_obj(n, 1) for n in (n1, n2, n3)))
+    finally:
+        await h.close()
+
+
+@async_test
+async def test_member_removal_on_device_wire():
+    h = DeviceRaftHarness()
+    try:
+        n1 = await h.add_node()
+        await h.wait_for_leader()
+        n2 = await h.add_node(join_from=n1)
+        n3 = await h.add_node(join_from=n1)
+        await h.wait_for_cluster()
+        removed_id = n3.raft_id
+        await n1.remove_member(removed_id)
+        await h.wait_for(lambda: len(n1.cluster.members) == 2)
+        assert n1.cluster.is_id_removed(removed_id)
+        await propose(n1, 4)
+        await h.wait_for(lambda: has_obj(n2, 4))
+    finally:
+        await h.close()
+
+
+def test_exchange_lowers_to_cross_device_collective():
+    """The delivery program's sender->receiver resharding must be a real
+    cross-device collective over the mesh, not 8 replicas (VERDICT r02
+    weak #6)."""
+    import numpy as np
+
+    net = DeviceMeshNet(rows=8)
+    assert len(net.mesh.devices.ravel()) == 8, "conftest provides 8 devices"
+    fn = net._exchange_fn(4, 64)
+    words = np.zeros((8, 8, 4, 64), np.uint32)
+    lens = np.zeros((8, 8, 4), np.int32)
+    keep = np.zeros((8, 8, 4), bool)
+    hlo = fn.lower(words, lens, keep).compile().as_text()
+    assert ("all-to-all" in hlo or "collective-permute" in hlo
+            or "all-gather" in hlo), \
+        f"no cross-device collective in exchange HLO:\n{hlo[:2000]}"

