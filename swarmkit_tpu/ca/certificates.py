@@ -24,6 +24,11 @@ from cryptography.x509.oid import NameOID
 MANAGER_ROLE_OU = "swarm-manager"
 WORKER_ROLE_OU = "swarm-worker"
 CA_ROLE_OU = "swarm-ca"
+# Every node cert carries this SAN; gRPC channels override the target name
+# to it, so transport-level TLS checks the chain while identity/role checks
+# happen against the subject OU/O (reference: swarmkit verifies roles, not
+# hostnames — MutualTLS ServerName handling in ca/config.go NewClientTLSConfig).
+TLS_SERVER_NAME = "swarmkit-node"
 
 DEFAULT_NODE_CERT_EXPIRATION = 90 * 24 * 3600.0   # ca/certificates.go:60
 MIN_NODE_CERT_EXPIRATION = 3600.0
@@ -177,6 +182,9 @@ class RootCA:
                 .add_extension(x509.ExtendedKeyUsage(
                     [x509.oid.ExtendedKeyUsageOID.SERVER_AUTH,
                      x509.oid.ExtendedKeyUsageOID.CLIENT_AUTH]),
+                    critical=False)
+                .add_extension(x509.SubjectAlternativeName(
+                    [x509.DNSName(TLS_SERVER_NAME), x509.DNSName(node_id)]),
                     critical=False)
                 .sign(self._key, hashes.SHA256()))
         return IssuedCertificate(cert_pem=cert_to_pem(cert), key_pem=key_pem)

@@ -44,6 +44,22 @@ class ParsedToken:
     secret: str
 
 
+def verify_root_digest(root_pem: bytes, token: str) -> bool:
+    """Constant-time check that a fetched root CA certificate matches the
+    digest pinned inside a join token (reference: GetRemoteCA digest
+    verification, ca/certificates.go). The single place pin semantics live;
+    used by the joining node, the RemoteManager bootstrap dial, and tests."""
+    import hmac
+
+    from swarmkit_tpu.ca.certificates import RootCA
+
+    try:
+        got = RootCA(root_pem).digest()
+    except Exception:
+        return False
+    return hmac.compare_digest(got, parse_join_token(token).ca_digest)
+
+
 def parse_join_token(token: str) -> ParsedToken:
     """reference: ca/config.go ParseJoinToken."""
     parts = token.split("-")
@@ -114,10 +130,20 @@ class TLSRenewer:
         self._rng = rng or random.Random()
         self._task: Optional[asyncio.Task] = None
         self._running = False
+        self._wake: Optional[asyncio.Event] = None
 
     def start(self) -> None:
         self._running = True
+        self._wake = asyncio.Event()
         self._task = asyncio.get_running_loop().create_task(self._run())
+
+    def renew_soon(self) -> None:
+        """Skip the half-life wait and renew at the next loop step —
+        triggered on expected-role changes (a promoted worker needs a
+        manager-OU cert NOW, reference: renewer.go SetExpectedRole →
+        renew channel) and on certificate-format migrations."""
+        if self._wake is not None:
+            self._wake.set()
 
     async def stop(self) -> None:
         self._running = False
@@ -137,7 +163,16 @@ class TLSRenewer:
     async def _run(self) -> None:
         try:
             while self._running:
-                await self.clock.sleep(self._next_delay())
+                sleeper = asyncio.ensure_future(
+                    self.clock.sleep(self._next_delay()))
+                waker = asyncio.ensure_future(self._wake.wait())
+                try:
+                    await asyncio.wait({sleeper, waker},
+                                       return_when=asyncio.FIRST_COMPLETED)
+                finally:
+                    sleeper.cancel()
+                    waker.cancel()
+                self._wake.clear()
                 # retry on the short backoff until the renewal lands —
                 # re-entering _next_delay() here would push each retry
                 # 50-80% of the remaining validity into the future

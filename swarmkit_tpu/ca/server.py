@@ -50,16 +50,21 @@ class CAServer:
 
     def _role_for_token(self, token: str) -> NodeRole:
         """Which join token matched decides the role
-        (reference: server.go checkNodeCertificate / token switch)."""
+        (reference: server.go checkNodeCertificate / token switch).
+        Comparisons are constant-time: join tokens are bearer secrets."""
+        import hmac
+
         parsed = parse_join_token(token)
-        if parsed.ca_digest != self.root_ca.digest():
+        if not hmac.compare_digest(parsed.ca_digest, self.root_ca.digest()):
             raise InvalidJoinToken("join token CA digest mismatch")
         cluster = self._cluster()
         if cluster is None:
             raise InvalidJoinToken("no cluster object")
-        if token == cluster.root_ca.join_token_manager:
+        if hmac.compare_digest(token,
+                               cluster.root_ca.join_token_manager or ""):
             return NodeRole.MANAGER
-        if token == cluster.root_ca.join_token_worker:
+        if hmac.compare_digest(token,
+                               cluster.root_ca.join_token_worker or ""):
             return NodeRole.WORKER
         raise InvalidJoinToken("join token not recognized")
 

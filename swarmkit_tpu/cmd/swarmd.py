@@ -62,10 +62,14 @@ async def run(args, network=None, executor=None, registry=None) -> Node:
 
     use_grpc = getattr(args, "backend", "inproc") == "grpc" \
         and network is None
+    node_box: list = []
     if use_grpc:
         from swarmkit_tpu.raft.grpc_transport import GrpcNetwork
 
-        network = GrpcNetwork()
+        # late-bound security: the node's TLS identity is loaded during
+        # node.start(), before the raft listener registers
+        network = GrpcNetwork(
+            security=lambda: node_box[0].security if node_box else None)
     network = network or Network()
     node_id = args.node_id or new_id()
     executor = executor or TestExecutor(hostname=args.hostname or node_id)
@@ -82,22 +86,33 @@ async def run(args, network=None, executor=None, registry=None) -> Node:
 
             rm = remote_managers.get(addr)
             if rm is None:
-                rm = RemoteManager(addr)
+                expected_digest = ""
+                if args.join_token:
+                    from swarmkit_tpu.ca.config import parse_join_token
+
+                    expected_digest = parse_join_token(
+                        args.join_token).ca_digest
+                rm = RemoteManager(
+                    addr,
+                    security_ref=lambda: (node_box[0].security
+                                          if node_box else None),
+                    expected_ca_digest=expected_digest)
                 rm.start()
                 remote_managers[addr] = rm
             return rm
         return None
 
-    node_box: list = []
     if use_grpc:
         # serve dispatcher/CA/control alongside raft on the same port
         # (reference: manager.go:526-548 service registrations)
         from swarmkit_tpu.rpc import ClusterService
 
-        network.add_service(
-            args.listen_remote_api,
-            ClusterService(lambda: node_box[0] if node_box else None)
-            .handlers())
+        cluster_service = ClusterService(
+            lambda: node_box[0] if node_box else None)
+        network.add_service(args.listen_remote_api,
+                            cluster_service.handlers())
+        network.add_join_service(args.listen_remote_api,
+                                 cluster_service.join_handlers())
 
     node = Node(NodeConfig(
         node_id=node_id,

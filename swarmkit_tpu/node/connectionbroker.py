@@ -68,10 +68,17 @@ class ConnectionBroker:
         raise NoManagerError("cannot locate the cluster leader")
 
     def select_dispatcher(self):
-        return self.select_leader().dispatcher
+        d = self.select_leader().dispatcher
+        if d is None:
+            # a RemoteManager whose channel hasn't connected yet
+            raise NoManagerError("leader connection not established yet")
+        return d
 
     def select_control(self):
-        return self.select_leader().control_api
+        c = self.select_leader().control_api
+        if c is None:
+            raise NoManagerError("leader connection not established yet")
+        return c
 
     def select_ca(self):
         ca = self.select_leader().ca_server

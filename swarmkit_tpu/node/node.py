@@ -120,6 +120,21 @@ class Node:
         except NoManagerError:
             return None
 
+    @staticmethod
+    def _cert_has_tls_san(cert_pem: bytes) -> bool:
+        from cryptography import x509
+
+        from swarmkit_tpu.ca.certificates import (
+            TLS_SERVER_NAME, cert_from_pem,
+        )
+
+        try:
+            san = cert_from_pem(cert_pem).extensions.get_extension_for_class(
+                x509.SubjectAlternativeName)
+        except x509.ExtensionNotFound:
+            return False
+        return TLS_SERVER_NAME in san.value.get_values_for_type(x509.DNSName)
+
     async def _load_security_config(self) -> None:
         """Obtain (or restore) this node's TLS identity
         (reference: loadSecurityConfig node/node.go:305 — may block on the
@@ -143,6 +158,17 @@ class Node:
             self.security = SecurityConfig(RootCA(root_pem), node_id,
                                            role_ou, org, cert, key)
             self._desired_manager = role_ou == MANAGER_ROLE_OU
+            # Migration: certificates issued before TLS SANs existed fail
+            # gRPC hostname checks when used as SERVER certs. They still
+            # work as CLIENT certs (no hostname check), so the renewal RPC
+            # goes through — force it immediately (start() wires the
+            # renewer after this returns).
+            self._needs_cert_refresh = not self._cert_has_tls_san(cert)
+            if self._needs_cert_refresh:
+                log.warning(
+                    "node %s: stored certificate lacks the TLS SAN; "
+                    "forcing renewal so peers can dial this node",
+                    self.node_id)
             return
 
         if self.config.join_token and self.config.join_addr:
@@ -160,6 +186,16 @@ class Node:
                 csr_pem, self.config.join_token, addr=self.addr,
                 requested_node_id=self.node_id)
             root_pem = ca.get_root_ca_certificate()
+            # Join-token pin: the received root CA's digest MUST match the
+            # digest embedded in the SWMTKN (reference: GetRemoteCA digest
+            # verification, ca/certificates.go) — otherwise a MITM CA could
+            # substitute its own root during the join.
+            from swarmkit_tpu.ca.config import verify_root_digest
+
+            if not verify_root_digest(root_pem, self.config.join_token):
+                raise RuntimeError(
+                    "root CA digest from the remote CA does not match the "
+                    "join token pin — refusing to join")
             self.keyrw.write_root_ca(root_pem)
             self.keyrw.write(issued.cert_pem, key_pem)
             self.node_id = node_id
@@ -207,6 +243,8 @@ class Node:
                                        _RenewClient(self),
                                        clock=self.clock)
             self._renewer.start()
+            if getattr(self, "_needs_cert_refresh", False):
+                self._renewer.renew_soon()
         self.agent = Agent(AgentConfig(
             node_id=self.node_id,
             executor=self.config.executor,
@@ -254,6 +292,16 @@ class Node:
         want = node.role == NodeRole.MANAGER
         if want != self._desired_manager:
             self._desired_manager = want
+            # The certificate must match the new role BEFORE the manager
+            # can join (raft RPCs are manager-OU-gated): force renewal now
+            # rather than at half-life (reference: renewer.go
+            # SetExpectedRole).
+            if self.security is not None and self._renewer is not None:
+                from swarmkit_tpu.ca.certificates import MANAGER_ROLE_OU
+
+                have_mgr_cert = self.security.role_ou == MANAGER_ROLE_OU
+                if want != have_mgr_cert:
+                    self._renewer.renew_soon()
             self._role_evt.set()
 
     def _on_managers_change(self, managers) -> None:

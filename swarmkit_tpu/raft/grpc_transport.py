@@ -36,6 +36,7 @@ GRPC_MAX_MSG_SIZE = 4 * 1024 * 1024   # reference: peer.go:24
 _CHUNK = GRPC_MAX_MSG_SIZE - (64 * 1024)   # headroom for framing
 
 _SVC = "swarmkit.Raft"
+_BOOT = "swarmkit.Bootstrap"
 _MEM = "swarmkit.RaftMembership"
 
 
@@ -52,12 +53,30 @@ _IDENT = lambda b: b
 # server side
 
 class _RaftService:
-    """Hosts one local raft node behind the gRPC services."""
+    """Hosts one local raft node behind the gRPC services.
 
-    def __init__(self, node) -> None:
+    With a SecurityConfig every raft RPC is manager-only, authorized from
+    the mTLS peer certificate (reference: api/raft.proto tls_authorization
+    roles=swarm-manager; ca/auth.go AuthorizeOrgAndRole)."""
+
+    def __init__(self, node, security=None) -> None:
         self.node = node
+        self.security = security
+
+    async def _authorize(self, context) -> None:
+        if self.security is None:
+            return
+        from swarmkit_tpu.ca.auth import PermissionDenied
+        from swarmkit_tpu.ca.certificates import MANAGER_ROLE_OU
+        from swarmkit_tpu.ca.tlsutil import authorize_peer
+
+        try:
+            authorize_peer(context, self.security, MANAGER_ROLE_OU)
+        except PermissionDenied as e:
+            await context.abort(grpc.StatusCode.PERMISSION_DENIED, str(e))
 
     async def process_raft_message(self, request: bytes, context) -> bytes:
+        await self._authorize(context)
         try:
             await self.node.process_raft_message(decode_message(request))
         except PeerRemoved:
@@ -65,17 +84,30 @@ class _RaftService:
                                 "member removed")
         return b""
 
+    # Reassembled stream cap: bounds a misbehaving peer's buffering before
+    # the message is even parsed (the per-message gRPC cap is 4 MiB; a
+    # snapshot stream may legitimately span many chunks).
+    MAX_STREAM_BYTES = 512 * 1024 * 1024
+
     async def stream_raft_message(self, request_iterator, context) -> bytes:
         """Chunked delivery for big snapshots
-        (reference: StreamRaftMessage raft.go:1330; reassembly then Step)."""
-        chunks = []
+        (reference: StreamRaftMessage raft.go:1330; reassembly then Step).
+        Authorization runs BEFORE consuming the stream so an unauthorized
+        peer cannot make us buffer unbounded data."""
+        await self._authorize(context)
+        chunks, total = [], 0
         async for chunk in request_iterator:
+            total += len(chunk)
+            if total > self.MAX_STREAM_BYTES:
+                await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                                    "stream exceeds reassembly cap")
             chunks.append(chunk)
         return await self.process_raft_message(b"".join(chunks), context)
 
     async def join(self, request: bytes, context) -> bytes:
         from swarmkit_tpu.raft.node import NotLeaderError
 
+        await self._authorize(context)
         node_id, addr = msgpack.unpackb(request)
         try:
             resp = await self.node.join(node_id, addr)
@@ -88,6 +120,7 @@ class _RaftService:
             list(resp.removed)))
 
     async def leave(self, request: bytes, context) -> bytes:
+        await self._authorize(context)
         (raft_id,) = msgpack.unpackb(request)
         await self.node.leave(raft_id)
         return b""
@@ -191,14 +224,32 @@ class GrpcNetwork:
     knobs — use the in-process Network for partition tests).
     """
 
-    def __init__(self) -> None:
+    def __init__(self, security=None) -> None:
+        # security: a ca.SecurityConfig or a zero-arg callable returning one
+        # (late-bound: swarmd loads its identity after the network object
+        # exists). When set, the listener serves with TLS from the node
+        # identity (client certs verified when presented) and every dialed
+        # channel is mutual-TLS; raft RPCs then require the swarm-manager
+        # role OU (reference: manager.go:252-270 + ca/auth.go). A companion
+        # plaintext BOOTSTRAP port (port+1) serves only the public root CA
+        # certificate so joiners can pin it against their token digest (the
+        # python-grpc analog of the reference's InsecureSkipVerify +
+        # digest-pin GetRemoteCA, ca/certificates.go).
+        # None = plaintext, for in-process tests only.
+        self._security_arg = security
         self._servers: dict[str, grpc.aio.Server] = {}
         self._channels: dict[str, grpc.aio.Channel] = {}
         self._stubs: dict[str, _RemoteStub] = {}
         self._local: dict[str, Any] = {}
         self._extra_handlers: dict[str, list] = {}
+        self._join_handlers: dict[str, list] = {}
         self.delivered = 0   # counters kept for interface parity
         self.dropped = 0
+
+    @property
+    def security(self):
+        s = self._security_arg
+        return s() if callable(s) else s
 
     def add_service(self, addr: str, handlers: list) -> None:
         """Queue extra generic handlers (dispatcher/CA/control services) to
@@ -216,29 +267,96 @@ class GrpcNetwork:
             ("grpc.max_send_message_length", GRPC_MAX_MSG_SIZE),
             ("grpc.max_receive_message_length", GRPC_MAX_MSG_SIZE),
         ])
-        for h in _RaftService(node).handlers():
+        for h in _RaftService(node, security=self.security).handlers():
             server.add_generic_rpc_handlers((h,))
         for h in self._extra_handlers.get(addr, ()):
             server.add_generic_rpc_handlers((h,))
-        if server.add_insecure_port(addr) == 0:
+        if self.security is not None:
+            from swarmkit_tpu.ca.tlsutil import server_credentials
+
+            bound = server.add_secure_port(addr,
+                                           server_credentials(self.security))
+        else:
+            bound = server.add_insecure_port(addr)
+        if bound == 0:
             raise RuntimeError(f"cannot bind raft listener on {addr}")
         self._servers[addr] = server
         loop.create_task(server.start())
+        if self.security is not None:
+            self._start_bootstrap(addr, loop)
+
+    def add_join_service(self, addr: str, handlers: list) -> None:
+        """Handlers served to certificate-less joiners on the TLS join port
+        (port+2): certificate issuance + leader info."""
+        self._join_handlers.setdefault(addr, []).extend(handlers)
+
+    def _start_bootstrap(self, addr: str, loop) -> None:
+        """Two companion listeners for the join dance (see ca/tlsutil):
+        plaintext port+1 serves ONLY the public root CA certificate (joiners
+        digest-pin it against their SWMTKN — the reference's
+        InsecureSkipVerify + pin, ca/certificates.go GetRemoteCA; python-grpc
+        cannot skip verify); TLS port+2 (server-auth only) serves
+        certificate issuance so the join token never travels plaintext."""
+        from swarmkit_tpu.ca.tlsutil import join_server_credentials
+
+        async def get_root(request: bytes, context) -> bytes:
+            sec = self.security
+            return sec.root_ca.cert_pem if sec is not None else b""
+
+        host, port = addr.rsplit(":", 1)
+        boot = grpc.aio.server()
+        boot.add_generic_rpc_handlers((
+            grpc.method_handlers_generic_handler(_BOOT, {
+                "GetRootCACertificate": grpc.unary_unary_rpc_method_handler(
+                    get_root, request_deserializer=_IDENT,
+                    response_serializer=_IDENT)}),))
+        if boot.add_insecure_port(f"{host}:{int(port) + 1}") == 0:
+            log.warning("cannot bind bootstrap listener on %s:%d — joins "
+                        "from certificate-less nodes will fail",
+                        host, int(port) + 1)
+        else:
+            self._servers[addr + "/bootstrap"] = boot
+            loop.create_task(boot.start())
+
+        join_handlers = self._join_handlers.get(addr, ())
+        if join_handlers:
+            join_srv = grpc.aio.server()
+            for h in join_handlers:
+                join_srv.add_generic_rpc_handlers((h,))
+            if join_srv.add_secure_port(
+                    f"{host}:{int(port) + 2}",
+                    join_server_credentials(self.security)) == 0:
+                log.warning("cannot bind join listener on %s:%d",
+                            host, int(port) + 2)
+            else:
+                self._servers[addr + "/join"] = join_srv
+                loop.create_task(join_srv.start())
 
     def unregister(self, addr: str) -> None:
         self._local.pop(addr, None)
-        server = self._servers.pop(addr, None)
-        if server is not None:
-            asyncio.get_event_loop().create_task(server.stop(grace=0.1))
+        for key in (addr, addr + "/bootstrap", addr + "/join"):
+            server = self._servers.pop(key, None)
+            if server is not None:
+                asyncio.get_event_loop().create_task(server.stop(grace=0.1))
 
     # -- dialing -----------------------------------------------------------
     def server(self, frm: str, to: str) -> _RemoteStub:
         stub = self._stubs.get(to)
         if stub is None:
-            channel = grpc.aio.insecure_channel(to, options=[
+            options = [
                 ("grpc.max_send_message_length", GRPC_MAX_MSG_SIZE),
                 ("grpc.max_receive_message_length", GRPC_MAX_MSG_SIZE),
-            ])
+            ]
+            if self.security is not None:
+                from swarmkit_tpu.ca.tlsutil import (
+                    channel_credentials, secure_channel_options,
+                )
+
+                channel = grpc.aio.secure_channel(
+                    to, channel_credentials(self.security),
+                    options=secure_channel_options(options))
+            else:
+                channel = grpc.aio.insecure_channel(to, options=options)
             self._channels[to] = channel
             stub = _RemoteStub(channel)
             self._stubs[to] = stub

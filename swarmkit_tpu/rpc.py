@@ -30,6 +30,7 @@ from swarmkit_tpu.api.dispatcher_msgs import (
     AssignmentsMessage, HeartbeatResponse, SessionMessage,
 )
 from swarmkit_tpu.api.types import NodeDescription
+from swarmkit_tpu.ca.certificates import MANAGER_ROLE_OU, WORKER_ROLE_OU
 
 log = logging.getLogger("swarmkit_tpu.rpc")
 
@@ -53,6 +54,12 @@ class ClusterService:
 
     ``node_ref()`` returns the local swarmkit_tpu.node.Node (its running
     manager may come and go with promotions).
+
+    Authorization (reference: the authenticatedwrapper codegen +
+    ca/auth.go): when the node has a SecurityConfig, each RPC checks the
+    mTLS peer certificate's role OU — dispatcher RPCs admit workers and
+    managers, control admits managers, certificate issuance is open (the
+    join token authorizes), renewal needs any valid certificate.
     """
 
     def __init__(self, node_ref: Callable[[], Any]) -> None:
@@ -65,6 +72,35 @@ class ClusterService:
         if m is None:
             raise RpcError("this node is not a manager")
         return m
+
+    def _security(self):
+        node = self.node_ref()
+        return getattr(node, "security", None) if node is not None else None
+
+    async def _authorize(self, context, *roles):
+        """Role-gate an RPC on the peer certificate; no-op when the node
+        runs without TLS identities (in-process tests)."""
+        sec = self._security()
+        if sec is None:
+            return None
+        from swarmkit_tpu.ca.auth import PermissionDenied
+        from swarmkit_tpu.ca.tlsutil import authorize_peer
+
+        try:
+            return authorize_peer(context, sec, *roles)
+        except PermissionDenied as e:
+            await context.abort(grpc.StatusCode.PERMISSION_DENIED, str(e))
+
+    async def _bind_identity(self, context, info, node_id: str) -> None:
+        """The node_id in a dispatcher payload MUST be the authenticated
+        certificate's CN — a worker cert cannot impersonate another node
+        (reference: the dispatcher derives the node from the TLS identity,
+        dispatcher.go nodeIDFromContext / ca.RemoteNode)."""
+        if info is not None and node_id and info.node_id != node_id:
+            await context.abort(
+                grpc.StatusCode.PERMISSION_DENIED,
+                f"certificate identity {info.node_id!r} may not act as "
+                f"{node_id!r}")
 
     def _leader_manager(self):
         m = self._manager()
@@ -88,7 +124,10 @@ class ClusterService:
 
     # -- Dispatcher ------------------------------------------------------
     async def session(self, request: bytes, context):
+        info = await self._authorize(context, WORKER_ROLE_OU,
+                                     MANAGER_ROLE_OU)
         node_id, desc_json, session_id, addr = msgpack.unpackb(request)
+        await self._bind_identity(context, info, node_id)
         description = (NodeDescription.decode(desc_json)
                        if desc_json else None)
         try:
@@ -102,7 +141,10 @@ class ClusterService:
             await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
 
     async def assignments(self, request: bytes, context):
+        info = await self._authorize(context, WORKER_ROLE_OU,
+                                     MANAGER_ROLE_OU)
         node_id, session_id = msgpack.unpackb(request)
+        await self._bind_identity(context, info, node_id)
         try:
             d = self._leader_manager().dispatcher
             async for msg in d.assignments(node_id, session_id):
@@ -113,7 +155,10 @@ class ClusterService:
             await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
 
     async def heartbeat(self, request: bytes, context) -> bytes:
+        info = await self._authorize(context, WORKER_ROLE_OU,
+                                     MANAGER_ROLE_OU)
         node_id, session_id = msgpack.unpackb(request)
+        await self._bind_identity(context, info, node_id)
         try:
             resp = await self._leader_manager().dispatcher.heartbeat(
                 node_id, session_id)
@@ -124,7 +169,10 @@ class ClusterService:
             await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
 
     async def update_task_status(self, request: bytes, context) -> bytes:
+        info = await self._authorize(context, WORKER_ROLE_OU,
+                                     MANAGER_ROLE_OU)
         node_id, session_id, updates = msgpack.unpackb(request)
+        await self._bind_identity(context, info, node_id)
         try:
             d = self._leader_manager().dispatcher
             await d.update_task_status(
@@ -158,7 +206,15 @@ class ClusterService:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
 
     async def renew_certificate(self, request: bytes, context) -> bytes:
+        from swarmkit_tpu.ca.certificates import (
+            MANAGER_ROLE_OU, WORKER_ROLE_OU,
+        )
+
+        # any valid cluster identity may renew — but only its own cert
+        info = await self._authorize(context, WORKER_ROLE_OU,
+                                     MANAGER_ROLE_OU)
         node_id, old_cert, csr = msgpack.unpackb(request)
+        await self._bind_identity(context, info, node_id)
         try:
             issued = await self._ca().renew_node_certificate(
                 node_id, old_cert, csr)
@@ -173,6 +229,10 @@ class ClusterService:
         from swarmkit_tpu.cmd.ctl import CtlError, dispatch_control
         from swarmkit_tpu.manager.controlapi import ControlError
 
+        # remote control API is manager-only (reference: controlapi RPCs
+        # carry tls_authorization roles=swarm-manager); operators use the
+        # local unix socket
+        await self._authorize(context, MANAGER_ROLE_OU)
         req = json.loads(request)
         try:
             c = self._leader_manager().control_api
@@ -216,6 +276,20 @@ class ClusterService:
                                           response_serializer=_IDENT)}),
             grpc.method_handlers_generic_handler(_CTL, {
                 "Call": u(self.control, request_deserializer=_IDENT,
+                          response_serializer=_IDENT)}),
+        ]
+
+    def join_handlers(self) -> list:
+        """The subset served on the TLS join port to certificate-less
+        joiners: token-gated issuance + leader info for redirects."""
+        u = grpc.unary_unary_rpc_method_handler
+        return [
+            grpc.method_handlers_generic_handler(_CA, {
+                "IssueNodeCertificate": u(self.issue_certificate,
+                                          request_deserializer=_IDENT,
+                                          response_serializer=_IDENT)}),
+            grpc.method_handlers_generic_handler(_INFO, {
+                "Info": u(self.info, request_deserializer=_IDENT,
                           response_serializer=_IDENT)}),
         ]
 
@@ -332,21 +406,50 @@ class RemoteCA:
         return self._root_ca_pem
 
 
+async def fetch_root_ca(addr: str, timeout: float = 5.0) -> bytes:
+    """Fetch the cluster root CA certificate from a manager's plaintext
+    BOOTSTRAP port (addr's port + 1). The returned PEM is UNTRUSTED until
+    the caller verifies its digest against the join-token pin (reference:
+    GetRemoteCA digest pinning, ca/certificates.go)."""
+    host, port = addr.rsplit(":", 1)
+    boot_addr = f"{host}:{int(port) + 1}"
+    channel = grpc.aio.insecure_channel(boot_addr)
+    try:
+        call = channel.unary_unary(
+            "/swarmkit.Bootstrap/GetRootCACertificate",
+            request_serializer=_IDENT, response_deserializer=_IDENT)
+        return await asyncio.wait_for(call(b""), timeout=timeout)
+    finally:
+        await channel.close()
+
+
 class RemoteManager:
     """Manager duck type over gRPC for the connection broker: cached
-    is_leader/leader_addr (refreshed on use) + remote services."""
+    is_leader/leader_addr (refreshed on use) + remote services.
 
-    def __init__(self, addr: str, refresh_interval: float = 1.0) -> None:
+    Channel security (reference: manager.go client-side mTLS everywhere):
+    - with a SecurityConfig (``security_ref``): mutual TLS;
+    - certificate-less but holding a join token (``expected_ca_digest``):
+      fetch the root CA from the bootstrap port, verify the token's digest
+      pin, then server-authenticated TLS — the join dance;
+    - neither: plaintext (in-process tests only).
+    The channel is rebuilt when the node's security state changes (a joiner
+    upgrades pinned -> mTLS once its certificate is issued).
+    """
+
+    def __init__(self, addr: str, refresh_interval: float = 1.0,
+                 security_ref: Optional[Callable[[], Any]] = None,
+                 expected_ca_digest: str = "") -> None:
         self.addr = addr
-        self._channel = grpc.aio.insecure_channel(addr)
-        self._info = self._channel.unary_unary(
-            f"/{_INFO}/Info", request_serializer=_IDENT,
-            response_deserializer=_IDENT)
-        self._ctl = self._channel.unary_unary(
-            f"/{_CTL}/Call", request_serializer=_IDENT,
-            response_deserializer=_IDENT)
-        self.dispatcher = RemoteDispatcher(self._channel)
-        self.ca_server = RemoteCA(self._channel)
+        self._security_ref = security_ref or (lambda: None)
+        self._expected_digest = expected_ca_digest
+        self._pinned_root: Optional[bytes] = None
+        self._mode: Optional[str] = None
+        self._channel: Optional[grpc.aio.Channel] = None
+        self._info = None
+        self._ctl = None
+        self.dispatcher: Optional[RemoteDispatcher] = None
+        self.ca_server: Optional[RemoteCA] = None
         self._is_leader = False
         self._leader_addr = ""
         self._has_manager = False
@@ -354,6 +457,70 @@ class RemoteManager:
         self._last_refresh = 0.0
         self._refresher: Optional[asyncio.Task] = None
         self._running = True
+        self._connect_lock: Optional[asyncio.Lock] = None
+        self._last_connect_error: str = ""
+
+    async def _connect(self) -> None:
+        # refresh loop and in-flight RPCs can race channel rebuilds
+        if self._connect_lock is None:
+            self._connect_lock = asyncio.Lock()
+        async with self._connect_lock:
+            await self._connect_locked()
+
+    async def _connect_locked(self) -> None:
+        sec = self._security_ref()
+        want = ("mtls" if sec is not None
+                else "pinned" if self._expected_digest
+                else "insecure")
+        if self._channel is not None and want == self._mode:
+            return
+        if self._channel is not None:
+            await self._channel.close()
+        if want == "insecure":
+            channel = grpc.aio.insecure_channel(self.addr)
+        else:
+            from swarmkit_tpu.ca.tlsutil import (
+                channel_credentials, secure_channel_options,
+            )
+
+            if want == "pinned":
+                if self._pinned_root is None:
+                    import hmac
+
+                    from swarmkit_tpu.ca.certificates import RootCA
+
+                    root_pem = await fetch_root_ca(self.addr)
+                    # compare against the raw digest (the caller passes the
+                    # SWMTKN's pin component, not the whole token)
+                    try:
+                        got = RootCA(root_pem).digest()
+                    except Exception:
+                        got = ""
+                    if not hmac.compare_digest(got, self._expected_digest):
+                        raise RpcError(
+                            "remote CA digest does not match the join "
+                            "token pin — refusing to join (possible MITM)")
+                    self._pinned_root = root_pem
+                creds = channel_credentials(
+                    pinned_root_pem=self._pinned_root)
+                # certificate-less joiners talk to the TLS join port
+                host, port = self.addr.rsplit(":", 1)
+                target = f"{host}:{int(port) + 2}"
+            else:
+                creds = channel_credentials(sec)
+                target = self.addr
+            channel = grpc.aio.secure_channel(
+                target, creds, options=secure_channel_options())
+        self._channel = channel
+        self._mode = want
+        self._info = channel.unary_unary(
+            f"/{_INFO}/Info", request_serializer=_IDENT,
+            response_deserializer=_IDENT)
+        self._ctl = channel.unary_unary(
+            f"/{_CTL}/Call", request_serializer=_IDENT,
+            response_deserializer=_IDENT)
+        self.dispatcher = RemoteDispatcher(channel)
+        self.ca_server = RemoteCA(channel)
 
     def start(self) -> None:
         self._refresher = asyncio.get_running_loop().create_task(
@@ -367,14 +534,26 @@ class RemoteManager:
                 await self._refresher
             except (asyncio.CancelledError, Exception):
                 pass
-        await self._channel.close()
+        if self._channel is not None:
+            await self._channel.close()
 
     async def refresh(self) -> None:
         try:
+            await self._connect()
             raw = await asyncio.wait_for(self._info(b""), timeout=2.0)
             self._is_leader, self._leader_addr, self._has_manager = \
                 msgpack.unpackb(raw)
-        except Exception:
+            self._last_connect_error = ""
+        except Exception as e:
+            # A digest-pin refusal is a security event, not connection
+            # noise — surface it (once per distinct message, the refresh
+            # loop runs every second).
+            msg = f"{type(e).__name__}: {e}"
+            if msg != self._last_connect_error:
+                self._last_connect_error = msg
+                level = (log.error if "digest" in str(e).lower()
+                         else log.debug)
+                level("manager %s unavailable: %s", self.addr, msg)
             self._is_leader, self._has_manager = False, False
 
     async def _refresh_loop(self) -> None:
@@ -396,6 +575,7 @@ class RemoteManager:
 
     async def control_call(self, method: str, params: dict):
         """Raw control dispatch (same JSON protocol as the unix socket)."""
+        await self._connect()
         try:
             raw = await self._ctl(json.dumps(
                 {"method": method, "params": params}).encode())
