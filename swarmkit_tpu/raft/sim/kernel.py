@@ -27,12 +27,17 @@ within one tick unless masked out. Control flow divergence (leader vs
 candidate vs follower) is handled with `jnp.where` over role masks — there is
 no data-dependent Python control flow, so the whole step jits once and scans.
 
-Semantics deliberately simplified vs the host golden core (swarmkit_tpu.raft
-.core): no PreVote, no CheckQuorum lease, no leader transfer, and rejection
-hints are coarse (hint = follower last index). Safety properties (election
-safety, log matching, leader completeness) are preserved and asserted by
-tests/test_raft_sim.py invariant checks and the per-tick differential gate
-(tests/test_raft_sim_differential.py against the golden core).
+Implemented etcd behaviors beyond the basic protocol: vote rejections with
+candidate step-down on a rejection quorum (vendor raft.go:988-1060),
+CheckQuorum — both the periodic partitioned-leader step-down
+(raft.go:536-560) and the leader lease that ignores vote requests from
+rejoining nodes. Deliberately simplified vs the host golden core
+(swarmkit_tpu.raft.core): no PreVote, no leader transfer, no flow-control
+windows, and rejection hints are coarse (hint = follower last index).
+Safety properties (election safety, log matching, leader completeness) are
+preserved and asserted by tests/test_raft_sim.py invariant checks and the
+per-tick differential gate (tests/test_raft_sim_differential.py against the
+golden core).
 """
 
 from __future__ import annotations
@@ -104,16 +109,31 @@ def step(state: SimState, cfg: SimConfig,
     snap_chk, apply_chk = state.snap_chk, state.apply_chk
     log_term, log_data = state.log_term, state.log_data
     match, next_, granted = state.match, state.next_, state.granted
+    rejected, recent_active = state.rejected, state.recent_active
     active = state.active
 
     up = alive & active
     n_active = jnp.sum(active.astype(I32))
     quorum = n_active // 2 + 1
 
-    # ---- Phase A: timers + campaign start --------------------------------
+    # ---- Phase A: timers + CheckQuorum + campaign start ------------------
     is_leader = (role == LEADER) & up
     elapsed = jnp.where(up, elapsed + 1, elapsed)
     hb_elapsed = jnp.where(is_leader, hb_elapsed + 1, hb_elapsed)
+
+    # CheckQuorum (vendor raft.go:536-560 tickHeartbeat + checkQuorumActive):
+    # every election_tick ticks a leader confirms it heard from a quorum of
+    # members since the last round; a partitioned stale leader steps down
+    # instead of lingering until a higher term reaches it.
+    check_due = is_leader & (elapsed >= cfg.election_tick)
+    heard = recent_active | eye
+    n_heard = jnp.sum((heard & active[None, :]).astype(I32), axis=1)
+    cq_fail = check_due & (n_heard < quorum)
+    role = jnp.where(cq_fail, FOLLOWER, role)
+    lead = jnp.where(cq_fail, NONE, lead)
+    elapsed = jnp.where(check_due, 0, elapsed)
+    recent_active = jnp.where(check_due[:, None], False, recent_active)
+    is_leader = (role == LEADER) & up
 
     campaign = up & (role != LEADER) & (elapsed >= timeout)
     term = term + campaign.astype(I32)
@@ -123,10 +143,17 @@ def step(state: SimState, cfg: SimConfig,
     elapsed = jnp.where(campaign, 0, elapsed)
     timeout = jnp.where(campaign, rand_timeout(cfg, node, term), timeout)
     granted = jnp.where(campaign[:, None], eye, granted)
+    rejected = jnp.where(campaign[:, None], False, rejected)
 
     # ---- Phase B: vote exchange ------------------------------------------
     is_cand = (role == CANDIDATE) & up
     req = is_cand[:, None] & up[None, :] & ~eye & ~drop          # [i, j]
+    # CheckQuorum leader lease (vendor raft.go Step, checkQuorum branch): a
+    # receiver that heard from a live leader within the last election_tick
+    # ignores vote requests entirely — no term catch-up, no response —
+    # so a rejoining partitioned node cannot depose a healthy leader.
+    leased = (lead != NONE) & (elapsed < cfg.election_tick)      # [j]
+    req = req & ~leased[None, :]
     # Receiver-side term catch-up (Step m.Term > r.Term with MsgVote).
     req_term = jnp.where(req, term[:, None], -1)
     mt = jnp.max(req_term, axis=0)                               # [j]
@@ -144,18 +171,33 @@ def step(state: SimState, cfg: SimConfig,
     # Compare the SEND-TIME candidate term (req_term) with the receiver's
     # post-catch-up term: a candidate whose own term was bumped this tick by
     # a higher-term rival must not have its stale request treated as current.
-    grantable = req & (req_term == term[None, :]) & can_vote & log_ok
+    cur = req & (req_term == term[None, :])  # requests at the rx's term
+    grantable = cur & can_vote & log_ok
     any_grant = jnp.any(grantable, axis=0)                       # [j]
     chosen_cand = jnp.argmax(grantable, axis=0).astype(I32)      # first True
     grant_mat = grantable & (node[:, None] == chosen_cand[None, :])
     vote = jnp.where(any_grant, chosen_cand, vote)
     elapsed = jnp.where(any_grant, 0, elapsed)
-    # Responses travel j -> i; may be dropped independently.
+    # Responses travel j -> i; may be dropped independently. Requests that
+    # were processed at the receiver's term but not granted come back as
+    # rejections (vendor raft.go:988-1060 stepCandidate poll).
     resp_arrive = grant_mat & ~drop.T
     granted = granted | (resp_arrive & is_cand[:, None])
+    reject_arrive = cur & ~grant_mat & ~drop.T
+    rejected = rejected | (reject_arrive & is_cand[:, None])
 
     votes = jnp.sum((granted & active[None, :]).astype(I32), axis=1)
     win = is_cand & (votes >= quorum)
+    # Rejection quorum: the candidate stands down for this term (keeps term
+    # and vote, waits out its timeout). A voter that granted earlier in the
+    # term never counts as a rejection — etcd's votes map records the FIRST
+    # response per voter (core._poll), and within one candidacy a grant can
+    # only precede a rejection (log/vote checks are monotone), so masking
+    # with ~granted reproduces first-response-wins exactly.
+    n_rej = jnp.sum((rejected & ~granted & active[None, :]).astype(I32),
+                    axis=1)
+    lose = is_cand & ~win & (n_rej >= quorum)
+    role = jnp.where(lose, FOLLOWER, role)
     # becomeLeader: reset progress, append a no-op entry at the new term.
     role = jnp.where(win, LEADER, role)
     lead = jnp.where(win, node, lead)
@@ -163,6 +205,7 @@ def step(state: SimState, cfg: SimConfig,
     elapsed = jnp.where(win, 0, elapsed)
     next_ = jnp.where(win[:, None], (last + 1)[:, None], next_)
     match = jnp.where(win[:, None], 0, match)
+    recent_active = jnp.where(win[:, None], eye, recent_active)
     noop_slot = _slot(cfg, last + 1)
     log_term = log_term.at[node, noop_slot].set(
         jnp.where(win, term, log_term[node, noop_slot]))
@@ -287,6 +330,8 @@ def step(state: SimState, cfg: SimConfig,
     arrive_back = ~drop.T & is_resp_tgt & is_leader[:, None] & has_lmsg[None, :]
     ok_mat = arrive_back & resp_ok[None, :]
     rej_mat = arrive_back & resp_reject[None, :]
+    # any response marks the peer recently-active for CheckQuorum
+    recent_active = recent_active | ok_mat | rej_mat
     match = jnp.where(ok_mat, jnp.maximum(match, resp_match[None, :]), match)
     next_ = jnp.where(ok_mat, jnp.maximum(next_, resp_match[None, :] + 1), next_)
     # Probe decrement (maybeDecrTo, coarse): jump next back to the hint.
@@ -356,6 +401,7 @@ def step(state: SimState, cfg: SimConfig,
         snap_chk=snap_chk, apply_chk=apply_chk,
         log_term=log_term, log_data=log_data,
         match=match, next_=next_, granted=granted,
+        rejected=rejected, recent_active=recent_active,
         tick=state.tick + 1,
     )
 

@@ -14,31 +14,34 @@ real `core.Raft` nodes.
 
 INTENTIONAL DIVERGENCES between the kernel and stock etcd/raft semantics,
 all masked here (this is the single list the differential gate maintains;
-each knob names the kernel simplification it mirrors):
+each knob names the kernel simplification it mirrors). Vote rejections
+(candidate steps down on a rejection quorum) and CheckQuorum (leader lease
+on vote requests + periodic step-down of partitioned leaders) are now
+IMPLEMENTED by the kernel and replayed faithfully here — they are no longer
+divergences.
 
- D1 no-vote-rejections: the kernel never delivers vote rejections, so a
-    losing candidate stands until its next timeout instead of stepping down
-    on a rejection quorum. Mask: reject VOTE_RESPs are dropped.
- D2 appends-as-heartbeats: the kernel has no heartbeat messages; every
-    leader sends an append (possibly empty) to every peer every tick.
-    Mask: the scheduler calls _bcast_append each tick and never fires BEAT.
- D3 no PreVote / CheckQuorum / leader transfer: kernel.py:19-23. Mask:
-    oracle Config(pre_vote=False, check_quorum=False); transfer untested
-    here (covered by host-level tests).
- D4 no flow control: the kernel re-sends the window from next_ every tick
+ D1 appends-as-heartbeats, one synchronous round per tick: the kernel has
+    no heartbeat messages (every leader appends to every peer every tick,
+    possibly empty) and does exactly one append round per tick — etcd
+    re-sends immediately on commit advance / rejection. Mask: the scheduler
+    calls _bcast_append each tick, never fires BEAT, and suppresses sends
+    while responses are being stepped (the next tick's bcast supersedes
+    them).
+ D2 no PreVote / leader transfer: kernel.py module docstring. Mask: oracle
+    Config(pre_vote=False); transfer untested here (covered by host-level
+    tests).
+ D3 no flow control: the kernel re-sends the window from next_ every tick
     and advances next_ only on acks — no probe pausing, no optimistic
     updates, no inflight windows. Mask: SyncRaft._send_append is a
     side-effect-free windowed send.
- D5 synchronous cascades: the kernel does exactly one append round per
-    tick; etcd re-sends immediately on commit advance / rejection. Mask:
-    sends are suppressed while responses are being stepped (the next tick's
-    bcast supersedes them).
- D6 timer scope: kernel election timers reset on (a) own campaign,
-    (b) granting a vote, (c) receiving a current-term leader message, and
-    re-randomize only at campaign time. Mask: the scheduler keeps its own
-    elapsed/timeout arrays with exactly those rules (the oracle's internal
-    tick()/randomized timeout machinery is never used).
- D7 proposals go to every node claiming leadership (even a crashed one —
+ D4 timer scope: kernel election timers reset on (a) own campaign,
+    (b) granting a vote, (c) receiving a current-term leader message,
+    (d) a leader's CheckQuorum round, and re-randomize only at campaign
+    time; the CheckQuorum cadence and lease both read this same counter.
+    Mask: the scheduler keeps its own elapsed/timeout arrays with exactly
+    those rules and drives core CheckQuorum decisions itself (oracle
+    Config(check_quorum=False) so core's internal lease stays off).
+ D5 proposals go to every node claiming leadership (even a crashed one —
     kernel propose() masks on role/active only), and apply/compaction run
     on crashed rows too (kernel phases E/F have no alive mask).
 """
@@ -88,7 +91,7 @@ def _data_u32(e: Entry) -> int:
 
 
 class SyncRaft(core.Raft):
-    """core.Raft with the kernel's send discipline (divergences D4/D5):
+    """core.Raft with the kernel's send discipline (divergences D1/D3):
     windowed side-effect-free appends, and a suppress flag that swallows
     sends triggered while responses are being stepped."""
 
@@ -170,6 +173,8 @@ class OracleCluster:
         self.timeout = [rand_timeout_py(cfg, i, 0) for i in range(n)]
         self.applied = [0] * n
         self.apply_chk = [0] * n
+        # CheckQuorum bookkeeping (mirrors kernel recent_active [N, N])
+        self.recent_active: list[set[int]] = [set() for _ in range(n)]
         # Canonical applied-log content (safety cross-check): idx ->
         # (term, data); chk_at[idx] = cumulative checksum through idx.
         self.canon: dict[int, tuple[int, int]] = {}
@@ -193,7 +198,7 @@ class OracleCluster:
         nodes = self.nodes
         up = [bool(alive[i]) for i in range(n)]
 
-        # Phase 0: propose (run_ticks calls propose() before step(); D7:
+        # Phase 0: propose (run_ticks calls propose() before step(); D5:
         # alive is not consulted, room mirrors kernel propose()).
         if prop_count:
             ents = tuple(
@@ -216,11 +221,23 @@ class OracleCluster:
                 nd.suppress = False
                 nd.take_msgs()
 
-        # Phase A: timers + campaign.
+        # Phase A: timers + CheckQuorum + campaign.
+        for i, nd in enumerate(nodes):
+            if up[i]:
+                self.elapsed[i] += 1
+        for i, nd in enumerate(nodes):
+            # CheckQuorum: every election_tick ticks a standing leader must
+            # have heard from a quorum since its last round (kernel Phase A)
+            if up[i] and nd.state == core.LEADER \
+                    and self.elapsed[i] >= cfg.election_tick:
+                heard = self.recent_active[i] | {i}
+                if len(heard) < (n // 2 + 1):
+                    nd.become_follower(nd.term, core.NONE)
+                self.elapsed[i] = 0
+                self.recent_active[i] = set()
         for i, nd in enumerate(nodes):
             if not up[i]:
                 continue
-            self.elapsed[i] += 1
             if nd.state != core.LEADER and self.elapsed[i] >= self.timeout[i]:
                 self.elapsed[i] = 0
                 nd.step(Message(type=MsgType.HUP, frm=nd.id))
@@ -230,12 +247,17 @@ class OracleCluster:
         # Phase B: vote exchange. Candidates re-request every tick (the
         # kernel's req matrix); delivery order (term desc, candidate asc)
         # reproduces the kernel's max-term catch-up + lowest-index grant.
+        # Lease flags snapshot BEFORE any vote is delivered (kernel computes
+        # `leased` once from post-Phase-A state).
+        leased = [nodes[j].lead != core.NONE
+                  and self.elapsed[j] < cfg.election_tick
+                  for j in range(n)]
         requests: list[tuple[int, int, Message]] = []  # (cand, to, msg)
         for i, nd in enumerate(nodes):
             if not up[i] or nd.state != core.CANDIDATE:
                 continue
             for j in range(n):
-                if j == i or not up[j] or drop[i][j]:
+                if j == i or not up[j] or drop[i][j] or leased[j]:
                     continue
                 requests.append((i, j, Message(
                     type=MsgType.VOTE, to=j + 1, frm=nd.id, term=nd.term,
@@ -243,13 +265,18 @@ class OracleCluster:
                     log_term=nd.log.last_term())))
         requests.sort(key=lambda r: (-r[2].term, r[0]))
         grants: list[tuple[int, int, Message]] = []  # (voter, cand, resp)
+        rejects: list[tuple[int, int, Message]] = []
         for i, j, msg in requests:
             nodes[j].step(msg)
             for resp in nodes[j].take_msgs():
                 if resp.type == MsgType.VOTE_RESP and not resp.reject:
                     self.elapsed[j] = 0
                     grants.append((j, i, resp))
-                # D1: rejections are dropped.
+                elif resp.type == MsgType.VOTE_RESP and resp.reject \
+                        and resp.term == msg.term:
+                    # processed at the candidate's term: a real rejection
+                    # (kernel counts only current-term refusals)
+                    rejects.append((j, i, resp))
         new_leader_msgs: list[Message] = []
         for j, i, resp in grants:
             if drop[j][i]:
@@ -259,7 +286,15 @@ class OracleCluster:
             msgs = nodes[i].take_msgs()
             if not was_leader and nodes[i].state == core.LEADER:
                 self.elapsed[i] = 0
+                self.recent_active[i] = set()
                 new_leader_msgs.extend(msgs)  # win-cascade appends (Phase C)
+        # rejections step in AFTER all grants (kernel: win evaluated before
+        # the rejection quorum); only still-candidates care
+        for j, i, resp in rejects:
+            if drop[j][i] or nodes[i].state != core.CANDIDATE:
+                continue
+            nodes[i].step(resp)
+            nodes[i].take_msgs()
 
         # Phase C: append/snapshot fan-out from every standing leader.
         out: list[Message] = list(new_leader_msgs)
@@ -289,6 +324,8 @@ class OracleCluster:
         for j, i, resp in responses:
             if drop[j][i] or not up[i]:
                 continue
+            if nodes[i].state == core.LEADER:
+                self.recent_active[i].add(j)  # kernel: any resp arrival
             nodes[i].suppress = True
             nodes[i].step(resp)
             nodes[i].suppress = False
@@ -303,7 +340,7 @@ class OracleCluster:
                 nd.suppress = False
                 nd.take_msgs()
 
-        # Phase E: apply batch (D7: no alive mask) + checksum bookkeeping.
+        # Phase E: apply batch (D5: no alive mask) + checksum bookkeeping.
         for i, nd in enumerate(nodes):
             if nd.log.applied > self.applied[i]:  # snapshot restore jumped
                 self.applied[i] = nd.log.applied
@@ -323,7 +360,7 @@ class OracleCluster:
             self.applied[i] = new_applied
             nd.log.applied_to(new_applied)
 
-        # Phase F: ring-pressure compaction (D7: no alive mask).
+        # Phase F: ring-pressure compaction (D5: no alive mask).
         for i, nd in enumerate(nodes):
             last, off = nd.log.last_index(), nd.log.offset
             pressure = (last - off) > (cfg.log_len - 2 * cfg.max_props - 1)

@@ -79,6 +79,11 @@ class SimState:
     match: jax.Array
     next_: jax.Array
     granted: jax.Array     # bool: granted[i, j] = j voted for i this term
+    rejected: jax.Array    # bool: rejected[i, j] = j refused i this term
+                           # (a rejection quorum steps the candidate down,
+                           # vendor raft.go stepCandidate poll)
+    recent_active: jax.Array  # bool: leader i heard from j since the last
+                              # CheckQuorum round (Progress.RecentActive)
     # membership / liveness [N] bool
     active: jax.Array      # raft membership (conf changes flip these)
     # global tick counter (scalar) — also the PRNG stream position
@@ -106,6 +111,8 @@ def init_state(cfg: SimConfig) -> SimState:
         match=z(n, n),
         next_=jnp.ones((n, n), i32),
         granted=jnp.zeros((n, n), jnp.bool_),
+        rejected=jnp.zeros((n, n), jnp.bool_),
+        recent_active=jnp.zeros((n, n), jnp.bool_),
         active=jnp.ones((n,), jnp.bool_),
         tick=jnp.zeros((), i32),
     )

@@ -7,7 +7,7 @@ applied-log-content checksum — equality implies identical applied prefixes).
 
 The scheduler that makes core.py comparable tick-for-tick lives in
 swarmkit_tpu.raft.sim.oracle, together with the single documented list of
-intentional kernel divergences (D1-D7) and how each is masked.
+intentional kernel divergences (D1-D5) and how each is masked.
 """
 
 from __future__ import annotations
