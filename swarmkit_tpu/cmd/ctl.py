@@ -100,6 +100,17 @@ async def dispatch_control(c, method: str, p: dict):
     """Shared control-API JSON dispatch (unix socket + gRPC Control.Call)."""
     if method == "cluster.inspect":
         return c.get_cluster().to_dict()
+    if method == "cluster.metrics":
+        # hot-path latency percentiles + object gauges (reference: the
+        # prometheus endpoint; names match raft.go:69-71 / memory.go:81-110)
+        from swarmkit_tpu.utils import metrics as _metrics
+
+        reg = getattr(c, "metrics_registry", None) or _metrics.REGISTRY
+        out = {"timers": reg.snapshot()}
+        collector = getattr(c, "metrics", None)
+        if collector is not None:
+            out["gauges"] = collector.snapshot()
+        return out
     if method == "cluster.unlock-key":
         cl = c.get_cluster()
         return {"worker": cl.root_ca.join_token_worker,

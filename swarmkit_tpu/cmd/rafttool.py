@@ -56,10 +56,12 @@ def dump_snapshot(state_dir: str, out=sys.stdout) -> int:
     rec = {"index": snap.meta.index, "term": snap.meta.term,
            "data_bytes": len(snap.data)}
     try:
-        import pickle
+        from swarmkit_tpu.api.raft_msgs import Snapshot as ApiSnapshot
 
-        payload = pickle.loads(snap.data)
+        payload = ApiSnapshot.decode(snap.data)
         rec["payload_type"] = type(payload).__name__
+        rec["version"] = payload.version
+        rec["members"] = len(payload.membership.members)
     except Exception:
         pass
     json.dump(rec, out, default=str)

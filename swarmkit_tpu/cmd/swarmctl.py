@@ -21,6 +21,7 @@ def build_parser() -> argparse.ArgumentParser:
     sub = p.add_subparsers(dest="cmd", required=True)
 
     sub.add_parser("cluster-inspect")
+    sub.add_parser("metrics")
     sub.add_parser("cluster-tokens")
 
     sub.add_parser("node-ls")
@@ -92,6 +93,8 @@ async def run(args, out=None) -> int:
         c = args.cmd
         if c == "cluster-inspect":
             show(await client.call("cluster.inspect"))
+        elif c == "metrics":
+            show(await client.call("cluster.metrics"))
         elif c == "cluster-tokens":
             show(await client.call("cluster.unlock-key"))
         elif c == "node-ls":

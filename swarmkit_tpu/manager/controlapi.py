@@ -131,11 +131,14 @@ def _validate_service_spec(spec) -> None:
 
 class ControlApi:
     def __init__(self, store: MemoryStore, raft=None,
-                 on_remove_node=None) -> None:
+                 on_remove_node=None, metrics=None,
+                 metrics_registry=None) -> None:
         self.store = store
         self.raft = raft   # for memberlist in node listings / demote checks
         # hook the manager uses to deregister raft members on node removal
         self.on_remove_node = on_remove_node
+        self.metrics = metrics  # gauge collector for cluster.metrics
+        self.metrics_registry = metrics_registry  # per-node latency timers
 
     # -- helpers ---------------------------------------------------------
     def _get(self, kind: str, obj_id: str):

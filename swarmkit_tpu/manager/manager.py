@@ -64,7 +64,10 @@ class Manager:
         # bootstrap (reference: manager.go uses SecurityConfig's RootCA)
         self.security = security
         self.ca_server: Optional[CAServer] = None
+        from swarmkit_tpu.utils.metrics import Registry
+        self.metrics_registry = Registry()
         self.raft = RaftNode(NodeOpts(
+            metrics_registry=self.metrics_registry,
             node_id=node_id, addr=addr, network=network,
             state_dir=state_dir, clock=self.clock, join_addr=join_addr,
             force_new_cluster=force_new_cluster,
@@ -73,15 +76,17 @@ class Manager:
         self.store: MemoryStore = self.raft.store
 
         # always-on services (reference: manager.go:526-548)
+        self.metrics = Collector(self.store)
         self.control_api = ControlApi(self.store, raft=self.raft,
-                                      on_remove_node=self._on_remove_node)
+                                      on_remove_node=self._on_remove_node,
+                                      metrics=self.metrics,
+                                      metrics_registry=self.metrics_registry)
         self.dispatcher = Dispatcher(
             self.store, managers_fn=self._weighted_peers, clock=self.clock,
             peers_queue=self.raft.cluster.broadcast)
         self.logbroker = LogBroker(self.store)
         self.watch_server = WatchServer(self.store, proposer=self.raft)
         self.health = HealthServer()
-        self.metrics = Collector(self.store)
         self.resource_api = ResourceApi(self.store, clock=self.clock)
 
         # leader-only control loops, built on becomeLeader

@@ -23,7 +23,6 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
-import pickle
 import random
 from dataclasses import dataclass, field
 from typing import Iterable, Optional, Sequence
@@ -42,6 +41,8 @@ from swarmkit_tpu.raft.rawnode import RawNode, Ready
 from swarmkit_tpu.raft.storage import EncryptedRaftLogger
 from swarmkit_tpu.raft.transport import Network, PeerRemoved, Transport
 from swarmkit_tpu.raft.wait import Wait
+from swarmkit_tpu.raft.wire import decode_conf_change, encode_conf_change
+from swarmkit_tpu.utils import metrics
 from swarmkit_tpu.store.memory import MemoryStore, Proposer
 from swarmkit_tpu.utils.clock import Clock, SystemClock, wait_for
 from swarmkit_tpu.watch.queue import Queue
@@ -121,10 +122,16 @@ class NodeOpts:
     # pass swarmkit_tpu.transport.DeviceMeshTransport (with a DeviceMeshNet
     # network) to exchange raft messages through the device mailbox.
     transport_factory: object = None
+    # Per-node metric registry; None = the process-global one. In-process
+    # multi-node deployments pass one per node so latency percentiles do
+    # not mix across members.
+    metrics_registry: object = None
 
 
 class Node(Proposer):
     """A full consensus member (reference: raft.Node raft.go:104)."""
+
+    _WEDGE_RETRY_S = 10.0  # cooldown between wedge-triggered transfers
 
     def __init__(self, opts: NodeOpts) -> None:
         self.opts = opts
@@ -136,7 +143,9 @@ class Node(Proposer):
         self.cluster = Cluster()
         self.storage = EncryptedRaftLogger(
             opts.state_dir, encrypter=opts.encrypter, decrypter=opts.decrypter)
-        self.store = MemoryStore(proposer=None, clock=self.clock.now)
+        self.metrics = opts.metrics_registry or metrics.REGISTRY
+        self.store = MemoryStore(proposer=None, clock=self.clock.now,
+                                 metrics_registry=self.metrics)
         self.transport: Optional[Transport] = None
         self.leadership = Queue()   # publishes LeadershipState
 
@@ -153,6 +162,7 @@ class Node(Proposer):
         self._was_leader = False
         self._removed = False
         self._ticks_until_campaign = 0
+        self._wedge_transfer_at = float("-inf")
         self.running = False
 
     # ------------------------------------------------------------------
@@ -209,7 +219,7 @@ class Node(Proposer):
                         node_id=self.raft_id,
                         context=self._member_context())
         ent = Entry(index=1, term=1, type=EntryType.CONF_CHANGE,
-                    data=pickle.dumps(cc))
+                    data=encode_conf_change(cc))
         r = self._raw.raft
         r.term = 1
         r.log.append([ent])
@@ -268,7 +278,7 @@ class Node(Proposer):
             if self.raft_id == 0:
                 for e in boot.entries:
                     if e.type == EntryType.CONF_CHANGE:
-                        cc = pickle.loads(e.data)
+                        cc = decode_conf_change(e.data)
                         nid, _ = self._decode_member_context(cc.context)
                         if cc.type == ConfChangeType.ADD_NODE \
                                 and nid == self.node_id:
@@ -385,6 +395,24 @@ class Node(Proposer):
                 return
 
     async def _process_ready(self, rd: Ready) -> None:
+        # 0. wedge watchdog (reference: raft.go:589-606 — a leader whose
+        #    store is wedged hands leadership away rather than stalling the
+        #    cluster behind a stuck writer). Retries with a cooldown: a
+        #    transfer whose random target is down must not latch the
+        #    watchdog off while the wedge persists.
+        if self.is_leader() and self.store.wedged():
+            now = self.clock.now()
+            if now - self._wedge_transfer_at > self._WEDGE_RETRY_S:
+                self._wedge_transfer_at = now
+                log.error("raft node %s: store wedged >%ss as leader; "
+                          "transferring leadership", self.node_id,
+                          self.store.WEDGE_TIMEOUT)
+                try:
+                    await self.transfer_leadership()
+                except Exception:
+                    log.exception(
+                        "wedge-triggered leadership transfer failed")
+
         # 1. persist hard state + entries (WAL fsync) BEFORE sending
         #    (reference: saveToStorage raft.go:1738, called at raft.go:585)
         self.storage.save(rd.hard_state, rd.entries)
@@ -441,7 +469,7 @@ class Node(Proposer):
     def _process_conf_change(self, e: Entry) -> None:
         """reference: processConfChange raft.go:1939 +
         applyAddNode/applyUpdateNode/applyRemoveNode :1953-2024."""
-        cc: ConfChange = pickle.loads(e.data)
+        cc: ConfChange = decode_conf_change(e.data)
         err: Optional[Exception] = None
         try:
             self.cluster.validate_configuration_change(cc)
@@ -499,7 +527,13 @@ class Node(Proposer):
         return snap.encode()
 
     def _do_snapshot(self) -> None:
-        """reference: triggerSnapshot raft.go:677 → storage.go:186."""
+        """reference: triggerSnapshot raft.go:677 → storage.go:186 (timed
+        per storage.go:20-29 snapshot latency)."""
+        with metrics.timed(metrics.RAFT_SNAPSHOT_LATENCY,
+                           registry=self.metrics):
+            self._do_snapshot_timed()
+
+    def _do_snapshot_timed(self) -> None:
         r = self._raw.raft
         index = self._applied
         snap = Snapshot(
@@ -587,7 +621,11 @@ class Node(Proposer):
         except ProposalDropped:
             self._wait.trigger(r.id, ErrLostLeadership("proposal dropped"))
         self._wake.set()
-        return await self._await_with_timeout(fut, timeout, r.id)
+        # reference: proposeLatencyTimer wraps exactly this wait
+        # (raft.go:69-71, observed at :1589)
+        with metrics.timed(metrics.RAFT_PROPOSE_LATENCY,
+                           registry=self.metrics):
+            return await self._await_with_timeout(fut, timeout, r.id)
 
     async def _await_with_timeout(self, fut: asyncio.Future, timeout: float,
                                   wait_id: Optional[int] = None):

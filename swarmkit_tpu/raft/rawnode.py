@@ -57,11 +57,11 @@ class RawNode:
                                entries=(Entry(data=data),)))
 
     def propose_conf_change(self, cc: ConfChange) -> None:
-        import pickle
+        from swarmkit_tpu.raft.wire import encode_conf_change
         self.raft.step(Message(
             type=MsgType.PROP, frm=self.raft.id,
             entries=(Entry(type=EntryType.CONF_CHANGE,
-                           data=pickle.dumps(cc)),)))
+                           data=encode_conf_change(cc)),)))
 
     def step(self, m: Message) -> None:
         if m.type in LOCAL_MSGS and m.frm != self.raft.id:

@@ -1,10 +1,13 @@
-"""Binary wire codec for raft protocol messages.
+"""Binary wire codec for raft protocol messages and conf-change entries.
 
 The device-mesh transport moves raft messages through fixed-width uint32
-mailbox arrays, and the gRPC transport moves them between processes; both
-need a compact, versioned, code-free encoding (the reference wire format is
-protobuf raftpb.Message — vendor/github.com/coreos/etcd/raft/raftpb).
-msgpack of positional tuples: no pickle, no class names on the wire.
+mailbox arrays, the gRPC transport moves them between processes, and the
+encrypted WAL persists conf-change entry payloads; all need a compact,
+versioned, CODE-FREE encoding — a log replay must never execute anything
+(the reference wire/WAL format is protobuf raftpb —
+vendor/github.com/coreos/etcd/raft/raftpb,
+manager/state/raft/storage/walwrap.go). msgpack of positional tuples: no
+pickle, no class names on the wire or on disk.
 """
 
 from __future__ import annotations
@@ -12,10 +15,33 @@ from __future__ import annotations
 import msgpack
 
 from swarmkit_tpu.raft.messages import (
-    Entry, EntryType, Message, MsgType, Snapshot, SnapshotMeta,
+    ConfChange, ConfChangeType, Entry, EntryType, Message, MsgType, Snapshot,
+    SnapshotMeta,
 )
 
 WIRE_VERSION = 1
+
+
+def encode_conf_change(cc: ConfChange) -> bytes:
+    return msgpack.packb((WIRE_VERSION, cc.id, int(cc.type), cc.node_id,
+                          cc.context))
+
+
+def decode_conf_change(raw: bytes) -> ConfChange:
+    """Strict decode; anything else — including entries pickled by builds
+    that predate this codec — fails loudly rather than deserializing
+    arbitrary payloads from the log."""
+    try:
+        fields = msgpack.unpackb(raw)
+        ver, cc_id, cc_type, node_id, context = fields
+        if ver != WIRE_VERSION:
+            raise ValueError(f"version {ver}")
+    except Exception as e:
+        raise ValueError(
+            "undecodable ConfChange entry (legacy/pickled WAL formats are "
+            f"not supported; re-bootstrap the member): {e}") from e
+    return ConfChange(id=cc_id, type=ConfChangeType(cc_type),
+                      node_id=node_id, context=context)
 
 
 def encode_message(m: Message) -> bytes:

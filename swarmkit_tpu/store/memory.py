@@ -24,6 +24,7 @@ from swarmkit_tpu.store.errors import (
     ErrExist, ErrInvalidFindBy, ErrNameConflict, ErrNotExist,
     ErrSequenceConflict, ErrTxTooLarge,
 )
+from swarmkit_tpu.utils import metrics
 from swarmkit_tpu.watch.queue import Queue
 
 # reference: manager/state/store/memory.go:45-48
@@ -356,13 +357,38 @@ def _match_object(by, kind: str, obj) -> bool:
 # the store
 
 class MemoryStore:
+    # reference: WedgeTimeout memory.go:79 (30s there). Here it must sit
+    # BELOW the default proposal timeout (node.py propose_value timeout=30):
+    # the stuck write is popped from _in_flight when its proposal times out,
+    # so the watchdog can only observe the stall while the await is pending.
+    WEDGE_TIMEOUT = 15.0
+
     def __init__(self, proposer: Optional[Proposer] = None,
-                 clock: Optional[Callable[[], float]] = None) -> None:
+                 clock: Optional[Callable[[], float]] = None,
+                 metrics_registry=None) -> None:
         self._tables: dict[str, _Table] = {k: _Table(k) for k in OBJECT_KINDS}
         self._proposer = proposer
         self._clock = clock or time.time
         self.queue = Queue()
         self._local_version = 0
+        self._in_flight: dict[int, float] = {}  # update id -> start time
+        self._in_flight_seq = 0
+        self.metrics = metrics_registry or metrics.REGISTRY
+
+    def _timed(self, name: str):
+        return metrics.timed(name, registry=self.metrics)
+
+    async def propose_in_flight(self, actions, cb) -> None:
+        """Propose with wedge bookkeeping — ALL write paths (update and
+        Batch flushes) must go through here so a stalled proposal marks the
+        store wedged (reference: timedMutex covers every store write)."""
+        self._in_flight_seq += 1
+        fid = self._in_flight_seq
+        self._in_flight[fid] = self._now()
+        try:
+            await self._proposer.propose_value(actions, cb)
+        finally:
+            self._in_flight.pop(fid, None)
 
     def _now(self) -> float:
         return self._clock()
@@ -375,7 +401,8 @@ class MemoryStore:
         return ReadTx(self)
 
     def view(self, cb: Callable[[ReadTx], Any]) -> Any:
-        return cb(ReadTx(self))
+        with self._timed(metrics.STORE_READ_TX_LATENCY):
+            return cb(ReadTx(self))
 
     def get(self, kind: str, id: str):
         return ReadTx(self).get(kind, id)
@@ -440,13 +467,25 @@ class MemoryStore:
         if size > MAX_TRANSACTION_BYTES:
             raise ErrTxTooLarge(f"transaction weighs ~{size} bytes")
 
-        if self._proposer is not None:
-            await self._proposer.propose_value(
-                actions, lambda index: self._commit(tx.changelist, index))
-        else:
-            self._local_version += 1
-            self._commit(tx.changelist, self._local_version)
+        with self._timed(metrics.STORE_WRITE_TX_LATENCY):
+            if self._proposer is not None:
+                await self.propose_in_flight(
+                    actions, lambda index: self._commit(tx.changelist, index))
+            else:
+                self._local_version += 1
+                self._commit(tx.changelist, self._local_version)
         return result
+
+    def wedged(self) -> bool:
+        """True when any write has been stuck in flight longer than
+        WEDGE_TIMEOUT (reference: timedMutex + Wedged() memory.go:117-144,
+        :972 — there it is a mutex held too long; in the asyncio build the
+        analogous stall is a proposal that never commits)."""
+        if not self._in_flight:
+            return False
+        now = self._now()
+        return any(now - t0 > self.WEDGE_TIMEOUT
+                   for t0 in self._in_flight.values())
 
     def _commit(self, changelist: list[Event], version: int) -> None:
         for ev in changelist:
@@ -547,6 +586,10 @@ class Batch:
     async def _flush(self) -> None:
         if not self._pending:
             return
+        with self._store._timed(metrics.STORE_BATCH_LATENCY):
+            await self._flush_timed()
+
+    async def _flush_timed(self) -> None:
         chunk, self._pending = (
             self._pending[:MAX_CHANGES_PER_TRANSACTION],
             self._pending[MAX_CHANGES_PER_TRANSACTION:])
@@ -554,7 +597,7 @@ class Batch:
         actions = [StoreAction.make(_ACTION_KIND[ev.action], ev.object)
                    for ev in chunk]
         if store._proposer is not None:
-            await store._proposer.propose_value(
+            await store.propose_in_flight(
                 actions, lambda index: store._commit(chunk, index))
         else:
             store._local_version += 1

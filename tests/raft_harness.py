@@ -7,7 +7,6 @@ gRPC, and ticks pumped by the test.
 
 from __future__ import annotations
 
-import pickle
 import random
 from typing import Callable, Optional
 
@@ -120,7 +119,8 @@ class InMemCluster:
 
     def _apply(self, pid: int, e: Entry) -> None:
         if e.type == EntryType.CONF_CHANGE:
-            cc: ConfChange = pickle.loads(e.data)
+            from swarmkit_tpu.raft.wire import decode_conf_change
+            cc: ConfChange = decode_conf_change(e.data)
             self.nodes[pid].apply_conf_change(cc)
             if cc.type == ConfChangeType.ADD_NODE and cc.node_id not in self.nodes:
                 # Instantiate the new member (empty log; will catch up).

@@ -205,3 +205,41 @@ async def test_template_expansion_through_agent():
     assert ex.controllers["t1"].task.spec.container.env == ["WHERE=realhost"]
     await agent.stop()
     await d.stop()
+
+
+@async_test
+async def test_swarmctl_metrics_shows_latency_percentiles():
+    """`swarmctl metrics` surfaces hot-path latency percentiles
+    (reference names from raft.go:69-71 / memory.go:81-110)."""
+    from swarmkit_tpu.cmd import swarmctl as ctl_cmd
+    from swarmkit_tpu.cmd import swarmd
+
+    tmp = tempfile.TemporaryDirectory(prefix="swarmd-metrics-")
+    sock = os.path.join(tmp.name, "swarmd.sock")
+    args = swarmd.build_parser().parse_args([
+        "--state-dir", os.path.join(tmp.name, "state"),
+        "--listen-control-api", sock,
+        "--node-id", "m1", "--manager",
+        "--election-tick", "4", "--backend", "inproc",
+    ])
+    node = await swarmd.run(args)
+    try:
+        for _ in range(200):
+            if node.is_leader():
+                break
+            await asyncio.sleep(0.05)
+        out = io.StringIO()
+        rc = await ctl_cmd.run(
+            ctl_cmd.build_parser().parse_args(
+                ["--socket", sock, "metrics"]), out=out)
+        assert rc == 0
+        data = json.loads(out.getvalue())
+        timers = data["timers"]
+        import swarmkit_tpu.utils.metrics as m
+        assert m.RAFT_PROPOSE_LATENCY in timers
+        assert "p99" in timers[m.RAFT_PROPOSE_LATENCY]
+        assert "swarm_manager_leader" in data["gauges"]
+        assert data["gauges"]["swarm_manager_leader"] == 1.0
+    finally:
+        await node.stop()
+        tmp.cleanup()

@@ -6,7 +6,6 @@ snapshots, leader transfer) plus etcd raft edge cases (prevote, checkquorum
 lease, stale-term nudge).
 """
 
-import pickle
 
 import pytest
 
