@@ -84,6 +84,9 @@ class Task(Message):
     endpoint: Optional[Endpoint] = None
     log_driver: Optional[Driver] = None
     service_annotations: Annotations = field(default_factory=Annotations)
+    # specific named-resource ids claimed by the scheduler for this task
+    # (reference: Task.AssignedGenericResources, api/genericresource)
+    assigned_generic: dict[str, list[str]] = field(default_factory=dict)
 
 
 @dataclass

@@ -148,6 +148,11 @@ class NodeResources(Message):
     nano_cpus: int = 0
     memory_bytes: int = 0
     generic: dict[str, int] = field(default_factory=dict)
+    # Named generic resources (reference: api/genericresource
+    # NamedGenericResource): a SET of claimable string ids per kind (e.g.
+    # tpu-chip -> ["0","1",...]); discrete `generic` counts and named sets
+    # may coexist under different kinds
+    generic_named: dict[str, list[str]] = field(default_factory=dict)
 
 
 @dataclass

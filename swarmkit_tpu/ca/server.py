@@ -36,6 +36,8 @@ _ROLE_OU = {NodeRole.MANAGER: MANAGER_ROLE_OU, NodeRole.WORKER: WORKER_ROLE_OU}
 class CAServer:
     def __init__(self, store: MemoryStore, root_ca: RootCA, org: str,
                  clock: Optional[Clock] = None) -> None:
+        # signing goes through _sign(): local root key when present, else
+        # the cluster's configured external CFSSL CAs (ca/external.go)
         self.store = store
         self.root_ca = root_ca
         self.org = org
@@ -69,6 +71,30 @@ class CAServer:
         raise InvalidJoinToken("join token not recognized")
 
     # ------------------------------------------------------------------
+    def _external_client(self):
+        from swarmkit_tpu.ca.external import ExternalCAClient
+
+        cluster = self._cluster()
+        cas = (cluster.spec.ca_config.external_cas
+               if cluster is not None and cluster.spec.ca_config else [])
+        client = ExternalCAClient(cas, self.root_ca)
+        return client if client.configured else None
+
+    async def _sign(self, node_id: str, role_ou: str, csr_pem: bytes
+                    ) -> IssuedCertificate:
+        """Local root key when available, else the cluster's external CA
+        (reference: server.go signNodeCert -> ca/external.go)."""
+        if self.root_ca.can_sign:
+            return self.root_ca.issue_node_certificate(
+                node_id, role_ou, self.org, csr_pem=csr_pem,
+                expiry=self._cert_expiry())
+        ext = self._external_client()
+        if ext is None:
+            raise CertificateError(
+                "root CA has no signing key and no external CA is "
+                "configured")
+        return await ext.sign(csr_pem, node_id, role_ou, self.org)
+
     async def issue_node_certificate(self, csr_pem: bytes, token: str,
                                      addr: str = "",
                                      requested_node_id: str = ""
@@ -81,9 +107,7 @@ class CAServer:
         if requested_node_id \
                 and self.store.get("node", requested_node_id) is None:
             node_id = requested_node_id
-        issued = self.root_ca.issue_node_certificate(
-            node_id, _ROLE_OU[role], self.org, csr_pem=csr_pem,
-            expiry=self._cert_expiry())
+        issued = await self._sign(node_id, _ROLE_OU[role], csr_pem)
         node = ApiNode(
             id=node_id,
             spec=NodeSpec(annotations=Annotations(name=node_id),
@@ -124,9 +148,7 @@ class CAServer:
         if node is None:
             raise CertificateError(f"node {node_id} not registered")
         role = NodeRole(node.spec.desired_role)
-        issued = self.root_ca.issue_node_certificate(
-            node_id, _ROLE_OU[role], self.org, csr_pem=csr_pem,
-            expiry=self._cert_expiry())
+        issued = await self._sign(node_id, _ROLE_OU[role], csr_pem)
 
         def txn(tx):
             cur = tx.get("node", node_id)
@@ -205,10 +227,10 @@ class CAServer:
                    and n.certificate.csr]
         for n in pending:
             try:
-                issued = self.root_ca.issue_node_certificate(
-                    n.id, _ROLE_OU[NodeRole(n.spec.desired_role)], self.org,
-                    csr_pem=n.certificate.csr, expiry=self._cert_expiry())
-            except CertificateError as e:
+                issued = await self._sign(
+                    n.id, _ROLE_OU[NodeRole(n.spec.desired_role)],
+                    n.certificate.csr)
+            except Exception as e:
                 log.warning("cannot sign CSR for %s: %s", n.id, e)
                 continue
 

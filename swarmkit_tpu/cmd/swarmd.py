@@ -47,6 +47,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--backend", choices=["grpc", "inproc"], default="grpc",
                    help="raft/cluster wire: real gRPC sockets (default) or "
                         "in-process (single-node/testing)")
+    p.add_argument("--executor", choices=["tpu", "test"], default="tpu",
+                   help="task runtime: compiled JAX programs on the local "
+                        "devices (tpu, default) or the instant fake (test)")
     return p
 
 
@@ -72,7 +75,13 @@ async def run(args, network=None, executor=None, registry=None) -> Node:
             security=lambda: node_box[0].security if node_box else None)
     network = network or Network()
     node_id = args.node_id or new_id()
-    executor = executor or TestExecutor(hostname=args.hostname or node_id)
+    if executor is None:
+        if getattr(args, "executor", "tpu") == "tpu":
+            from swarmkit_tpu.agent.tpu import TpuExecutor
+
+            executor = TpuExecutor(hostname=args.hostname or node_id)
+        else:
+            executor = TestExecutor(hostname=args.hostname or node_id)
     nodes = registry if registry is not None else {}
     remote_managers: dict[str, object] = {}
 

@@ -38,8 +38,9 @@ def tasks_equal_stable(a, b) -> bool:
 
 
 class AssignmentSet:
-    def __init__(self, node_id: str) -> None:
+    def __init__(self, node_id: str, drivers=None) -> None:
         self.node_id = node_id
+        self.drivers = drivers  # DriverProvider for external secrets
         self.tasks: dict[str, Task] = {}
         # (kind, id) -> set of task ids using it
         self.tasks_using_dependency: dict[tuple[str, str], set[str]] = {}
@@ -47,11 +48,33 @@ class AssignmentSet:
 
     # ------------------------------------------------------------------
     def _add_task_dependencies(self, read_tx, t) -> None:
+        from swarmkit_tpu.manager.drivers import resolve_secret
+
         for kind, dep_id in _task_dependencies(t):
             key = (kind, dep_id)
             users = self.tasks_using_dependency.setdefault(key, set())
             if not users:
-                obj = read_tx.get(kind, dep_id)
+                if kind == "secret":
+                    # External secrets resolve through their driver at
+                    # assignment time, once per node per secret with the
+                    # FIRST task's context — exactly the reference's dedup
+                    # (assignments.go addTaskDependencies:
+                    # len(tasksUsingDependency)==0 gate + assignSecret).
+                    # Any driver failure withholds the secret, never the
+                    # whole assignment stream.
+                    try:
+                        obj = resolve_secret(self.drivers, read_tx, t,
+                                             dep_id)
+                    except Exception as e:
+                        import logging
+
+                        logging.getLogger(
+                            "swarmkit_tpu.dispatcher").warning(
+                            "secret %s for task %s unavailable: %s",
+                            dep_id, t.id, e)
+                        obj = None
+                else:
+                    obj = read_tx.get(kind, dep_id)
                 if obj is not None:
                     self.changes[key] = AssignmentChange(
                         assignment=Assignment(**{kind: obj}),

@@ -70,8 +70,10 @@ class Dispatcher:
                  managers_fn: Optional[Callable[[], list[WeightedPeer]]] = None,
                  clock: Optional[Clock] = None,
                  peers_queue=None,
-                 rng: Optional[random.Random] = None) -> None:
+                 rng: Optional[random.Random] = None,
+                 drivers=None) -> None:
         self.store = store
+        self.drivers = drivers
         self.clock = clock or SystemClock()
         self.managers_fn = managers_fn or (lambda: [])
         # raft membership broadcast (membership.Cluster.broadcast /
@@ -387,7 +389,7 @@ class Dispatcher:
         """Reference: Assignments dispatcher.go:917."""
         self._check_running()
         rn = self.nodes.get_with_session(node_id, session_id)
-        aset = AssignmentSet(node_id)
+        aset = AssignmentSet(node_id, drivers=self.drivers)
 
         def init(read_tx):
             for t in read_tx.find("task", ByNode(node_id)):

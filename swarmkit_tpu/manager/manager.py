@@ -81,9 +81,11 @@ class Manager:
                                       on_remove_node=self._on_remove_node,
                                       metrics=self.metrics,
                                       metrics_registry=self.metrics_registry)
+        from swarmkit_tpu.manager.drivers import DriverProvider
+        self.drivers = DriverProvider()
         self.dispatcher = Dispatcher(
             self.store, managers_fn=self._weighted_peers, clock=self.clock,
-            peers_queue=self.raft.cluster.broadcast)
+            peers_queue=self.raft.cluster.broadcast, drivers=self.drivers)
         self.logbroker = LogBroker(self.store)
         self.watch_server = WatchServer(self.store, proposer=self.raft)
         self.health = HealthServer()

@@ -58,7 +58,12 @@ class ResourceFilter(Filter):
         if self._mem > info.available_memory:
             return False
         for k, v in self._generic.items():
-            if v > info.available_generic.get(k, 0):
+            # a named id set satisfies a count reservation when enough ids
+            # remain free (reference: filter.go:107-150 generic resources)
+            if k in info.available_named:
+                if v > len(info.available_named[k]):
+                    return False
+            elif v > info.available_generic.get(k, 0):
                 return False
         return True
 

@@ -31,11 +31,24 @@ class NodeInfo:
         self.available_cpus = 0
         self.available_memory = 0
         self.available_generic: dict[str, int] = {}
+        # named string-set resources: kind -> ids still free on this node
+        # (reference: api/genericresource string sets + nodeinfo claims)
+        self.available_named: dict[str, set[str]] = {}
+        self._advertised_named: dict[str, frozenset] = {}
         desc = node.description
         if desc is not None and desc.resources is not None:
             self.available_cpus = desc.resources.nano_cpus
             self.available_memory = desc.resources.memory_bytes
             self.available_generic = dict(desc.resources.generic)
+            self.available_named = {
+                k: set(v)
+                for k, v in desc.resources.generic_named.items()}
+            # releases are clamped to what the node CURRENTLY advertises —
+            # a re-register that drops dead chips must not let a finishing
+            # task resurrect them
+            self._advertised_named = {
+                k: frozenset(v)
+                for k, v in desc.resources.generic_named.items()}
         # service id -> timestamps of recent task failures on this node
         self.recent_failures: dict[str, list[float]] = {}
         for t in (tasks or {}).values():
@@ -59,7 +72,16 @@ class NodeInfo:
             self.available_cpus -= cpus
             self.available_memory -= mem
             for k, v in gen.items():
+                # named kinds deduct their claimed ids below; a task with a
+                # named-kind reservation but no recorded claim (scheduled
+                # before the kind became named) falls back to the discrete
+                # counter so the pool is not overcommitted
+                if k in self.available_named and task.assigned_generic.get(k):
+                    continue
                 self.available_generic[k] = self.available_generic.get(k, 0) - v
+            for k, ids in task.assigned_generic.items():
+                self.available_named.setdefault(k, set()).difference_update(
+                    ids)
             if task.service_id:
                 self.active_tasks_per_service[task.service_id] = \
                     self.active_tasks_per_service.get(task.service_id, 0) + 1
@@ -74,7 +96,13 @@ class NodeInfo:
             self.available_cpus += cpus
             self.available_memory += mem
             for k, v in gen.items():
+                if k in self.available_named and old.assigned_generic.get(k):
+                    continue
                 self.available_generic[k] = self.available_generic.get(k, 0) + v
+            for k, ids in old.assigned_generic.items():
+                allowed = self._advertised_named.get(k, frozenset())
+                self.available_named.setdefault(k, set()).update(
+                    set(ids) & allowed)
             if old.service_id:
                 n = self.active_tasks_per_service.get(old.service_id, 1) - 1
                 if n <= 0:
@@ -82,6 +110,22 @@ class NodeInfo:
                 else:
                     self.active_tasks_per_service[old.service_id] = n
         return True
+
+    def claim_named(self, requirements: dict) -> dict[str, list[str]]:
+        """Pick the specific named ids satisfying a reservation on this
+        node (reference: genericresource.Claim). Deterministic: sorted ids,
+        lowest first. Caller records them on the task so add_task deducts
+        exactly these."""
+        claimed: dict[str, list[str]] = {}
+        for k, v in requirements.items():
+            pool = self.available_named.get(k)
+            if pool is None:
+                continue  # discrete kind
+            ids = sorted(pool)[:v]
+            if len(ids) < v:
+                return {}
+            claimed[k] = ids
+        return claimed
 
     def active_task_count(self) -> int:
         return sum(1 for t in self.tasks.values()

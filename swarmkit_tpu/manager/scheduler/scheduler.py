@@ -17,7 +17,7 @@ from typing import Optional
 
 from swarmkit_tpu.api import TaskState
 from swarmkit_tpu.manager.scheduler.filters import Pipeline
-from swarmkit_tpu.manager.scheduler.nodeinfo import NodeInfo
+from swarmkit_tpu.manager.scheduler.nodeinfo import NodeInfo, task_reserved
 from swarmkit_tpu.manager.scheduler.nodeset import NodeSet
 from swarmkit_tpu.store.by import ByTaskState
 from swarmkit_tpu.store.memory import Event, EventCommit, MemoryStore, match, match_commit
@@ -251,6 +251,11 @@ class Scheduler:
             # mirror the assignment so the next pick sees updated load
             assigned = task.copy()
             assigned.node_id = info.id
+            # claim concrete named-resource ids now so parallel decisions
+            # in this pass cannot hand the same id to two tasks
+            _, _, gen = task_reserved(task)
+            if gen:
+                assigned.assigned_generic = info.claim_named(gen)
             info.add_task(assigned)
             out.append((task, info.id, assigned))
         return out
@@ -262,7 +267,7 @@ class Scheduler:
         batch = self.store.batch()
         applied: dict[str, bool] = {}
         for task, node_id, _assigned in decisions:
-            def txn(tx, task=task, node_id=node_id):
+            def txn(tx, task=task, node_id=node_id, _assigned=_assigned):
                 current = tx.get("task", task.id)
                 if current is None:
                     return False
@@ -274,6 +279,7 @@ class Scheduler:
                 current.status.message = "scheduler assigned task"
                 current.status.timestamp = self.clock.now()
                 current.node_id = node_id
+                current.assigned_generic = dict(_assigned.assigned_generic)
                 tx.update(current)
                 return True
 

@@ -404,14 +404,21 @@ class Node(Proposer):
             now = self.clock.now()
             if now - self._wedge_transfer_at > self._WEDGE_RETRY_S:
                 self._wedge_transfer_at = now
-                log.error("raft node %s: store wedged >%ss as leader; "
-                          "transferring leadership", self.node_id,
-                          self.store.WEDGE_TIMEOUT)
-                try:
-                    await self.transfer_leadership()
-                except Exception:
-                    log.exception(
-                        "wedge-triggered leadership transfer failed")
+                if len(self.cluster.members) <= 1:
+                    # nowhere to transfer to; surface the stall without a
+                    # traceback storm
+                    log.error("raft node %s: store wedged >%ss but this is "
+                              "the only manager — no transfer possible",
+                              self.node_id, self.store.WEDGE_TIMEOUT)
+                else:
+                    log.error("raft node %s: store wedged >%ss as leader; "
+                              "transferring leadership", self.node_id,
+                              self.store.WEDGE_TIMEOUT)
+                    try:
+                        await self.transfer_leadership()
+                    except Exception:
+                        log.exception(
+                            "wedge-triggered leadership transfer failed")
 
         # 1. persist hard state + entries (WAL fsync) BEFORE sending
         #    (reference: saveToStorage raft.go:1738, called at raft.go:585)

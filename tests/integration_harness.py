@@ -72,9 +72,10 @@ class TestCluster:
 
     # ------------------------------------------------------------------
     def _config(self, node_id: str, is_manager: bool, join_addr: str,
-                force_new_cluster: bool = False) -> NodeConfig:
+                force_new_cluster: bool = False,
+                executor=None) -> NodeConfig:
         self._n += 1
-        ex = TestExecutor(hostname=node_id)
+        ex = executor or TestExecutor(hostname=node_id)
         self.executors[node_id] = ex
         return NodeConfig(
             node_id=node_id,
@@ -91,19 +92,20 @@ class TestCluster:
             heartbeat_tick=1,
             seed=self.seed + self._n)
 
-    async def add_manager(self, node_id: str = "") -> Node:
+    async def add_manager(self, node_id: str = "", executor=None) -> Node:
         """reference: AddManager cluster.go."""
         node_id = node_id or f"manager-{self._n + 1}"
         lead = self.leader()
         join = lead.addr if lead is not None else ""
-        node = Node(self._config(node_id, is_manager=True, join_addr=join))
+        node = Node(self._config(node_id, is_manager=True, join_addr=join,
+                                 executor=executor))
         self.nodes[node_id] = node
         await node.start()
         await self.wait_leader()
         # the manager seeded its own node record; nothing else needed
         return node
 
-    async def add_agent(self, node_id: str = "") -> Node:
+    async def add_agent(self, node_id: str = "", executor=None) -> Node:
         """reference: AddAgent cluster.go — the CA join creates the node
         record; until the CA layer lands the harness seeds it."""
         node_id = node_id or f"agent-{self._n + 1}"
@@ -114,7 +116,7 @@ class TestCluster:
                           membership=MembershipState.ACCEPTED),
             status=NodeStatus())))
         node = Node(self._config(node_id, is_manager=False,
-                                 join_addr=lead.addr))
+                                 join_addr=lead.addr, executor=executor))
         self.nodes[node_id] = node
         await node.start()
         return node

@@ -58,6 +58,7 @@ async def test_swarmd_swarmctl_round_trip():
         "--listen-control-api", sock,
         "--node-id", "m1", "--manager",
         "--election-tick", "4", "--backend", "inproc",
+        "--executor", "test",
     ])
     # fast ticks for tests
     node = await swarmd.run(args)
@@ -221,6 +222,7 @@ async def test_swarmctl_metrics_shows_latency_percentiles():
         "--listen-control-api", sock,
         "--node-id", "m1", "--manager",
         "--election-tick", "4", "--backend", "inproc",
+        "--executor", "test",
     ])
     node = await swarmd.run(args)
     try:

@@ -155,6 +155,7 @@ async def test_worker_joins_manager_over_grpc_rpc_layer():
         "--listen-control-api", os.path.join(tmp.name, "m1.sock"),
         "--listen-remote-api", m_addr,
         "--node-id", "m1", "--manager", "--election-tick", "4",
+        "--executor", "test",
     ])
     manager_node = await swarmd.run(m_args)
     try:
@@ -173,6 +174,7 @@ async def test_worker_joins_manager_over_grpc_rpc_layer():
             "--listen-remote-api", w_addr,
             "--node-id", "w1",
             "--join-addr", m_addr, "--join-token", token,
+            "--executor", "test",
         ])
         worker_node = await swarmd.run(w_args)
         try:

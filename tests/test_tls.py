@@ -208,6 +208,7 @@ async def test_swarmd_tls_worker_join_by_token():
         "--listen-control-api", os.path.join(tmp.name, "m1.sock"),
         "--listen-remote-api", f"127.0.0.1:{p1}",
         "--node-id", "m1", "--manager", "--election-tick", "4",
+        "--executor", "test",
     ])
     m1 = w1 = None
     try:
@@ -226,6 +227,7 @@ async def test_swarmd_tls_worker_join_by_token():
             "--node-id", "w1",
             "--join-addr", f"127.0.0.1:{p1}",
             "--join-token", token, "--election-tick", "4",
+            "--executor", "test",
         ])
         w1 = await swarmd.run(args2)
         assert w1.security is not None, "worker must be issued a cert"
