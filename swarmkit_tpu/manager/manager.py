@@ -237,10 +237,16 @@ class Manager:
 
     async def _ensure_member_node_records(self) -> None:
         members = list(self.raft.cluster.members.values())
+        # records the role manager is deleting must stay deleted — the
+        # sweep otherwise resurrects them faster than the member removal
+        # converges
+        being_removed = (set(self.role_manager.pending_removal)
+                         if self.role_manager is not None else set())
 
         def txn(tx):
             for m in members:
-                if not m.node_id or tx.get("node", m.node_id) is not None:
+                if not m.node_id or m.node_id in being_removed \
+                        or tx.get("node", m.node_id) is not None:
                     continue
                 tx.create(ApiNode(
                     id=m.node_id,
@@ -253,16 +259,40 @@ class Manager:
         await self.store.update(txn)
 
     async def _watch_members(self, watcher) -> None:
+        # Event-driven with a periodic sweep: a membership event arriving
+        # during a transient leadership blip must not end reconciliation
+        # forever (the blip window is exactly when joins churn), and a
+        # failed ensure (proposal timeout on a flip) retries. The txn is
+        # create-only, so sweeps are free once records exist.
+        get_ev = timer = None
         try:
-            async for _ in watcher:
-                if not self._is_leader:
-                    return
-                await self._ensure_member_node_records()
+            while self._running:
+                get_ev = asyncio.ensure_future(watcher.get())
+                timer = asyncio.ensure_future(self.clock.sleep(2.0))
+                done, pending = await asyncio.wait(
+                    {get_ev, timer}, return_when=asyncio.FIRST_COMPLETED)
+                for p_ in pending:
+                    p_.cancel()
+                if get_ev in done and isinstance(
+                        get_ev.exception(), Exception):
+                    return  # watcher closed
+                if self._is_leader:
+                    try:
+                        await self._ensure_member_node_records()
+                    except Exception as e:
+                        log.debug("member-record reconcile failed; "
+                                  "retrying: %s", e)
         except asyncio.CancelledError:
             pass
         except Exception:
             log.exception("member watch crashed")
         finally:
+            # cancellation can land inside asyncio.wait, which does NOT
+            # cancel its waited futures — reap them or every leadership
+            # flip leaks a getter that trips on watcher.close()
+            for t in (get_ev, timer):
+                if t is not None and not t.done():
+                    t.cancel()
             watcher.close()
 
     async def _become_follower(self) -> None:

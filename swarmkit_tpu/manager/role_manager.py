@@ -33,6 +33,8 @@ class RoleManager:
         self.reconcile_interval = reconcile_interval
         self.pending: dict[str, object] = {}
         self.pending_removal: set[str] = set()
+        # node_id -> first time its member was seen without a node record
+        self._orphan_since: dict[str, float] = {}
         self._task: Optional[asyncio.Task] = None
         self._running = False
 
@@ -62,6 +64,7 @@ class RoleManager:
             self._task = None
 
     async def _run(self, watcher) -> None:
+        get_ev = timer = None
         try:
             await self._reconcile_all()
             while self._running:
@@ -76,7 +79,11 @@ class RoleManager:
                     ev = get_ev.result()
                     if isinstance(ev, Event):
                         if ev.action == "remove":
+                            # explicit record deletion: no join-in-progress
+                            # grace — the member goes as soon as quorum
+                            # rules allow
                             self.pending_removal.add(ev.object.id)
+                            self._orphan_since[ev.object.id] = float("-inf")
                         elif ev.object.spec.desired_role != ev.object.role:
                             self.pending[ev.object.id] = ev.object
                 await self._reconcile_all()
@@ -84,20 +91,67 @@ class RoleManager:
             raise
         except Exception:
             log.exception("role manager crashed")
+        finally:
+            # asyncio.wait does not cancel its waited futures; reap them
+            # and release the store subscription (one RoleManager per
+            # leadership term — leaks would accumulate per flip)
+            for t in (get_ev, timer):
+                if t is not None and not t.done():
+                    t.cancel()
+            watcher.close()
 
     async def _reconcile_all(self) -> None:
+        # Leader-only, re-checked on EVERY pass: after this manager hands
+        # leadership away (self-demotion transfer), a stale pass here must
+        # not keep injecting TRANSFER_LEADER requests — followers forward
+        # those to the new leader, deposing it and bouncing leadership in a
+        # loop that can starve the demotion from ever committing.
+        if not self._is_leader():
+            return
         for node_id in list(self.pending):
             node = self.store.get("node", node_id)
             if node is None:
                 self.pending.pop(node_id, None)
                 continue
-            await self._reconcile_role(node)
+            try:
+                await self._reconcile_role(node)
+            except Exception as e:
+                # one node's failed reconciliation (proposal timeout on a
+                # leadership flip, version conflict) must not kill the loop
+                log.info("reconcile of %s failed; retrying later: %s",
+                         node_id, e)
+            if not self._is_leader():
+                return
         for node_id in list(self.pending_removal):
             member = self._member_by_node_id(node_id)
             if member is None:
                 self.pending_removal.discard(node_id)
+                self._orphan_since.pop(node_id, None)
                 continue
-            await self._remove_member(member)
+            # A member without a node record is only an orphan once the
+            # record has been missing for a full reconcile interval: in
+            # certless clusters the leader CREATES member records AFTER the
+            # raft join, so a role manager freshly started by a leadership
+            # flip would otherwise kill a member that is mid-join (the
+            # reference never hits this because CA issuance creates the
+            # record before the manager ever joins raft).
+            if self.store.get("node", node_id) is not None:
+                self.pending_removal.discard(node_id)
+                self._orphan_since.pop(node_id, None)
+                continue
+            first = self._orphan_since.setdefault(node_id, self.clock.now())
+            if self.clock.now() - first < self.reconcile_interval:
+                continue
+            try:
+                await self._remove_member(member)
+            except Exception as e:
+                log.info("member removal of %s failed; retrying later: %s",
+                         node_id, e)
+            if not self._is_leader():
+                return
+
+    def _is_leader(self) -> bool:
+        return self.raft.is_leader()
 
     def _member_by_node_id(self, node_id: str):
         for m in self.raft.cluster.members.values():
@@ -113,6 +167,8 @@ class RoleManager:
                       member.node_id)
             return
         if member.raft_id == self.raft.raft_id:
+            if not self._is_leader():
+                return  # stale pass after the transfer already happened
             log.info("demoted; transferring leadership")
             try:
                 await self.raft.transfer_leadership()

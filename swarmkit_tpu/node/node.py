@@ -261,6 +261,7 @@ class Node:
 
     async def stop(self) -> None:
         self._running = False
+        self._cancel_role_watches()
         if getattr(self, "_renewer", None) is not None:
             await self._renewer.stop()
             self._renewer = None
@@ -288,21 +289,9 @@ class Node:
     # ------------------------------------------------------------------
     def _on_node_change(self, node) -> None:
         """Role flips observed via the session stream
-        (reference: the cert-renewal waitRole seam node/node.go:933)."""
-        want = node.role == NodeRole.MANAGER
-        if want != self._desired_manager:
-            self._desired_manager = want
-            # The certificate must match the new role BEFORE the manager
-            # can join (raft RPCs are manager-OU-gated): force renewal now
-            # rather than at half-life (reference: renewer.go
-            # SetExpectedRole).
-            if self.security is not None and self._renewer is not None:
-                from swarmkit_tpu.ca.certificates import MANAGER_ROLE_OU
-
-                have_mgr_cert = self.security.role_ou == MANAGER_ROLE_OU
-                if want != have_mgr_cert:
-                    self._renewer.renew_soon()
-            self._role_evt.set()
+        (reference: the cert-renewal waitRole seam node/node.go:933; the
+        renewal forcing mirrors renewer.go SetExpectedRole)."""
+        self._set_desired_role(manager=node.role == NodeRole.MANAGER)
 
     def _on_managers_change(self, managers) -> None:
         for wp in managers:
@@ -332,6 +321,7 @@ class Node:
                 elif not self._desired_manager and self.manager is not None:
                     log.info("node %s demoted; stopping manager",
                              self.node_id)
+                    self._cancel_role_watches()
                     m, self.manager = self.manager, None
                     await m.stop()
         except asyncio.CancelledError:
@@ -362,6 +352,50 @@ class Node:
             heartbeat_tick=self.config.heartbeat_tick,
             seed=self.config.seed, security=self.security)
         await self.manager.start()
+        # Demotion safety net: the dispatcher session is the primary
+        # role-change channel, but during a demotion the session churns
+        # with leadership at the exact moment the role flips, and by then
+        # this node's raft member is already removed — so its local store
+        # never sees the flip either. Member removal itself is therefore
+        # the authoritative demotion signal (reference: superviseManager
+        # treats ErrMemberRemoved as demotion, node/node.go:1080).
+        self._removal_watch = asyncio.get_running_loop().create_task(
+            self._watch_member_removal(self.manager))
+
+    async def _watch_member_removal(self, manager) -> None:
+        try:
+            while manager is self.manager and manager._running:
+                if manager.raft.removed:
+                    log.info("node %s: raft member removed; demoting",
+                             self.node_id)
+                    self._note_demoted()
+                    return
+                await self.clock.sleep(0.5)
+        except asyncio.CancelledError:
+            raise
+
+    def _note_demoted(self) -> None:
+        self._set_desired_role(manager=False)
+
+    def _set_desired_role(self, manager: bool) -> None:
+        """One place for the role-flip invariant (both the session path
+        and the member-removal path): update the desired role, force
+        certificate renewal when the cert's role no longer matches, and
+        wake the supervisor."""
+        if manager == self._desired_manager:
+            return
+        self._desired_manager = manager
+        if self.security is not None and self._renewer is not None:
+            have_mgr_cert = self.security.role_ou == MANAGER_ROLE_OU
+            if manager != have_mgr_cert:
+                self._renewer.renew_soon()
+        self._role_evt.set()
+
+    def _cancel_role_watches(self) -> None:
+        t = getattr(self, "_removal_watch", None)
+        if t is not None:
+            t.cancel()
+            self._removal_watch = None
 
     def _leader_addr(self) -> str:
         for addr in self.remotes.weights():

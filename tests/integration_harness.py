@@ -102,7 +102,14 @@ class TestCluster:
         self.nodes[node_id] = node
         await node.start()
         await self.wait_leader()
-        # the manager seeded its own node record; nothing else needed
+        # wait for the manager-role node record to exist — callers that
+        # immediately demote another manager must see the true manager
+        # count, or controlapi's last-manager safeguard misfires
+        await self.poll(
+            lambda: (l := self.leader()) is not None
+            and (rec := l.store.get("node", node_id)) is not None
+            and rec.role == NodeRole.MANAGER or None,
+            f"{node_id} manager record", timeout=20)
         return node
 
     async def add_agent(self, node_id: str = "", executor=None) -> Node:

@@ -75,6 +75,9 @@ async def test_role_manager_promote_and_demote():
             self.cluster.members = {1: FakeMember(1, "n1"),
                                     2: FakeMember(2, "n2")}
 
+        def is_leader(self):
+            return True
+
         def can_remove_member(self, raft_id):
             return True
 
