@@ -38,6 +38,30 @@ log = logging.getLogger("swarmkit_tpu.agent.tpu")
 
 SCHEME = "tpu://"
 
+_backend_checked = False
+
+
+def ensure_jax_backend() -> None:
+    """Fall back to the CPU backend when the configured platform cannot
+    initialize (e.g. JAX_PLATFORMS names a TPU plugin that is not on
+    PYTHONPATH in this process).  Without this every task the executor
+    touches fails at PREPARING even though a working CPU backend exists."""
+    global _backend_checked
+    if _backend_checked:
+        return
+    import jax
+
+    try:
+        jax.devices()
+    except Exception as e:
+        log.warning("jax platform init failed (%s); falling back to cpu", e)
+        try:
+            jax.config.update("jax_platforms", "cpu")
+            jax.devices()
+        except Exception:
+            log.exception("cpu fallback failed too; tasks will fail")
+    _backend_checked = True
+
 # name -> builder(params: dict[str, str]) -> (fn, example_args)
 PROGRAMS: dict[str, Callable] = {}
 
@@ -147,6 +171,7 @@ class TpuController(Controller):
         def build_and_compile():
             import jax
 
+            ensure_jax_backend()
             fn, args = builder(params)
             return jax.jit(fn).lower(*args).compile(), args
 
@@ -208,6 +233,7 @@ class TpuExecutor(Executor):
     def _devices(self):
         import jax
 
+        ensure_jax_backend()
         try:
             return jax.devices()
         except Exception:

@@ -131,6 +131,7 @@ class Manager:
     async def start(self) -> None:
         """reference: manager.Run manager.go:427."""
         self._running = True
+        self.raft.pre_join_hook = self._create_joiner_node_record
         leadership = self.raft.leadership.watch()
         await self.raft.start()
         await self.metrics.start()
@@ -235,6 +236,34 @@ class Manager:
         self._members_task = asyncio.get_running_loop().create_task(
             self._watch_members(members_watcher))
 
+    @staticmethod
+    def _manager_node_record(node_id: str) -> ApiNode:
+        """The node record the leader materializes for a raft member —
+        single source for both the pre-join hook and the sweep."""
+        return ApiNode(
+            id=node_id,
+            spec=NodeSpec(
+                annotations=Annotations(name=node_id),
+                desired_role=NodeRole.MANAGER,
+                membership=MembershipState.ACCEPTED),
+            role=NodeRole.MANAGER,
+            status=NodeStatus())
+
+    async def _create_joiner_node_record(self, node_id: str,
+                                         addr: str) -> None:
+        """pre_join_hook: commit the joiner's node record before its member
+        can exist, so the role manager never sees a record-less member to
+        reap (reference ordering: ca/server.go IssueNodeCertificate runs
+        before the manager joins raft)."""
+        if self.role_manager is not None \
+                and node_id in self.role_manager.pending_removal:
+            return  # a record the role manager is deleting must stay gone
+
+        def txn(tx):
+            if tx.get("node", node_id) is None:
+                tx.create(self._manager_node_record(node_id))
+        await self.store.update(txn)
+
     async def _ensure_member_node_records(self) -> None:
         members = list(self.raft.cluster.members.values())
         # records the role manager is deleting must stay deleted — the
@@ -248,14 +277,7 @@ class Manager:
                 if not m.node_id or m.node_id in being_removed \
                         or tx.get("node", m.node_id) is not None:
                     continue
-                tx.create(ApiNode(
-                    id=m.node_id,
-                    spec=NodeSpec(
-                        annotations=Annotations(name=m.node_id),
-                        desired_role=NodeRole.MANAGER,
-                        membership=MembershipState.ACCEPTED),
-                    role=NodeRole.MANAGER,
-                    status=NodeStatus()))
+                tx.create(self._manager_node_record(m.node_id))
         await self.store.update(txn)
 
     async def _watch_members(self, watcher) -> None:
@@ -264,18 +286,10 @@ class Manager:
         # forever (the blip window is exactly when joins churn), and a
         # failed ensure (proposal timeout on a flip) retries. The txn is
         # create-only, so sweeps are free once records exist.
-        get_ev = timer = None
         try:
-            while self._running:
-                get_ev = asyncio.ensure_future(watcher.get())
-                timer = asyncio.ensure_future(self.clock.sleep(2.0))
-                done, pending = await asyncio.wait(
-                    {get_ev, timer}, return_when=asyncio.FIRST_COMPLETED)
-                for p_ in pending:
-                    p_.cancel()
-                if get_ev in done and isinstance(
-                        get_ev.exception(), Exception):
-                    return  # watcher closed
+            async for _ev in watch_with_sweep(watcher, self.clock, 2.0):
+                if not self._running:
+                    return
                 if self._is_leader:
                     try:
                         await self._ensure_member_node_records()
@@ -286,14 +300,6 @@ class Manager:
             pass
         except Exception:
             log.exception("member watch crashed")
-        finally:
-            # cancellation can land inside asyncio.wait, which does NOT
-            # cancel its waited futures — reap them or every leadership
-            # flip leaks a getter that trips on watcher.close()
-            for t in (get_ev, timer):
-                if t is not None and not t.done():
-                    t.cancel()
-            watcher.close()
 
     async def _become_follower(self) -> None:
         """reference: becomeFollower manager.go:1088."""

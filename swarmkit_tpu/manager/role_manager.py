@@ -18,6 +18,7 @@ from typing import Optional
 from swarmkit_tpu.api import NodeRole
 from swarmkit_tpu.store.memory import Event, MemoryStore, match
 from swarmkit_tpu.utils.clock import Clock, SystemClock
+from swarmkit_tpu.watch.queue import watch_with_sweep
 
 log = logging.getLogger("swarmkit_tpu.rolemanager")
 
@@ -64,41 +65,26 @@ class RoleManager:
             self._task = None
 
     async def _run(self, watcher) -> None:
-        get_ev = timer = None
         try:
             await self._reconcile_all()
-            while self._running:
-                get_ev = asyncio.ensure_future(watcher.get())
-                timer = asyncio.ensure_future(
-                    self.clock.sleep(self.reconcile_interval))
-                done, pending = await asyncio.wait(
-                    {get_ev, timer}, return_when=asyncio.FIRST_COMPLETED)
-                for p in pending:
-                    p.cancel()
-                if get_ev in done:
-                    ev = get_ev.result()
-                    if isinstance(ev, Event):
-                        if ev.action == "remove":
-                            # explicit record deletion: no join-in-progress
-                            # grace — the member goes as soon as quorum
-                            # rules allow
-                            self.pending_removal.add(ev.object.id)
-                            self._orphan_since[ev.object.id] = float("-inf")
-                        elif ev.object.spec.desired_role != ev.object.role:
-                            self.pending[ev.object.id] = ev.object
+            async for ev in watch_with_sweep(watcher, self.clock,
+                                             self.reconcile_interval):
+                if not self._running:
+                    return
+                if isinstance(ev, Event):
+                    if ev.action == "remove":
+                        # explicit record deletion: no join-in-progress
+                        # grace — the member goes as soon as quorum
+                        # rules allow
+                        self.pending_removal.add(ev.object.id)
+                        self._orphan_since[ev.object.id] = float("-inf")
+                    elif ev.object.spec.desired_role != ev.object.role:
+                        self.pending[ev.object.id] = ev.object
                 await self._reconcile_all()
         except asyncio.CancelledError:
             raise
         except Exception:
             log.exception("role manager crashed")
-        finally:
-            # asyncio.wait does not cancel its waited futures; reap them
-            # and release the store subscription (one RoleManager per
-            # leadership term — leaks would accumulate per flip)
-            for t in (get_ev, timer):
-                if t is not None and not t.done():
-                    t.cancel()
-            watcher.close()
 
     async def _reconcile_all(self) -> None:
         # Leader-only, re-checked on EVERY pass: after this manager hands

@@ -20,6 +20,7 @@ import os
 import struct
 import subprocess
 import tempfile
+import threading
 import zlib
 from typing import Optional
 
@@ -133,18 +134,26 @@ def _build_native() -> Optional[NativeWalCodec]:
     cache_dir = os.path.join(tempfile.gettempdir(), "swarmkit_tpu_native")
     os.makedirs(cache_dir, exist_ok=True)
     so_path = os.path.join(cache_dir, "wal_codec.so")
-    try:
-        if not os.path.exists(so_path) \
-                or os.path.getmtime(so_path) < os.path.getmtime(src):
-            tmp_so = so_path + f".build-{os.getpid()}"
-            subprocess.run(
-                ["g++", "-O2", "-shared", "-fPIC", "-o", tmp_so, src],
-                check=True, capture_output=True, timeout=120)
-            os.replace(tmp_so, so_path)
-        return NativeWalCodec(ctypes.CDLL(so_path))
-    except Exception as e:
-        log.info("native wal codec unavailable (%s); using python", e)
-        return None
+    # Unique per builder: the prebuild thread and an import-time caller can
+    # both land here in one process, so a pid-keyed temp name would collide.
+    tmp_so = so_path + f".build-{os.getpid()}-{threading.get_ident()}"
+    for attempt in range(2):
+        try:
+            if not os.path.exists(so_path) \
+                    or os.path.getmtime(so_path) < os.path.getmtime(src):
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-o", tmp_so, src],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(tmp_so, so_path)
+            return NativeWalCodec(ctypes.CDLL(so_path))
+        except Exception as e:
+            # A concurrent builder may have replaced so_path mid-load; one
+            # retry picks up whichever build won.
+            if attempt == 0 and os.path.exists(so_path):
+                continue
+            log.info("native wal codec unavailable (%s); using python", e)
+            return None
+    return None
 
 
 def wal_codec():

@@ -148,6 +148,10 @@ class Node(Proposer):
                                  metrics_registry=self.metrics)
         self.transport: Optional[Transport] = None
         self.leadership = Queue()   # publishes LeadershipState
+        # awaited with (node_id, addr) before a NEW member's ADD_NODE is
+        # proposed; the manager points this at node-record creation
+        self.pre_join_hook = None
+        self._JOIN_TIMEOUT_S = 30.0
 
         self._raw: Optional[RawNode] = None
         self._wait = Wait()
@@ -235,17 +239,42 @@ class Node(Proposer):
         net = self.opts.network
         target = self.opts.join_addr
         resp: Optional[JoinResponse] = None
-        for _ in range(10):  # follow leader redirects
-            server = net.server(self.addr, target)
+        # Keep dialing through transient failures — the seed manager may
+        # still be electing itself or mid-restart (reference: joinCluster
+        # retries via the connection broker until the context deadline).
+        deadline = self.clock.now() + self._JOIN_TIMEOUT_S
+        backoff = 0.2
+        redirects = 0
+        last_err: Optional[Exception] = None
+        while resp is None and self.clock.now() < deadline:
             try:
+                server = net.server(self.addr, target)
                 resp = await server.join(self.node_id, self.addr)
-                break
             except NotLeaderError as e:
-                if not e.leader_addr:
-                    raise
-                target = e.leader_addr
+                last_err = e
+                # Follow a few redirects eagerly, then assume an election
+                # is bouncing leadership between peers and back off — an
+                # unthrottled redirect ping-pong would spin the event loop
+                # (and under a fake clock never advance the deadline).
+                if e.leader_addr and redirects < 5:
+                    redirects += 1
+                    target = e.leader_addr
+                    continue
+                redirects = 0
+                target = e.leader_addr or self.opts.join_addr
+                await self.clock.sleep(backoff)
+                backoff = min(backoff * 2, 2.0)
+            except Exception as e:
+                # includes dial errors from net.server() itself: the seed
+                # manager may be mid-restart with its listener unregistered
+                last_err = e
+                redirects = 0
+                target = self.opts.join_addr
+                await self.clock.sleep(backoff)
+                backoff = min(backoff * 2, 2.0)
         if resp is None:
-            raise RuntimeError("could not reach the raft leader to join")
+            raise RuntimeError(
+                f"could not reach the raft leader to join: {last_err}")
         self.raft_id = resp.raft_id
         self.storage.bootstrap_new()
         self._raw = self._make_raw(cfg_kwargs)
@@ -454,8 +483,16 @@ class Node(Proposer):
         if self._applied - self._snapshot_index >= self.opts.snapshot_interval:
             self._do_snapshot()
 
+        applied_conf_change = any(e.type == EntryType.CONF_CHANGE
+                                  for e in rd.committed_entries)
         self._raw.advance(rd)
-        # applying entries can make more work (e.g. campaign after boot cc)
+        # The bootstrap/join campaign must be re-attempted AFTER advance:
+        # during entry processing the conf change still sits in
+        # log.unapplied_entries(), and step(HUP) refuses to campaign over a
+        # pending conf change — a check done mid-apply silently no-ops and
+        # the node waits out a full election timeout instead.
+        if applied_conf_change:
+            self._maybe_campaign_bootstrap()
         if self._raw.has_ready():
             self._wake.set()
 
@@ -688,6 +725,13 @@ class Node(Proposer):
         if not self.opts.network.healthy(addr):
             raise RuntimeError(f"joiner at {addr} failed health check "
                                "(reference: raft.go:986)")
+        # Create the joiner's node record BEFORE the member exists (set by
+        # the manager; reference parity: ca/server.go IssueNodeCertificate
+        # creates the record before the manager ever joins raft).  Without
+        # this ordering the role manager can observe a member with no
+        # record and reap it as an orphan mid-join.
+        if self.pre_join_hook is not None:
+            await self.pre_join_hook(node_id, addr)
         raft_id = self._new_raft_id()
         await self._configure(ConfChange(
             type=ConfChangeType.ADD_NODE, node_id=raft_id,
@@ -833,13 +877,20 @@ class Node(Proposer):
         return self.leadership.watch()
 
     async def transfer_leadership(self, to: int = NONE) -> None:
-        """reference: TransferLeadership raft.go:1222."""
+        """reference: TransferLeadership raft.go:1222 — the target is the
+        most caught-up reachable member (transferee.Match maximal), so the
+        TIMEOUT_NOW shortcut fires and the transfer cannot stall behind a
+        lagging or partitioned follower."""
         if to == NONE:
             candidates = [rid for rid in self.cluster.members
                           if rid != self.raft_id]
             if not candidates:
                 raise ErrCannotRemoveMember("no transfer target")
-            to = self._rng.choice(candidates)
+            prs = self._raw.raft.prs if self._raw is not None else {}
+            to = max(candidates, key=lambda rid: (
+                (pr := prs.get(rid)) is not None and pr.recent_active,
+                pr.match if pr is not None else -1,
+                self._rng.random()))
         self._raw.transfer_leadership(to)
         self._wake.set()
 

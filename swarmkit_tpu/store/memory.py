@@ -12,6 +12,7 @@ Differences from the reference are deliberate TPU-era simplifications:
 
 from __future__ import annotations
 
+import asyncio
 import time
 from dataclasses import dataclass, field as dc_field
 from typing import Any, Callable, Iterable, Optional
@@ -373,6 +374,14 @@ class MemoryStore:
         self._local_version = 0
         self._in_flight: dict[int, float] = {}  # update id -> start time
         self._in_flight_seq = 0
+        # Serializes write transactions ACROSS the proposal round-trip
+        # (reference: memstore's updateLock is held through proposeValue —
+        # the very lock timedMutex/Wedged() watches).  Without it, a txn
+        # whose callback read state at version v can commit after a
+        # concurrent writer's v+1 and silently resurrect fields its stale
+        # full-object copy carried (observed: a dispatcher status write
+        # undoing a just-committed node demotion).
+        self._write_lock = asyncio.Lock()
         self.metrics = metrics_registry or metrics.REGISTRY
 
     def _timed(self, name: str):
@@ -453,28 +462,33 @@ class MemoryStore:
     # -- writes ----------------------------------------------------------
     async def update(self, cb: Callable[[Tx], Any]) -> Any:
         """Run a write transaction; replicate via the proposer (if any) and
-        apply + publish on commit (reference memory.go:319-377)."""
-        tx = Tx(self)
-        result = cb(tx)
-        if not tx.changelist:
-            return result
-        if len(tx.changelist) > MAX_CHANGES_PER_TRANSACTION:
-            raise ErrTxTooLarge(
-                f"{len(tx.changelist)} changes > {MAX_CHANGES_PER_TRANSACTION}")
-        actions = [StoreAction.make(_ACTION_KIND[ev.action], ev.object)
-                   for ev in tx.changelist]
-        size = sum(len(repr(a.target)) for a in actions)
-        if size > MAX_TRANSACTION_BYTES:
-            raise ErrTxTooLarge(f"transaction weighs ~{size} bytes")
+        apply + publish on commit (reference memory.go:319-377).  The write
+        lock is held from callback through commit so the callback's reads
+        stay valid until the txn lands."""
+        async with self._write_lock:
+            tx = Tx(self)
+            result = cb(tx)
+            if not tx.changelist:
+                return result
+            if len(tx.changelist) > MAX_CHANGES_PER_TRANSACTION:
+                raise ErrTxTooLarge(
+                    f"{len(tx.changelist)} changes > "
+                    f"{MAX_CHANGES_PER_TRANSACTION}")
+            actions = [StoreAction.make(_ACTION_KIND[ev.action], ev.object)
+                       for ev in tx.changelist]
+            size = sum(len(repr(a.target)) for a in actions)
+            if size > MAX_TRANSACTION_BYTES:
+                raise ErrTxTooLarge(f"transaction weighs ~{size} bytes")
 
-        with self._timed(metrics.STORE_WRITE_TX_LATENCY):
-            if self._proposer is not None:
-                await self.propose_in_flight(
-                    actions, lambda index: self._commit(tx.changelist, index))
-            else:
-                self._local_version += 1
-                self._commit(tx.changelist, self._local_version)
-        return result
+            with self._timed(metrics.STORE_WRITE_TX_LATENCY):
+                if self._proposer is not None:
+                    await self.propose_in_flight(
+                        actions,
+                        lambda index: self._commit(tx.changelist, index))
+                else:
+                    self._local_version += 1
+                    self._commit(tx.changelist, self._local_version)
+            return result
 
     def wedged(self) -> bool:
         """True when any write has been stuck in flight longer than
@@ -569,25 +583,60 @@ class Batch:
         self._store = store
         self._pending: list[Event] = []
         self.applied = 0
+        self._holds_lock = False
+
+    async def _acquire_segment(self) -> None:
+        # The write lock is held from a segment's FIRST callback until that
+        # segment flushes (reference: Batch keeps the store's updateLock
+        # across each MaxChangesPerTransaction sub-batch), so no foreign
+        # commit can invalidate what the callbacks read.
+        if not self._holds_lock:
+            await self._store._write_lock.acquire()
+            self._holds_lock = True
+
+    def _release_segment(self) -> None:
+        if self._holds_lock:
+            self._holds_lock = False
+            self._store._write_lock.release()
 
     async def update(self, cb: Callable[[Tx], Any]) -> Any:
-        tx = Tx(self._store)
-        # seed overlay with pending (so batched txs see each other's writes)
-        for ev in self._pending:
-            key = (ev.kind, ev.object.id)
-            tx._overlay[key] = _REMOVED if ev.action == "remove" else ev.object
-        base = len(tx.changelist)
-        result = cb(tx)
-        self._pending.extend(tx.changelist[base:])
+        await self._acquire_segment()
+        try:
+            tx = Tx(self._store)
+            # seed overlay with pending (batched txs see each other's writes)
+            for ev in self._pending:
+                key = (ev.kind, ev.object.id)
+                tx._overlay[key] = (_REMOVED if ev.action == "remove"
+                                    else ev.object)
+            base = len(tx.changelist)
+            result = cb(tx)
+            self._pending.extend(tx.changelist[base:])
+        except BaseException:
+            # Callers catch per-callback errors and continue the batch
+            # (dispatcher, scheduler), so keep the lock while earlier
+            # callbacks' changes are still queued under it; with nothing
+            # queued, holding it would just stall other writers.
+            if not self._pending:
+                self._release_segment()
+            raise
         if len(self._pending) >= MAX_CHANGES_PER_TRANSACTION:
             await self._flush()
         return result
 
     async def _flush(self) -> None:
+        try:
+            if self._pending:
+                await self._acquire_segment()  # no-op when already held
+                with self._store._timed(metrics.STORE_BATCH_LATENCY):
+                    await self._flush_timed()
+        except BaseException:
+            self._release_segment()
+            raise
+        # Keep the lock while changes built under it are still queued
+        # (one callback can add >1 chunk); release only once drained, or
+        # foreign commits could interleave with the stale remainder.
         if not self._pending:
-            return
-        with self._store._timed(metrics.STORE_BATCH_LATENCY):
-            await self._flush_timed()
+            self._release_segment()
 
     async def _flush_timed(self) -> None:
         chunk, self._pending = (
@@ -605,6 +654,9 @@ class Batch:
         self.applied += len(chunk)
 
     async def commit(self) -> int:
-        while self._pending:
-            await self._flush()
+        try:
+            while self._pending:
+                await self._flush()
+        finally:
+            self._release_segment()
         return self.applied

@@ -124,3 +124,33 @@ class Queue:
 
     def __len__(self) -> int:
         return len(self._watchers)
+
+
+async def watch_with_sweep(watcher: Watcher, clock, interval: float):
+    """Yield events from ``watcher`` plus ``None`` sweep ticks every
+    ``interval`` — the shape of every event-driven-with-periodic-reconcile
+    control loop (role manager, member-record reconciler).  Terminates
+    cleanly when the watcher closes; cancels its internal futures on exit
+    (asyncio.wait does NOT cancel the futures it waited on), and closes the
+    watcher so callers can't leak the subscription."""
+    get_ev = timer = None
+    try:
+        while True:
+            get_ev = asyncio.ensure_future(watcher.get())
+            timer = asyncio.ensure_future(clock.sleep(interval))
+            done, pending = await asyncio.wait(
+                {get_ev, timer}, return_when=asyncio.FIRST_COMPLETED)
+            for p in pending:
+                p.cancel()
+            if get_ev in done:
+                try:
+                    yield get_ev.result()
+                except WatcherClosed:
+                    return
+            else:
+                yield None
+    finally:
+        for t in (get_ev, timer):
+            if t is not None and not t.done():
+                t.cancel()
+        watcher.close()

@@ -140,13 +140,23 @@ class TestCluster:
                 pass
 
     async def set_node_role(self, node_id: str, role: NodeRole) -> None:
-        """reference: SetNodeRole cluster.go — via control api."""
-        lead = await self.wait_leader()
-        cur = lead.control_api.get_node(node_id)
-        spec = cur.spec.copy()
-        spec.desired_role = role
-        await lead.control_api.update_node(node_id, spec,
-                                           version=cur.meta.version.index)
+        """reference: SetNodeRole cluster.go — via control api.  Retries
+        out-of-sequence failures like any real control client: concurrent
+        status writes bump the node version between read and update."""
+        deadline = asyncio.get_running_loop().time() + 20
+        while True:
+            lead = await self.wait_leader()
+            cur = lead.control_api.get_node(node_id)
+            spec = cur.spec.copy()
+            spec.desired_role = role
+            try:
+                await lead.control_api.update_node(
+                    node_id, spec, version=cur.meta.version.index)
+                return
+            except Exception:
+                if asyncio.get_running_loop().time() > deadline:
+                    raise
+                await asyncio.sleep(0.02)
 
     async def stop_node(self, node_id: str) -> Node:
         """Stop without removing state (reference: testNode.Pause)."""

@@ -165,6 +165,10 @@ async def test_worker_joins_manager_over_grpc_rpc_layer():
             await asyncio.sleep(0.05)
         assert manager_node.is_leader()
         lead = manager_node._running_manager()
+        for _ in range(200):   # leader startup creates the cluster object
+            if lead.store.find("cluster"):
+                break
+            await asyncio.sleep(0.05)
         token = lead.store.find("cluster")[0].root_ca.join_token_worker
 
         w_addr = f"127.0.0.1:{free_port()}"

@@ -215,7 +215,10 @@ async def test_swarmd_tls_worker_join_by_token():
         m1 = await swarmd.run(args1)
         assert await wait_until(m1.is_leader, timeout=15)
         assert m1.security is not None, "manager must have a TLS identity"
-
+        # raft leadership precedes the manager's leader startup (which
+        # creates the cluster object) — wait for the record, not the flag
+        assert await wait_until(
+            lambda: m1.manager.store.find("cluster"), timeout=15)
         cluster = m1.manager.store.find("cluster")[0]
         token = cluster.root_ca.join_token_worker
         assert token.startswith("SWMTKN-1-")
