@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import threading
 from typing import Callable, Optional
 
 from swarmkit_tpu.agent.exec import (
@@ -39,28 +40,33 @@ log = logging.getLogger("swarmkit_tpu.agent.tpu")
 SCHEME = "tpu://"
 
 _backend_checked = False
+_backend_lock = threading.Lock()
 
 
 def ensure_jax_backend() -> None:
     """Fall back to the CPU backend when the configured platform cannot
     initialize (e.g. JAX_PLATFORMS names a TPU plugin that is not on
     PYTHONPATH in this process).  Without this every task the executor
-    touches fails at PREPARING even though a working CPU backend exists."""
+    touches fails at PREPARING even though a working CPU backend exists.
+    Serialized: callers run on executor threads, and concurrent first-time
+    backend init + config mutation is not thread-safe in jax."""
     global _backend_checked
-    if _backend_checked:
-        return
-    import jax
+    with _backend_lock:
+        if _backend_checked:
+            return
+        import jax
 
-    try:
-        jax.devices()
-    except Exception as e:
-        log.warning("jax platform init failed (%s); falling back to cpu", e)
         try:
-            jax.config.update("jax_platforms", "cpu")
             jax.devices()
-        except Exception:
-            log.exception("cpu fallback failed too; tasks will fail")
-    _backend_checked = True
+        except Exception as e:
+            log.warning("jax platform init failed (%s); falling back to cpu",
+                        e)
+            try:
+                jax.config.update("jax_platforms", "cpu")
+                jax.devices()
+            except Exception:
+                log.exception("cpu fallback failed too; tasks will fail")
+        _backend_checked = True
 
 # name -> builder(params: dict[str, str]) -> (fn, example_args)
 PROGRAMS: dict[str, Callable] = {}

@@ -176,7 +176,13 @@ async def run(args, out=None) -> int:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    return asyncio.run(run(args))
+    try:
+        return asyncio.run(run(args))
+    except BrokenPipeError:
+        # stdout consumer (e.g. `| head`) went away — normal CLI etiquette
+        import os
+        os.dup2(os.open(os.devnull, os.O_WRONLY), 1)
+        return 0
 
 
 if __name__ == "__main__":

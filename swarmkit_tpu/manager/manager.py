@@ -44,6 +44,7 @@ from swarmkit_tpu.ca import CAServer, RootCA, generate_join_token as ca_token
 from swarmkit_tpu.raft.node import LeadershipState, Node as RaftNode, NodeOpts
 from swarmkit_tpu.store.memory import MemoryStore
 from swarmkit_tpu.utils.clock import Clock, SystemClock
+from swarmkit_tpu.watch.queue import watch_with_sweep
 
 log = logging.getLogger("swarmkit_tpu.manager")
 

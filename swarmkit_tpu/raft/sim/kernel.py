@@ -22,10 +22,24 @@ cross-row gathers and no sorts on its hot path. State-machine checksums are
 order-independent sums of per-entry hashes, computed on the fly from
 (index, payload); there is no checksum ring.
 
-The network model is tick-synchronous: requests and their responses complete
-within one tick unless masked out. Control flow divergence (leader vs
-candidate vs follower) is handled with `jnp.where` over role masks — there is
-no data-dependent Python control flow, so the whole step jits once and scans.
+Two network models share one delivery-processing path:
+- tick-synchronous (cfg.latency == 0): requests and their responses
+  complete within one tick unless masked out — the bench fast path, with
+  no mailbox state allocated.
+- device-mailbox wire (cfg.latency/latency_jitter > 0; SURVEY §7's [N, N]
+  in-flight slots): every message spends latency + hash-jitter ticks in a
+  per-edge, per-class slot (one in flight per directed edge — an inflight
+  window of 1), so delivery is delayed and jitter REORDERS messages across
+  edges.  Headers (term, prev) are captured at send; bodies are read from
+  the sender's current ring at delivery, guarded by "sender role/term
+  unchanged since send" (stale messages drop — always raft-safe, and the
+  prefix (idx, term) content is immutable within a leader term).  At
+  latency 0 the slots pass messages through same-tick, reproducing the
+  synchronous semantics exactly (asserted by the differential gate's
+  force_mailboxes cases).
+Control flow divergence (leader vs candidate vs follower) is handled with
+`jnp.where` over role masks — there is no data-dependent Python control
+flow, so the whole step jits once and scans.
 
 Implemented etcd behaviors beyond the basic protocol: vote rejections with
 candidate step-down on a rejection quorum (vendor raft.go:988-1060),
@@ -50,7 +64,7 @@ import jax.numpy as jnp
 
 from swarmkit_tpu.raft.sim.state import (
     CANDIDATE, FOLLOWER, LEADER, NONE, SimConfig, SimState, hash32,
-    rand_timeout,
+    latency_matrix, rand_timeout,
 )
 
 I32 = jnp.int32
@@ -147,13 +161,37 @@ def step(state: SimState, cfg: SimConfig,
 
     # ---- Phase B: vote exchange ------------------------------------------
     is_cand = (role == CANDIDATE) & up
-    req = is_cand[:, None] & up[None, :] & ~eye & ~drop          # [i, j]
     # CheckQuorum leader lease (vendor raft.go Step, checkQuorum branch): a
     # receiver that heard from a live leader within the last election_tick
     # ignores vote requests entirely — no term catch-up, no response —
     # so a rejoining partitioned node cannot depose a healthy leader.
     leased = (lead != NONE) & (elapsed < cfg.election_tick)      # [j]
-    req = req & ~leased[None, :]
+    if cfg.mailboxes:
+        # Device-mailbox wire (SURVEY §7): one in-flight message per class
+        # per directed edge; *_at stores deliver-tick+1 (0 = empty).  The
+        # drop matrix acts at SEND (a dropped message never enters the
+        # wire); receiver-side guards act at DELIVERY.
+        now = state.tick
+        lat = latency_matrix(cfg, now)
+        vreq_at, vreq_term = state.vreq_at, state.vreq_term
+        vresp_at, vresp_term = state.vresp_at, state.vresp_term
+        vresp_grant = state.vresp_grant
+        # sends: candidates (re-)request on any edge with no same-term
+        # request still in flight (etcd does not retry within a term —
+        # the re-send on a cleared slot mirrors duplicate-tolerant voters)
+        free = (vreq_at == 0) | (vreq_term != term[:, None])
+        send_vr = is_cand[:, None] & ~eye & ~drop & free
+        vreq_at = jnp.where(send_vr, now + 1 + lat, vreq_at)
+        vreq_term = jnp.where(send_vr, term[:, None], vreq_term)
+        # deliveries: stale requests (sender no longer a candidate at the
+        # captured term) vanish — candidate log state (last/last_term) is
+        # then safely readable at delivery, since candidates never append
+        due_vr = (vreq_at > 0) & (now + 1 >= vreq_at)
+        req = due_vr & (role[:, None] == CANDIDATE) \
+            & (term[:, None] == vreq_term) & up[None, :] & ~leased[None, :]
+        vreq_at = jnp.where(due_vr, 0, vreq_at)
+    else:
+        req = is_cand[:, None] & up[None, :] & ~eye & ~drop & ~leased[None, :]
     # Receiver-side term catch-up (Step m.Term > r.Term with MsgVote).
     req_term = jnp.where(req, term[:, None], -1)
     mt = jnp.max(req_term, axis=0)                               # [j]
@@ -181,10 +219,24 @@ def step(state: SimState, cfg: SimConfig,
     # Responses travel j -> i; may be dropped independently. Requests that
     # were processed at the receiver's term but not granted come back as
     # rejections (vendor raft.go:988-1060 stepCandidate poll).
-    resp_arrive = grant_mat & ~drop.T
-    granted = granted | (resp_arrive & is_cand[:, None])
-    reject_arrive = cur & ~grant_mat & ~drop.T
-    rejected = rejected | (reject_arrive & is_cand[:, None])
+    if cfg.mailboxes:
+        # enqueue responses on the reverse edge; a response already in
+        # flight on that edge is superseded (it addressed an older term and
+        # would be guard-dropped at delivery anyway)
+        send_vresp = cur & ~drop.T
+        vresp_at = jnp.where(send_vresp, now + 1 + lat.T, vresp_at)
+        vresp_term = jnp.where(send_vresp, term[None, :], vresp_term)
+        vresp_grant = jnp.where(send_vresp, grant_mat, vresp_grant)
+        due_vs = (vresp_at > 0) & (now + 1 >= vresp_at)
+        rvalid = due_vs & is_cand[:, None] & (term[:, None] == vresp_term)
+        granted = granted | (rvalid & vresp_grant)
+        rejected = rejected | (rvalid & ~vresp_grant)
+        vresp_at = jnp.where(due_vs, 0, vresp_at)
+    else:
+        resp_arrive = grant_mat & ~drop.T
+        granted = granted | (resp_arrive & is_cand[:, None])
+        reject_arrive = cur & ~grant_mat & ~drop.T
+        rejected = rejected | (reject_arrive & is_cand[:, None])
 
     votes = jnp.sum((granted & active[None, :]).astype(I32), axis=1)
     win = is_cand & (votes >= quorum)
@@ -216,15 +268,50 @@ def step(state: SimState, cfg: SimConfig,
     match = jnp.where(win[:, None] & eye, last[:, None], match)
 
     # ---- Phase C: append / heartbeat fan-out -----------------------------
-    prev = next_ - 1                                             # [i, j]
-    can_ring = prev >= snap_idx[:, None]
-    send_base = is_leader[:, None] & up[None, :] & active[None, :] & ~eye & ~drop
-    send_app = send_base & can_ring
-    send_snap = send_base & ~can_ring
+    if cfg.mailboxes:
+        app_at, app_prev = state.app_at, state.app_prev
+        app_term_box = state.app_term
+        snp_at, snp_term_box = state.snp_at, state.snp_term
+        # sends: ONE append or snapshot in flight per edge (inflight
+        # window of 1) — the next message leaves only after the previous
+        # one delivered (or went stale with the term)
+        free_edge = ((app_at == 0) | (app_term_box != term[:, None])) \
+            & ((snp_at == 0) | (snp_term_box != term[:, None]))
+        can_ring_send = (next_ - 1) >= snap_idx[:, None]
+        send_base = is_leader[:, None] & active[None, :] & ~eye & ~drop \
+            & free_edge
+        s_app = send_base & can_ring_send
+        s_snp = send_base & ~can_ring_send
+        app_at = jnp.where(s_app, now + 1 + lat, app_at)
+        app_prev = jnp.where(s_app, next_ - 1, app_prev)
+        app_term_box = jnp.where(s_app, term[:, None], app_term_box)
+        snp_at = jnp.where(s_snp, now + 1 + lat, snp_at)
+        snp_term_box = jnp.where(s_snp, term[:, None], snp_term_box)
+        # deliveries: sender must still be the same-term leader, so ring
+        # reads at delivery see an immutable prefix; an append whose
+        # captured prev was compacted since send is undeliverable and
+        # drops (the freed slot lets a snapshot go out next tick)
+        due_a = (app_at > 0) & (now + 1 >= app_at)
+        due_s = (snp_at > 0) & (now + 1 >= snp_at)
+        lead_ok = role[:, None] == LEADER
+        send_app = due_a & lead_ok & (term[:, None] == app_term_box) \
+            & up[None, :] & (app_prev >= snap_idx[:, None])
+        send_snap = due_s & lead_ok & (term[:, None] == snp_term_box) \
+            & up[None, :]
+        prev_mat = app_prev
+        app_at = jnp.where(due_a, 0, app_at)
+        snp_at = jnp.where(due_s, 0, snp_at)
+    else:
+        prev_mat = next_ - 1                                     # [i, j]
+        can_ring = prev_mat >= snap_idx[:, None]
+        send_base = is_leader[:, None] & up[None, :] & active[None, :] \
+            & ~eye & ~drop
+        send_app = send_base & can_ring
+        send_snap = send_base & ~can_ring
 
     # Receiver-side term catch-up from append/snapshot senders.
-    app_term = jnp.where(send_app | send_snap, term[:, None], -1)
-    mt2 = jnp.max(app_term, axis=0)
+    msg_term = jnp.where(send_app | send_snap, term[:, None], -1)
+    mt2 = jnp.max(msg_term, axis=0)
     newer2 = mt2 > term
     term = jnp.where(newer2, mt2, term)
     role = jnp.where(newer2, FOLLOWER, role)
@@ -233,7 +320,7 @@ def step(state: SimState, cfg: SimConfig,
 
     # Receiver picks its (unique) current-term leader, judged by the
     # SEND-TIME sender term (a leader deposed this tick sent at its old term).
-    eligible = (send_app | send_snap) & (app_term == term[None, :])
+    eligible = (send_app | send_snap) & (msg_term == term[None, :])
     has_lmsg = jnp.any(eligible, axis=0)
     src = jnp.argmax(eligible, axis=0).astype(I32)               # [j]
     role = jnp.where(has_lmsg & (role == CANDIDATE), FOLLOWER, role)
@@ -256,7 +343,7 @@ def step(state: SimState, cfg: SimConfig,
     last_src, snap_src = last[src], snap_idx[src]
     lead_idx = _idx_at_slots(cfg, last_src)                      # [N, L]
 
-    p = prev[src, node]                                          # [j]
+    p = prev_mat[src, node]                                      # [j]
     p_slot = _slot(cfg, p)
     p_ring_term = jnp.take_along_axis(lead_term_row, p_slot[:, None],
                                       axis=1)[:, 0]
@@ -327,17 +414,39 @@ def step(state: SimState, cfg: SimConfig,
     reject_hint = last                                           # [j]
 
     is_resp_tgt = node[:, None] == src[None, :]                  # [i, j]
-    arrive_back = ~drop.T & is_resp_tgt & is_leader[:, None] & has_lmsg[None, :]
-    ok_mat = arrive_back & resp_ok[None, :]
-    rej_mat = arrive_back & resp_reject[None, :]
+    if cfg.mailboxes:
+        aresp_at, aresp_term = state.aresp_at, state.aresp_term
+        aresp_match, aresp_ok = state.aresp_match, state.aresp_ok
+        send_ar = is_resp_tgt & has_lmsg[None, :] & ~drop.T
+        aresp_at = jnp.where(send_ar, now + 1 + lat.T, aresp_at)
+        aresp_term = jnp.where(send_ar, term[None, :], aresp_term)
+        aresp_ok = jnp.where(send_ar, resp_ok[None, :], aresp_ok)
+        aresp_match = jnp.where(
+            send_ar,
+            jnp.where(resp_reject[None, :], reject_hint[None, :],
+                      resp_match[None, :]),
+            aresp_match)
+        due_ar = (aresp_at > 0) & (now + 1 >= aresp_at)
+        arvalid = due_ar & is_leader[:, None] & (term[:, None] == aresp_term)
+        ok_mat = arvalid & aresp_ok
+        rej_mat = arvalid & ~aresp_ok
+        aresp_at = jnp.where(due_ar, 0, aresp_at)
+        resp_match_del = reject_hint_del = aresp_match
+    else:
+        arrive_back = ~drop.T & is_resp_tgt & is_leader[:, None] \
+            & has_lmsg[None, :]
+        ok_mat = arrive_back & resp_ok[None, :]
+        rej_mat = arrive_back & resp_reject[None, :]
+        resp_match_del = resp_match[None, :]
+        reject_hint_del = reject_hint[None, :]
     # any response marks the peer recently-active for CheckQuorum
     recent_active = recent_active | ok_mat | rej_mat
-    match = jnp.where(ok_mat, jnp.maximum(match, resp_match[None, :]), match)
-    next_ = jnp.where(ok_mat, jnp.maximum(next_, resp_match[None, :] + 1), next_)
+    match = jnp.where(ok_mat, jnp.maximum(match, resp_match_del), match)
+    next_ = jnp.where(ok_mat, jnp.maximum(next_, resp_match_del + 1), next_)
     # Probe decrement (maybeDecrTo, coarse): jump next back to the hint.
     next_ = jnp.where(
         rej_mat,
-        jnp.maximum(1, jnp.minimum(next_ - 1, reject_hint[None, :] + 1)),
+        jnp.maximum(1, jnp.minimum(next_ - 1, reject_hint_del + 1)),
         next_)
 
     # ---- Phase D: leader commit (quorum threshold on the match row) ------
@@ -392,6 +501,16 @@ def step(state: SimState, cfg: SimConfig,
     snap_chk = jnp.where(do_compact, nsc, snap_chk)
     snap_idx = jnp.where(do_compact, new_snap, snap_idx)
 
+    boxes = {}
+    if cfg.mailboxes:
+        boxes = dict(
+            vreq_at=vreq_at, vreq_term=vreq_term,
+            vresp_at=vresp_at, vresp_term=vresp_term,
+            vresp_grant=vresp_grant,
+            app_at=app_at, app_prev=app_prev, app_term=app_term_box,
+            snp_at=snp_at, snp_term=snp_term_box,
+            aresp_at=aresp_at, aresp_term=aresp_term,
+            aresp_match=aresp_match, aresp_ok=aresp_ok)
     return dataclasses.replace(
         state,
         term=term, vote=vote, role=role, lead=lead,
@@ -403,6 +522,7 @@ def step(state: SimState, cfg: SimConfig,
         match=match, next_=next_, granted=granted,
         rejected=rejected, recent_active=recent_active,
         tick=state.tick + 1,
+        **boxes,
     )
 
 

@@ -20,20 +20,22 @@ on vote requests + periodic step-down of partitioned leaders) are now
 IMPLEMENTED by the kernel and replayed faithfully here — they are no longer
 divergences.
 
- D1 appends-as-heartbeats, one synchronous round per tick: the kernel has
-    no heartbeat messages (every leader appends to every peer every tick,
-    possibly empty) and does exactly one append round per tick — etcd
-    re-sends immediately on commit advance / rejection. Mask: the scheduler
-    calls _bcast_append each tick, never fires BEAT, and suppresses sends
-    while responses are being stepped (the next tick's bcast supersedes
-    them).
+ D1 appends-as-heartbeats: the kernel has no heartbeat messages (an idle
+    leader keeps sending possibly-empty appends), and the send cadence is
+    one round per tick on the synchronous wire / one message in flight per
+    edge on the mailbox wire — etcd re-sends immediately on commit
+    advance / rejection. Mask: the scheduler calls _bcast_append each tick
+    (sync) or mirrors the slot-gated sends (_tick_mailbox), never fires
+    BEAT, and suppresses sends while responses are being stepped.
  D2 no PreVote / leader transfer: kernel.py module docstring. Mask: oracle
     Config(pre_vote=False); transfer untested here (covered by host-level
     tests).
- D3 no flow control: the kernel re-sends the window from next_ every tick
-    and advances next_ only on acks — no probe pausing, no optimistic
-    updates, no inflight windows. Mask: SyncRaft._send_append is a
-    side-effect-free windowed send.
+ D3 flow control is inflight-1, not windowed: on the synchronous wire the
+    kernel re-sends the window from next_ every tick; on the mailbox wire
+    exactly one append rides each edge at a time — etcd pipelines up to
+    max_inflight_msgs with probe pausing and optimistic next updates.
+    Mask: SyncRaft._send_append is a side-effect-free windowed send, and
+    _tick_mailbox captures prev at send exactly like the kernel.
  D4 timer scope: kernel election timers reset on (a) own campaign,
     (b) granting a vote, (c) receiving a current-term leader message,
     (d) a leader's CheckQuorum round, and re-randomize only at campaign
@@ -179,6 +181,27 @@ class OracleCluster:
         # (term, data); chk_at[idx] = cumulative checksum through idx.
         self.canon: dict[int, tuple[int, int]] = {}
         self.chk_at: dict[int, int] = {0: 0}
+        # Mailbox wire replay (kernel [N, N] in-flight slots; see
+        # kernel.py "Device-mailbox wire").  Keyed (sender, receiver) for
+        # request classes and (leader, responder) for response classes;
+        # values carry (deliver_tick, captured header...).
+        self.now = 0
+        self.vreq: dict[tuple[int, int], tuple[int, int]] = {}
+        self.vresp: dict[tuple[int, int], tuple[int, int, bool]] = {}
+        self.appq: dict[tuple[int, int], tuple[int, int, int]] = {}
+        self.snpq: dict[tuple[int, int], tuple[int, int]] = {}
+        self.arespq: dict[tuple[int, int], tuple[int, int, Message]] = {}
+
+    def _lat(self, i: int, j: int, tick: int) -> int:
+        """Python mirror of state.latency_matrix for one edge."""
+        cfg = self.cfg
+        if cfg.latency_jitter == 0:
+            return cfg.latency
+        h = hash32_py(((i * 0x9E3779B1) & M32)
+                      ^ ((j * 0x01000193) & M32)
+                      ^ ((tick * 0xC2B2AE35) & M32)
+                      ^ ((cfg.seed ^ 0x7A77) & M32))
+        return cfg.latency + (h % (cfg.latency_jitter + 1))
 
     # -- canonical applied-log bookkeeping --------------------------------
     def _canon_note(self, idx: int, term: int, data: int) -> None:
@@ -192,37 +215,36 @@ class OracleCluster:
             self.chk_at[idx] = (self.chk_at[idx - 1]
                                 + entry_chk_py(idx, data)) & M32
 
-    # -- one kernel-schedule tick -----------------------------------------
-    def tick(self, alive, drop, payloads=(), prop_count: int = 0) -> None:
-        cfg, n = self.cfg, self.cfg.n
-        nodes = self.nodes
-        up = [bool(alive[i]) for i in range(n)]
+    # -- shared phases -----------------------------------------------------
+    def _phase_propose(self, payloads, prop_count: int) -> None:
+        """Phase 0: propose (run_ticks calls propose() before step(); D5:
+        alive is not consulted, room mirrors kernel propose())."""
+        cfg = self.cfg
+        if not prop_count:
+            return
+        ents = tuple(
+            Entry(type=EntryType.NORMAL,
+                  data=int(payloads[k]).to_bytes(4, "big"))
+            for k in range(prop_count))
+        for nd in self.nodes:
+            if nd.state != core.LEADER:
+                continue
+            room = (nd.log.last_index() + cfg.max_props
+                    - nd.log.offset) <= cfg.log_len
+            if not room:
+                continue
+            nd.suppress = True
+            try:
+                nd.step(Message(type=MsgType.PROP, frm=nd.id, entries=ents))
+            except core.ProposalDropped:
+                pass
+            nd.suppress = False
+            nd.take_msgs()
 
-        # Phase 0: propose (run_ticks calls propose() before step(); D5:
-        # alive is not consulted, room mirrors kernel propose()).
-        if prop_count:
-            ents = tuple(
-                Entry(type=EntryType.NORMAL,
-                      data=int(payloads[k]).to_bytes(4, "big"))
-                for k in range(prop_count))
-            for i, nd in enumerate(nodes):
-                if nd.state != core.LEADER:
-                    continue
-                room = (nd.log.last_index() + cfg.max_props
-                        - nd.log.offset) <= cfg.log_len
-                if not room:
-                    continue
-                nd.suppress = True
-                try:
-                    nd.step(Message(type=MsgType.PROP, frm=nd.id,
-                                    entries=ents))
-                except core.ProposalDropped:
-                    pass
-                nd.suppress = False
-                nd.take_msgs()
-
-        # Phase A: timers + CheckQuorum + campaign.
-        for i, nd in enumerate(nodes):
+    def _phase_a(self, up) -> None:
+        """Phase A: timers + CheckQuorum + campaign."""
+        cfg, n, nodes = self.cfg, self.cfg.n, self.nodes
+        for i in range(n):
             if up[i]:
                 self.elapsed[i] += 1
         for i, nd in enumerate(nodes):
@@ -243,6 +265,56 @@ class OracleCluster:
                 nd.step(Message(type=MsgType.HUP, frm=nd.id))
                 nd.take_msgs()  # Phase B re-emits vote requests uniformly
                 self.timeout[i] = rand_timeout_py(cfg, i, nd.term)
+
+    def _phase_def(self, up) -> None:
+        """Phases D (leader commit), E (apply + checksums), F (compaction)."""
+        cfg, nodes = self.cfg, self.nodes
+        for i, nd in enumerate(nodes):
+            if up[i] and nd.state == core.LEADER:
+                nd.suppress = True
+                nd._maybe_commit()
+                nd.suppress = False
+                nd.take_msgs()
+        for i, nd in enumerate(nodes):
+            if nd.log.applied > self.applied[i]:  # snapshot restore jumped
+                self.applied[i] = nd.log.applied
+                base = self.chk_at.get(self.applied[i])
+                if base is None:
+                    raise AssertionError(
+                        f"restore to unapplied index {self.applied[i]}")
+                self.apply_chk[i] = base
+            new_applied = min(nd.log.committed,
+                              self.applied[i] + cfg.apply_batch)
+            for idx in range(self.applied[i] + 1, new_applied + 1):
+                e = nd.log.entries[idx - nd.log.offset - 1]
+                d = _data_u32(e)
+                self._canon_note(idx, e.term, d)
+                self.apply_chk[i] = (self.apply_chk[i]
+                                     + entry_chk_py(idx, d)) & M32
+            self.applied[i] = new_applied
+            nd.log.applied_to(new_applied)
+        for i, nd in enumerate(nodes):
+            last, off = nd.log.last_index(), nd.log.offset
+            pressure = (last - off) > (cfg.log_len - 2 * cfg.max_props - 1)
+            new_snap = max(off, self.applied[i] - cfg.keep)
+            if pressure and new_snap > off:
+                nd.log.compact(new_snap)
+
+    # -- one kernel-schedule tick -----------------------------------------
+    def tick(self, alive, drop, payloads=(), prop_count: int = 0) -> None:
+        if self.cfg.mailboxes:
+            self._tick_mailbox(alive, drop, payloads, prop_count)
+        else:
+            self._tick_sync(alive, drop, payloads, prop_count)
+
+    def _tick_sync(self, alive, drop, payloads=(), prop_count: int = 0
+                   ) -> None:
+        cfg, n = self.cfg, self.cfg.n
+        nodes = self.nodes
+        up = [bool(alive[i]) for i in range(n)]
+
+        self._phase_propose(payloads, prop_count)
+        self._phase_a(up)
 
         # Phase B: vote exchange. Candidates re-request every tick (the
         # kernel's req matrix); delivery order (term desc, candidate asc)
@@ -331,42 +403,164 @@ class OracleCluster:
             nodes[i].suppress = False
             nodes[i].take_msgs()
 
-        # Phase D: leader quorum-commit (no-ack ticks still re-check, as the
-        # kernel's median does; sends stay suppressed).
-        for i, nd in enumerate(nodes):
-            if up[i] and nd.state == core.LEADER:
-                nd.suppress = True
-                nd._maybe_commit()
-                nd.suppress = False
-                nd.take_msgs()
+        # Phases D/E/F (commit, apply, compaction) — shared with the
+        # mailbox tick.
+        self._phase_def(up)
+        self.now += 1
 
-        # Phase E: apply batch (D5: no alive mask) + checksum bookkeeping.
-        for i, nd in enumerate(nodes):
-            if nd.log.applied > self.applied[i]:  # snapshot restore jumped
-                self.applied[i] = nd.log.applied
-                base = self.chk_at.get(self.applied[i])
-                if base is None:
-                    raise AssertionError(
-                        f"restore to unapplied index {self.applied[i]}")
-                self.apply_chk[i] = base
-            new_applied = min(nd.log.committed,
-                              self.applied[i] + cfg.apply_batch)
-            for idx in range(self.applied[i] + 1, new_applied + 1):
-                e = nd.log.entries[idx - nd.log.offset - 1]
-                d = _data_u32(e)
-                self._canon_note(idx, e.term, d)
-                self.apply_chk[i] = (self.apply_chk[i]
-                                     + entry_chk_py(idx, d)) & M32
-            self.applied[i] = new_applied
-            nd.log.applied_to(new_applied)
+    def _tick_mailbox(self, alive, drop, payloads=(), prop_count: int = 0
+                      ) -> None:
+        """Replay of the kernel's mailbox wire (kernel.py Phase B/C under
+        cfg.mailboxes): sends fill empty per-edge slots capturing (term,
+        prev); deliveries at deliver-tick construct messages from the
+        sender's CURRENT core state, dropped when the sender's term/role
+        changed since send; responses ride the reverse edge with the same
+        latency schedule."""
+        cfg, n = self.cfg, self.cfg.n
+        nodes = self.nodes
+        up = [bool(alive[i]) for i in range(n)]
+        now = self.now
 
-        # Phase F: ring-pressure compaction (D5: no alive mask).
+        self._phase_propose(payloads, prop_count)
+        self._phase_a(up)
+
+        # ---- Phase B: vote wire ----
+        # sends: any candidate refills edges with no same-term request
         for i, nd in enumerate(nodes):
-            last, off = nd.log.last_index(), nd.log.offset
-            pressure = (last - off) > (cfg.log_len - 2 * cfg.max_props - 1)
-            new_snap = max(off, self.applied[i] - cfg.keep)
-            if pressure and new_snap > off:
-                nd.log.compact(new_snap)
+            if not up[i] or nd.state != core.CANDIDATE:
+                continue
+            for j in range(n):
+                if j == i or drop[i][j]:
+                    continue
+                slot = self.vreq.get((i, j))
+                if slot is None or slot[1] != nd.term:
+                    self.vreq[(i, j)] = (now + self._lat(i, j, now), nd.term)
+        # request deliveries (lease snapshot BEFORE any vote is stepped)
+        leased = [nodes[j].lead != core.NONE
+                  and self.elapsed[j] < cfg.election_tick
+                  for j in range(n)]
+        due = sorted(k for k, v in self.vreq.items() if v[0] <= now)
+        requests: list[tuple[int, int, Message]] = []
+        for (i, j) in due:
+            _, tm = self.vreq.pop((i, j))
+            nd = nodes[i]
+            # stale guard: sender crashed state is frozen, so an in-flight
+            # request from a crashed candidate still delivers (kernel: the
+            # validity mask reads the frozen role/term row)
+            if nd.state != core.CANDIDATE or nd.term != tm:
+                continue
+            if not up[j] or leased[j]:
+                continue
+            requests.append((i, j, Message(
+                type=MsgType.VOTE, to=j + 1, frm=nd.id, term=nd.term,
+                index=nd.log.last_index(), log_term=nd.log.last_term())))
+        requests.sort(key=lambda r: (-r[2].term, r[0]))
+        for i, j, msg in requests:
+            nodes[j].step(msg)
+            for resp in nodes[j].take_msgs():
+                if resp.type != MsgType.VOTE_RESP:
+                    continue
+                if not resp.reject:
+                    self.elapsed[j] = 0
+                    if not drop[j][i]:
+                        self.vresp[(i, j)] = (
+                            now + self._lat(j, i, now), msg.term, True)
+                elif resp.term == msg.term:
+                    # processed at the candidate's term: a real rejection
+                    if not drop[j][i]:
+                        self.vresp[(i, j)] = (
+                            now + self._lat(j, i, now), msg.term, False)
+        # response deliveries: all due grants integrate before rejections
+        # (kernel evaluates win before the rejection quorum)
+        vdue = sorted(k for k, v in self.vresp.items() if v[0] <= now)
+        arrivals = [(i, j, *self.vresp.pop((i, j))[1:]) for (i, j) in vdue]
+        for want_grant in (True, False):
+            for (i, j, tm, grant) in arrivals:
+                if grant is not want_grant:
+                    continue
+                nd = nodes[i]
+                if not up[i] or nd.state != core.CANDIDATE or nd.term != tm:
+                    continue
+                nd.step(Message(type=MsgType.VOTE_RESP, to=nd.id, frm=j + 1,
+                                term=tm, reject=not grant))
+                nd.take_msgs()  # win-cascade appends go via the mailbox wire
+                if nd.state == core.LEADER:  # the guard above filtered
+                    self.elapsed[i] = 0      # out already-leaders
+                    self.recent_active[i] = set()
+
+        # ---- Phase C: append/snapshot wire ----
+        # sends: leaders fill edges with no same-term message in flight
+        for i, nd in enumerate(nodes):
+            if not up[i] or nd.state != core.LEADER:
+                continue
+            for j in range(n):
+                if j == i or drop[i][j]:
+                    continue
+                a = self.appq.get((i, j))
+                s = self.snpq.get((i, j))
+                if (a is not None and a[2] == nd.term) \
+                        or (s is not None and s[1] == nd.term):
+                    continue  # inflight window of 1 per edge
+                prev = nd.prs[j + 1].next - 1
+                if prev >= nd.log.offset:
+                    self.appq[(i, j)] = (now + self._lat(i, j, now), prev,
+                                         nd.term)
+                else:
+                    self.snpq[(i, j)] = (now + self._lat(i, j, now), nd.term)
+        # deliveries: construct messages from the sender's CURRENT state
+        out: list[tuple[int, int, Message]] = []
+        for (i, j) in sorted(k for k, v in self.appq.items() if v[0] <= now):
+            _, prev, tm = self.appq.pop((i, j))
+            nd = nodes[i]
+            if nd.state != core.LEADER or nd.term != tm or not up[j]:
+                continue
+            if prev < nd.log.offset:
+                continue  # compacted since send; a snapshot goes out next
+            prev_term = nd.log.term(prev)
+            ents = nd.log.slice(prev + 1, nd.log.last_index() + 1,
+                                cfg.window)
+            out.append((i, j, Message(
+                type=MsgType.APP, to=j + 1, frm=nd.id, term=nd.term,
+                index=prev, log_term=prev_term, entries=tuple(ents),
+                commit=nd.log.committed)))
+        for (i, j) in sorted(k for k, v in self.snpq.items() if v[0] <= now):
+            _, tm = self.snpq.pop((i, j))
+            nd = nodes[i]
+            if nd.state != core.LEADER or nd.term != tm or not up[j]:
+                continue
+            meta = SnapshotMeta(index=nd.log.offset, term=nd.log.offset_term,
+                                voters=nd.voter_ids())
+            out.append((i, j, Message(
+                type=MsgType.SNAP, to=j + 1, frm=nd.id, term=nd.term,
+                snapshot=Snapshot(meta=meta))))
+        by_rcpt: dict[int, list[tuple[int, Message]]] = {}
+        for i, j, m in out:
+            by_rcpt.setdefault(j, []).append((i, m))
+        for j, msgs in sorted(by_rcpt.items()):
+            msgs.sort(key=lambda im: (-im[1].term, im[1].frm))
+            for i, m in msgs:
+                nodes[j].step(m)
+                for resp in nodes[j].take_msgs():
+                    if resp.type == MsgType.APP_RESP and not drop[j][i]:
+                        self.arespq[(i, j)] = (
+                            now + self._lat(j, i, now), m.term, resp)
+                if m.term == nodes[j].term:
+                    self.elapsed[j] = 0
+        # response deliveries
+        for (i, j) in sorted(k for k, v in self.arespq.items()
+                             if v[0] <= now):
+            _, tm, resp = self.arespq.pop((i, j))
+            nd = nodes[i]
+            if not up[i] or nd.state != core.LEADER or nd.term != tm:
+                continue
+            self.recent_active[i].add(j)  # kernel: any resp arrival
+            nd.suppress = True
+            nd.step(resp)
+            nd.suppress = False
+            nd.take_msgs()
+
+        self._phase_def(up)
+        self.now += 1
 
     # -- comparable view ---------------------------------------------------
     def view(self) -> OracleView:

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 from dataclasses import dataclass
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -44,10 +45,32 @@ class SimConfig:
     heartbeat_tick: int = 1
     keep: int = 500             # entries kept behind `applied` at compaction
     seed: int = 0
+    # Per-edge message latency in ticks (SURVEY §7 device mailboxes).
+    # latency=0 with jitter=0 is the tick-synchronous fast path: no mailbox
+    # arrays are allocated and request+response complete within one tick.
+    # Otherwise every message spends latency + (hash % (jitter+1)) ticks in
+    # an [N, N] in-flight slot; one message per class per directed edge
+    # (inflight window of 1), content read at delivery, stale messages
+    # (sender term/role changed since send) dropped at delivery.
+    latency: int = 0
+    latency_jitter: int = 0
+    # testing knob: run the mailbox wire even at latency 0 (same-tick
+    # delivery) — must be decision-identical to the synchronous path
+    force_mailboxes: bool = False
+
+    @property
+    def mailboxes(self) -> bool:
+        return self.latency > 0 or self.latency_jitter > 0 \
+            or self.force_mailboxes
 
     def __post_init__(self):
         assert self.apply_batch >= self.max_props
         assert self.log_len > self.keep + 2 * self.max_props + self.window
+        assert self.latency >= 0 and self.latency_jitter >= 0
+        if self.mailboxes:
+            # a full round trip must fit well inside the election timeout or
+            # healthy leaders get deposed by their own followers
+            assert 2 * (self.latency + self.latency_jitter) < self.election_tick
 
 
 @jax.tree_util.register_dataclass
@@ -88,13 +111,45 @@ class SimState:
     active: jax.Array      # raft membership (conf changes flip these)
     # global tick counter (scalar) — also the PRNG stream position
     tick: jax.Array
+    # ---- in-flight mailboxes [N, N], only when cfg.mailboxes ------------
+    # One slot per message class per directed edge; *_at holds deliver
+    # tick + 1 (0 = empty).  Request classes index [sender, receiver];
+    # response classes index [original sender, responder] so the leader's
+    # progress row stays row-major.  Content beyond the captured header is
+    # read from the sender's CURRENT state at delivery, guarded by "sender
+    # term unchanged since send" (stale messages drop — always raft-safe).
+    vreq_at: Optional[jax.Array] = None     # i -> j vote request
+    vreq_term: Optional[jax.Array] = None
+    vresp_at: Optional[jax.Array] = None    # j -> i vote response
+    vresp_term: Optional[jax.Array] = None
+    vresp_grant: Optional[jax.Array] = None  # bool
+    app_at: Optional[jax.Array] = None      # i -> j append
+    app_prev: Optional[jax.Array] = None
+    app_term: Optional[jax.Array] = None
+    snp_at: Optional[jax.Array] = None      # i -> j snapshot install
+    snp_term: Optional[jax.Array] = None
+    aresp_at: Optional[jax.Array] = None    # j -> i append/snap response
+    aresp_term: Optional[jax.Array] = None
+    aresp_match: Optional[jax.Array] = None
+    aresp_ok: Optional[jax.Array] = None    # bool (False = rejection)
 
 
 def init_state(cfg: SimConfig) -> SimState:
     n, L = cfg.n, cfg.log_len
     i32 = jnp.int32
     z = lambda *s: jnp.zeros(s, i32)  # noqa: E731
+    boxes = {}
+    if cfg.mailboxes:
+        boxes = dict(
+            vreq_at=z(n, n), vreq_term=z(n, n),
+            vresp_at=z(n, n), vresp_term=z(n, n),
+            vresp_grant=jnp.zeros((n, n), jnp.bool_),
+            app_at=z(n, n), app_prev=z(n, n), app_term=z(n, n),
+            snp_at=z(n, n), snp_term=z(n, n),
+            aresp_at=z(n, n), aresp_term=z(n, n), aresp_match=z(n, n),
+            aresp_ok=jnp.zeros((n, n), jnp.bool_))
     return SimState(
+        **boxes,
         term=z(n),
         vote=jnp.full((n,), NONE, i32),
         role=z(n),
@@ -142,6 +197,22 @@ def rand_timeout(cfg: SimConfig, node: jax.Array, term: jax.Array) -> jax.Array:
 def _initial_timeouts(cfg: SimConfig) -> jax.Array:
     node = jnp.arange(cfg.n, dtype=jnp.int32)
     return rand_timeout(cfg, node, jnp.zeros((cfg.n,), jnp.int32))
+
+
+def latency_matrix(cfg: SimConfig, tick: jax.Array) -> jax.Array:
+    """[N, N] per-message latency in ticks for messages SENT this tick:
+    cfg.latency + hash(i, j, tick, seed) % (jitter+1).  Deterministic, so
+    the oracle replays the identical schedule."""
+    n = cfg.n
+    base = jnp.full((n, n), cfg.latency, jnp.int32)
+    if cfg.latency_jitter == 0:
+        return base
+    i = jnp.arange(n, dtype=jnp.uint32)
+    h = hash32(i[:, None] * jnp.uint32(0x9E3779B1)
+               ^ i[None, :] * jnp.uint32(0x01000193)
+               ^ tick.astype(jnp.uint32) * jnp.uint32(0xC2B2AE35)
+               ^ jnp.uint32(cfg.seed ^ 0x7A77))
+    return base + (h % jnp.uint32(cfg.latency_jitter + 1)).astype(jnp.int32)
 
 
 def drop_matrix(cfg: SimConfig, tick: jax.Array, rate: float) -> jax.Array:

@@ -612,11 +612,16 @@ class Batch:
             result = cb(tx)
             self._pending.extend(tx.changelist[base:])
         except BaseException:
-            # Callers catch per-callback errors and continue the batch
-            # (dispatcher, scheduler), so keep the lock while earlier
-            # callbacks' changes are still queued under it; with nothing
-            # queued, holding it would just stall other writers.
-            if not self._pending:
+            # A failed callback must not leave the store-wide lock held by
+            # an abandoned batch (most call sites don't commit() in a
+            # finally).  Earlier callbacks' changes are complete txns, so
+            # flush them — which also releases the lock — then re-raise;
+            # callers that catch per-callback errors and continue
+            # (dispatcher, scheduler) just start a fresh segment.
+            try:
+                while self._pending:
+                    await self._flush()
+            finally:
                 self._release_segment()
             raise
         if len(self._pending) >= MAX_CHANGES_PER_TRANSACTION:

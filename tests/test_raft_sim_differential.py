@@ -169,3 +169,73 @@ def test_differential_compaction_snapshot(seed):
     stats = run_differential(CFG3, n_ticks=150, seed=rngseed, prop_prob=0.9,
                              crash_prob=0.06)
     assert stats["max_commit"] > 20  # compaction pressure was reached
+
+
+# ---------------------------------------------------------------------------
+# Mailbox-wire differential: the SAME schedules, but messages ride the
+# [N, N] in-flight mailboxes (kernel.py "Device-mailbox wire") with per-edge
+# latency and optional per-message jitter.  The oracle replays the identical
+# send-gating/guard-drop/latency schedule (oracle._tick_mailbox).
+# ---------------------------------------------------------------------------
+
+CFG3_LAT = SimConfig(n=3, log_len=64, window=8, apply_batch=16, max_props=8,
+                     keep=4, election_tick=12, seed=501, latency=1)
+CFG5_LAT = SimConfig(n=5, log_len=64, window=8, apply_batch=16, max_props=8,
+                     keep=4, election_tick=14, seed=502, latency=2)
+CFG5_JIT = SimConfig(n=5, log_len=64, window=8, apply_batch=16, max_props=8,
+                     keep=4, election_tick=16, seed=503, latency=1,
+                     latency_jitter=2)
+CFG7_LAT = SimConfig(n=7, log_len=64, window=8, apply_batch=16, max_props=8,
+                     keep=4, election_tick=14, seed=504, latency=2,
+                     latency_jitter=1)
+CFG3_SYNC_BOX = SimConfig(n=3, log_len=64, window=8, apply_batch=16,
+                          max_props=8, keep=4, election_tick=10, seed=505,
+                          force_mailboxes=True)
+
+
+@pytest.mark.parametrize("seed", range(500, 530))
+def test_differential_mailbox_latency1_n3(seed):
+    drop = [0.0, 0.05, 0.15][seed % 3]
+    run_differential(CFG3_LAT, n_ticks=120, seed=seed, drop_rate=drop)
+
+
+@pytest.mark.parametrize("seed", range(530, 560))
+def test_differential_mailbox_latency2_crash_n5(seed):
+    drop = [0.0, 0.1][seed % 2]
+    crash = [0.0, 0.05][(seed // 2) % 2]
+    run_differential(CFG5_LAT, n_ticks=120, seed=seed, drop_rate=drop,
+                     crash_prob=crash)
+
+
+@pytest.mark.parametrize("seed", range(560, 590))
+def test_differential_mailbox_jitter_reordering_n5(seed):
+    drop = [0.0, 0.1, 0.2][seed % 3]
+    run_differential(CFG5_JIT, n_ticks=140, seed=seed, drop_rate=drop,
+                     crash_prob=0.04)
+
+
+@pytest.mark.parametrize("seed", range(590, 610))
+def test_differential_mailbox_heavy_faults_n7(seed):
+    run_differential(CFG7_LAT, n_ticks=100, seed=seed, drop_rate=0.15,
+                     crash_prob=0.06)
+
+
+@pytest.mark.parametrize("seed", range(610, 620))
+def test_differential_mailbox_leader_crash_cycles(seed):
+    stats = run_differential(CFG5_LAT, n_ticks=140, seed=seed,
+                             crash_leader_every=35, prop_prob=0.7)
+    assert stats["max_commit"] > 0
+
+
+@pytest.mark.parametrize("seed", range(620, 630))
+def test_differential_mailbox_partition_heal(seed):
+    run_differential(CFG5_JIT, n_ticks=140, seed=seed, drop_rate=0.05,
+                     partition_at=(40, 80, 2))
+
+
+@pytest.mark.parametrize("seed", range(630, 640))
+def test_differential_forced_mailbox_at_latency_zero(seed):
+    """The mailbox machinery at latency 0 must replay the synchronous
+    semantics exactly (same-tick delivery through the slots)."""
+    run_differential(CFG3_SYNC_BOX, n_ticks=90, seed=seed, drop_rate=0.1,
+                     crash_prob=0.05)
