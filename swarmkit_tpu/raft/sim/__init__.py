@@ -1,6 +1,8 @@
 """Batched JAX/XLA raft simulation: N managers as rows of device arrays."""
 
-from swarmkit_tpu.raft.sim.kernel import propose, step
+from swarmkit_tpu.raft.sim.kernel import (
+    propose, step, transfer_leadership,
+)
 from swarmkit_tpu.raft.sim.run import (
     committed_entries, has_leader, leader_mask, run_ticks, run_until_leader,
 )
@@ -10,7 +12,7 @@ from swarmkit_tpu.raft.sim.state import (
 )
 
 __all__ = [
-    "propose", "step", "committed_entries", "has_leader", "leader_mask",
+    "propose", "step", "transfer_leadership", "committed_entries", "has_leader", "leader_mask",
     "run_ticks", "run_until_leader", "CANDIDATE", "FOLLOWER", "LEADER",
     "NONE", "SimConfig", "SimState", "drop_matrix", "init_state",
     "rand_timeout",

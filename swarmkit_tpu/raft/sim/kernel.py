@@ -42,12 +42,16 @@ Control flow divergence (leader vs candidate vs follower) is handled with
 flow, so the whole step jits once and scans.
 
 Implemented etcd behaviors beyond the basic protocol: vote rejections with
-candidate step-down on a rejection quorum (vendor raft.go:988-1060),
+candidate step-down on a rejection quorum (vendor raft.go:988-1060);
 CheckQuorum — both the periodic partitioned-leader step-down
 (raft.go:536-560) and the leader lease that ignores vote requests from
-rejoining nodes. Deliberately simplified vs the host golden core
-(swarmkit_tpu.raft.core): no PreVote, no leader transfer, no flow-control
-windows, and rejection hints are coarse (hint = follower last index).
+rejoining nodes; PreVote (campaignPreElection: non-binding poll at term+1,
+no term inflation from flapping nodes); leader transfer
+(transfer_leadership() + the TIMEOUT_NOW wire, with CAMPAIGN_TRANSFER
+lease bypass and proposal blocking while a transfer is in flight).
+Deliberately simplified vs the host golden core (swarmkit_tpu.raft.core):
+flow control is inflight-1 rather than windowed, and rejection hints are
+coarse (hint = follower last index).
 Safety properties (election safety, log matching, leader completeness) are
 preserved and asserted by tests/test_raft_sim.py invariant checks and the
 per-tick differential gate (tests/test_raft_sim_differential.py against the
@@ -124,11 +128,14 @@ def step(state: SimState, cfg: SimConfig,
     log_term, log_data = state.log_term, state.log_data
     match, next_, granted = state.match, state.next_, state.granted
     rejected, recent_active = state.rejected, state.recent_active
+    pre = state.pre
     active = state.active
 
     up = alive & active
     n_active = jnp.sum(active.astype(I32))
     quorum = n_active // 2 + 1
+
+    now = state.tick   # pre-increment tick: all wire timestamps key off it
 
     # ---- Phase A: timers + CheckQuorum + campaign start ------------------
     is_leader = (role == LEADER) & up
@@ -148,16 +155,64 @@ def step(state: SimState, cfg: SimConfig,
     elapsed = jnp.where(check_due, 0, elapsed)
     recent_active = jnp.where(check_due[:, None], False, recent_active)
     is_leader = (role == LEADER) & up
+    # a transfer that hasn't completed within an election timeout is
+    # aborted so the leader can accept proposals again (vendor raft.go
+    # tickHeartbeat abortLeaderTransfer)
+    transferee = state.transferee
+    transferee = jnp.where(check_due, NONE, transferee)
+    transferee = jnp.where(role != LEADER, NONE, transferee)
 
-    campaign = up & (role != LEADER) & (elapsed >= timeout)
-    term = term + campaign.astype(I32)
-    vote = jnp.where(campaign, node, vote)
-    role = jnp.where(campaign, CANDIDATE, role)
-    lead = jnp.where(campaign, NONE, lead)
-    elapsed = jnp.where(campaign, 0, elapsed)
-    timeout = jnp.where(campaign, rand_timeout(cfg, node, term), timeout)
-    granted = jnp.where(campaign[:, None], eye, granted)
-    rejected = jnp.where(campaign[:, None], False, rejected)
+    # TIMEOUT_NOW delivery (vendor stepFollower MsgTimeoutNow): the
+    # transfer target campaigns immediately — a REAL campaign even under
+    # PreVote, whose requests carry CAMPAIGN_TRANSFER and bypass leases.
+    tx_cand = state.tx_cand
+    tn_at, tn_term, tn_from = state.tn_at, state.tn_term, state.tn_from
+    tn_due = (tn_at > 0) & (state.tick + 1 >= tn_at)
+    # only followers act on an equal-term TIMEOUT_NOW (stepCandidate has no
+    # case for it); a higher-term one first demotes any non-leader to
+    # follower via the Step catch-up, which then campaigns
+    tn_ok = tn_due & up & active & (role != LEADER) & (tn_term >= term) \
+        & ((role == FOLLOWER) | (tn_term > term))
+    tn_newer = tn_ok & (tn_term > term)   # Step catch-up before campaign
+    term = jnp.where(tn_newer, tn_term, term)
+    vote = jnp.where(tn_newer, NONE, vote)
+    role = jnp.where(tn_newer, FOLLOWER, role)
+    lead = jnp.where(tn_newer, tn_from, lead)
+    pre = pre & ~tn_newer
+    tn_at = jnp.where(tn_due, 0, tn_at)
+
+    campaign = (up & (role != LEADER) & (elapsed >= timeout)) & ~tn_ok
+    if cfg.pre_vote:
+        # becomePreCandidate (vendor raft.go): a non-binding poll — no term
+        # bump, no vote change, no timeout re-randomization, and the known
+        # leader is KEPT (only the real campaign's reset clears it); only
+        # the vote tallies and the candidacy marker reset.
+        pre = jnp.where(campaign, True, pre)
+        role = jnp.where(campaign, CANDIDATE, role)
+        elapsed = jnp.where(campaign, 0, elapsed)
+        granted = jnp.where(campaign[:, None], eye, granted)
+        rejected = jnp.where(campaign[:, None], False, rejected)
+    else:
+        term = term + campaign.astype(I32)
+        vote = jnp.where(campaign, node, vote)
+        role = jnp.where(campaign, CANDIDATE, role)
+        lead = jnp.where(campaign, NONE, lead)
+        elapsed = jnp.where(campaign, 0, elapsed)
+        timeout = jnp.where(campaign, rand_timeout(cfg, node, term), timeout)
+        granted = jnp.where(campaign[:, None], eye, granted)
+        rejected = jnp.where(campaign[:, None], False, rejected)
+    tx_cand = tx_cand & ~campaign   # a timeout candidacy is never forced
+    # forced (transfer) campaign: always real, even under PreVote
+    term = term + tn_ok.astype(I32)
+    vote = jnp.where(tn_ok, node, vote)
+    role = jnp.where(tn_ok, CANDIDATE, role)
+    pre = pre & ~tn_ok
+    lead = jnp.where(tn_ok, NONE, lead)
+    elapsed = jnp.where(tn_ok, 0, elapsed)
+    timeout = jnp.where(tn_ok, rand_timeout(cfg, node, term), timeout)
+    granted = jnp.where(tn_ok[:, None], eye, granted)
+    rejected = jnp.where(tn_ok[:, None], False, rejected)
+    tx_cand = jnp.where(tn_ok, True, tx_cand)
 
     # ---- Phase B: vote exchange ------------------------------------------
     is_cand = (role == CANDIDATE) & up
@@ -171,27 +226,85 @@ def step(state: SimState, cfg: SimConfig,
         # per directed edge; *_at stores deliver-tick+1 (0 = empty).  The
         # drop matrix acts at SEND (a dropped message never enters the
         # wire); receiver-side guards act at DELIVERY.
-        now = state.tick
         lat = latency_matrix(cfg, now)
         vreq_at, vreq_term = state.vreq_at, state.vreq_term
+        vreq_pre = state.vreq_pre
         vresp_at, vresp_term = state.vresp_at, state.vresp_term
-        vresp_grant = state.vresp_grant
-        # sends: candidates (re-)request on any edge with no same-term
-        # request still in flight (etcd does not retry within a term —
-        # the re-send on a cleared slot mirrors duplicate-tolerant voters)
-        free = (vreq_at == 0) | (vreq_term != term[:, None])
+        vresp_grant, vresp_pre = state.vresp_grant, state.vresp_pre
+        # sends: candidates (re-)request on any edge with no message from
+        # the SAME candidacy (term, pre) still in flight (etcd does not
+        # retry within a term — the re-send on a cleared slot mirrors
+        # duplicate-tolerant voters)
+        free = (vreq_at == 0) | (vreq_term != term[:, None]) \
+            | (vreq_pre != pre[:, None])
         send_vr = is_cand[:, None] & ~eye & ~drop & free
         vreq_at = jnp.where(send_vr, now + 1 + lat, vreq_at)
         vreq_term = jnp.where(send_vr, term[:, None], vreq_term)
-        # deliveries: stale requests (sender no longer a candidate at the
-        # captured term) vanish — candidate log state (last/last_term) is
-        # then safely readable at delivery, since candidates never append
+        vreq_pre = jnp.where(send_vr, pre[:, None], vreq_pre)
+        # deliveries: stale requests (sender no longer in the captured
+        # candidacy) vanish — candidate log state (last/last_term) is then
+        # safely readable at delivery, since candidates never append
         due_vr = (vreq_at > 0) & (now + 1 >= vreq_at)
-        req = due_vr & (role[:, None] == CANDIDATE) \
-            & (term[:, None] == vreq_term) & up[None, :] & ~leased[None, :]
+        deliv = due_vr & (role[:, None] == CANDIDATE) \
+            & (term[:, None] == vreq_term) & (pre[:, None] == vreq_pre) \
+            & up[None, :] & (~leased[None, :] | tx_cand[:, None])
+        req = deliv & ~pre[:, None]
+        preq = deliv & pre[:, None]
         vreq_at = jnp.where(due_vr, 0, vreq_at)
     else:
-        req = is_cand[:, None] & up[None, :] & ~eye & ~drop & ~leased[None, :]
+        base_req = is_cand[:, None] & up[None, :] & ~eye & ~drop \
+            & (~leased[None, :] | tx_cand[:, None])
+        req = base_req & ~pre[:, None]
+        preq = base_req & pre[:, None]
+
+    # -- PreVote exchange (vendor raft.go Step MsgPreVote): processed
+    # BEFORE real votes each tick (defined delivery order), against the
+    # receiver's pre-catch-up state; grants change NO receiver state.
+    last_term = _term_own(cfg, log_term, snap_idx, snap_term, last, last)
+    lt_i, lt_j = last_term[:, None], last_term[None, :]
+    log_ok = (lt_i > lt_j) | ((lt_i == lt_j) & (last[:, None] >= last[None, :]))
+    if cfg.pre_vote:
+        pv_term = jnp.where(preq, term[:, None] + 1, -1)  # message term
+        # below the receiver's term: silently ignored (core stale return)
+        pv_cur = preq & (pv_term >= term[None, :])
+        pv_can = (vote[None, :] == NONE) | (pv_term > term[None, :]) \
+            | (vote[None, :] == node[:, None])
+        pv_grant = pv_cur & pv_can & log_ok
+        # rejections count only when stamped with the candidacy's own term
+        # (a reject from a receiver already past term+1 is dropped in the
+        # wire; the lagging pre-candidate catches up via appends — D2')
+        pv_reject = pv_cur & ~pv_grant & (term[None, :] == term[:, None])
+        pre_cand = is_cand & pre
+        if cfg.mailboxes:
+            send_pv = (pv_grant | pv_reject) & ~drop.T
+            vresp_at = jnp.where(send_pv, now + 1 + lat.T, vresp_at)
+            vresp_term = jnp.where(send_pv, term[:, None], vresp_term)
+            vresp_pre = jnp.where(send_pv, True, vresp_pre)
+            vresp_grant = jnp.where(send_pv, pv_grant, vresp_grant)
+            due_pv = (vresp_at > 0) & (now + 1 >= vresp_at) & vresp_pre
+            rv_pv = due_pv & pre_cand[:, None] & (term[:, None] == vresp_term)
+            granted = granted | (rv_pv & vresp_grant)
+            rejected = rejected | (rv_pv & ~vresp_grant)
+            vresp_at = jnp.where(due_pv, 0, vresp_at)
+        else:
+            granted = granted | (pv_grant & ~drop.T & pre_cand[:, None])
+            rejected = rejected | (pv_reject & ~drop.T & pre_cand[:, None])
+        # Pre-quorum -> REAL campaign, evaluated BEFORE the real exchange
+        # (vendor stepCandidate transitions the moment the poll reaches
+        # quorum): bump term, vote self, reset tallies, re-randomize the
+        # timeout.  Real vote requests go out next send opportunity.
+        votes_pv = jnp.sum((granted & active[None, :]).astype(I32), axis=1)
+        pre_win = pre_cand & (votes_pv >= quorum)
+        term = term + pre_win.astype(I32)
+        vote = jnp.where(pre_win, node, vote)
+        pre = jnp.where(pre_win, False, pre)
+        lead = jnp.where(pre_win, NONE, lead)  # becomeCandidate reset
+        elapsed = jnp.where(pre_win, 0, elapsed)
+        timeout = jnp.where(pre_win, rand_timeout(cfg, node, term), timeout)
+        granted = jnp.where(pre_win[:, None], eye, granted)
+        rejected = jnp.where(pre_win[:, None], False, rejected)
+
+    # -- real vote exchange.
     # Receiver-side term catch-up (Step m.Term > r.Term with MsgVote).
     req_term = jnp.where(req, term[:, None], -1)
     mt = jnp.max(req_term, axis=0)                               # [j]
@@ -202,9 +315,8 @@ def step(state: SimState, cfg: SimConfig,
     lead = jnp.where(newer, NONE, lead)
     is_cand = (role == CANDIDATE) & up  # stepped-down candidates drop out
 
-    last_term = _term_own(cfg, log_term, snap_idx, snap_term, last, last)
-    lt_i, lt_j = last_term[:, None], last_term[None, :]
-    log_ok = (lt_i > lt_j) | ((lt_i == lt_j) & (last[:, None] >= last[None, :]))
+    # (last_term / log_ok computed above the PreVote block; Phase B never
+    # mutates log state, so they stay valid here.)
     can_vote = (vote[None, :] == NONE) | (vote[None, :] == node[:, None])
     # Compare the SEND-TIME candidate term (req_term) with the receiver's
     # post-catch-up term: a candidate whose own term was bumped this tick by
@@ -226,30 +338,40 @@ def step(state: SimState, cfg: SimConfig,
         send_vresp = cur & ~drop.T
         vresp_at = jnp.where(send_vresp, now + 1 + lat.T, vresp_at)
         vresp_term = jnp.where(send_vresp, term[None, :], vresp_term)
+        vresp_pre = jnp.where(send_vresp, False, vresp_pre)
         vresp_grant = jnp.where(send_vresp, grant_mat, vresp_grant)
         due_vs = (vresp_at > 0) & (now + 1 >= vresp_at)
-        rvalid = due_vs & is_cand[:, None] & (term[:, None] == vresp_term)
+        rvalid = due_vs & is_cand[:, None] \
+            & (term[:, None] == vresp_term) \
+            & (pre[:, None] == vresp_pre)
         granted = granted | (rvalid & vresp_grant)
         rejected = rejected | (rvalid & ~vresp_grant)
         vresp_at = jnp.where(due_vs, 0, vresp_at)
     else:
+        real_cand = is_cand & ~pre
         resp_arrive = grant_mat & ~drop.T
-        granted = granted | (resp_arrive & is_cand[:, None])
+        granted = granted | (resp_arrive & real_cand[:, None])
         reject_arrive = cur & ~grant_mat & ~drop.T
-        rejected = rejected | (reject_arrive & is_cand[:, None])
+        rejected = rejected | (reject_arrive & real_cand[:, None])
 
+    # (pre-candidacies transitioned in the PreVote block above; a fresh
+    # pre-winner has granted=eye here, so with a single active voter it
+    # wins immediately — core's _campaign self-poll cascade.)
     votes = jnp.sum((granted & active[None, :]).astype(I32), axis=1)
-    win = is_cand & (votes >= quorum)
-    # Rejection quorum: the candidate stands down for this term (keeps term
-    # and vote, waits out its timeout). A voter that granted earlier in the
-    # term never counts as a rejection — etcd's votes map records the FIRST
-    # response per voter (core._poll), and within one candidacy a grant can
-    # only precede a rejection (log/vote checks are monotone), so masking
-    # with ~granted reproduces first-response-wins exactly.
+    win = is_cand & ~pre & (votes >= quorum)
+    # Rejection quorum: the candidate stands down (a REAL candidacy keeps
+    # term and vote; a pre-candidacy keeps both untouched by design) and
+    # waits out its timeout. A voter that granted earlier in the term never
+    # counts as a rejection — etcd's votes map records the FIRST response
+    # per voter (core._poll), and within one candidacy a grant can only
+    # precede a rejection (log/vote checks are monotone), so masking with
+    # ~granted reproduces first-response-wins exactly.
     n_rej = jnp.sum((rejected & ~granted & active[None, :]).astype(I32),
                     axis=1)
     lose = is_cand & ~win & (n_rej >= quorum)
     role = jnp.where(lose, FOLLOWER, role)
+    lead = jnp.where(lose, NONE, lead)  # become_follower(term, NONE)
+    pre = pre & ~lose
     # becomeLeader: reset progress, append a no-op entry at the new term.
     role = jnp.where(win, LEADER, role)
     lead = jnp.where(win, node, lead)
@@ -449,6 +571,25 @@ def step(state: SimState, cfg: SimConfig,
         jnp.maximum(1, jnp.minimum(next_ - 1, reject_hint_del + 1)),
         next_)
 
+    # -- leader transfer completion: once the target's log caught up,
+    # fire TIMEOUT_NOW on its wire slot (vendor stepLeader MsgAppResp
+    # transferee branch).  Single slot per target; concurrent transfers to
+    # one target are rare and last-writer-wins.
+    tgt = jnp.clip(transferee, 0, n - 1)
+    has_tx = is_leader & (transferee != NONE) & active[tgt] & (tgt != node)
+    caught = has_tx & (match[node, tgt] == last)
+    if cfg.mailboxes:
+        tn_lat_i = lat[node, tgt]
+    else:
+        tn_lat_i = jnp.zeros((n,), I32)
+    want_tn = caught & (tn_at[tgt] == 0) & ~drop[node, tgt]
+    send_tn = want_tn[:, None] & (tgt[:, None] == node[None, :])  # [i, j]
+    any_tn = jnp.any(send_tn, axis=0)                             # [j]
+    tn_src = jnp.argmax(send_tn, axis=0).astype(I32)  # lowest leader wins
+    tn_at = jnp.where(any_tn, now + 1 + tn_lat_i[tn_src], tn_at)
+    tn_term = jnp.where(any_tn, term[tn_src], tn_term)
+    tn_from = jnp.where(any_tn, tn_src, tn_from)
+
     # ---- Phase D: leader commit (quorum threshold on the match row) ------
     # maybeCommit (vendor raft.go:478-486) takes the quorum-th largest match
     # index. Equivalent decision, computed as the largest X in (commit, last]
@@ -501,12 +642,18 @@ def step(state: SimState, cfg: SimConfig,
     snap_chk = jnp.where(do_compact, nsc, snap_chk)
     snap_idx = jnp.where(do_compact, new_snap, snap_idx)
 
+    # invariants: `pre`/`tx_cand` mark live candidacies only (any
+    # transition away from CANDIDATE clears them), and `transferee` only
+    # means anything on a standing leader
+    pre = pre & (role == CANDIDATE)
+    tx_cand = tx_cand & (role == CANDIDATE) & ~pre
+    transferee = jnp.where(role == LEADER, transferee, NONE)
     boxes = {}
     if cfg.mailboxes:
         boxes = dict(
-            vreq_at=vreq_at, vreq_term=vreq_term,
+            vreq_at=vreq_at, vreq_term=vreq_term, vreq_pre=vreq_pre,
             vresp_at=vresp_at, vresp_term=vresp_term,
-            vresp_grant=vresp_grant,
+            vresp_grant=vresp_grant, vresp_pre=vresp_pre,
             app_at=app_at, app_prev=app_prev, app_term=app_term_box,
             snp_at=snp_at, snp_term=snp_term_box,
             aresp_at=aresp_at, aresp_term=aresp_term,
@@ -520,7 +667,9 @@ def step(state: SimState, cfg: SimConfig,
         snap_chk=snap_chk, apply_chk=apply_chk,
         log_term=log_term, log_data=log_data,
         match=match, next_=next_, granted=granted,
-        rejected=rejected, recent_active=recent_active,
+        rejected=rejected, recent_active=recent_active, pre=pre,
+        transferee=transferee, tx_cand=tx_cand,
+        tn_at=tn_at, tn_term=tn_term, tn_from=tn_from,
         tick=state.tick + 1,
         **boxes,
     )
@@ -535,7 +684,9 @@ def propose(state: SimState, cfg: SimConfig, payloads: jax.Array,
     node = jnp.arange(n, dtype=I32)
     is_leader = (state.role == LEADER) & state.active
     room = (state.last + cfg.max_props - state.snap_idx) <= cfg.log_len
-    ok = is_leader & room
+    # a transferring leader rejects proposals (vendor stepLeader MsgProp:
+    # ErrProposalDropped while leadTransferee is set)
+    ok = is_leader & room & (state.transferee == NONE)
     k = jnp.arange(cfg.max_props, dtype=I32)
     valid = (k[None, :] < count) & ok[:, None]                   # [N, B]
     idx = state.last[:, None] + 1 + k[None, :]
@@ -564,7 +715,7 @@ def propose_dense(state: SimState, cfg: SimConfig,
     n = cfg.n
     is_leader = (state.role == LEADER) & state.active
     room = (state.last + cfg.max_props - state.snap_idx) <= cfg.log_len
-    ok = is_leader & room
+    ok = is_leader & room & (state.transferee == NONE)
     count = jnp.asarray(count, I32)
     # slot -> new index map anchored one batch ahead of last
     new_idx = _idx_at_slots(cfg, state.last + count)             # [N, L]
@@ -578,3 +729,22 @@ def propose_dense(state: SimState, cfg: SimConfig,
     match = jnp.where(ok[:, None] & eye, new_last[:, None], state.match)
     return dataclasses.replace(state, log_term=log_term, log_data=log_data,
                                last=new_last, match=match)
+
+
+def transfer_leadership(state: SimState, cfg: SimConfig, leader,
+                        target) -> SimState:
+    """Host-side transfer request (vendor stepLeader MsgTransferLeader):
+    records the target on the leader row and resets its election timer; the
+    kernel fires TIMEOUT_NOW once the target's log catches up.  A repeat
+    request for the SAME in-flight target is a no-op; a different target
+    aborts and replaces the previous transfer."""
+    leader = jnp.asarray(leader, I32)
+    target = jnp.asarray(target, I32)
+    is_l = (state.role[leader] == LEADER) & (target != leader) \
+        & state.active[target]
+    changed = is_l & (state.transferee[leader] != target)
+    transferee = state.transferee.at[leader].set(
+        jnp.where(changed, target, state.transferee[leader]))
+    elapsed = state.elapsed.at[leader].set(
+        jnp.where(changed, 0, state.elapsed[leader]))
+    return dataclasses.replace(state, transferee=transferee, elapsed=elapsed)

@@ -27,9 +27,14 @@ divergences.
     advance / rejection. Mask: the scheduler calls _bcast_append each tick
     (sync) or mirrors the slot-gated sends (_tick_mailbox), never fires
     BEAT, and suppresses sends while responses are being stepped.
- D2 no PreVote / leader transfer: kernel.py module docstring. Mask: oracle
-    Config(pre_vote=False); transfer untested here (covered by host-level
-    tests).
+ D2' PreVote and leader transfer ARE implemented (cfg.pre_vote;
+    kernel.transfer_leadership + the TIMEOUT_NOW wire) and replayed here.
+    One wire simplification remains: a PreVote rejection stamped with a
+    receiver term ABOVE the candidacy's own term is dropped in the wire
+    instead of deposing the pre-candidate (it catches up via appends);
+    equal-term rejections count toward the rejection quorum exactly as
+    etcd's poll does. Mask: _prevote_exchange_sync/_tick_mailbox enqueue
+    only countable rejections.
  D3 flow control is inflight-1, not windowed: on the synchronous wire the
     kernel re-sends the window from next_ every tick; on the mailbox wire
     exactly one append rides each edge at a time — etcd pipelines up to
@@ -166,7 +171,8 @@ class OracleCluster:
                                  heartbeat_tick=cfg.heartbeat_tick,
                                  max_size_per_msg=cfg.window,
                                  max_inflight_msgs=1 << 30,
-                                 check_quorum=False, pre_vote=False,
+                                 check_quorum=False,
+                                 pre_vote=cfg.pre_vote,
                                  seed=cfg.seed),
                      window=cfg.window)
             for i in range(n)
@@ -186,8 +192,12 @@ class OracleCluster:
         # request classes and (leader, responder) for response classes;
         # values carry (deliver_tick, captured header...).
         self.now = 0
+        # leader transfer mirrors (kernel transferee/tx_cand/tn_* wires)
+        self.tx_term: dict[int, int] = {}   # i -> term of tx-born candidacy
+        self.tnq: dict[int, tuple[int, int, int]] = {}  # tgt -> (at, tm, frm)
         self.vreq: dict[tuple[int, int], tuple[int, int]] = {}
-        self.vresp: dict[tuple[int, int], tuple[int, int, bool]] = {}
+        # (deliver_at, candidacy_term, grant, is_pre)
+        self.vresp: dict[tuple[int, int], tuple[int, int, bool, bool]] = {}
         self.appq: dict[tuple[int, int], tuple[int, int, int]] = {}
         self.snpq: dict[tuple[int, int], tuple[int, int]] = {}
         self.arespq: dict[tuple[int, int], tuple[int, int, Message]] = {}
@@ -255,8 +265,15 @@ class OracleCluster:
                 heard = self.recent_active[i] | {i}
                 if len(heard) < (n // 2 + 1):
                     nd.become_follower(nd.term, core.NONE)
+                else:
+                    # transfer not completed within an election timeout:
+                    # abort (kernel Phase A; vendor tickHeartbeat)
+                    nd._abort_leader_transfer()
                 self.elapsed[i] = 0
                 self.recent_active[i] = set()
+        # TIMEOUT_NOW deliveries land between CheckQuorum and the timeout
+        # campaigns (kernel Phase A order)
+        self._transfer_deliver(up)
         for i, nd in enumerate(nodes):
             if not up[i]:
                 continue
@@ -264,7 +281,12 @@ class OracleCluster:
                 self.elapsed[i] = 0
                 nd.step(Message(type=MsgType.HUP, frm=nd.id))
                 nd.take_msgs()  # Phase B re-emits vote requests uniformly
-                self.timeout[i] = rand_timeout_py(cfg, i, nd.term)
+                if nd.state != core.PRE_CANDIDATE:
+                    # vendor becomePreCandidate never re-randomizes the
+                    # timeout (only a REAL campaign's reset does); the
+                    # kernel matches, so the oracle must too or the two
+                    # sides fire later campaigns on different ticks
+                    self.timeout[i] = rand_timeout_py(cfg, i, nd.term)
 
     def _phase_def(self, up) -> None:
         """Phases D (leader commit), E (apply + checksums), F (compaction)."""
@@ -300,6 +322,127 @@ class OracleCluster:
             if pressure and new_snap > off:
                 nd.log.compact(new_snap)
 
+    def transfer(self, leader: int, target: int) -> None:
+        """Mirror of kernel.transfer_leadership: record the target on the
+        leader's core node and reset its election timer (vendor stepLeader
+        MsgTransferLeader; a repeat for the same in-flight target is a
+        no-op)."""
+        nd = self.nodes[leader]
+        if nd.state != core.LEADER or target == leader:
+            return
+        if nd.lead_transferee == target + 1:
+            return
+        self.elapsed[leader] = 0
+        nd.step(Message(type=MsgType.TRANSFER_LEADER, frm=target + 1,
+                        to=nd.id))
+        nd.take_msgs()   # TIMEOUT_NOW/append bursts ride the kernel's wire
+
+    def _is_tx(self, i: int) -> bool:
+        nd = self.nodes[i]
+        return (nd.state == core.CANDIDATE
+                and self.tx_term.get(i) == nd.term)
+
+    def _transfer_fire(self, up, drop) -> None:
+        """Kernel's per-tick TIMEOUT_NOW send rule: a transferring leader
+        whose target is fully caught up fires once into the target's
+        single wire slot (vendor stepLeader MsgAppResp transferee
+        branch)."""
+        cfg, n, nodes = self.cfg, self.cfg.n, self.nodes
+        now = self.now
+        for i in range(n):   # lowest leader index wins a contested slot
+            nd = nodes[i]
+            if not up[i] or nd.state != core.LEADER \
+                    or nd.lead_transferee == core.NONE:
+                continue
+            t = nd.lead_transferee - 1
+            if t == i or not (0 <= t < n) or t in self.tnq:
+                continue
+            if nd.prs[nd.lead_transferee].match != nd.log.last_index():
+                continue
+            if drop[i][t]:
+                continue
+            lat = self._lat(i, t, now) if cfg.mailboxes else 0
+            self.tnq[t] = (now + 1 + lat, nd.term, i)
+
+    def _transfer_deliver(self, up) -> None:
+        """TIMEOUT_NOW deliveries (kernel Phase A): the target runs a
+        forced REAL campaign whose requests bypass the leader lease."""
+        cfg, nodes = self.cfg, self.nodes
+        now = self.now
+        for t in sorted(k for k, v in self.tnq.items() if v[0] <= now + 1):
+            _, tm, frm = self.tnq.pop(t)
+            nd = nodes[t]
+            if not up[t] or nd.state == core.LEADER or tm < nd.term:
+                continue
+            if tm == nd.term and nd.state != core.FOLLOWER:
+                continue  # candidates ignore equal-term TIMEOUT_NOW
+            nd.step(Message(type=MsgType.TIMEOUT_NOW, frm=frm + 1,
+                            to=nd.id, term=tm))
+            nd.take_msgs()
+            if nd.state == core.CANDIDATE:
+                self.elapsed[t] = 0
+                self.timeout[t] = rand_timeout_py(cfg, t, nd.term)
+                self.tx_term[t] = nd.term
+            elif nd.state == core.LEADER:   # quorum-of-1 forced cascade
+                self.elapsed[t] = 0
+                self.timeout[t] = rand_timeout_py(cfg, t, nd.term)
+                self.recent_active[t] = set()
+
+    def _prevote_exchange_sync(self, up, drop, leased) -> None:
+        """PreVote round on the synchronous wire, processed BEFORE real
+        votes (the kernel's defined delivery order).  Grants mutate no
+        receiver state; rejections count only when stamped with the
+        candidacy's own term (kernel D2' drop rule for higher-term
+        rejects); pre-quorum transitions to a real candidacy with the
+        kernel's elapsed/timeout resets."""
+        cfg, n, nodes = self.cfg, self.cfg.n, self.nodes
+        if not cfg.pre_vote:
+            return
+        pv_requests: list[tuple[int, int, Message]] = []
+        for i in range(n):
+            nd = nodes[i]
+            if not up[i] or nd.state != core.PRE_CANDIDATE:
+                continue
+            for j in range(n):
+                if j == i or not up[j] or drop[i][j] or leased[j]:
+                    continue
+                pv_requests.append((i, j, Message(
+                    type=MsgType.PRE_VOTE, to=j + 1, frm=nd.id,
+                    term=nd.term + 1, index=nd.log.last_index(),
+                    log_term=nd.log.last_term())))
+        pv_requests.sort(key=lambda r: (-r[2].term, r[0]))
+        pv_grants: list[tuple[int, int, Message]] = []
+        pv_rejects: list[tuple[int, int, Message]] = []
+        for i, j, msg in pv_requests:
+            nodes[j].step(msg)
+            for resp in nodes[j].take_msgs():
+                if resp.type != MsgType.PRE_VOTE_RESP:
+                    continue
+                if not resp.reject:
+                    pv_grants.append((j, i, resp))
+                elif resp.term == msg.term - 1:
+                    pv_rejects.append((j, i, resp))
+        for j, i, resp in pv_grants:
+            if drop[j][i]:
+                continue
+            nd = nodes[i]
+            if nd.state != core.PRE_CANDIDATE:
+                continue
+            nd.step(resp)
+            nd.take_msgs()   # real-campaign bursts go via normal sends
+            if nd.state in (core.CANDIDATE, core.LEADER):
+                # pre-win: kernel bumps term, resets elapsed and
+                # re-randomizes the timeout at the new term
+                self.elapsed[i] = 0
+                self.timeout[i] = rand_timeout_py(cfg, i, nd.term)
+                if nd.state == core.LEADER:  # quorum-of-1 cascade
+                    self.recent_active[i] = set()
+        for j, i, resp in pv_rejects:
+            if drop[j][i] or nodes[i].state != core.PRE_CANDIDATE:
+                continue
+            nodes[i].step(resp)
+            nodes[i].take_msgs()
+
     # -- one kernel-schedule tick -----------------------------------------
     def tick(self, alive, drop, payloads=(), prop_count: int = 0) -> None:
         if self.cfg.mailboxes:
@@ -324,12 +467,20 @@ class OracleCluster:
         leased = [nodes[j].lead != core.NONE
                   and self.elapsed[j] < cfg.election_tick
                   for j in range(n)]
+        # capture candidacies BEFORE any exchange (kernel send sets are
+        # fixed from post-Phase-A state: a pre-winner sends real requests
+        # only from the NEXT tick)
+        real_cands = [i for i in range(n)
+                      if up[i] and nodes[i].state == core.CANDIDATE]
+        self._prevote_exchange_sync(up, drop, leased)
         requests: list[tuple[int, int, Message]] = []  # (cand, to, msg)
-        for i, nd in enumerate(nodes):
-            if not up[i] or nd.state != core.CANDIDATE:
+        for i in real_cands:
+            nd = nodes[i]
+            if nd.state != core.CANDIDATE:
                 continue
             for j in range(n):
-                if j == i or not up[j] or drop[i][j] or leased[j]:
+                if j == i or not up[j] or drop[i][j] \
+                        or (leased[j] and not self._is_tx(i)):
                     continue
                 requests.append((i, j, Message(
                     type=MsgType.VOTE, to=j + 1, frm=nd.id, term=nd.term,
@@ -405,6 +556,7 @@ class OracleCluster:
 
         # Phases D/E/F (commit, apply, compaction) — shared with the
         # mailbox tick.
+        self._transfer_fire(up, drop)
         self._phase_def(up)
         self.now += 1
 
@@ -425,35 +577,87 @@ class OracleCluster:
         self._phase_a(up)
 
         # ---- Phase B: vote wire ----
-        # sends: any candidate refills edges with no same-term request
+        # sends: any candidacy (pre or real) refills edges carrying no
+        # message from the SAME candidacy (term, pre)
         for i, nd in enumerate(nodes):
-            if not up[i] or nd.state != core.CANDIDATE:
+            if not up[i] or nd.state not in (core.CANDIDATE,
+                                             core.PRE_CANDIDATE):
                 continue
+            is_pre = nd.state == core.PRE_CANDIDATE
             for j in range(n):
                 if j == i or drop[i][j]:
                     continue
                 slot = self.vreq.get((i, j))
-                if slot is None or slot[1] != nd.term:
-                    self.vreq[(i, j)] = (now + self._lat(i, j, now), nd.term)
-        # request deliveries (lease snapshot BEFORE any vote is stepped)
+                if slot is None or slot[1] != nd.term or slot[2] != is_pre:
+                    self.vreq[(i, j)] = (now + self._lat(i, j, now),
+                                         nd.term, is_pre)
+        # request deliveries (lease snapshot BEFORE any vote is stepped);
+        # prevote requests process before real ones (kernel phase order)
         leased = [nodes[j].lead != core.NONE
                   and self.elapsed[j] < cfg.election_tick
                   for j in range(n)]
         due = sorted(k for k, v in self.vreq.items() if v[0] <= now)
+        pv_requests: list[tuple[int, int, Message]] = []
         requests: list[tuple[int, int, Message]] = []
         for (i, j) in due:
-            _, tm = self.vreq.pop((i, j))
+            _, tm, is_pre = self.vreq.pop((i, j))
             nd = nodes[i]
             # stale guard: sender crashed state is frozen, so an in-flight
             # request from a crashed candidate still delivers (kernel: the
-            # validity mask reads the frozen role/term row)
-            if nd.state != core.CANDIDATE or nd.term != tm:
+            # validity mask reads the frozen role/term/pre row)
+            want = core.PRE_CANDIDATE if is_pre else core.CANDIDATE
+            if nd.state != want or nd.term != tm:
                 continue
-            if not up[j] or leased[j]:
+            if not up[j] or (leased[j] and not self._is_tx(i)):
                 continue
-            requests.append((i, j, Message(
-                type=MsgType.VOTE, to=j + 1, frm=nd.id, term=nd.term,
-                index=nd.log.last_index(), log_term=nd.log.last_term())))
+            if is_pre:
+                pv_requests.append((i, j, Message(
+                    type=MsgType.PRE_VOTE, to=j + 1, frm=nd.id,
+                    term=nd.term + 1, index=nd.log.last_index(),
+                    log_term=nd.log.last_term())))
+            else:
+                requests.append((i, j, Message(
+                    type=MsgType.VOTE, to=j + 1, frm=nd.id, term=nd.term,
+                    index=nd.log.last_index(),
+                    log_term=nd.log.last_term())))
+        # prevote exchange: requests, then due prevote responses, then the
+        # pre-win transition — all BEFORE any real vote is stepped
+        pv_requests.sort(key=lambda r: (-r[2].term, r[0]))
+        for i, j, msg in pv_requests:
+            nodes[j].step(msg)
+            for resp in nodes[j].take_msgs():
+                if resp.type != MsgType.PRE_VOTE_RESP or drop[j][i]:
+                    continue
+                if not resp.reject:
+                    self.vresp[(i, j)] = (now + self._lat(j, i, now),
+                                          msg.term - 1, True, True)
+                elif resp.term == msg.term - 1:
+                    # countable only at the candidacy's own term (kernel
+                    # D2' higher-term reject drop rule)
+                    self.vresp[(i, j)] = (now + self._lat(j, i, now),
+                                          msg.term - 1, False, True)
+        pv_due = sorted(k for k, v in self.vresp.items()
+                        if v[0] <= now and v[3])
+        pv_arrivals = [(i, j, *self.vresp.pop((i, j))[1:])
+                       for (i, j) in pv_due]
+        for want_grant in (True, False):
+            for (i, j, tm, grant, _pre) in pv_arrivals:
+                if grant is not want_grant:
+                    continue
+                nd = nodes[i]
+                if not up[i] or nd.state != core.PRE_CANDIDATE \
+                        or nd.term != tm:
+                    continue
+                nd.step(Message(
+                    type=MsgType.PRE_VOTE_RESP, to=nd.id, frm=j + 1,
+                    term=tm + 1 if grant else tm, reject=not grant))
+                nd.take_msgs()
+                if nd.state in (core.CANDIDATE, core.LEADER):
+                    self.elapsed[i] = 0
+                    self.timeout[i] = rand_timeout_py(cfg, i, nd.term)
+                    if nd.state == core.LEADER:  # quorum-of-1 cascade
+                        self.recent_active[i] = set()
+        # real vote exchange
         requests.sort(key=lambda r: (-r[2].term, r[0]))
         for i, j, msg in requests:
             nodes[j].step(msg)
@@ -464,18 +668,21 @@ class OracleCluster:
                     self.elapsed[j] = 0
                     if not drop[j][i]:
                         self.vresp[(i, j)] = (
-                            now + self._lat(j, i, now), msg.term, True)
+                            now + self._lat(j, i, now), msg.term, True,
+                            False)
                 elif resp.term == msg.term:
                     # processed at the candidate's term: a real rejection
                     if not drop[j][i]:
                         self.vresp[(i, j)] = (
-                            now + self._lat(j, i, now), msg.term, False)
+                            now + self._lat(j, i, now), msg.term, False,
+                            False)
         # response deliveries: all due grants integrate before rejections
         # (kernel evaluates win before the rejection quorum)
-        vdue = sorted(k for k, v in self.vresp.items() if v[0] <= now)
+        vdue = sorted(k for k, v in self.vresp.items()
+                      if v[0] <= now and not v[3])
         arrivals = [(i, j, *self.vresp.pop((i, j))[1:]) for (i, j) in vdue]
         for want_grant in (True, False):
-            for (i, j, tm, grant) in arrivals:
+            for (i, j, tm, grant, _pre) in arrivals:
                 if grant is not want_grant:
                     continue
                 nd = nodes[i]
@@ -559,6 +766,7 @@ class OracleCluster:
             nd.suppress = False
             nd.take_msgs()
 
+        self._transfer_fire(up, drop)
         self._phase_def(up)
         self.now += 1
 

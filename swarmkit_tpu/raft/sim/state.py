@@ -57,6 +57,11 @@ class SimConfig:
     # testing knob: run the mailbox wire even at latency 0 (same-tick
     # delivery) — must be decision-identical to the synchronous path
     force_mailboxes: bool = False
+    # PreVote (vendor raft.go campaignPreElection): a timed-out node runs a
+    # non-binding poll at term+1 WITHOUT bumping its term first, so a
+    # flapping/partitioned node cannot inflate cluster terms.  Mirrors
+    # core.Config.pre_vote.
+    pre_vote: bool = False
 
     @property
     def mailboxes(self) -> bool:
@@ -105,6 +110,19 @@ class SimState:
     rejected: jax.Array    # bool: rejected[i, j] = j refused i this term
                            # (a rejection quorum steps the candidate down,
                            # vendor raft.go stepCandidate poll)
+    pre: jax.Array         # bool [N]: candidacy is a PreVote poll (role ==
+                           # CANDIDATE, term NOT yet bumped; vendor
+                           # becomePreCandidate)
+    # leader transfer (vendor raft.go leadTransferee + MsgTimeoutNow):
+    transferee: jax.Array  # i32 [N]: row i's pending transfer target while
+                           # i leads (NONE = no transfer in progress)
+    tx_cand: jax.Array     # bool [N]: candidacy was forced by TIMEOUT_NOW
+                           # (its vote requests carry CAMPAIGN_TRANSFER and
+                           # bypass the leader lease)
+    tn_at: jax.Array       # i32 [N]: TIMEOUT_NOW wire, deliver tick+1
+                           # (0 = empty; single slot per target)
+    tn_term: jax.Array     # i32 [N]: sender leader's term at send
+    tn_from: jax.Array     # i32 [N]: sender leader row
     recent_active: jax.Array  # bool: leader i heard from j since the last
                               # CheckQuorum round (Progress.RecentActive)
     # membership / liveness [N] bool
@@ -119,10 +137,13 @@ class SimState:
     # read from the sender's CURRENT state at delivery, guarded by "sender
     # term unchanged since send" (stale messages drop — always raft-safe).
     vreq_at: Optional[jax.Array] = None     # i -> j vote request
-    vreq_term: Optional[jax.Array] = None
+    vreq_term: Optional[jax.Array] = None   # SENDER's term at send (message
+                                            # term = vreq_term + vreq_pre)
+    vreq_pre: Optional[jax.Array] = None    # bool: request is a PreVote
     vresp_at: Optional[jax.Array] = None    # j -> i vote response
     vresp_term: Optional[jax.Array] = None
     vresp_grant: Optional[jax.Array] = None  # bool
+    vresp_pre: Optional[jax.Array] = None    # bool: response to a PreVote
     app_at: Optional[jax.Array] = None      # i -> j append
     app_prev: Optional[jax.Array] = None
     app_term: Optional[jax.Array] = None
@@ -142,6 +163,8 @@ def init_state(cfg: SimConfig) -> SimState:
     if cfg.mailboxes:
         boxes = dict(
             vreq_at=z(n, n), vreq_term=z(n, n),
+            vreq_pre=jnp.zeros((n, n), jnp.bool_),
+            vresp_pre=jnp.zeros((n, n), jnp.bool_),
             vresp_at=z(n, n), vresp_term=z(n, n),
             vresp_grant=jnp.zeros((n, n), jnp.bool_),
             app_at=z(n, n), app_prev=z(n, n), app_term=z(n, n),
@@ -167,6 +190,10 @@ def init_state(cfg: SimConfig) -> SimState:
         next_=jnp.ones((n, n), i32),
         granted=jnp.zeros((n, n), jnp.bool_),
         rejected=jnp.zeros((n, n), jnp.bool_),
+        pre=jnp.zeros((n,), jnp.bool_),
+        transferee=jnp.full((n,), NONE, i32),
+        tx_cand=jnp.zeros((n,), jnp.bool_),
+        tn_at=z(n), tn_term=z(n), tn_from=z(n),
         recent_active=jnp.zeros((n, n), jnp.bool_),
         active=jnp.ones((n,), jnp.bool_),
         tick=jnp.zeros((), i32),

@@ -13,6 +13,7 @@ Differences from the reference are deliberate TPU-era simplifications:
 from __future__ import annotations
 
 import asyncio
+import logging
 import time
 from dataclasses import dataclass, field as dc_field
 from typing import Any, Callable, Iterable, Optional
@@ -27,6 +28,8 @@ from swarmkit_tpu.store.errors import (
 )
 from swarmkit_tpu.utils import metrics
 from swarmkit_tpu.watch.queue import Queue
+
+log = logging.getLogger("swarmkit_tpu.store")
 
 # reference: manager/state/store/memory.go:45-48
 MAX_CHANGES_PER_TRANSACTION = 200
@@ -615,12 +618,18 @@ class Batch:
             # A failed callback must not leave the store-wide lock held by
             # an abandoned batch (most call sites don't commit() in a
             # finally).  Earlier callbacks' changes are complete txns, so
-            # flush them — which also releases the lock — then re-raise;
-            # callers that catch per-callback errors and continue
-            # (dispatcher, scheduler) just start a fresh segment.
+            # flush them — which also releases the lock — then re-raise
+            # the CALLBACK's exception; callers that catch per-callback
+            # errors and continue (dispatcher, scheduler) must see the
+            # error type they expect, so a flush failure here is logged,
+            # never allowed to replace it.
             try:
                 while self._pending:
                     await self._flush()
+            except Exception:
+                log.exception("batch flush failed while unwinding a "
+                              "callback error")
+                self._pending.clear()
             finally:
                 self._release_segment()
             raise

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 
 from swarmkit_tpu.raft.sim import (
     LEADER, SimConfig, committed_entries, init_state, propose, run_ticks,
-    run_until_leader, step,
+    run_until_leader, step, transfer_leadership,
 )
 
 SMALL = SimConfig(n=5, log_len=256, window=32, apply_batch=64, max_props=16,
@@ -497,3 +497,125 @@ class TestLatencyMailboxes:
                         latency=1, latency_jitter=1)
         st, chk = drive(cfg, 80, prop_count=8, drop_rate=0.02)
         assert np.asarray(st.commit).max() > 0
+
+
+class TestPreVoteAndTransfer:
+    """PreVote (vendor campaignPreElection) + leader transfer
+    (TransferLeadership/TIMEOUT_NOW) at the kernel level."""
+
+    def _elect(self, cfg, max_ticks=400):
+        st = init_state(cfg)
+        for _ in range(max_ticks):
+            st = step_j(st, cfg)
+            if len(leaders_of(st)) == 1:
+                return st
+        raise AssertionError("no leader")
+
+    def test_prevote_partitioned_node_does_not_inflate_terms(self):
+        cfg = SimConfig(n=5, log_len=256, window=32, apply_batch=64,
+                        max_props=16, keep=8, seed=9, election_tick=12,
+                        pre_vote=True)
+        st = self._elect(cfg)
+        term0 = int(np.asarray(st.term).max())
+        # cut node 0 off for a long time: it pre-campaigns repeatedly but
+        # must never bump its term
+        cut = np.zeros((cfg.n, cfg.n), bool)
+        cut[0, :] = cut[:, 0] = True
+        np.fill_diagonal(cut, False)
+        for _ in range(120):
+            st = step_j(st, cfg, drop=jnp.asarray(cut))
+        assert int(np.asarray(st.term)[0]) == term0, \
+            "pre-candidate inflated its term while partitioned"
+        # heal: the cluster leader is NOT deposed
+        for _ in range(60):
+            st = step_j(st, cfg)
+        assert int(np.asarray(st.term).max()) == term0
+        assert len(leaders_of(st)) == 1
+
+    @pytest.mark.parametrize("kw", [
+        {}, {"pre_vote": True}, {"latency": 2},
+        {"pre_vote": True, "latency": 1, "latency_jitter": 1},
+    ])
+    def test_transfer_moves_leadership(self, kw):
+        cfg = SimConfig(n=5, log_len=256, window=32, apply_batch=64,
+                        max_props=16, keep=8, seed=5, election_tick=12, **kw)
+        st = self._elect(cfg)
+        (lead,) = leaders_of(st)
+        tgt = int((lead + 2) % cfg.n)
+        st = transfer_leadership(st, cfg, int(lead), tgt)
+        for _ in range(80):
+            st = step_j(st, cfg)
+            role = np.asarray(st.role)
+            if role[tgt] == LEADER and role[lead] != LEADER:
+                break
+        role = np.asarray(st.role)
+        assert role[tgt] == LEADER and role[lead] != LEADER
+
+    def test_transfer_blocks_proposals_until_done_or_aborted(self):
+        cfg = SimConfig(n=5, log_len=256, window=32, apply_batch=64,
+                        max_props=16, keep=8, seed=6, election_tick=12)
+        st = self._elect(cfg)
+        (lead,) = leaders_of(st)
+        st = transfer_leadership(st, cfg, int(lead), int((lead + 1) % cfg.n))
+        last0 = int(np.asarray(st.last)[lead])
+        st2 = propose_j(st, cfg,
+                        jnp.arange(cfg.max_props, dtype=jnp.uint32),
+                        jnp.asarray(4))
+        assert int(np.asarray(st2.last)[lead]) == last0, \
+            "transferring leader must drop proposals"
+
+    def test_transfer_waits_for_catchup_then_completes(self):
+        cfg = SimConfig(n=5, log_len=256, window=8, apply_batch=64,
+                        max_props=8, keep=8, seed=8, election_tick=20,
+                        latency=2)
+        st = self._elect(cfg)
+        (lead,) = leaders_of(st)
+        tgt = int((lead + 1) % cfg.n)
+        # briefly crash the target so it lags by ~2 windows, then transfer:
+        # it must catch up first and then take over (TIMEOUT_NOW only fires
+        # at match == last)
+        alive = np.ones(cfg.n, bool)
+        alive[tgt] = False
+        for _ in range(2):
+            st = propose_j(st, cfg,
+                           jnp.arange(cfg.max_props, dtype=jnp.uint32),
+                           jnp.asarray(8))
+            st = step_j(st, cfg, alive=jnp.asarray(alive))
+        st = transfer_leadership(st, cfg, int(lead), tgt)
+        moved = False
+        for _ in range(120):
+            st = step_j(st, cfg)
+            if np.asarray(st.role)[tgt] == LEADER:
+                moved = True
+                break
+        assert moved, "transfer must complete after the target catches up"
+        assert int(np.asarray(st.last)[tgt]) >= 16
+
+    def test_transfer_to_deeply_lagging_target_aborts(self):
+        """vendor tickHeartbeat: a transfer that cannot complete within an
+        election timeout is aborted and the leader accepts proposals
+        again."""
+        cfg = SimConfig(n=5, log_len=256, window=8, apply_batch=64,
+                        max_props=8, keep=8, seed=8, election_tick=14,
+                        latency=2)
+        st = self._elect(cfg)
+        (lead,) = leaders_of(st)
+        tgt = int((lead + 1) % cfg.n)
+        alive = np.ones(cfg.n, bool)
+        alive[tgt] = False
+        for _ in range(10):   # ~80 entries behind: unreachable in 14 ticks
+            st = propose_j(st, cfg,
+                           jnp.arange(cfg.max_props, dtype=jnp.uint32),
+                           jnp.asarray(8))
+            st = step_j(st, cfg, alive=jnp.asarray(alive))
+        st = transfer_leadership(st, cfg, int(lead), tgt)
+        for _ in range(2 * cfg.election_tick):
+            st = step_j(st, cfg)
+        assert int(np.asarray(st.transferee)[lead]) == -1, \
+            "stalled transfer must abort after an election timeout"
+        assert np.asarray(st.role)[lead] == LEADER
+        last0 = int(np.asarray(st.last)[lead])
+        st = propose_j(st, cfg, jnp.arange(cfg.max_props, dtype=jnp.uint32),
+                       jnp.asarray(4))
+        assert int(np.asarray(st.last)[lead]) == last0 + 4, \
+            "proposals must flow again after the abort"

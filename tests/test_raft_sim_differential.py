@@ -19,7 +19,7 @@ import numpy as np
 import pytest
 
 from swarmkit_tpu.raft.sim import SimConfig, init_state
-from swarmkit_tpu.raft.sim.kernel import propose, step
+from swarmkit_tpu.raft.sim.kernel import propose, step, transfer_leadership
 from swarmkit_tpu.raft.sim.oracle import OracleCluster
 
 _step = jax.jit(step, static_argnames=("cfg",))
@@ -51,7 +51,8 @@ def kernel_view(state) -> dict:
 def run_differential(cfg: SimConfig, n_ticks: int, seed: int,
                      drop_rate: float = 0.0, crash_prob: float = 0.0,
                      prop_prob: float = 0.5, partition_at: tuple = (),
-                     crash_leader_every: int = 0) -> dict:
+                     crash_leader_every: int = 0,
+                     transfer_every: int = 0) -> dict:
     """Drive kernel + oracle on one random schedule; assert per-tick equality.
     Returns summary stats (max commit etc.) so callers can assert progress.
     """
@@ -86,6 +87,16 @@ def run_differential(cfg: SimConfig, n_ticks: int, seed: int,
             if start <= t < end:
                 side = np.arange(n) < cut
                 drop = drop | (side[:, None] != side[None, :])
+
+        # -- leader-transfer schedule: ask the sitting leader to hand off
+        if transfer_every and t > 0 and t % transfer_every == 0:
+            kv = kernel_view(state)
+            leaders = np.nonzero((kv["role"] == 2) & alive)[0]
+            if len(leaders):
+                ldr = int(leaders[0])
+                tgt = int(rng.integers(n))
+                state = transfer_leadership(state, cfg, ldr, tgt)
+                oracle.transfer(ldr, tgt)
 
         # -- proposal schedule
         prop_count = 0
@@ -239,3 +250,93 @@ def test_differential_forced_mailbox_at_latency_zero(seed):
     semantics exactly (same-tick delivery through the slots)."""
     run_differential(CFG3_SYNC_BOX, n_ticks=90, seed=seed, drop_rate=0.1,
                      crash_prob=0.05)
+
+
+# ---------------------------------------------------------------------------
+# PreVote differential: candidacies poll at term+1 without bumping terms
+# (vendor raft.go campaignPreElection) on both wires.
+# ---------------------------------------------------------------------------
+
+CFG3_PV = SimConfig(n=3, log_len=64, window=8, apply_batch=16, max_props=8,
+                    keep=4, election_tick=10, seed=701, pre_vote=True)
+CFG5_PV = SimConfig(n=5, log_len=64, window=8, apply_batch=16, max_props=8,
+                    keep=4, election_tick=12, seed=702, pre_vote=True)
+CFG5_PV_LAT = SimConfig(n=5, log_len=64, window=8, apply_batch=16,
+                        max_props=8, keep=4, election_tick=14, seed=703,
+                        pre_vote=True, latency=2)
+CFG7_PV_JIT = SimConfig(n=7, log_len=64, window=8, apply_batch=16,
+                        max_props=8, keep=4, election_tick=16, seed=704,
+                        pre_vote=True, latency=1, latency_jitter=2)
+
+
+@pytest.mark.parametrize("seed", range(700, 730))
+def test_differential_prevote_sync_n3(seed):
+    drop = [0.0, 0.1, 0.2][seed % 3]
+    run_differential(CFG3_PV, n_ticks=100, seed=seed, drop_rate=drop)
+
+
+@pytest.mark.parametrize("seed", range(730, 760))
+def test_differential_prevote_crash_n5(seed):
+    drop = [0.0, 0.1][seed % 2]
+    crash = [0.0, 0.06][(seed // 2) % 2]
+    run_differential(CFG5_PV, n_ticks=110, seed=seed, drop_rate=drop,
+                     crash_prob=crash)
+
+
+@pytest.mark.parametrize("seed", range(760, 780))
+def test_differential_prevote_partition_no_term_inflation(seed):
+    """The point of PreVote: a partitioned node must NOT inflate terms.
+    Partition a minority, heal, and check terms stayed flat while the
+    differential held per-tick."""
+    stats = run_differential(CFG5_PV, n_ticks=140, seed=seed, drop_rate=0.02,
+                             partition_at=(30, 90, 1))
+    # without pre_vote the cut-off node campaigns ~5x during the partition
+    # and would drag max_term up with it on heal
+    assert stats["max_term"] <= 4
+
+
+@pytest.mark.parametrize("seed", range(780, 800))
+def test_differential_prevote_mailbox_latency(seed):
+    drop = [0.0, 0.1][seed % 2]
+    run_differential(CFG5_PV_LAT, n_ticks=120, seed=seed, drop_rate=drop,
+                     crash_prob=0.04)
+
+
+@pytest.mark.parametrize("seed", range(800, 815))
+def test_differential_prevote_mailbox_jitter_n7(seed):
+    run_differential(CFG7_PV_JIT, n_ticks=110, seed=seed, drop_rate=0.12,
+                     crash_prob=0.05)
+
+
+# ---------------------------------------------------------------------------
+# Leader-transfer differential: TIMEOUT_NOW forced campaigns with
+# CAMPAIGN_TRANSFER lease bypass and proposal blocking mid-transfer.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", range(900, 925))
+def test_differential_leader_transfer_sync(seed):
+    drop = [0.0, 0.1][seed % 2]
+    stats = run_differential(CFG5, n_ticks=130, seed=seed, drop_rate=drop,
+                             transfer_every=25, prop_prob=0.6)
+    assert stats["max_commit"] > 0
+
+
+@pytest.mark.parametrize("seed", range(925, 945))
+def test_differential_leader_transfer_prevote(seed):
+    stats = run_differential(CFG5_PV, n_ticks=130, seed=seed, drop_rate=0.05,
+                             transfer_every=30, prop_prob=0.6)
+    assert stats["max_commit"] > 0
+
+
+@pytest.mark.parametrize("seed", range(945, 965))
+def test_differential_leader_transfer_mailbox(seed):
+    stats = run_differential(CFG5_LAT, n_ticks=140, seed=seed,
+                             transfer_every=30, prop_prob=0.6,
+                             crash_prob=0.03)
+    assert stats["max_commit"] > 0
+
+
+@pytest.mark.parametrize("seed", range(965, 980))
+def test_differential_leader_transfer_jitter_prevote(seed):
+    run_differential(CFG7_PV_JIT, n_ticks=120, seed=seed, drop_rate=0.08,
+                     transfer_every=35)
