@@ -138,7 +138,8 @@ class MeasureError(Exception):
 
 
 def measure(jax, n: int, entries: int, seed: int, election_tick: int,
-            latency: int = 0, latency_jitter: int = 0, **run_kw):
+            latency: int = 0, latency_jitter: int = 0, inflight: int = 1,
+            **run_kw):
     """Elect a leader, then time one compiled steady-state replication run of
     ~`entries` committed entries. Returns a dict of measurements; raises
     MeasureError if no leader emerges.
@@ -161,7 +162,8 @@ def measure(jax, n: int, entries: int, seed: int, election_tick: int,
     cfg = SimConfig(n=n, log_len=8192, window=2048, apply_batch=2048,
                     max_props=2048, keep=500, seed=seed,
                     election_tick=election_tick,
-                    latency=latency, latency_jitter=latency_jitter)
+                    latency=latency, latency_jitter=latency_jitter,
+                    inflight=inflight)
     ticks_needed = max(1, (entries + cfg.max_props - 1) // cfg.max_props)
     chunk = int(os.environ.get("BENCH_CHUNK_TICKS", "64"))
     n_chunks = (ticks_needed + chunk - 1) // chunk
@@ -330,10 +332,10 @@ def main() -> None:
             ("64-steady", 64, {}),
             ("1024-crash-every-100", 1024, {"crash_every": 100, "down_for": 5}),
             ("4096-drop-5pct", 4096, {"drop_rate": 0.05}),
-            # device-mailbox wire: per-edge latency 2 + jitter 1 (inflight
-            # window of 1 gates throughput to ~max_props per round trip)
-            ("1024-mailbox-lat2-jitter1", 1024,
-             {"latency": 2, "latency_jitter": 1}),
+            # device-mailbox wire: per-edge latency 2 + jitter 1 with a
+            # 4-deep pipelined append window (vendor MaxInflightMsgs)
+            ("1024-mailbox-lat2-jitter1-inflight4", 1024,
+             {"latency": 2, "latency_jitter": 1, "inflight": 4}),
         ):
             if on_cpu and cn > 256:
                 extra[name] = "skipped (cpu)"

@@ -28,15 +28,16 @@ Two network models share one delivery-processing path:
   no mailbox state allocated.
 - device-mailbox wire (cfg.latency/latency_jitter > 0; SURVEY §7's [N, N]
   in-flight slots): every message spends latency + hash-jitter ticks in a
-  per-edge, per-class slot (one in flight per directed edge — an inflight
-  window of 1), so delivery is delayed and jitter REORDERS messages across
+  per-edge, per-class slot (appends: cfg.inflight slots — a pipelined
+  window), so delivery is delayed and jitter REORDERS messages across
   edges.  Headers (term, prev) are captured at send; bodies are read from
   the sender's current ring at delivery, guarded by "sender role/term
   unchanged since send" (stale messages drop — always raft-safe, and the
   prefix (idx, term) content is immutable within a leader term).  At
-  latency 0 the slots pass messages through same-tick, reproducing the
-  synchronous semantics exactly (asserted by the differential gate's
-  force_mailboxes cases).
+  latency 0 the slots pass messages through same-tick, matching the
+  synchronous wire bit-for-bit on fault-free runs; under faults the
+  mailbox wire keeps its etcd flow-control semantics (gated by the
+  differential suite's force_mailboxes cases).
 Control flow divergence (leader vs candidate vs follower) is handled with
 `jnp.where` over role masks — there is no data-dependent Python control
 flow, so the whole step jits once and scans.
@@ -49,9 +50,11 @@ rejoining nodes; PreVote (campaignPreElection: non-binding poll at term+1,
 no term inflation from flapping nodes); leader transfer
 (transfer_leadership() + the TIMEOUT_NOW wire, with CAMPAIGN_TRANSFER
 lease bypass and proposal blocking while a transfer is in flight).
+Windowed flow control (cfg.inflight = vendor MaxInflightMsgs) pipelines
+appends on the mailbox wire with etcd's probe/replicate Progress states.
 Deliberately simplified vs the host golden core (swarmkit_tpu.raft.core):
-flow control is inflight-1 rather than windowed, and rejection hints are
-coarse (hint = follower last index).
+rejection hints are coarse (hint = follower last index), and the
+synchronous wire keeps its one-round-per-tick resend cadence.
 Safety properties (election safety, log matching, leader completeness) are
 preserved and asserted by tests/test_raft_sim.py invariant checks and the
 per-tick differential gate (tests/test_raft_sim_differential.py against the
@@ -173,12 +176,11 @@ def step(state: SimState, cfg: SimConfig,
     # follower via the Step catch-up, which then campaigns
     tn_ok = tn_due & up & active & (role != LEADER) & (tn_term >= term) \
         & ((role == FOLLOWER) | (tn_term > term))
-    tn_newer = tn_ok & (tn_term > term)   # Step catch-up before campaign
+    # Step catch-up for a higher-term TIMEOUT_NOW: only the term carries
+    # through — role/vote/lead are immediately overwritten by the forced
+    # campaign below (vendor becomeFollower(m.Term) then campaign)
+    tn_newer = tn_ok & (tn_term > term)
     term = jnp.where(tn_newer, tn_term, term)
-    vote = jnp.where(tn_newer, NONE, vote)
-    role = jnp.where(tn_newer, FOLLOWER, role)
-    lead = jnp.where(tn_newer, tn_from, lead)
-    pre = pre & ~tn_newer
     tn_at = jnp.where(tn_due, 0, tn_at)
 
     campaign = (up & (role != LEADER) & (elapsed >= timeout)) & ~tn_ok
@@ -380,6 +382,11 @@ def step(state: SimState, cfg: SimConfig,
     next_ = jnp.where(win[:, None], (last + 1)[:, None], next_)
     match = jnp.where(win[:, None], 0, match)
     recent_active = jnp.where(win[:, None], eye, recent_active)
+    if cfg.mailboxes:
+        # becomeLeader resets every Progress to StateProbe (vendor reset)
+        probing = jnp.where(win[:, None], True, state.probing)
+    else:
+        probing = None
     noop_slot = _slot(cfg, last + 1)
     log_term = log_term.at[node, noop_slot].set(
         jnp.where(win, term, log_term[node, noop_slot]))
@@ -391,37 +398,66 @@ def step(state: SimState, cfg: SimConfig,
 
     # ---- Phase C: append / heartbeat fan-out -----------------------------
     if cfg.mailboxes:
+        K = cfg.inflight
         app_at, app_prev = state.app_at, state.app_prev
         app_term_box = state.app_term
         snp_at, snp_term_box = state.snp_at, state.snp_term
-        # sends: ONE append or snapshot in flight per edge (inflight
-        # window of 1) — the next message leaves only after the previous
-        # one delivered (or went stale with the term)
-        free_edge = ((app_at == 0) | (app_term_box != term[:, None])) \
-            & ((snp_at == 0) | (snp_term_box != term[:, None]))
-        can_ring_send = (next_ - 1) >= snap_idx[:, None]
+        term_e = term[:, None]            # [i, 1] sender term per edge
+        term_k = term[:, None, None]      # [i, 1, 1] per slot
+        # sends: up to K appends pipeline per edge (vendor MaxInflightMsgs)
+        # with one NEW message per tick; next_ advances OPTIMISTICALLY by
+        # the entries known at send (etcd Replicate-state pipelining) and
+        # backtracks on rejection.  An idle edge (nothing new, nothing of
+        # this term in flight) still gets an empty append — the heartbeat.
+        free_k = (app_at == 0) | (app_term_box != term_k)         # [i,j,K]
+        any_free = jnp.any(free_k, axis=2)
+        slot_sel = jnp.argmax(free_k, axis=2)                     # [i, j]
+        onehot = slot_sel[:, :, None] == jnp.arange(K, dtype=I32)[None, None]
+        inflight_same = jnp.any((app_at != 0) & (app_term_box == term_k),
+                                axis=2)
+        snp_free = (snp_at == 0) | (snp_term_box != term_e)
+        prev_send = next_ - 1
+        can_ring_send = prev_send >= snap_idx[:, None]
+        has_new = next_ <= last[:, None]
         send_base = is_leader[:, None] & active[None, :] & ~eye & ~drop \
-            & free_edge
-        s_app = send_base & can_ring_send
-        s_snp = send_base & ~can_ring_send
-        app_at = jnp.where(s_app, now + 1 + lat, app_at)
-        app_prev = jnp.where(s_app, next_ - 1, app_prev)
-        app_term_box = jnp.where(s_app, term[:, None], app_term_box)
+            & snp_free
+        # StateProbe: one append at a time, no pipelining; StateReplicate:
+        # pipeline while a slot is free (vendor progress.go)
+        may = jnp.where(probing, ~inflight_same, has_new | ~inflight_same)
+        s_app = send_base & can_ring_send & any_free & may
+        s_snp = send_base & ~can_ring_send  # snp_free already in send_base
+        put = s_app[:, :, None] & onehot
+        app_at = jnp.where(put, (now + 1 + lat)[:, :, None], app_at)
+        app_prev = jnp.where(put, prev_send[:, :, None], app_prev)
+        app_term_box = jnp.where(put, term_k, app_term_box)
+        n_send = jnp.clip(last[:, None] - prev_send, 0, cfg.window)
+        # optimistic advance only in replicate state (optimisticUpdate)
+        next_ = jnp.where(s_app & has_new & ~probing, next_ + n_send, next_)
         snp_at = jnp.where(s_snp, now + 1 + lat, snp_at)
-        snp_term_box = jnp.where(s_snp, term[:, None], snp_term_box)
-        # deliveries: sender must still be the same-term leader, so ring
+        snp_term_box = jnp.where(s_snp, term_e, snp_term_box)
+        # deliveries: the wire drains AT MOST ONE append per edge per tick
+        # — the smallest-prev deliverable one; later-due messages wait
+        # their turn.  Sender must still be the same-term leader, so ring
         # reads at delivery see an immutable prefix; an append whose
         # captured prev was compacted since send is undeliverable and
-        # drops (the freed slot lets a snapshot go out next tick)
-        due_a = (app_at > 0) & (now + 1 >= app_at)
+        # drops (the freed slot lets a snapshot go out next tick).
+        due_k = (app_at > 0) & (now + 1 >= app_at)
+        lead_k = role[:, None, None] == LEADER
+        valid_k = due_k & lead_k & (app_term_box == term_k) \
+            & up[None, :, None] & (app_prev >= snap_idx[:, None, None])
+        big = jnp.iinfo(jnp.int32).max
+        key = jnp.where(valid_k, app_prev, big)
+        sel_prev = jnp.min(key, axis=2)                           # [i, j]
+        sel_slot = jnp.argmin(key, axis=2)
+        send_app = jnp.any(valid_k, axis=2)
+        taken = send_app[:, :, None] \
+            & (sel_slot[:, :, None] == jnp.arange(K, dtype=I32)[None, None])
+        # clear the delivered slot and every due-but-invalid (stale) slot
+        app_at = jnp.where(taken | (due_k & ~valid_k), 0, app_at)
         due_s = (snp_at > 0) & (now + 1 >= snp_at)
-        lead_ok = role[:, None] == LEADER
-        send_app = due_a & lead_ok & (term[:, None] == app_term_box) \
-            & up[None, :] & (app_prev >= snap_idx[:, None])
-        send_snap = due_s & lead_ok & (term[:, None] == snp_term_box) \
-            & up[None, :]
-        prev_mat = app_prev
-        app_at = jnp.where(due_a, 0, app_at)
+        send_snap = due_s & (role[:, None] == LEADER) \
+            & (term_e == snp_term_box) & up[None, :]
+        prev_mat = sel_prev
         snp_at = jnp.where(due_s, 0, snp_at)
     else:
         prev_mat = next_ - 1                                     # [i, j]
@@ -472,7 +508,13 @@ def step(state: SimState, cfg: SimConfig,
     p_term_sent = jnp.where(
         p == snap_src, snap_term[src],
         jnp.where((p > snap_src) & (p <= last_src), p_ring_term, 0))
-    n_avail = jnp.clip(last_src - p, 0, cfg.window)
+    # Window clamp for ring safety: accepting past snap_idx + L would wrap
+    # the receiver's ring over entries it has not applied yet (a pipelining
+    # leader can run its log far ahead of a catching-up follower's
+    # compaction watermark).  The clamped remainder arrives after the
+    # follower applies + compacts and headroom opens up.
+    ring_cap = snap_idx + cfg.log_len - p                        # [j]
+    n_avail = jnp.clip(jnp.minimum(last_src - p, ring_cap), 0, cfg.window)
     hi = p + n_avail                                             # lastnewi
 
     commit0 = commit  # pre-append commit (handleAppendEntries fast path)
@@ -539,21 +581,35 @@ def step(state: SimState, cfg: SimConfig,
     if cfg.mailboxes:
         aresp_at, aresp_term = state.aresp_at, state.aresp_term
         aresp_match, aresp_ok = state.aresp_match, state.aresp_ok
+        big = jnp.iinfo(jnp.int32).max
+        kr_idx = jnp.arange(cfg.ack_depth, dtype=I32)[None, None]
+        # enqueue into the first free slot — cfg.ack_depth guarantees one
+        # exists (acks arrive at most once per tick per edge and live at
+        # most latency+jitter ticks), so no eviction policy is needed
         send_ar = is_resp_tgt & has_lmsg[None, :] & ~drop.T
-        aresp_at = jnp.where(send_ar, now + 1 + lat.T, aresp_at)
-        aresp_term = jnp.where(send_ar, term[None, :], aresp_term)
-        aresp_ok = jnp.where(send_ar, resp_ok[None, :], aresp_ok)
+        free_r = aresp_at == 0
+        wslot = jnp.argmax(free_r, axis=2).astype(I32)
+        put_r = send_ar[:, :, None] & (wslot[:, :, None] == kr_idx)
+        aresp_at = jnp.where(put_r, (now + 1 + lat.T)[:, :, None], aresp_at)
+        aresp_term = jnp.where(put_r, term[None, :, None], aresp_term)
+        aresp_ok = jnp.where(put_r, resp_ok[None, :, None], aresp_ok)
         aresp_match = jnp.where(
-            send_ar,
-            jnp.where(resp_reject[None, :], reject_hint[None, :],
-                      resp_match[None, :]),
+            put_r,
+            jnp.where(resp_reject, reject_hint, resp_match)[None, :, None],
             aresp_match)
-        due_ar = (aresp_at > 0) & (now + 1 >= aresp_at)
-        arvalid = due_ar & is_leader[:, None] & (term[:, None] == aresp_term)
-        ok_mat = arvalid & aresp_ok
-        rej_mat = arvalid & ~aresp_ok
-        aresp_at = jnp.where(due_ar, 0, aresp_at)
-        resp_match_del = reject_hint_del = aresp_match
+        # deliveries: ALL due acks integrate this tick, aggregated (ok:
+        # max match; reject: min hint — applied after the ok advance, the
+        # conservative order)
+        due_r = (aresp_at > 0) & (now + 1 >= aresp_at)
+        val_r = due_r & is_leader[:, None, None] \
+            & (term[:, None, None] == aresp_term)
+        ok_k = val_r & aresp_ok
+        rej_k = val_r & ~aresp_ok
+        ok_mat = jnp.any(ok_k, axis=2)
+        rej_mat = jnp.any(rej_k, axis=2)
+        resp_match_del = jnp.max(jnp.where(ok_k, aresp_match, -1), axis=2)
+        reject_hint_del = jnp.min(jnp.where(rej_k, aresp_match, big), axis=2)
+        aresp_at = jnp.where(due_r, 0, aresp_at)
     else:
         arrive_back = ~drop.T & is_resp_tgt & is_leader[:, None] \
             & has_lmsg[None, :]
@@ -563,13 +619,35 @@ def step(state: SimState, cfg: SimConfig,
         reject_hint_del = reject_hint[None, :]
     # any response marks the peer recently-active for CheckQuorum
     recent_active = recent_active | ok_mat | rej_mat
-    match = jnp.where(ok_mat, jnp.maximum(match, resp_match_del), match)
-    next_ = jnp.where(ok_mat, jnp.maximum(next_, resp_match_del + 1), next_)
+    if cfg.mailboxes:
+        # vendor stepLeader MsgAppResp: maybeUpdate advances match (and
+        # next to at least m+1); a match ADVANCE on a probing edge enters
+        # replicate with next = match+1 EXACTLY (becomeReplicate may lower
+        # an optimistic next)
+        adv = ok_mat & (resp_match_del > match)
+        to_repl = adv & probing
+        match = jnp.where(ok_mat, jnp.maximum(match, resp_match_del), match)
+        next_ = jnp.where(
+            to_repl, resp_match_del + 1,
+            jnp.where(ok_mat, jnp.maximum(next_, resp_match_del + 1), next_))
+        probing = probing & ~to_repl
+    else:
+        match = jnp.where(ok_mat, jnp.maximum(match, resp_match_del), match)
+        next_ = jnp.where(ok_mat,
+                          jnp.maximum(next_, resp_match_del + 1), next_)
     # Probe decrement (maybeDecrTo, coarse): jump next back to the hint.
     next_ = jnp.where(
         rej_mat,
         jnp.maximum(1, jnp.minimum(next_ - 1, reject_hint_del + 1)),
         next_)
+    if cfg.mailboxes:
+        probing = probing | rej_mat   # becomeProbe on rejection
+        # probe reset flush: optimistically pipelined appends beyond the
+        # conflict are now useless — clear the edge's same-term in-flight
+        # slots so the backtracked window goes out instead of waiting
+        app_at = jnp.where(
+            rej_mat[:, :, None] & (app_term_box == term[:, None, None]),
+            0, app_at)
 
     # -- leader transfer completion: once the target's log caught up,
     # fire TIMEOUT_NOW on its wire slot (vendor stepLeader MsgAppResp
@@ -655,7 +733,7 @@ def step(state: SimState, cfg: SimConfig,
             vresp_at=vresp_at, vresp_term=vresp_term,
             vresp_grant=vresp_grant, vresp_pre=vresp_pre,
             app_at=app_at, app_prev=app_prev, app_term=app_term_box,
-            snp_at=snp_at, snp_term=snp_term_box,
+            snp_at=snp_at, snp_term=snp_term_box, probing=probing,
             aresp_at=aresp_at, aresp_term=aresp_term,
             aresp_match=aresp_match, aresp_ok=aresp_ok)
     return dataclasses.replace(

@@ -35,12 +35,17 @@ divergences.
     equal-term rejections count toward the rejection quorum exactly as
     etcd's poll does. Mask: _prevote_exchange_sync/_tick_mailbox enqueue
     only countable rejections.
- D3 flow control is inflight-1, not windowed: on the synchronous wire the
-    kernel re-sends the window from next_ every tick; on the mailbox wire
-    exactly one append rides each edge at a time — etcd pipelines up to
-    max_inflight_msgs with probe pausing and optimistic next updates.
+ D3' windowed flow control IS implemented on the mailbox wire
+    (cfg.inflight = vendor MaxInflightMsgs): up to K appends pipeline per
+    edge with optimistic next advance in StateReplicate, becomeReplicate's
+    exact next=match+1 on the probe->replicate flip, and becomeProbe +
+    pipeline flush on rejection — replayed here via per-edge queues and
+    core's own Progress states.  The synchronous wire still re-sends the
+    window from next_ every tick (its whole point is one-tick rounds).
     Mask: SyncRaft._send_append is a side-effect-free windowed send, and
-    _tick_mailbox captures prev at send exactly like the kernel.
+    _tick_mailbox mirrors the kernel's send gating and aggregate-ack
+    integration (all due acks per edge per tick: max match, then one
+    min-hint rejection fallback).
  D4 timer scope: kernel election timers reset on (a) own campaign,
     (b) granting a vote, (c) receiving a current-term leader message,
     (d) a leader's CheckQuorum round, and re-randomize only at campaign
@@ -106,6 +111,17 @@ class SyncRaft(core.Raft):
         super().__init__(cfg)
         self.window = window
         self.suppress = False
+        self.cluster = None   # backref set by OracleCluster (ring clamp)
+
+    def _ring_limit(self, to: int, prev: int) -> int:
+        """Receiver ring headroom (kernel's snap_idx + L - prev clamp):
+        a window past it would wrap the fixed-width device ring over
+        unapplied entries."""
+        if self.cluster is None:
+            return self.window
+        rcv = self.cluster.nodes[to - 1]
+        cap = rcv.log.offset + self.cluster.cfg.log_len - prev
+        return max(0, min(self.window, cap))
 
     def _send_append(self, to: int) -> None:
         if self.suppress:
@@ -115,7 +131,7 @@ class SyncRaft(core.Raft):
         try:
             prev_term = self.log.term(prev)
             ents = self.log.slice(pr.next, self.log.last_index() + 1,
-                                  self.window)
+                                  self._ring_limit(to, prev))
         except (CompactedError, UnavailableError):
             meta = SnapshotMeta(index=self.log.offset,
                                 term=self.log.offset_term,
@@ -177,6 +193,8 @@ class OracleCluster:
                      window=cfg.window)
             for i in range(n)
         ]
+        for nd in self.nodes:
+            nd.cluster = self
         self.elapsed = [0] * n
         self.timeout = [rand_timeout_py(cfg, i, 0) for i in range(n)]
         self.applied = [0] * n
@@ -195,12 +213,16 @@ class OracleCluster:
         # leader transfer mirrors (kernel transferee/tx_cand/tn_* wires)
         self.tx_term: dict[int, int] = {}   # i -> term of tx-born candidacy
         self.tnq: dict[int, tuple[int, int, int]] = {}  # tgt -> (at, tm, frm)
-        self.vreq: dict[tuple[int, int], tuple[int, int]] = {}
+        # vreq: (deliver_at, sender_term, is_pre) per edge
+        self.vreq: dict[tuple[int, int], tuple[int, int, bool]] = {}
         # (deliver_at, candidacy_term, grant, is_pre)
         self.vresp: dict[tuple[int, int], tuple[int, int, bool, bool]] = {}
-        self.appq: dict[tuple[int, int], tuple[int, int, int]] = {}
+        # appq: per-edge pipelined list of (deliver_at, prev, term)
+        self.appq: dict[tuple[int, int], list[tuple[int, int, int]]] = {}
         self.snpq: dict[tuple[int, int], tuple[int, int]] = {}
-        self.arespq: dict[tuple[int, int], tuple[int, int, Message]] = {}
+        # arespq: per-edge list of (deliver_at, term, resp) — capacity is
+        # unbounded here; the kernel's ack_depth guarantees the same set
+        self.arespq: dict[tuple[int, int], list[tuple[int, int, Message]]] = {}
 
     def _lat(self, i: int, j: int, tick: int) -> int:
         """Python mirror of state.latency_matrix for one edge."""
@@ -696,40 +718,71 @@ class OracleCluster:
                     self.recent_active[i] = set()
 
         # ---- Phase C: append/snapshot wire ----
-        # sends: leaders fill edges with no same-term message in flight
+        # sends: up to cfg.inflight appends pipeline per edge, one NEW one
+        # per tick, with pr.next advanced optimistically by the entries
+        # known at send (kernel n_send; etcd Replicate-state pipelining).
+        # Entries from a stale candidacy never deliver on either side, so
+        # they are pruned eagerly here.
+        K = cfg.inflight
         for i, nd in enumerate(nodes):
             if not up[i] or nd.state != core.LEADER:
                 continue
             for j in range(n):
                 if j == i or drop[i][j]:
                     continue
-                a = self.appq.get((i, j))
+                q = [e for e in self.appq.get((i, j), [])
+                     if e[2] == nd.term]
+                self.appq[(i, j)] = q
                 s = self.snpq.get((i, j))
-                if (a is not None and a[2] == nd.term) \
-                        or (s is not None and s[1] == nd.term):
-                    continue  # inflight window of 1 per edge
-                prev = nd.prs[j + 1].next - 1
+                if s is not None and s[1] == nd.term:
+                    continue   # snapshot in flight blocks the edge
+                pr = nd.prs[j + 1]
+                prev = pr.next - 1
+                last = nd.log.last_index()
+                has_new = pr.next <= last
+                probing = pr.state == core.PROBE
                 if prev >= nd.log.offset:
-                    self.appq[(i, j)] = (now + self._lat(i, j, now), prev,
-                                         nd.term)
+                    # StateProbe: one append at a time, no optimism;
+                    # StateReplicate: pipeline while a slot is free
+                    if probing:
+                        if q:
+                            continue
+                    elif len(q) >= K or not (has_new or not q):
+                        continue
+                    q.append((now + self._lat(i, j, now), prev, nd.term))
+                    if has_new and not probing:  # optimisticUpdate
+                        pr.next = prev + min(cfg.window, last - prev) + 1
                 else:
                     self.snpq[(i, j)] = (now + self._lat(i, j, now), nd.term)
-        # deliveries: construct messages from the sender's CURRENT state
+        # deliveries: the wire drains AT MOST ONE append per edge per tick
+        # — the smallest-prev deliverable one; construct messages from the
+        # sender's CURRENT state
         out: list[tuple[int, int, Message]] = []
-        for (i, j) in sorted(k for k, v in self.appq.items() if v[0] <= now):
-            _, prev, tm = self.appq.pop((i, j))
+        for (i, j) in sorted(self.appq):
+            q = self.appq[(i, j)]
             nd = nodes[i]
-            if nd.state != core.LEADER or nd.term != tm or not up[j]:
+            due = [e for e in q if e[0] <= now]
+            if not due:
                 continue
-            if prev < nd.log.offset:
-                continue  # compacted since send; a snapshot goes out next
-            prev_term = nd.log.term(prev)
-            ents = nd.log.slice(prev + 1, nd.log.last_index() + 1,
-                                cfg.window)
-            out.append((i, j, Message(
-                type=MsgType.APP, to=j + 1, frm=nd.id, term=nd.term,
-                index=prev, log_term=prev_term, entries=tuple(ents),
-                commit=nd.log.committed)))
+            # stale/undeliverable due entries clear without delivering
+            deliverable = []
+            for e in due:
+                if nd.state != core.LEADER or nd.term != e[2] \
+                        or not up[j] or e[1] < nd.log.offset:
+                    continue   # cleared
+                deliverable.append(e)
+            if deliverable:
+                sel = min(deliverable, key=lambda e: e[1])
+                deliverable.remove(sel)
+                _, prev, tm = sel
+                prev_term = nd.log.term(prev)
+                ents = nd.log.slice(prev + 1, nd.log.last_index() + 1,
+                                    nd._ring_limit(j + 1, prev))
+                out.append((i, j, Message(
+                    type=MsgType.APP, to=j + 1, frm=nd.id, term=nd.term,
+                    index=prev, log_term=prev_term, entries=tuple(ents),
+                    commit=nd.log.committed)))
+            self.appq[(i, j)] = [e for e in q if e[0] > now] + deliverable
         for (i, j) in sorted(k for k, v in self.snpq.items() if v[0] <= now):
             _, tm = self.snpq.pop((i, j))
             nd = nodes[i]
@@ -749,22 +802,45 @@ class OracleCluster:
                 nodes[j].step(m)
                 for resp in nodes[j].take_msgs():
                     if resp.type == MsgType.APP_RESP and not drop[j][i]:
-                        self.arespq[(i, j)] = (
-                            now + self._lat(j, i, now), m.term, resp)
+                        rq = self.arespq.setdefault((i, j), [])
+                        rq.append((now + self._lat(j, i, now), m.term, resp))
                 if m.term == nodes[j].term:
                     self.elapsed[j] = 0
-        # response deliveries
-        for (i, j) in sorted(k for k, v in self.arespq.items()
-                             if v[0] <= now):
-            _, tm, resp = self.arespq.pop((i, j))
-            nd = nodes[i]
-            if not up[i] or nd.state != core.LEADER or nd.term != tm:
+        # response deliveries: ALL due acks integrate, oks first (core's
+        # match/next merges are monotone), then ONE aggregate rejection
+        # fallback with the min hint (the kernel's conservative order)
+        for (i, j) in sorted(self.arespq):
+            rq = self.arespq[(i, j)]
+            due = [e for e in rq if e[0] <= now]
+            if not due:
                 continue
-            self.recent_active[i].add(j)  # kernel: any resp arrival
-            nd.suppress = True
-            nd.step(resp)
-            nd.suppress = False
-            nd.take_msgs()
+            self.arespq[(i, j)] = [e for e in rq if e[0] > now]
+            nd = nodes[i]
+            oks = []
+            rej_hints = []
+            for _, tm, resp in due:
+                if not up[i] or nd.state != core.LEADER or nd.term != tm:
+                    continue
+                self.recent_active[i].add(j)  # kernel: any resp arrival
+                if resp.reject:
+                    rej_hints.append(resp.reject_hint)
+                else:
+                    oks.append(resp)
+            for resp in oks:
+                nd.suppress = True
+                nd.step(resp)
+                nd.suppress = False
+                nd.take_msgs()
+            if rej_hints and nd.state == core.LEADER:
+                # kernel reject rule + becomeProbe (flush pipelined
+                # same-term appends past the conflict)
+                pr = nd.prs[j + 1]
+                pr.next = max(1, min(pr.next - 1, min(rej_hints) + 1))
+                pr.state = core.PROBE
+                pr.inflights = []
+                pr.paused = False
+                self.appq[(i, j)] = [e for e in self.appq.get((i, j), [])
+                                     if e[2] != nd.term]
 
         self._transfer_fire(up, drop)
         self._phase_def(up)

@@ -54,6 +54,13 @@ class SimConfig:
     # (sender term/role changed since send) dropped at delivery.
     latency: int = 0
     latency_jitter: int = 0
+    # Append pipelining depth on the mailbox wire (vendor MaxInflightMsgs,
+    # reference swarmkit uses 256): up to `inflight` appends ride each
+    # directed edge concurrently, with optimistic next_ advance at send and
+    # rejection backtracking (etcd Replicate-state pipeline).  Depth ~RTT
+    # sustains full window throughput per tick.  Vote/snapshot classes stay
+    # single-slot (etcd also serializes those).
+    inflight: int = 1
     # testing knob: run the mailbox wire even at latency 0 (same-tick
     # delivery) — must be decision-identical to the synchronous path
     force_mailboxes: bool = False
@@ -64,6 +71,14 @@ class SimConfig:
     pre_vote: bool = False
 
     @property
+    def ack_depth(self) -> int:
+        """Ack-wire slots per edge: acks are generated at most once per
+        tick per edge and live latency..latency+jitter ticks, so this
+        depth can NEVER overflow — no eviction policy to keep in sync
+        between kernel and oracle."""
+        return self.latency + self.latency_jitter + 1
+
+    @property
     def mailboxes(self) -> bool:
         return self.latency > 0 or self.latency_jitter > 0 \
             or self.force_mailboxes
@@ -72,6 +87,9 @@ class SimConfig:
         assert self.apply_batch >= self.max_props
         assert self.log_len > self.keep + 2 * self.max_props + self.window
         assert self.latency >= 0 and self.latency_jitter >= 0
+        assert self.inflight >= 1
+        assert self.inflight == 1 or self.mailboxes, \
+            "append pipelining requires the mailbox wire"
         if self.mailboxes:
             # a full round trip must fit well inside the election timeout or
             # healthy leaders get deposed by their own followers
@@ -144,15 +162,23 @@ class SimState:
     vresp_term: Optional[jax.Array] = None
     vresp_grant: Optional[jax.Array] = None  # bool
     vresp_pre: Optional[jax.Array] = None    # bool: response to a PreVote
-    app_at: Optional[jax.Array] = None      # i -> j append
-    app_prev: Optional[jax.Array] = None
-    app_term: Optional[jax.Array] = None
+    app_at: Optional[jax.Array] = None      # i -> j append [N, N, K]
+    app_prev: Optional[jax.Array] = None    # (K = cfg.inflight pipelining
+    app_term: Optional[jax.Array] = None    #  depth; delivery drains one
+                                            #  per edge per tick, smallest
+                                            #  prev first)
     snp_at: Optional[jax.Array] = None      # i -> j snapshot install
     snp_term: Optional[jax.Array] = None
+    probing: Optional[jax.Array] = None     # bool [N, N]: edge is in etcd
+                                            # StateProbe (one append at a
+                                            # time, no optimistic next);
+                                            # an accepted ack flips it to
+                                            # replicate, a rejection back
     aresp_at: Optional[jax.Array] = None    # j -> i append/snap response
-    aresp_term: Optional[jax.Array] = None
-    aresp_match: Optional[jax.Array] = None
-    aresp_ok: Optional[jax.Array] = None    # bool (False = rejection)
+    aresp_term: Optional[jax.Array] = None  # [N, N, ack_depth]: one ack is
+    aresp_match: Optional[jax.Array] = None  # generated per delivery, and
+    aresp_ok: Optional[jax.Array] = None    # deliveries aggregate (max
+                                            # match / min reject hint)
 
 
 def init_state(cfg: SimConfig) -> SimState:
@@ -167,10 +193,14 @@ def init_state(cfg: SimConfig) -> SimState:
             vresp_pre=jnp.zeros((n, n), jnp.bool_),
             vresp_at=z(n, n), vresp_term=z(n, n),
             vresp_grant=jnp.zeros((n, n), jnp.bool_),
-            app_at=z(n, n), app_prev=z(n, n), app_term=z(n, n),
+            app_at=z(n, n, cfg.inflight), app_prev=z(n, n, cfg.inflight),
+            app_term=z(n, n, cfg.inflight),
             snp_at=z(n, n), snp_term=z(n, n),
-            aresp_at=z(n, n), aresp_term=z(n, n), aresp_match=z(n, n),
-            aresp_ok=jnp.zeros((n, n), jnp.bool_))
+            probing=jnp.ones((n, n), jnp.bool_),
+            aresp_at=z(n, n, cfg.ack_depth),
+            aresp_term=z(n, n, cfg.ack_depth),
+            aresp_match=z(n, n, cfg.ack_depth),
+            aresp_ok=jnp.zeros((n, n, cfg.ack_depth), jnp.bool_))
     return SimState(
         **boxes,
         term=z(n),

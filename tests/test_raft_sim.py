@@ -415,6 +415,12 @@ class TestLatencyMailboxes:
                   "match", "next_", "granted", "rejected", "recent_active")
 
     def test_mailbox_at_latency_zero_matches_sync_path(self):
+        """On a FAULT-FREE schedule the two wires coincide bit-for-bit at
+        latency 0.  (Under faults they intentionally differ: the mailbox
+        wire carries etcd flow control — optimistic next survives a
+        dropped ack, sends are not gated on receiver liveness — while the
+        sync wire re-sends from next_ every tick.  The faulty regimes are
+        covered by the forced-mailbox differential gate instead.)"""
         base = dict(n=7, log_len=256, window=16, apply_batch=32,
                     max_props=16, election_tick=10, keep=8, seed=11)
         cfg_s = SimConfig(**base)
@@ -426,10 +432,8 @@ class TestLatencyMailboxes:
             pay = jnp.arange(cfg_s.max_props, dtype=jnp.uint32) + t * 31
             s1 = propose_j(s1, cfg_s, pay, cnt)
             s2 = propose_j(s2, cfg_m, pay, cnt)
-            alive = jnp.asarray(rng.random(7) > 0.05)
-            drop = jnp.asarray(rng.random((7, 7)) < 0.1)
-            s1 = step_j(s1, cfg_s, alive=alive, drop=drop)
-            s2 = step_j(s2, cfg_m, alive=alive, drop=drop)
+            s1 = step_j(s1, cfg_s)
+            s2 = step_j(s2, cfg_m)
             for f in self.CMP_FIELDS:
                 a = np.asarray(getattr(s1, f))
                 b = np.asarray(getattr(s2, f))
@@ -619,3 +623,74 @@ class TestPreVoteAndTransfer:
                        jnp.asarray(4))
         assert int(np.asarray(st.last)[lead]) == last0 + 4, \
             "proposals must flow again after the abort"
+
+
+class TestPipelinedAppends:
+    """Windowed inflight pipelining (vendor MaxInflightMsgs + the
+    probe/replicate Progress states) on the mailbox wire."""
+
+    def test_throughput_scales_with_depth(self):
+        """The point of pipelining: K appends in flight over a lat-2 wire
+        must commit ~K times faster than inflight-1 (until proposal-bound)."""
+        rates = {}
+        for K in (1, 2, 4):
+            cfg = SimConfig(n=5, log_len=512, window=16, apply_batch=64,
+                            max_props=16, keep=8, seed=3, election_tick=14,
+                            latency=2, inflight=K)
+            st = init_state(cfg)
+            lt = None
+            for t in range(300):
+                st = step_j(st, cfg)
+                if lt is None and len(leaders_of(st)) == 1:
+                    lt = t
+                if lt is not None:
+                    st = propose_j(
+                        st, cfg, jnp.arange(cfg.max_props, dtype=jnp.uint32),
+                        jnp.asarray(16))
+            rates[K] = int(np.asarray(st.commit).max()) / (300 - lt)
+        assert rates[2] > 1.7 * rates[1], rates
+        assert rates[4] > 2.5 * rates[1], rates
+
+    def test_pipeline_survives_drops_and_crashes(self):
+        cfg = SimConfig(n=7, log_len=256, window=16, apply_batch=32,
+                        max_props=8, keep=8, seed=13, election_tick=16,
+                        latency=2, latency_jitter=1, inflight=3)
+        rng = np.random.default_rng(9)
+
+        def crash(t, st):
+            return rng.random(cfg.n) > 0.06
+
+        st, chk = drive(cfg, 400, prop_count=4, drop_rate=0.1, crash=crash)
+        assert np.asarray(st.commit).max() > 0
+        assert len(chk.term_leaders) >= 1
+
+    def test_rejection_backtracks_and_recovers(self):
+        """A follower revived with a divergent-suffix-free gap: the leader's
+        optimistic pipeline overshoots, the rejection flips the edge back
+        to probe, and the follower still converges to the tip."""
+        cfg = SimConfig(n=5, log_len=512, window=16, apply_batch=64,
+                        max_props=16, keep=8, seed=5, election_tick=16,
+                        latency=2, inflight=4)
+        st = init_state(cfg)
+        lt = None
+        for t in range(60):
+            st = step_j(st, cfg)
+            if len(leaders_of(st)) == 1:
+                lt = t
+                break
+        (lead,) = leaders_of(st)
+        victim = int((lead + 1) % cfg.n)
+        alive = np.ones(cfg.n, bool)
+        alive[victim] = False
+        for _ in range(30):
+            st = propose_j(st, cfg,
+                           jnp.arange(cfg.max_props, dtype=jnp.uint32),
+                           jnp.asarray(8))
+            st = step_j(st, cfg, alive=jnp.asarray(alive))
+        for _ in range(200):
+            st = step_j(st, cfg)
+            if int(np.asarray(st.commit)[victim]) \
+                    == int(np.asarray(st.commit).max()):
+                break
+        assert int(np.asarray(st.commit)[victim]) \
+            == int(np.asarray(st.commit).max()), "victim never converged"

@@ -340,3 +340,47 @@ def test_differential_leader_transfer_mailbox(seed):
 def test_differential_leader_transfer_jitter_prevote(seed):
     run_differential(CFG7_PV_JIT, n_ticks=120, seed=seed, drop_rate=0.08,
                      transfer_every=35)
+
+
+# ---------------------------------------------------------------------------
+# Pipelined-append differential (vendor MaxInflightMsgs): K appends ride
+# each edge with optimistic next / probe-replicate transitions.
+# ---------------------------------------------------------------------------
+
+CFG3_K2 = SimConfig(n=3, log_len=64, window=8, apply_batch=16, max_props=8,
+                    keep=4, election_tick=12, seed=801, latency=1,
+                    inflight=2)
+CFG5_K3 = SimConfig(n=5, log_len=64, window=8, apply_batch=16, max_props=8,
+                    keep=4, election_tick=14, seed=802, latency=2,
+                    inflight=3)
+CFG5_K4_JIT = SimConfig(n=5, log_len=64, window=8, apply_batch=16,
+                        max_props=8, keep=4, election_tick=18, seed=803,
+                        latency=2, latency_jitter=2, inflight=4,
+                        pre_vote=True)
+
+
+@pytest.mark.parametrize("seed", range(820, 850))
+def test_differential_pipelined_k2_n3(seed):
+    drop = [0.0, 0.05, 0.15][seed % 3]
+    run_differential(CFG3_K2, n_ticks=120, seed=seed, drop_rate=drop)
+
+
+@pytest.mark.parametrize("seed", range(850, 880))
+def test_differential_pipelined_k3_crash_n5(seed):
+    drop = [0.0, 0.1][seed % 2]
+    crash = [0.0, 0.05][(seed // 2) % 2]
+    run_differential(CFG5_K3, n_ticks=120, seed=seed, drop_rate=drop,
+                     crash_prob=crash)
+
+
+@pytest.mark.parametrize("seed", range(880, 900))
+def test_differential_pipelined_k4_jitter_prevote(seed):
+    run_differential(CFG5_K4_JIT, n_ticks=130, seed=seed, drop_rate=0.1,
+                     crash_prob=0.04)
+
+
+@pytest.mark.parametrize("seed", range(900, 910))
+def test_differential_pipelined_transfer(seed):
+    stats = run_differential(CFG5_K3, n_ticks=140, seed=seed,
+                             transfer_every=35, prop_prob=0.7)
+    assert stats["max_commit"] > 0
