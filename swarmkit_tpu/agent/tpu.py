@@ -113,6 +113,49 @@ def _builtin_axpy(params: dict):
     return fn, (x, x * 0.5)
 
 
+def _builtin_pmatmul(params: dict):
+    """Sharded bf16 matmul chain over ALL local devices: the batch axis is
+    sharded on a 1-D mesh, each step does a local matmul on the MXU plus a
+    cross-device `psum` of activation norms over ICI (shard_map + jax.lax
+    collectives — the multi-chip execution path of a task program).  On a
+    single device this degenerates to `matmul` with an extra reduction."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax import shard_map
+
+    n = int(params.get("n", 256))
+    steps = int(params.get("steps", 4))
+    batch = int(params.get("batch", 8))
+    devices = jax.devices()
+    d = len(devices)
+    while d > 1 and batch % d != 0:
+        d -= 1
+    mesh = Mesh(devices[:d], axis_names=("batch",))
+
+    key = jax.random.PRNGKey(int(params.get("seed", 0)))
+    a = jax.random.normal(key, (n, n), dtype=jnp.bfloat16)
+    x = jax.random.normal(jax.random.PRNGKey(1), (batch, n, n),
+                          dtype=jnp.bfloat16)
+    x = jax.device_put(x, NamedSharding(mesh, P("batch")))
+
+    def local_step(xs):
+        def body(carry, _):
+            y = (carry @ a).astype(jnp.bfloat16)
+            # cross-device normalization: psum of squared norms over ICI
+            sq = jnp.mean(jnp.square(y.astype(jnp.float32)))
+            total = jax.lax.psum(sq, "batch")
+            y = y / jnp.maximum(jnp.sqrt(total / d), 1e-6).astype(jnp.bfloat16)
+            return y, ()
+        out, _ = jax.lax.scan(body, xs, None, length=steps)
+        # replicated scalar result: psum the local contributions
+        return jax.lax.psum(jnp.sum(out.astype(jnp.float32)), "batch")
+
+    fn = shard_map(local_step, mesh=mesh, in_specs=P("batch"),
+                   out_specs=P())
+    return fn, (x,)
+
+
 def _builtin_spin(params: dict):
     """Fixed-length device scan — a long-running task for lifecycle tests."""
     import jax
@@ -130,6 +173,7 @@ def _builtin_spin(params: dict):
 
 
 register_program("matmul", _builtin_matmul)
+register_program("pmatmul", _builtin_pmatmul)
 register_program("axpy", _builtin_axpy)
 register_program("spin", _builtin_spin)
 
