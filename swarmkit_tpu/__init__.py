@@ -24,9 +24,13 @@ Layout:
     transport/  device-mesh mailbox transport behind the Transport seam
     parallel/   mesh + sharding helpers for the batched raft state
     manager/    control plane services and leader loops
-    agent/      worker/executor side
+    agent/      worker/executor side (incl. the TPU task runtime)
+    node/       node lifecycle: joins, role flips, manager supervision
     ca/         certificate authority + TLS identities
-    utils/      ids, clocks, logging
+    encryption/ at-rest encryption primitives (WAL/snap DEKs)
+    native/     C++ hot-path components (WAL codec), ctypes-loaded
+    cmd/        swarmd / swarmctl / rafttool / swarm-bench / external-ca
+    utils/      ids, clocks, metrics, logging
 """
 
 __version__ = "0.1.0"

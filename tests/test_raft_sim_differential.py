@@ -384,3 +384,20 @@ def test_differential_pipelined_transfer(seed):
     stats = run_differential(CFG5_K3, n_ticks=140, seed=seed,
                              transfer_every=35, prop_prob=0.7)
     assert stats["max_commit"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Wider-cluster mailbox differential: n=15 exercises quorum math, multi-way
+# vote splits and fan-in aggregation at a size past the toy configs.
+# ---------------------------------------------------------------------------
+
+CFG15 = SimConfig(n=15, log_len=64, window=8, apply_batch=16, max_props=8,
+                  keep=4, election_tick=20, seed=901, latency=2,
+                  latency_jitter=1, inflight=2, pre_vote=True)
+
+
+@pytest.mark.parametrize("seed", range(910, 925))
+def test_differential_wide_cluster_mailbox(seed):
+    drop = [0.0, 0.1][seed % 2]
+    run_differential(CFG15, n_ticks=100, seed=seed, drop_rate=drop,
+                     crash_prob=0.03)
