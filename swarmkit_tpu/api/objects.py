@@ -103,13 +103,22 @@ class Network(Message):
 
 
 @dataclass
+class RootRotation(Message):
+    """In-flight root-CA rotation (reference: api/ca.proto RootRotation):
+    the new root + its cert cross-signed by the old root."""
+    ca_cert: bytes = b""
+    ca_key: bytes = b""
+    cross_signed_ca_cert: bytes = b""
+
+
+@dataclass
 class RootCA(Message):
     ca_key: bytes = b""
     ca_cert: bytes = b""
     ca_cert_hash: str = ""
     join_token_worker: str = ""
     join_token_manager: str = ""
-    root_rotation: Optional[dict] = None
+    root_rotation: Optional[RootRotation] = None
 
 
 @dataclass

@@ -92,6 +92,9 @@ def _csr_for_key(key, node_id: str) -> bytes:
 class IssuedCertificate:
     cert_pem: bytes
     key_pem: Optional[bytes]   # None when signed from an external CSR
+    # current CA trust bundle (old+new during a root rotation) — renewal
+    # responses carry it so nodes refresh their trust store in step
+    root_bundle: bytes = b""
 
 
 class RootCA:
@@ -123,6 +126,11 @@ class RootCA:
                     seconds=ROOT_CA_EXPIRATION))
                 .add_extension(x509.BasicConstraints(ca=True, path_length=None),
                                critical=True)
+                # SKI/AKI disambiguate chain building: a rotation's old and
+                # new roots share the same subject CN, and without key ids
+                # OpenSSL may try the wrong same-subject issuer
+                .add_extension(x509.SubjectKeyIdentifier.from_public_key(
+                    key.public_key()), critical=False)
                 .add_extension(x509.KeyUsage(
                     digital_signature=True, key_cert_sign=True,
                     crl_sign=True, content_commitment=False,
@@ -179,6 +187,11 @@ class RootCA:
                 .add_extension(x509.BasicConstraints(ca=False,
                                                      path_length=None),
                                critical=True)
+                .add_extension(x509.SubjectKeyIdentifier.from_public_key(
+                    public_key), critical=False)
+                .add_extension(
+                    x509.AuthorityKeyIdentifier.from_issuer_public_key(
+                        self._key.public_key()), critical=False)
                 .add_extension(x509.ExtendedKeyUsage(
                     [x509.oid.ExtendedKeyUsageOID.SERVER_AUTH,
                      x509.oid.ExtendedKeyUsageOID.CLIENT_AUTH]),
@@ -192,19 +205,29 @@ class RootCA:
     # ------------------------------------------------------------------
     def validate_cert_chain(self, cert_pem: bytes) -> x509.Certificate:
         """Verify a leaf was signed by this root and is in its validity
-        window (reference: CheckValidCertificate ca/config.go)."""
+        window (reference: CheckValidCertificate ca/config.go).  This
+        RootCA's cert_pem may be an old+new BUNDLE mid-rotation — the leaf
+        is accepted when it chains to ANY member root."""
         leaf = cert_from_pem(cert_pem)
         now = _now()
         if not (leaf.not_valid_before_utc <= now
                 <= leaf.not_valid_after_utc):
             raise CertificateError("certificate outside validity window")
         try:
-            self.cert.public_key().verify(
-                leaf.signature, leaf.tbs_certificate_bytes,
-                ec.ECDSA(leaf.signature_hash_algorithm))
-        except Exception as e:
-            raise CertificateError(f"certificate not signed by this CA: {e}")
-        return leaf
+            roots = x509.load_pem_x509_certificates(self.cert_pem)
+        except Exception:
+            roots = [self.cert]
+        last_err: Optional[Exception] = None
+        for root in roots:
+            try:
+                root.public_key().verify(
+                    leaf.signature, leaf.tbs_certificate_bytes,
+                    ec.ECDSA(leaf.signature_hash_algorithm))
+                return leaf
+            except Exception as e:
+                last_err = e
+        raise CertificateError(
+            f"certificate not signed by this CA: {last_err}")
 
     def cross_sign_ca_certificate(self, other_cert_pem: bytes) -> bytes:
         """Sign another root's public key with ours, for root rotation
@@ -223,6 +246,11 @@ class RootCA:
                 .add_extension(x509.BasicConstraints(ca=True,
                                                      path_length=None),
                                critical=True)
+                .add_extension(x509.SubjectKeyIdentifier.from_public_key(
+                    other.public_key()), critical=False)
+                .add_extension(
+                    x509.AuthorityKeyIdentifier.from_issuer_public_key(
+                        self._key.public_key()), critical=False)
                 .sign(self._key, hashes.SHA256()))
         return cert_to_pem(cert)
 
@@ -239,3 +267,36 @@ def parse_identity(cert_pem: bytes) -> tuple[str, str, str]:
     return (attr(NameOID.COMMON_NAME),
             attr(NameOID.ORGANIZATIONAL_UNIT_NAME),
             attr(NameOID.ORGANIZATION_NAME))
+
+
+def is_issued_by(leaf_pem: bytes, root_cert_pem: bytes) -> bool:
+    """True when the FIRST certificate in ``leaf_pem`` was signed by the
+    root in ``root_cert_pem`` (rotation progress check — reference:
+    ca/reconciler.go hasIssuer)."""
+    try:
+        leaf = cert_from_pem(leaf_pem)
+        root = cert_from_pem(root_cert_pem)
+        root.public_key().verify(
+            leaf.signature, leaf.tbs_certificate_bytes,
+            ec.ECDSA(leaf.signature_hash_algorithm))
+        return True
+    except Exception:
+        return False
+
+
+def split_bundle(bundle_pem: bytes) -> list[tuple[bytes, str]]:
+    """(cert_pem, sha256-of-DER) for every certificate in a PEM bundle."""
+    out = []
+    try:
+        for cert in x509.load_pem_x509_certificates(bundle_pem):
+            der = cert.public_bytes(serialization.Encoding.DER)
+            out.append((cert_to_pem(cert),
+                        hashlib.sha256(der).hexdigest()))
+    except Exception:
+        pass
+    return out
+
+
+def bundle_digests(bundle_pem: bytes) -> list[str]:
+    """sha256 digests of every certificate in a PEM bundle."""
+    return [d for _, d in split_bundle(bundle_pem)]

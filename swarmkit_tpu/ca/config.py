@@ -44,20 +44,30 @@ class ParsedToken:
     secret: str
 
 
-def verify_root_digest(root_pem: bytes, token: str) -> bool:
-    """Constant-time check that a fetched root CA certificate matches the
-    digest pinned inside a join token (reference: GetRemoteCA digest
-    verification, ca/certificates.go). The single place pin semantics live;
-    used by the joining node, the RemoteManager bootstrap dial, and tests."""
+def pinned_cert(root_pem: bytes, token: str) -> Optional[bytes]:
+    """The ONE certificate in a served (possibly old+new rotation) bundle
+    whose digest matches the join token's pin, or None.  Only the pinned
+    member may be trusted from an UNAUTHENTICATED fetch — trusting the
+    whole bundle would let a MITM smuggle a rogue root alongside the real
+    one (reference: GetRemoteCA digest verification).  The full rotation
+    bundle is installed later from the ISSUANCE response, which arrives
+    over a channel verified against this pinned cert."""
     import hmac
 
-    from swarmkit_tpu.ca.certificates import RootCA
+    from swarmkit_tpu.ca.certificates import split_bundle
 
-    try:
-        got = RootCA(root_pem).digest()
-    except Exception:
-        return False
-    return hmac.compare_digest(got, parse_join_token(token).ca_digest)
+    want = parse_join_token(token).ca_digest
+    for cert_pem, digest in split_bundle(root_pem):
+        if hmac.compare_digest(digest, want):
+            return cert_pem
+    return None
+
+
+def verify_root_digest(root_pem: bytes, token: str) -> bool:
+    """True when the join token's pin matches a member of the served
+    bundle (see pinned_cert — callers needing trust material should use
+    that and trust ONLY the returned cert)."""
+    return pinned_cert(root_pem, token) is not None
 
 
 def parse_join_token(token: str) -> ParsedToken:

@@ -17,11 +17,13 @@ import logging
 from typing import Optional
 
 from swarmkit_tpu.api import MembershipState, NodeRole, NodeSpec, Annotations
-from swarmkit_tpu.api.objects import Node as ApiNode, NodeStatus
+from swarmkit_tpu.api.objects import (
+    Node as ApiNode, NodeStatus, RootRotation,
+)
 from swarmkit_tpu.api.types import Certificate, IssuanceState
 from swarmkit_tpu.ca.certificates import (
     MANAGER_ROLE_OU, WORKER_ROLE_OU, CertificateError, IssuedCertificate,
-    RootCA, parse_identity,
+    RootCA, is_issued_by, parse_identity,
 )
 from swarmkit_tpu.ca.config import InvalidJoinToken, parse_join_token
 from swarmkit_tpu.store.memory import Event, MemoryStore, match
@@ -44,6 +46,107 @@ class CAServer:
         self.clock = clock or SystemClock()
         self._task: Optional[asyncio.Task] = None
         self._running = False
+        self._rot_cache: Optional[RootCA] = None
+
+    # ------------------------------------------------------------------
+    # Root rotation (reference: ca/server.go rotation handling +
+    # ca/reconciler.go rootRotationReconciler + integration
+    # TestSuccessfulRootRotation).  Protocol: the NEW root's certificate
+    # is cross-signed by the OLD root, issuance switches to the new key
+    # with the cross-signed cert appended (old-trusting verifiers still
+    # chain), the trust bundle carries old+new, nodes with old-root certs
+    # are marked ROTATE so their renewers re-issue, and once every node
+    # certificate chains to the new root the cluster flips to it and
+    # regenerates join tokens.
+    def _rotation(self) -> Optional[RootRotation]:
+        cl = self._cluster()
+        rot = cl.root_ca.root_rotation if cl is not None else None
+        return rot or None
+
+    def _rotation_root(self) -> Optional[RootCA]:
+        rot = self._rotation()
+        if not rot:
+            self._rot_cache = None
+            return None
+        if self._rot_cache is None \
+                or self._rot_cache.cert_pem != rot.ca_cert:
+            self._rot_cache = RootCA(rot.ca_cert, rot.ca_key)
+        return self._rot_cache
+
+    async def start_root_rotation(self, new_cert_pem: bytes = b"",
+                                  new_key_pem: bytes = b"") -> None:
+        """Begin rotating the cluster root CA to ``new_cert``/``new_key``
+        (generated when omitted)."""
+        if not self.root_ca.can_sign:
+            raise CertificateError(
+                "root rotation requires the local signing key "
+                "(external-CA rotation is driven by re-configuring the "
+                "external CA set)")
+        if self._rotation() is not None:
+            raise CertificateError(
+                "a root rotation is already in progress — wait for it to "
+                "finalize (re-rotating would orphan certificates already "
+                "issued under the incoming root)")
+        if new_cert_pem:
+            new_root = RootCA(new_cert_pem, new_key_pem or None)
+            if not new_root.can_sign:
+                raise CertificateError("new root needs a signing key")
+        else:
+            new_root = RootCA.create()
+        cross = self.root_ca.cross_sign_ca_certificate(new_root.cert_pem)
+
+        def txn(tx):
+            cl = tx.find("cluster")[0]
+            cl = cl.copy()
+            cl.root_ca.root_rotation = RootRotation(
+                ca_cert=new_root.cert_pem,
+                ca_key=new_root.key_pem or b"",
+                cross_signed_ca_cert=cross)
+            tx.update(cl)
+            # every node holding an old-root cert renews (ROTATE wakes the
+            # node-side TLSRenewer through its session node-watch); nodes
+            # with NO recorded cert (the bootstrap manager self-issued its
+            # identity before any CA server existed) are marked too — their
+            # renewal both rotates the identity and records it
+            for n in tx.find("node"):
+                if not n.certificate.certificate or not is_issued_by(
+                        n.certificate.certificate, new_root.cert_pem):
+                    n = n.copy()
+                    n.certificate.status_state = int(IssuanceState.ROTATE)
+                    tx.update(n)
+        await self.store.update(txn)
+        await self._maybe_finalize_rotation()
+
+    async def _maybe_finalize_rotation(self) -> None:
+        rot = self._rotation()
+        if not rot:
+            return
+        new_cert = rot.ca_cert
+        for n in self.store.find("node"):
+            if n.certificate.status_state == int(IssuanceState.ROTATE):
+                return  # a marked node has not renewed yet
+            if n.certificate.certificate \
+                    and not is_issued_by(n.certificate.certificate,
+                                         new_cert):
+                return  # still converging
+        from swarmkit_tpu.ca.config import generate_join_token
+
+        new_root = RootCA(rot.ca_cert, rot.ca_key)
+
+        def txn(tx):
+            cl = tx.find("cluster")[0]
+            cl = cl.copy()
+            cl.root_ca.ca_cert = new_root.cert_pem
+            cl.root_ca.ca_key = new_root.key_pem or b""
+            cl.root_ca.ca_cert_hash = new_root.digest()
+            cl.root_ca.join_token_worker = generate_join_token(new_root)
+            cl.root_ca.join_token_manager = generate_join_token(new_root)
+            cl.root_ca.root_rotation = None
+            tx.update(cl)
+        await self.store.update(txn)
+        self.root_ca = new_root
+        self._rot_cache = None
+        log.info("root CA rotation complete; join tokens regenerated")
 
     # ------------------------------------------------------------------
     def _cluster(self):
@@ -84,10 +187,24 @@ class CAServer:
                     ) -> IssuedCertificate:
         """Local root key when available, else the cluster's external CA
         (reference: server.go signNodeCert -> ca/external.go)."""
-        if self.root_ca.can_sign:
-            return self.root_ca.issue_node_certificate(
+        rot_root = self._rotation_root()
+        if rot_root is not None and rot_root.can_sign:
+            issued = rot_root.issue_node_certificate(
                 node_id, role_ou, self.org, csr_pem=csr_pem,
                 expiry=self._cert_expiry())
+            # append the cross-signed new-root cert: verifiers that still
+            # trust only the OLD root chain through it
+            cross = self._rotation().cross_signed_ca_cert
+            return IssuedCertificate(
+                cert_pem=issued.cert_pem + cross, key_pem=issued.key_pem,
+                root_bundle=self.get_root_ca_certificate())
+        if self.root_ca.can_sign:
+            issued = self.root_ca.issue_node_certificate(
+                node_id, role_ou, self.org, csr_pem=csr_pem,
+                expiry=self._cert_expiry())
+            return IssuedCertificate(
+                cert_pem=issued.cert_pem, key_pem=issued.key_pem,
+                root_bundle=self.get_root_ca_certificate())
         ext = self._external_client()
         if ext is None:
             raise CertificateError(
@@ -133,7 +250,15 @@ class CAServer:
         from cryptography.hazmat.primitives import serialization as _ser
 
         cn, _, org = parse_identity(old_cert_pem)
-        old_cert = self.root_ca.validate_cert_chain(old_cert_pem)
+        try:
+            old_cert = self.root_ca.validate_cert_chain(old_cert_pem)
+        except CertificateError:
+            rot_root = self._rotation_root()
+            if rot_root is None:
+                raise
+            # mid-rotation: the presenting cert may already chain to the
+            # new root
+            old_cert = rot_root.validate_cert_chain(old_cert_pem)
         if cn != node_id or org != self.org:
             raise CertificateError("certificate identity mismatch")
         csr = _x509.load_pem_x509_csr(csr_pem)
@@ -173,6 +298,11 @@ class CAServer:
                 node.certificate.certificate)
 
     def get_root_ca_certificate(self) -> bytes:
+        """The trust bundle to distribute: the current root, plus the
+        incoming root while a rotation is converging."""
+        rot = self._rotation()
+        if rot:
+            return self.root_ca.cert_pem + rot.ca_cert
         """reference: GetRootCACertificate ca.proto."""
         return self.root_ca.cert_pem
 
@@ -190,6 +320,9 @@ class CAServer:
         + ca/reconciler.go)."""
         self._watcher = self.store.watch(match(kind="node"))
         await self._sign_pending()
+        # a leader failover mid-rotation must not wedge it: the last
+        # renewal may have landed just before the old leader died
+        await self._maybe_finalize_rotation()
         self._running = True
         self._task = asyncio.get_running_loop().create_task(
             self._run(self._watcher))
@@ -216,6 +349,13 @@ class CAServer:
                         and ev.object.certificate.status_state \
                         == IssuanceState.PENDING:
                     await self._sign_pending()
+                if isinstance(ev, Event) \
+                        and (ev.action == "remove"
+                             or ev.object.certificate.status_state
+                             == IssuanceState.ISSUED):
+                    # a renewal — or the REMOVAL of the last old-root
+                    # node — may be what the rotation was waiting on
+                    await self._maybe_finalize_rotation()
         except asyncio.CancelledError:
             raise
         except Exception:

@@ -25,23 +25,38 @@ import grpc
 from swarmkit_tpu.ca.certificates import TLS_SERVER_NAME
 
 
+def _cert_config_fetcher(security):
+    """Serve the CURRENT identity on every new handshake — a renewed
+    certificate (role flip, root rotation) takes effect without restarting
+    the listener (the reference gets this from Go's dynamic GetCertificate
+    in its tls.Config; python-grpc's equivalent is the certificate
+    configuration fetcher)."""
+    def fetch():
+        return grpc.ssl_server_certificate_configuration(
+            [(security.key_pem, security.cert_pem)],
+            root_certificates=security.root_ca.cert_pem)
+    return fetch
+
+
 def server_credentials(security) -> grpc.ServerCredentials:
     """Strict-mTLS server credentials for the main cluster port: the client
     must present a certificate chaining to the cluster root; per-RPC role
-    authorization then reads it (authorize_peer)."""
-    return grpc.ssl_server_credentials(
-        [(security.key_pem, security.cert_pem)],
-        root_certificates=security.root_ca.cert_pem,
-        require_client_auth=True)
+    authorization then reads it (authorize_peer).  DYNAMIC: each handshake
+    reads the live SecurityConfig, so renewals and root rotations take
+    effect immediately."""
+    fetch = _cert_config_fetcher(security)
+    return grpc.dynamic_ssl_server_credentials(
+        fetch(), lambda: fetch(), require_client_authentication=True)
 
 
 def join_server_credentials(security) -> grpc.ServerCredentials:
     """Server-auth-only TLS for the join port: certificate-less nodes
     verify US (via the digest-pinned root) and send their join token
-    encrypted; they cannot present a client certificate yet."""
-    return grpc.ssl_server_credentials(
-        [(security.key_pem, security.cert_pem)],
-        require_client_auth=False)
+    encrypted; they cannot present a client certificate yet.  Dynamic for
+    the same rotation reasons as server_credentials."""
+    fetch = _cert_config_fetcher(security)
+    return grpc.dynamic_ssl_server_credentials(
+        fetch(), lambda: fetch(), require_client_authentication=False)
 
 
 def channel_credentials(security=None,

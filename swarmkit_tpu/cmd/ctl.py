@@ -111,6 +111,8 @@ async def dispatch_control(c, method: str, p: dict):
         if collector is not None:
             out["gauges"] = collector.snapshot()
         return out
+    if method == "cluster.rotate-ca":
+        return await c.rotate_root_ca()
     if method == "cluster.unlock-key":
         cl = c.get_cluster()
         return {"worker": cl.root_ca.join_token_worker,

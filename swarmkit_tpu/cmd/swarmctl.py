@@ -23,6 +23,7 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser("cluster-inspect")
     sub.add_parser("metrics")
     sub.add_parser("cluster-tokens")
+    sub.add_parser("cluster-rotate-ca")
 
     sub.add_parser("node-ls")
     for name in ("node-inspect", "node-rm", "node-promote", "node-demote"):
@@ -97,6 +98,8 @@ async def run(args, out=None) -> int:
             show(await client.call("cluster.metrics"))
         elif c == "cluster-tokens":
             show(await client.call("cluster.unlock-key"))
+        elif c == "cluster-rotate-ca":
+            show(await client.call("cluster.rotate-ca"))
         elif c == "node-ls":
             for n in await client.call("node.ls"):
                 role = "manager" if n.get("role") else "worker"

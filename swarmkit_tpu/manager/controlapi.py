@@ -377,8 +377,26 @@ class ControlApi:
         unlock keys never leave the manager)."""
         cl = cl.copy()
         cl.root_ca.ca_key = b""
+        if cl.root_ca.root_rotation is not None:
+            cl.root_ca.root_rotation.ca_key = b""
         cl.unlock_keys = []
         return cl
+
+    async def rotate_root_ca(self) -> dict:
+        """Begin a root-CA rotation on the leader (reference: controlapi
+        UpdateCluster with a new root + ca/server.go rotation path; the
+        integration bar is TestSuccessfulRootRotation)."""
+        ca = getattr(self, "ca_server", None)
+        if ca is None:
+            raise FailedPrecondition("no CA server on this manager (not "
+                                     "the leader, or external-CA-only)")
+        await ca.start_root_rotation()
+        cl = self.get_cluster()
+        rot = cl.root_ca.root_rotation
+        new_cert = rot.ca_cert if rot else cl.root_ca.ca_cert
+        from swarmkit_tpu.ca import RootCA
+        return {"rotation_active": rot is not None,
+                "new_ca_digest": RootCA(new_cert).digest()}
 
     def get_cluster(self, cluster_id: str = "") -> Cluster:
         if cluster_id:

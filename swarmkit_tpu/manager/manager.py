@@ -203,6 +203,7 @@ class Manager:
                 self.store,
                 RootCA(cluster.root_ca.ca_cert, cluster.root_ca.ca_key),
                 org=cluster.id, clock=self.clock)
+        self.control_api.ca_server = self.ca_server
 
         sched = Scheduler(self.store, clock=self.clock)
         replicated = ReplicatedOrchestrator(self.store, clock=self.clock)
@@ -325,6 +326,7 @@ class Manager:
         self._leader_components = []
         self.role_manager = None
         self.ca_server = None
+        self.control_api.ca_server = None
 
     def _bootstrap_root_ca(self) -> RootCA:
         if self.security is not None and self.security.root_ca.can_sign:

@@ -51,6 +51,15 @@ class _RenewClient:
         if self.node.keyrw is not None:
             self.node.keyrw.write(issued.cert_pem,
                                   self.node.security.key_pem)
+        if issued.root_bundle:
+            # a root rotation is distributing new trust: persist it and
+            # refresh the in-memory trust store (old+new during the
+            # transition, new-only once the rotation finalizes)
+            from swarmkit_tpu.ca import RootCA
+
+            if self.node.keyrw is not None:
+                self.node.keyrw.write_root_ca(issued.root_bundle)
+            self.node.security.root_ca = RootCA(issued.root_bundle)
         return issued
 
 
@@ -186,16 +195,20 @@ class Node:
                 csr_pem, self.config.join_token, addr=self.addr,
                 requested_node_id=self.node_id)
             root_pem = ca.get_root_ca_certificate()
-            # Join-token pin: the received root CA's digest MUST match the
-            # digest embedded in the SWMTKN (reference: GetRemoteCA digest
-            # verification, ca/certificates.go) — otherwise a MITM CA could
-            # substitute its own root during the join.
-            from swarmkit_tpu.ca.config import verify_root_digest
+            # Join-token pin: the fetched bundle MUST contain a cert whose
+            # digest matches the SWMTKN pin, and ONLY that cert becomes
+            # trust from this unauthenticated fetch (a MITM could append a
+            # rogue root to the bundle otherwise).  The full rotation
+            # bundle, if any, is installed below from the issuance
+            # response, which rode a channel verified against the pin.
+            from swarmkit_tpu.ca.config import pinned_cert
 
-            if not verify_root_digest(root_pem, self.config.join_token):
+            pin = pinned_cert(root_pem, self.config.join_token)
+            if pin is None:
                 raise RuntimeError(
                     "root CA digest from the remote CA does not match the "
                     "join token pin — refusing to join")
+            root_pem = issued.root_bundle or pin
             self.keyrw.write_root_ca(root_pem)
             self.keyrw.write(issued.cert_pem, key_pem)
             self.node_id = node_id
@@ -292,6 +305,15 @@ class Node:
         (reference: the cert-renewal waitRole seam node/node.go:933; the
         renewal forcing mirrors renewer.go SetExpectedRole)."""
         self._set_desired_role(manager=node.role == NodeRole.MANAGER)
+        # a ROTATE-marked certificate means the cluster root is rotating:
+        # renew NOW so the rotation can converge (reference:
+        # rootRotationReconciler marking + renewer pickup)
+        from swarmkit_tpu.api.types import IssuanceState
+
+        if self._renewer is not None and node.certificate is not None \
+                and node.certificate.status_state \
+                == int(IssuanceState.ROTATE):
+            self._renewer.renew_soon()
 
     def _on_managers_change(self, managers) -> None:
         for wp in managers:

@@ -218,7 +218,8 @@ class ClusterService:
         try:
             issued = await self._ca().renew_node_certificate(
                 node_id, old_cert, csr)
-            return msgpack.packb((issued.cert_pem, issued.key_pem))
+            return msgpack.packb((issued.cert_pem, issued.key_pem,
+                                  issued.root_bundle))
         except RpcError as e:
             await self._abort(context, e)
         except Exception as e:
@@ -388,8 +389,11 @@ class RemoteCA:
             raise _redirectable(e)
         node_id, cert_pem, key_pem, root_pem = msgpack.unpackb(raw)
         self._root_ca_pem = root_pem
+        # the bundle arrived over the pin-verified TLS channel, so it is
+        # authenticated trust (unlike the plaintext bootstrap fetch)
         return node_id, IssuedCertificate(cert_pem=cert_pem,
-                                          key_pem=key_pem)
+                                          key_pem=key_pem,
+                                          root_bundle=root_pem or b"")
 
     async def renew_node_certificate(self, node_id, old_cert_pem, csr_pem):
         from swarmkit_tpu.ca import IssuedCertificate
@@ -399,8 +403,11 @@ class RemoteCA:
                 (node_id, old_cert_pem, csr_pem)))
         except grpc.aio.AioRpcError as e:
             raise _redirectable(e)
-        cert_pem, key_pem = msgpack.unpackb(raw)
-        return IssuedCertificate(cert_pem=cert_pem, key_pem=key_pem)
+        parts = msgpack.unpackb(raw)
+        cert_pem, key_pem = parts[0], parts[1]
+        root_bundle = parts[2] if len(parts) > 2 else b""
+        return IssuedCertificate(cert_pem=cert_pem, key_pem=key_pem,
+                                 root_bundle=root_bundle or b"")
 
     def get_root_ca_certificate(self) -> bytes:
         return self._root_ca_pem
@@ -487,20 +494,23 @@ class RemoteManager:
                 if self._pinned_root is None:
                     import hmac
 
-                    from swarmkit_tpu.ca.certificates import RootCA
+                    from swarmkit_tpu.ca.certificates import split_bundle
 
                     root_pem = await fetch_root_ca(self.addr)
                     # compare against the raw digest (the caller passes the
-                    # SWMTKN's pin component, not the whole token)
-                    try:
-                        got = RootCA(root_pem).digest()
-                    except Exception:
-                        got = ""
-                    if not hmac.compare_digest(got, self._expected_digest):
+                    # SWMTKN's pin component, not the whole token).  The
+                    # served trust may be an old+new BUNDLE mid-rotation —
+                    # trust ONLY the member matching the pin, never the
+                    # whole unauthenticated bundle.
+                    pin = next(
+                        (c for c, d in split_bundle(root_pem)
+                         if hmac.compare_digest(d, self._expected_digest)),
+                        None)
+                    if pin is None:
                         raise RpcError(
                             "remote CA digest does not match the join "
                             "token pin — refusing to join (possible MITM)")
-                    self._pinned_root = root_pem
+                    self._pinned_root = pin
                 creds = channel_credentials(
                     pinned_root_pem=self._pinned_root)
                 # certificate-less joiners talk to the TLS join port

@@ -251,3 +251,107 @@ async def test_swarmd_tls_worker_join_by_token():
                 except Exception:
                     pass
         tmp.cleanup()
+
+
+@async_test
+async def test_root_ca_rotation_end_to_end():
+    """Rotate the cluster root CA with a live manager + worker (reference:
+    integration_test.go TestSuccessfulRootRotation + ca/reconciler.go):
+    the new root is cross-signed by the old one, nodes are marked ROTATE
+    and renew over their sessions, trust bundles carry old+new during the
+    transition, and once every node cert chains to the new root the
+    cluster flips to it and regenerates the join tokens — after which a
+    NEW worker joins with the NEW token."""
+    from swarmkit_tpu.ca.certificates import is_issued_by
+    from swarmkit_tpu.cmd import swarmd
+
+    tmp = tempfile.TemporaryDirectory(prefix="swarmd-rot-")
+    p1, p2, p3 = free_port(), free_port(), free_port()
+    args1 = swarmd.build_parser().parse_args([
+        "--state-dir", os.path.join(tmp.name, "m1"),
+        "--listen-control-api", os.path.join(tmp.name, "m1.sock"),
+        "--listen-remote-api", f"127.0.0.1:{p1}",
+        "--node-id", "m1", "--manager", "--election-tick", "4",
+        "--executor", "test",
+    ])
+    m1 = w1 = w2 = None
+    try:
+        m1 = await swarmd.run(args1)
+        assert await wait_until(m1.is_leader, timeout=15)
+        assert await wait_until(
+            lambda: m1.manager.store.find("cluster"), timeout=15)
+        cluster = m1.manager.store.find("cluster")[0]
+        old_root = cluster.root_ca.ca_cert
+        old_token = cluster.root_ca.join_token_worker
+
+        args2 = swarmd.build_parser().parse_args([
+            "--state-dir", os.path.join(tmp.name, "w1"),
+            "--listen-control-api", os.path.join(tmp.name, "w1.sock"),
+            "--listen-remote-api", f"127.0.0.1:{p2}",
+            "--node-id", "w1",
+            "--join-addr", f"127.0.0.1:{p1}",
+            "--join-token", old_token, "--election-tick", "4",
+            "--executor", "test",
+        ])
+        w1 = await swarmd.run(args2)
+        assert await wait_until(
+            lambda: m1.manager.store.get("node", w1.node_id) is not None,
+            timeout=20)
+
+        # --- rotate
+        res = await m1.manager.control_api.rotate_root_ca()
+        assert "new_ca_digest" in res and len(res["new_ca_digest"]) == 64
+
+        def rotated():
+            cl = m1.manager.store.find("cluster")[0]
+            if cl.root_ca.root_rotation is not None:
+                return False
+            return cl.root_ca.ca_cert != old_root
+        assert await wait_until(rotated, timeout=40), \
+            "rotation never finalized"
+
+        cl = m1.manager.store.find("cluster")[0]
+        new_root = cl.root_ca.ca_cert
+        # every node certificate now chains to the new root
+        for n in m1.manager.store.find("node"):
+            if n.certificate.certificate:
+                assert is_issued_by(n.certificate.certificate, new_root), \
+                    f"{n.id} still on the old root"
+        # join tokens were regenerated against the new root
+        assert cl.root_ca.join_token_worker != old_token
+
+        # the rotated worker's on-disk identity chains to the new root too
+        assert await wait_until(
+            lambda: is_issued_by(w1.security.cert_pem, new_root),
+            timeout=20), "worker identity never re-issued"
+
+        # a NEW worker joins with the NEW token against the NEW root
+        args3 = swarmd.build_parser().parse_args([
+            "--state-dir", os.path.join(tmp.name, "w2"),
+            "--listen-control-api", os.path.join(tmp.name, "w2.sock"),
+            "--listen-remote-api", f"127.0.0.1:{p3}",
+            "--node-id", "w2",
+            "--join-addr", f"127.0.0.1:{p1}",
+            "--join-token", cl.root_ca.join_token_worker,
+            "--election-tick", "4", "--executor", "test",
+        ])
+        w2 = await swarmd.run(args3)
+        assert is_issued_by(w2.security.cert_pem, new_root)
+        # ...and its mTLS agent session passes the per-RPC authorization
+        # against the rotated trust (node status goes READY, not just the
+        # issuance-time record existing)
+        from swarmkit_tpu.api import NodeState
+
+        def w2_ready():
+            rec = m1.manager.store.get("node", w2.node_id)
+            return rec is not None and rec.status.state == NodeState.READY
+        assert await wait_until(w2_ready, timeout=20), (
+            "post-rotation worker session never authorized")
+    finally:
+        for n in (w2, w1, m1):
+            if n is not None:
+                try:
+                    await n.stop()
+                except Exception:
+                    pass
+        tmp.cleanup()
