@@ -40,13 +40,17 @@ class KeyReadWriter:
         return Fernet(base64.urlsafe_b64encode(
             hashlib.sha256(kek).digest()))
 
-    def set_kek(self, kek: Optional[bytes]) -> None:
-        """Re-encrypt the stored key under a new kek
-        (reference: RotateKEK keyreadwriter.go)."""
+    def set_kek(self, kek: Optional[bytes]) -> bool:
+        """Re-encrypt the stored key under a new kek; no-op (returns False)
+        when it is already in effect (reference: RotateKEK
+        keyreadwriter.go)."""
+        if kek == self._kek:
+            return False
         cert, key = self.read()
         self._kek = kek
         if key is not None:
             self.write(cert or b"", key)
+        return True
 
     # ------------------------------------------------------------------
     def write(self, cert_pem: bytes, key_pem: bytes) -> None:

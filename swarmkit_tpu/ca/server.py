@@ -122,9 +122,14 @@ class CAServer:
         if not rot:
             return
         new_cert = rot.ca_cert
-        for n in self.store.find("node"):
-            if n.certificate.status_state == int(IssuanceState.ROTATE):
-                return  # a marked node has not renewed yet
+        nodes = self.store.find("node")
+        # cheap flag scan first: signature checks (ECDSA verify per node)
+        # run only once the marked set has drained, keeping convergence
+        # O(N) instead of O(N^2) verifies across the rotation
+        if any(n.certificate.status_state == int(IssuanceState.ROTATE)
+               for n in nodes):
+            return  # a marked node has not renewed yet
+        for n in nodes:
             if n.certificate.certificate \
                     and not is_issued_by(n.certificate.certificate,
                                          new_cert):
@@ -299,11 +304,11 @@ class CAServer:
 
     def get_root_ca_certificate(self) -> bytes:
         """The trust bundle to distribute: the current root, plus the
-        incoming root while a rotation is converging."""
+        incoming root while a rotation is converging (reference:
+        GetRootCACertificate ca.proto)."""
         rot = self._rotation()
         if rot:
             return self.root_ca.cert_pem + rot.ca_cert
-        """reference: GetRootCACertificate ca.proto."""
         return self.root_ca.cert_pem
 
     def _cert_expiry(self) -> float:

@@ -113,7 +113,18 @@ async def dispatch_control(c, method: str, p: dict):
         return out
     if method == "cluster.rotate-ca":
         return await c.rotate_root_ca()
+    if method == "cluster.autolock":
+        cl = c.get_cluster()
+        spec = cl.spec.copy()
+        spec.encryption_config.auto_lock_managers = bool(p["enabled"])
+        await c.update_cluster(cl.id, spec,
+                               version=cl.meta.version.index)
+        return c.get_unlock_key()
+    if method == "cluster.get-unlock-key":
+        return c.get_unlock_key()
     if method == "cluster.unlock-key":
+        # historical name: returns the JOIN TOKENS (swarmctl
+        # cluster-tokens); the autolock key lives at cluster.get-unlock-key
         cl = c.get_cluster()
         return {"worker": cl.root_ca.join_token_worker,
                 "manager": cl.root_ca.join_token_manager}

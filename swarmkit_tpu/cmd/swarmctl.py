@@ -24,6 +24,9 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser("metrics")
     sub.add_parser("cluster-tokens")
     sub.add_parser("cluster-rotate-ca")
+    sp = sub.add_parser("cluster-autolock")
+    sp.add_argument("enabled", choices=["on", "off"])
+    sub.add_parser("cluster-unlock-key")
 
     sub.add_parser("node-ls")
     for name in ("node-inspect", "node-rm", "node-promote", "node-demote"):
@@ -100,6 +103,11 @@ async def run(args, out=None) -> int:
             show(await client.call("cluster.unlock-key"))
         elif c == "cluster-rotate-ca":
             show(await client.call("cluster.rotate-ca"))
+        elif c == "cluster-autolock":
+            show(await client.call("cluster.autolock",
+                                   enabled=args.enabled == "on"))
+        elif c == "cluster-unlock-key":
+            show(await client.call("cluster.get-unlock-key"))
         elif c == "node-ls":
             for n in await client.call("node.ls"):
                 role = "manager" if n.get("role") else "worker"

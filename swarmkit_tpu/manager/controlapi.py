@@ -398,6 +398,20 @@ class ControlApi:
         return {"rotation_active": rot is not None,
                 "new_ca_digest": RootCA(new_cert).digest()}
 
+    def get_unlock_key(self) -> dict:
+        """The manager autolock key (reference: GetUnlockKey ca/server.go —
+        deliberately excluded from redacted cluster objects; this is the
+        one endpoint that returns it)."""
+        clusters = self.store.find("cluster")
+        if not clusters:
+            raise NotFound("cluster object not created yet")
+        cl = clusters[0]
+        key = next((k.key for k in cl.unlock_keys
+                    if k.subsystem == "manager"), b"")
+        return {"unlock_key": key.decode() if key else "",
+                "autolock": bool(
+                    cl.spec.encryption_config.auto_lock_managers)}
+
     def get_cluster(self, cluster_id: str = "") -> Cluster:
         if cluster_id:
             return self._redact_cluster(self._get("cluster", cluster_id))
@@ -437,6 +451,23 @@ class ControlApi:
             if rotate_manager_token:
                 cl.root_ca.join_token_manager = generate_join_token(
                     ca_cert=cl.root_ca.ca_cert)
+            # Manager autolock (reference: cluster.go UpdateCluster unlock
+            # key management + keyreadwriter RotateKEK): toggling it on
+            # mints the manager KEK; off clears it.  Every manager node
+            # applies the replicated key to its KeyReadWriter (node.py
+            # autolock watch).
+            want_lock = bool(spec.encryption_config.auto_lock_managers)
+            have = [k for k in cl.unlock_keys if k.subsystem == "manager"]
+            if want_lock and not have:
+                import secrets as _secrets
+
+                from swarmkit_tpu.api.objects import EncryptionKey
+                cl.unlock_keys = list(cl.unlock_keys) + [EncryptionKey(
+                    subsystem="manager",
+                    key=("SWMKEY-1-" + _secrets.token_hex(32)).encode())]
+            elif not want_lock and have:
+                cl.unlock_keys = [k for k in cl.unlock_keys
+                                  if k.subsystem != "manager"]
             tx.update(cl)
             return cl
         try:

@@ -383,6 +383,39 @@ class Node:
         # treats ErrMemberRemoved as demotion, node/node.go:1080).
         self._removal_watch = asyncio.get_running_loop().create_task(
             self._watch_member_removal(self.manager))
+        self._autolock_watch = asyncio.get_running_loop().create_task(
+            self._watch_autolock(self.manager))
+
+    async def _watch_autolock(self, manager) -> None:
+        """Apply the cluster's manager autolock KEK to this node's key
+        store as it changes in the replicated state (reference:
+        manager.go handleKEKChange / keyreadwriter RotateKEK).  With
+        autolock on, a restarted manager cannot load its TLS key without
+        --unlock-key."""
+        from swarmkit_tpu.store.memory import match
+        from swarmkit_tpu.watch.queue import watch_with_sweep
+
+        def current_kek():
+            clusters = manager.store.find("cluster")
+            if not clusters:
+                return None
+            return next((k.key for k in clusters[0].unlock_keys
+                         if k.subsystem == "manager"), None)
+
+        try:
+            watcher = manager.store.watch(match(kind="cluster"))
+            async for _ev in watch_with_sweep(watcher, self.clock, 2.0):
+                if manager is not self.manager or not manager._running:
+                    return
+                kek = current_kek()
+                if self.keyrw is not None and self.security is not None \
+                        and self.keyrw.set_kek(kek):
+                    log.info("node %s: manager autolock %s", self.node_id,
+                             "engaged" if kek else "released")
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.exception("autolock watch crashed")
 
     async def _watch_member_removal(self, manager) -> None:
         try:
@@ -407,6 +440,15 @@ class Node:
         if manager == self._desired_manager:
             return
         self._desired_manager = manager
+        if not manager and self.keyrw is not None:
+            # a worker runs no autolock watch and must never be locked
+            # out of its own key: release the manager KEK at-rest
+            # encryption on EVERY demotion path (reference: keyreadwriter
+            # RotateKEK(nil) on demotion)
+            try:
+                self.keyrw.set_kek(None)
+            except Exception:
+                log.exception("cannot release the autolock KEK on demotion")
         if self.security is not None and self._renewer is not None:
             have_mgr_cert = self.security.role_ou == MANAGER_ROLE_OU
             if manager != have_mgr_cert:
@@ -414,10 +456,11 @@ class Node:
         self._role_evt.set()
 
     def _cancel_role_watches(self) -> None:
-        t = getattr(self, "_removal_watch", None)
-        if t is not None:
-            t.cancel()
-            self._removal_watch = None
+        for attr in ("_removal_watch", "_autolock_watch"):
+            t = getattr(self, attr, None)
+            if t is not None:
+                t.cancel()
+                setattr(self, attr, None)
 
     def _leader_addr(self) -> str:
         for addr in self.remotes.weights():

@@ -355,3 +355,159 @@ async def test_root_ca_rotation_end_to_end():
                 except Exception:
                     pass
         tmp.cleanup()
+
+
+@async_test
+async def test_manager_autolock_locks_key_at_rest():
+    """Autolock (reference: integration_test.go autolock scenarios +
+    keyreadwriter RotateKEK): enabling it mints a manager unlock key,
+    every manager re-encrypts its TLS key at rest, a restart WITHOUT
+    --unlock-key refuses to load the identity, the right key unlocks it,
+    and disabling autolock decrypts the key again."""
+    from swarmkit_tpu.cmd import swarmd
+
+    tmp = tempfile.TemporaryDirectory(prefix="swarmd-lock-")
+    p1 = free_port()
+
+    def m1_args(unlock_key=""):
+        argv = [
+            "--state-dir", os.path.join(tmp.name, "m1"),
+            "--listen-control-api", os.path.join(tmp.name, "m1.sock"),
+            "--listen-remote-api", f"127.0.0.1:{p1}",
+            "--node-id", "m1", "--manager", "--election-tick", "4",
+            "--executor", "test",
+        ]
+        if unlock_key:
+            argv += ["--unlock-key", unlock_key]
+        return swarmd.build_parser().parse_args(argv)
+
+    m1 = None
+    try:
+        m1 = await swarmd.run(m1_args())
+        assert await wait_until(m1.is_leader, timeout=15)
+        assert await wait_until(
+            lambda: m1.manager.store.find("cluster"), timeout=15)
+        cl = m1.manager.store.find("cluster")[0]
+
+        # enable autolock through the control API
+        spec = cl.spec.copy()
+        spec.encryption_config.auto_lock_managers = True
+        await m1.manager.control_api.update_cluster(
+            cl.id, spec, version=cl.meta.version.index)
+        info = m1.manager.control_api.get_unlock_key()
+        assert info["autolock"] and info["unlock_key"].startswith("SWMKEY-1-")
+        unlock = info["unlock_key"]
+
+        # the node-side watch engages the KEK: key file encrypted at rest
+        key_path = os.path.join(tmp.name, "m1", "certificates",
+                                "swarm-node.key")
+        meta_path = key_path + ".meta"
+
+        def locked():
+            if not os.path.exists(meta_path):
+                return False
+            import json as _json
+            return _json.loads(open(meta_path).read()).get("encrypted")
+        assert await wait_until(locked, timeout=15), \
+            "manager key never encrypted after autolock"
+        assert b"PRIVATE KEY" not in open(key_path, "rb").read()
+
+        await m1.stop()
+        m1 = None
+
+        # restart without the unlock key: locked out
+        with pytest.raises(PermissionError):
+            await swarmd.run(m1_args())
+
+        # restart WITH the key: unlocked and leading again
+        m1 = await swarmd.run(m1_args(unlock_key=unlock))
+        assert await wait_until(m1.is_leader, timeout=15)
+
+        # disable autolock: key decrypts at rest again
+        cl = m1.manager.store.find("cluster")[0]
+        spec = cl.spec.copy()
+        spec.encryption_config.auto_lock_managers = False
+        await m1.manager.control_api.update_cluster(
+            cl.id, spec, version=cl.meta.version.index)
+        assert await wait_until(lambda: not locked(), timeout=15), \
+            "key not decrypted after autolock disabled"
+        assert m1.manager.control_api.get_unlock_key()["unlock_key"] == ""
+    finally:
+        if m1 is not None:
+            try:
+                await m1.stop()
+            except Exception:
+                pass
+        tmp.cleanup()
+
+
+@async_test
+async def test_autolock_kek_released_on_demotion():
+    """A demoted manager must get its key DECRYPTED at rest (workers run
+    no autolock watch and have no --unlock-key); reference: keyreadwriter
+    RotateKEK(nil) on demotion."""
+    from swarmkit_tpu.api import NodeRole
+    from swarmkit_tpu.cmd import swarmd
+
+    tmp = tempfile.TemporaryDirectory(prefix="swarmd-demolock-")
+    p1, p2 = free_port(), free_port()
+    args1 = swarmd.build_parser().parse_args([
+        "--state-dir", os.path.join(tmp.name, "m1"),
+        "--listen-control-api", os.path.join(tmp.name, "m1.sock"),
+        "--listen-remote-api", f"127.0.0.1:{p1}",
+        "--node-id", "m1", "--manager", "--election-tick", "4",
+        "--executor", "test",
+    ])
+    m1 = m2 = None
+    try:
+        m1 = await swarmd.run(args1)
+        assert await wait_until(m1.is_leader, timeout=15)
+        assert await wait_until(
+            lambda: m1.manager.store.find("cluster"), timeout=15)
+        cl = m1.manager.store.find("cluster")[0]
+
+        args2 = swarmd.build_parser().parse_args([
+            "--state-dir", os.path.join(tmp.name, "m2"),
+            "--listen-control-api", os.path.join(tmp.name, "m2.sock"),
+            "--listen-remote-api", f"127.0.0.1:{p2}",
+            "--node-id", "m2", "--manager",
+            "--join-addr", f"127.0.0.1:{p1}",
+            "--join-token", cl.root_ca.join_token_manager,
+            "--election-tick", "4", "--executor", "test",
+        ])
+        m2 = await swarmd.run(args2)
+        assert await wait_until(m2.is_manager, timeout=20)
+
+        # autolock on: both managers encrypt their keys at rest
+        cl = m1.manager.store.find("cluster")[0]
+        spec = cl.spec.copy()
+        spec.encryption_config.auto_lock_managers = True
+        await m1.manager.control_api.update_cluster(
+            cl.id, spec, version=cl.meta.version.index)
+
+        def key_encrypted(name):
+            path = os.path.join(tmp.name, name, "certificates",
+                                "swarm-node.key.meta")
+            import json as _json
+            return os.path.exists(path) and _json.loads(
+                open(path).read()).get("encrypted")
+        assert await wait_until(lambda: key_encrypted("m2"), timeout=20), \
+            "joined manager never engaged the autolock KEK"
+
+        # demote m2: its key must decrypt at rest
+        node_rec = m1.manager.store.get("node", m2.node_id)
+        spec2 = node_rec.spec.copy()
+        spec2.desired_role = NodeRole.WORKER
+        await m1.manager.control_api.update_node(
+            m2.node_id, spec2, version=node_rec.meta.version.index)
+        assert await wait_until(lambda: not m2.is_manager(), timeout=30)
+        assert await wait_until(lambda: not key_encrypted("m2"), timeout=20), \
+            "demoted node still locked out of its own key"
+    finally:
+        for nd in (m2, m1):
+            if nd is not None:
+                try:
+                    await nd.stop()
+                except Exception:
+                    pass
+        tmp.cleanup()
