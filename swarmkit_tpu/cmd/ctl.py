@@ -122,6 +122,8 @@ async def dispatch_control(c, method: str, p: dict):
         return c.get_unlock_key()
     if method == "cluster.get-unlock-key":
         return c.get_unlock_key()
+    if method == "cluster.rotate-unlock-key":
+        return await c.rotate_unlock_key()
     if method == "cluster.unlock-key":
         # historical name: returns the JOIN TOKENS (swarmctl
         # cluster-tokens); the autolock key lives at cluster.get-unlock-key

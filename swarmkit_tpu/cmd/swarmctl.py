@@ -26,7 +26,8 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser("cluster-rotate-ca")
     sp = sub.add_parser("cluster-autolock")
     sp.add_argument("enabled", choices=["on", "off"])
-    sub.add_parser("cluster-unlock-key")
+    sp = sub.add_parser("cluster-unlock-key")
+    sp.add_argument("--rotate", action="store_true")
 
     sub.add_parser("node-ls")
     for name in ("node-inspect", "node-rm", "node-promote", "node-demote"):
@@ -107,7 +108,9 @@ async def run(args, out=None) -> int:
             show(await client.call("cluster.autolock",
                                    enabled=args.enabled == "on"))
         elif c == "cluster-unlock-key":
-            show(await client.call("cluster.get-unlock-key"))
+            method = ("cluster.rotate-unlock-key" if args.rotate
+                      else "cluster.get-unlock-key")
+            show(await client.call(method))
         elif c == "node-ls":
             for n in await client.call("node.ls"):
                 role = "manager" if n.get("role") else "worker"

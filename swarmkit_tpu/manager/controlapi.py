@@ -129,6 +129,16 @@ def _validate_service_spec(spec) -> None:
         raise InvalidArgument("spec: unrecognized service mode")
 
 
+def _mint_manager_kek():
+    """A fresh manager autolock key record (reference: generateUnlockKey)."""
+    import secrets as _secrets
+
+    from swarmkit_tpu.api.objects import EncryptionKey
+    return EncryptionKey(
+        subsystem="manager",
+        key=("SWMKEY-1-" + _secrets.token_hex(32)).encode())
+
+
 class ControlApi:
     def __init__(self, store: MemoryStore, raft=None,
                  on_remove_node=None, metrics=None,
@@ -398,6 +408,28 @@ class ControlApi:
         return {"rotation_active": rot is not None,
                 "new_ca_digest": RootCA(new_cert).digest()}
 
+    async def rotate_unlock_key(self) -> dict:
+        """Mint a fresh manager autolock key (reference: swarmctl/swarm
+        unlock-key --rotate); manager nodes re-encrypt their keys via the
+        autolock watch."""
+        minted = _mint_manager_kek()
+
+        def txn(tx):
+            clusters = tx.find("cluster")
+            if not clusters:
+                raise NotFound("cluster object not created yet")
+            cl = clusters[0].copy()
+            if not cl.spec.encryption_config.auto_lock_managers:
+                raise FailedPrecondition(
+                    "autolock is not enabled on this cluster")
+            cl.unlock_keys = [k for k in cl.unlock_keys
+                              if k.subsystem != "manager"] + [minted]
+            tx.update(cl)
+        await self.store.update(txn)
+        # return the key THIS call minted — a re-read could race a
+        # concurrent autolock-off or second rotation
+        return {"unlock_key": minted.key.decode(), "autolock": True}
+
     def get_unlock_key(self) -> dict:
         """The manager autolock key (reference: GetUnlockKey ca/server.go —
         deliberately excluded from redacted cluster objects; this is the
@@ -459,12 +491,7 @@ class ControlApi:
             want_lock = bool(spec.encryption_config.auto_lock_managers)
             have = [k for k in cl.unlock_keys if k.subsystem == "manager"]
             if want_lock and not have:
-                import secrets as _secrets
-
-                from swarmkit_tpu.api.objects import EncryptionKey
-                cl.unlock_keys = list(cl.unlock_keys) + [EncryptionKey(
-                    subsystem="manager",
-                    key=("SWMKEY-1-" + _secrets.token_hex(32)).encode())]
+                cl.unlock_keys = list(cl.unlock_keys) + [_mint_manager_kek()]
             elif not want_lock and have:
                 cl.unlock_keys = [k for k in cl.unlock_keys
                                   if k.subsystem != "manager"]

@@ -276,3 +276,35 @@ async def test_manager_join_with_manager_token():
                      "raft grew to 2 via token join", timeout=30)
     finally:
         await c.stop_all()
+
+
+@async_test
+async def test_demote_downed_manager():
+    """reference: integration_test.go demotion matrix — demoting a manager
+    that is DOWN must still remove its raft member and flip its role, so
+    the cluster doesn't wait on a dead peer."""
+    c = TestCluster()
+    try:
+        await c.add_manager("m1")
+        await c.add_manager("m2")
+        await c.add_manager("m3")
+        lead = await c.wait_leader()
+        victim = "m3" if lead.node_id != "m3" else "m2"
+
+        await c.stop_node(victim)
+        await c.set_node_role(victim, NodeRole.WORKER)
+        await c.poll(
+            lambda: (l := c.leader()) is not None
+            and len(l.raft.cluster.members) == 2 or None,
+            "downed manager's raft member removed", timeout=40)
+        await c.poll(
+            lambda: (l := c.leader()) is not None
+            and (n := l.store.get("node", victim)) is not None
+            and n.role == NodeRole.WORKER or None,
+            "downed manager's role flipped", timeout=40)
+        # the survivors still commit
+        svc = await c.create_service(replicas=2)
+        await c.poll(lambda: len(c.running_tasks(svc.id)) == 2,
+                     "tasks running after demoting a downed manager")
+    finally:
+        await c.stop_all()

@@ -511,3 +511,62 @@ async def test_autolock_kek_released_on_demotion():
                 except Exception:
                     pass
         tmp.cleanup()
+
+
+@async_test
+async def test_unlock_key_rotation():
+    """`swarmctl cluster-unlock-key --rotate` equivalent: the key changes,
+    the manager re-encrypts under the NEW KEK, and the OLD key no longer
+    unlocks a restart (reference: unlock-key rotation flows)."""
+    from swarmkit_tpu.cmd import swarmd
+
+    tmp = tempfile.TemporaryDirectory(prefix="swarmd-kekrot-")
+    p1 = free_port()
+
+    def m1_args(unlock_key=""):
+        argv = [
+            "--state-dir", os.path.join(tmp.name, "m1"),
+            "--listen-control-api", os.path.join(tmp.name, "m1.sock"),
+            "--listen-remote-api", f"127.0.0.1:{p1}",
+            "--node-id", "m1", "--manager", "--election-tick", "4",
+            "--executor", "test",
+        ]
+        if unlock_key:
+            argv += ["--unlock-key", unlock_key]
+        return swarmd.build_parser().parse_args(argv)
+
+    m1 = None
+    try:
+        m1 = await swarmd.run(m1_args())
+        assert await wait_until(m1.is_leader, timeout=15)
+        assert await wait_until(
+            lambda: m1.manager.store.find("cluster"), timeout=15)
+        cl = m1.manager.store.find("cluster")[0]
+        spec = cl.spec.copy()
+        spec.encryption_config.auto_lock_managers = True
+        await m1.manager.control_api.update_cluster(
+            cl.id, spec, version=cl.meta.version.index)
+        key1 = m1.manager.control_api.get_unlock_key()["unlock_key"]
+        assert await wait_until(
+            lambda: m1.keyrw._kek == key1.encode(), timeout=15)
+
+        rotated = await m1.manager.control_api.rotate_unlock_key()
+        key2 = rotated["unlock_key"]
+        assert key2 != key1 and key2.startswith("SWMKEY-1-")
+        assert await wait_until(
+            lambda: m1.keyrw._kek == key2.encode(), timeout=15), \
+            "manager never re-encrypted under the rotated KEK"
+
+        await m1.stop()
+        m1 = None
+        with pytest.raises(PermissionError):   # old key no longer works
+            await swarmd.run(m1_args(unlock_key=key1))
+        m1 = await swarmd.run(m1_args(unlock_key=key2))
+        assert await wait_until(m1.is_leader, timeout=15)
+    finally:
+        if m1 is not None:
+            try:
+                await m1.stop()
+            except Exception:
+                pass
+        tmp.cleanup()
