@@ -694,3 +694,56 @@ class TestPipelinedAppends:
                 break
         assert int(np.asarray(st.commit)[victim]) \
             == int(np.asarray(st.commit).max()), "victim never converged"
+
+
+class TestAllFeaturesSoak:
+    def test_everything_on_at_once(self):
+        """All kernel features simultaneously — prevote, jittered latency
+        mailboxes, pipelined appends, leadership transfers, crashes,
+        drops, ring compaction — under per-tick safety invariants."""
+        cfg = SimConfig(n=128, log_len=256, window=16, apply_batch=64,
+                        max_props=16, keep=16, seed=77, election_tick=20,
+                        latency=2, latency_jitter=2, inflight=3,
+                        pre_vote=True)
+        rng = np.random.default_rng(1)
+        st = init_state(cfg)
+        term_leaders: dict[int, int] = {}
+        prev_commit = prev_term = None
+        down_until = np.zeros(cfg.n, np.int64)
+        for t in range(300):
+            alive = down_until <= t
+            if rng.random() < 0.05:
+                v = int(rng.integers(cfg.n))
+                down_until[v] = t + int(rng.integers(5, 40))
+                alive[v] = False
+            drop = rng.random((cfg.n, cfg.n)) < 0.05
+            if t % 120 == 99:
+                role = np.asarray(st.role)
+                leaders = np.flatnonzero((role == LEADER) & alive)
+                if len(leaders):
+                    st = transfer_leadership(
+                        st, cfg, int(leaders[0]), int(rng.integers(cfg.n)))
+            st = propose_j(st, cfg,
+                           jnp.arange(cfg.max_props, dtype=jnp.uint32)
+                           + np.uint32(t * 977), jnp.asarray(8))
+            st = step_j(st, cfg, alive=jnp.asarray(alive),
+                        drop=jnp.asarray(drop))
+            if t % 10 == 0 or t == 299:
+                term = np.asarray(st.term)
+                commit = np.asarray(st.commit)
+                role = np.asarray(st.role)
+                for lid in np.flatnonzero(
+                        (role == LEADER) & np.asarray(st.active)):
+                    tt = int(term[lid])
+                    assert term_leaders.setdefault(tt, int(lid)) \
+                        == int(lid), f"two leaders in term {tt}"
+                if prev_commit is not None:
+                    assert (commit >= prev_commit).all()
+                    assert (term >= prev_term).all()
+                prev_commit, prev_term = commit, term
+                by: dict = {}
+                for a, c in zip(np.asarray(st.applied).tolist(),
+                                np.asarray(st.apply_chk).tolist()):
+                    assert by.setdefault(a, c) == c, \
+                        f"checksum divergence at applied={a}"
+        assert int(np.asarray(st.commit).max()) > 200
