@@ -507,3 +507,65 @@ async def test_hot_path_latency_metrics_recorded():
         assert snap[metrics.RAFT_SNAPSHOT_LATENCY]["count"] >= 1
     finally:
         await h.close()
+
+
+@async_test
+async def test_join_twice_is_idempotent():
+    """raft_test.go TestRaftJoinTwice: a member that re-sends its join
+    (e.g. after losing the first response) keeps its raft id and the
+    membership does not grow; a re-join from a NEW address updates the
+    member record."""
+    h = RaftHarness()
+    try:
+        n1 = await h.add_node()
+        await h.wait_for_leader()
+        n2 = await h.add_node(join_from=n1)
+        await h.wait_for_cluster()
+        assert len(n1.cluster.members) == 2
+        rid = n2.raft_id
+
+        # same node id, same addr: idempotent
+        resp = await n1.join(n2.node_id, n2.addr)
+        assert resp.raft_id == rid
+        assert len(n1.cluster.members) == 2
+
+        # same node id, NEW addr: the member record follows
+        resp = await n1.join(n2.node_id, "moved:999")
+        assert resp.raft_id == rid
+        await h.wait_for(
+            lambda: n1.cluster.members[rid].addr == "moved:999")
+        assert len(n1.cluster.members) == 2
+    finally:
+        await h.close()
+
+
+@async_test
+async def test_staggered_cluster_restart():
+    """raft_test.go TestRaftRestartClusterStaggered: nodes restart one at a
+    time with the survivors running, preserving state and leadership
+    continuity throughout."""
+    h = RaftHarness()
+    try:
+        n1 = await h.add_node()
+        await h.wait_for_leader()
+        n2 = await h.add_node(join_from=n1)
+        n3 = await h.add_node(join_from=n1)
+        await h.wait_for_cluster()
+        await propose(n1, 1)
+        await h.wait_for(lambda: has_obj(n2, 1) and has_obj(n3, 1))
+
+        nodes = {n.node_id: n for n in (n1, n2, n3)}
+        for nid in list(nodes):
+            await h.shutdown_node(nodes[nid])
+            # quorum of 2 still serves while one node is down
+            lead = await h.wait_for_leader()
+            await propose(lead, 100 + int(nid.split("-")[1]))
+            nodes[nid] = await h.restart_node(nodes[nid])
+            await h.wait_for_cluster()
+        lead = await h.wait_for_cluster()
+        await propose(lead, 2)
+        await h.wait_for(lambda: all(
+            has_obj(n, i) for n in nodes.values()
+            for i in (1, 2, 101, 102, 103)))
+    finally:
+        await h.close()
