@@ -130,7 +130,19 @@ class MultiDecrypter(Decrypter):
     (reference: NewMultiDecrypter encryption.go:104)."""
 
     def __init__(self, *decrypters: Decrypter) -> None:
-        self._decrypters = [d for d in decrypters if d is not None]
+        # Flatten nested MultiDecrypters: a Multi has no `.algorithm` of
+        # its own, so as a MEMBER it would never match any record and its
+        # whole chain would be silently skipped (observed: DEK rotation
+        # composing Multi(new, old_multi) losing the old generations).
+        flat: list[Decrypter] = []
+        for d in decrypters:
+            if d is None:
+                continue
+            if isinstance(d, MultiDecrypter):
+                flat.extend(d._decrypters)
+            else:
+                flat.append(d)
+        self._decrypters = flat
 
     def decrypt(self, rec: MaybeEncryptedRecord) -> bytes:
         last: Optional[Exception] = None

@@ -57,7 +57,8 @@ class Manager:
                  force_new_cluster: bool = False,
                  tick_interval: float = 1.0,
                  election_tick: int = 10, heartbeat_tick: int = 1,
-                 seed: int = 0, security=None) -> None:
+                 seed: int = 0, security=None,
+                 encrypter=None, decrypter=None) -> None:
         self.node_id = node_id
         self.addr = addr
         self.clock = clock or SystemClock()
@@ -73,7 +74,8 @@ class Manager:
             state_dir=state_dir, clock=self.clock, join_addr=join_addr,
             force_new_cluster=force_new_cluster,
             tick_interval=tick_interval, election_tick=election_tick,
-            heartbeat_tick=heartbeat_tick, seed=seed))
+            heartbeat_tick=heartbeat_tick, seed=seed,
+            encrypter=encrypter, decrypter=decrypter))
         self.store: MemoryStore = self.raft.store
 
         # always-on services (reference: manager.go:526-548)

@@ -364,6 +364,7 @@ class Node:
                 prefix=f"swarmkit-{self.node_id}-")
             state_dir = self._ephemeral_dir.name
         # raft storage appends its own "raft" subdir (raft/storage.py)
+        encrypter, decrypter = self._raft_dek_crypters()
         self.manager = Manager(
             node_id=self.node_id, addr=self.addr,
             network=self.config.network, state_dir=state_dir,
@@ -372,7 +373,8 @@ class Node:
             tick_interval=self.config.tick_interval,
             election_tick=self.config.election_tick,
             heartbeat_tick=self.config.heartbeat_tick,
-            seed=self.config.seed, security=self.security)
+            seed=self.config.seed, security=self.security,
+            encrypter=encrypter, decrypter=decrypter)
         await self.manager.start()
         # Demotion safety net: the dispatcher session is the primary
         # role-change channel, but during a demotion the session churns
@@ -412,10 +414,64 @@ class Node:
                         and self.keyrw.set_kek(kek):
                     log.info("node %s: manager autolock %s", self.node_id,
                              "engaged" if kek else "released")
+                    self._rotate_raft_dek()
         except asyncio.CancelledError:
             raise
         except Exception:
             log.exception("autolock watch crashed")
+
+    def _raft_dek_crypters(self):
+        """The raft WAL/snapshot data-encryption key, minted on first
+        manager start and persisted in the KEK-protected key-store headers
+        (reference: manager/deks.go — the DEK rides the TLS key headers so
+        autolock covers it).  Returns (encrypter, decrypter); plaintext
+        only for keyrw-less harness nodes."""
+        from swarmkit_tpu.encryption.encryption import (
+            MultiDecrypter, SecretboxCrypter,
+        )
+
+        if self.keyrw is None or self.security is None:
+            return None, None
+        dek, history = self.keyrw.get_raft_deks()
+        if dek is None:
+            dek = os.urandom(32)
+            self.keyrw.set_raft_deks(dek, history)
+        crypter = SecretboxCrypter(dek)
+        history = [h for h in history if h != dek]
+        if history:
+            return crypter, MultiDecrypter(
+                crypter, *(SecretboxCrypter(h) for h in history))
+        return crypter, crypter
+
+    def _rotate_raft_dek(self) -> None:
+        """KEK change => DEK rotation (reference: deks.go NeedsRotation —
+        a key that protected the old DEK may be known to holders of the
+        old KEK)."""
+        from swarmkit_tpu.encryption.encryption import SecretboxCrypter
+
+        if self.keyrw is None or self.manager is None:
+            return
+        old, history = self.keyrw.get_raft_deks()
+        if old is None:
+            return
+        new = os.urandom(32)
+        # History is NEVER auto-drained: a same-index snapshot can keep a
+        # live WAL segment with old-generation records, so dropping a
+        # generation on "snapshot success" risks an unbootable state dir.
+        # Generations are 32 bytes per KEK rotation — keeping them all is
+        # the safe trade (the snapshot below still re-encrypts history so
+        # old keys stop MATTERING; they just remain available).
+        self.keyrw.set_raft_deks(new, history + [old])
+        self.manager.raft.storage.rotate_encryption_key(
+            SecretboxCrypter(new), SecretboxCrypter(new))
+        try:
+            # re-encrypt the log under the new key ASAP (reference:
+            # deks.go triggers a snapshot to complete rotation)
+            self.manager.raft.snapshot_now()
+        except Exception:
+            log.exception("post-rotation snapshot failed; the old DEK "
+                          "generation still decrypts existing segments")
+        log.info("node %s: raft DEK rotated with the KEK", self.node_id)
 
     async def _watch_member_removal(self, manager) -> None:
         try:

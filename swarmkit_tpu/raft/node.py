@@ -570,6 +570,14 @@ class Node(Proposer):
             store=self.store.save())
         return snap.encode()
 
+    def snapshot_now(self) -> None:
+        """Force an immediate snapshot (reference: the DEK-rotation path
+        triggers one so the log history re-encrypts under the new key and
+        old generations become garbage; manager/deks.go MaybeUpdateKEK ->
+        TriggerSnapshot)."""
+        if self.running and self._raw is not None:
+            self._do_snapshot()
+
     def _do_snapshot(self) -> None:
         """reference: triggerSnapshot raft.go:677 → storage.go:186 (timed
         per storage.go:20-29 snapshot latency)."""
