@@ -663,3 +663,75 @@ async def test_raft_wal_encrypted_at_rest_and_dek_rotates_with_kek():
             except Exception:
                 pass
         tmp.cleanup()
+
+
+@async_test
+async def test_foreign_cluster_certificate_rejected():
+    """A node holding a VALID certificate from a DIFFERENT cluster must be
+    rejected by mTLS/authorization (reference: integration_test.go
+    wrong-cert join rejection — trust is per-cluster root, and identity
+    carries the cluster org)."""
+    from swarmkit_tpu.ca.certificates import WORKER_ROLE_OU
+    from swarmkit_tpu.cmd import swarmd
+
+    tmp = tempfile.TemporaryDirectory(prefix="swarmd-foreign-")
+    p1, p2, p3 = free_port(), free_port(), free_port()
+
+    def margs(name, port):
+        return swarmd.build_parser().parse_args([
+            "--state-dir", os.path.join(tmp.name, name),
+            "--listen-control-api", os.path.join(tmp.name, f"{name}.sock"),
+            "--listen-remote-api", f"127.0.0.1:{port}",
+            "--node-id", name, "--manager", "--election-tick", "4",
+            "--executor", "test",
+        ])
+
+    a = b = w = None
+    try:
+        a = await swarmd.run(margs("ca-a", p1))
+        b = await swarmd.run(margs("cb-b", p2))
+        for m in (a, b):
+            assert await wait_until(m.is_leader, timeout=15)
+            assert await wait_until(
+                lambda m=m: m.manager.store.find("cluster"), timeout=15)
+
+        # join a worker to cluster A legitimately
+        cl_a = a.manager.store.find("cluster")[0]
+        wargs = swarmd.build_parser().parse_args([
+            "--state-dir", os.path.join(tmp.name, "w"),
+            "--listen-control-api", os.path.join(tmp.name, "w.sock"),
+            "--listen-remote-api", f"127.0.0.1:{p3}",
+            "--node-id", "w",
+            "--join-addr", f"127.0.0.1:{p1}",
+            "--join-token", cl_a.root_ca.join_token_worker,
+            "--election-tick", "4", "--executor", "test",
+        ])
+        w = await swarmd.run(wargs)
+        assert w.security.role_ou == WORKER_ROLE_OU
+        assert await wait_until(
+            lambda: a.manager.store.get("node", w.node_id) is not None,
+            timeout=20)
+
+        # the same identity dialing cluster B: TLS trust differs, so the
+        # session/RPC must fail and B must never register the node
+        from swarmkit_tpu.rpc import RemoteManager, RpcError
+
+        rm = RemoteManager(f"127.0.0.1:{p2}",
+                           security_ref=lambda: w.security)
+        rm.start()
+        try:
+            with pytest.raises(Exception) as exc_info:
+                await rm.control_call("node.ls", {})
+            assert not isinstance(exc_info.value, AssertionError)
+        finally:
+            await rm.close()
+        assert b.manager.store.get("node", w.node_id) is None, \
+            "foreign-cluster node must not register"
+    finally:
+        for nd in (w, b, a):
+            if nd is not None:
+                try:
+                    await nd.stop()
+                except Exception:
+                    pass
+        tmp.cleanup()
