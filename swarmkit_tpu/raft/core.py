@@ -289,7 +289,7 @@ class Raft:
             self.become_candidate()
             vote_msg = MsgType.VOTE
             term = self.term
-        if self.quorum() == self._poll(self.id, True):
+        if self._poll(self.id, True) >= self.quorum():
             if pre:
                 self._campaign(transfer=transfer)
             else:
@@ -305,9 +305,16 @@ class Raft:
                 context=ctx))
 
     def _poll(self, pid: int, granted: bool) -> int:
+        """Record first response per voter; tally grants over the CURRENT
+        configuration (modern etcd counts via the tracker config, so a vote
+        from a peer an applied conf change removed is dead weight)."""
         if pid not in self.votes:
             self.votes[pid] = granted
-        return sum(1 for v in self.votes.values() if v)
+        return sum(1 for p, v in self.votes.items() if v and p in self.prs)
+
+    def _poll_rejections(self) -> int:
+        return sum(1 for p, v in self.votes.items()
+                   if not v and p in self.prs)
 
     # -- replication sends -------------------------------------------------
     def _append_entries(self, ents: Sequence[Entry]) -> None:
@@ -523,14 +530,18 @@ class Raft:
             self.become_follower(m.term, m.frm)
             self._handle_snapshot(m)
         elif m.type == my_resp:
+            # >= (not etcd's ==): identical decisions in the static-config
+            # sequential case (counts rise by 1 per response, checked each
+            # time), and well-defined when an applied conf change shrinks
+            # the quorum below an already-recorded tally.
             gr = self._poll(m.frm, not m.reject)
-            if gr == self.quorum():
+            if gr >= self.quorum():
                 if self.state == PRE_CANDIDATE:
                     self._campaign()
                 else:
                     self.become_leader()
                     self._bcast_append()
-            elif len(self.votes) - gr == self.quorum():
+            elif self._poll_rejections() >= self.quorum():
                 self.become_follower(self.term, NONE)
 
     def _step_follower(self, m: Message) -> None:
@@ -632,13 +643,17 @@ class Raft:
         # A new joiner is considered recently active (raft.go addNode).
         self.prs[pid].recent_active = True
 
-    def remove_node(self, pid: int) -> None:
+    def remove_node(self, pid: int, recheck: bool = True) -> None:
+        """`recheck=False` defers the quorum-lowering commit re-check to the
+        next commit evaluation (the sim oracle's once-per-tick Phase D —
+        same decision one tick later); the Node shell keeps the reference's
+        immediate re-check."""
         self.prs.pop(pid, None)
         self.pending_conf = False
         if not self.prs:
             return
         # Removal can lower the quorum size: re-check commit.
-        if self.state == LEADER and self._maybe_commit():
+        if recheck and self.state == LEADER and self._maybe_commit():
             self._bcast_append()
         if self.state == LEADER and self.lead_transferee == pid:
             self._abort_leader_transfer()

@@ -1,7 +1,7 @@
 """Batched JAX/XLA raft simulation: N managers as rows of device arrays."""
 
 from swarmkit_tpu.raft.sim.kernel import (
-    propose, step, transfer_leadership,
+    propose, propose_conf, step, transfer_leadership,
 )
 from swarmkit_tpu.raft.sim.run import (
     committed_entries, has_leader, leader_mask, run_ticks, run_until_leader,
@@ -12,7 +12,8 @@ from swarmkit_tpu.raft.sim.state import (
 )
 
 __all__ = [
-    "propose", "step", "transfer_leadership", "committed_entries", "has_leader", "leader_mask",
+    "propose", "propose_conf", "step", "transfer_leadership",
+    "committed_entries", "has_leader", "leader_mask",
     "run_ticks", "run_until_leader", "CANDIDATE", "FOLLOWER", "LEADER",
     "NONE", "SimConfig", "SimState", "drop_matrix", "init_state",
     "rand_timeout",

@@ -52,6 +52,22 @@ no term inflation from flapping nodes); leader transfer
 lease bypass and proposal blocking while a transfer is in flight).
 Windowed flow control (cfg.inflight = vendor MaxInflightMsgs) pipelines
 appends on the mailbox wire with etcd's probe/replicate Progress states.
+LOG-DRIVEN MEMBERSHIP: conf changes travel as committed CONF_TAG entries
+(propose_conf) and activate at each row's own apply point (Phase E),
+flipping that row's [N] slice of the `member` [N, N] view matrix — the
+device analog of processConfChange (manager/state/raft/raft.go:1939,
+membership/cluster.go:185).  Every quorum computation (votes, rejection
+quorums, CheckQuorum, the commit bisection) counts over the deciding
+row's view; campaign eligibility is the row's own self-membership (etcd
+promotable); snapshots carry the sender's config.  etcd's one-in-flight
+rule (pendingConf), the HUP gate on committed-but-unapplied conf entries
+and the becomeLeader rescan are per-row registers (`pending_conf`,
+`hup_conf`, `tail_conf`), the latter two carried from the previous tick's
+Phase E scan (exact: nothing before their consumers mutates those log
+ranges).  Win/lose poll decisions evaluate only on poll events (candidacy
+start or response arrival), so a conf change shrinking a quorum between
+arrivals cannot retro-promote a stale tally — mirroring core's _poll call
+sites.
 Deliberately simplified vs the host golden core (swarmkit_tpu.raft.core):
 rejection hints are coarse (hint = follower last index), and the
 synchronous wire keeps its one-round-per-tick resend cadence.
@@ -70,12 +86,17 @@ import jax
 import jax.numpy as jnp
 
 from swarmkit_tpu.raft.sim.state import (
-    CANDIDATE, FOLLOWER, LEADER, NONE, SimConfig, SimState, hash32,
-    latency_matrix, rand_timeout,
+    CANDIDATE, CONF_REMOVE, CONF_TAG, CONF_TARGET_MASK, FOLLOWER, LEADER,
+    NONE, SimConfig, SimState, hash32, latency_matrix, rand_timeout,
 )
 
 I32 = jnp.int32
 U32 = jnp.uint32
+
+
+def _is_conf(data: jax.Array) -> jax.Array:
+    """Conf-change entries are tagged in the payload (state.CONF_TAG)."""
+    return (data & U32(CONF_TAG)) != 0
 
 
 def _slot(cfg: SimConfig, idx):
@@ -132,17 +153,25 @@ def step(state: SimState, cfg: SimConfig,
     match, next_, granted = state.match, state.next_, state.granted
     rejected, recent_active = state.rejected, state.recent_active
     pre = state.pre
-    active = state.active
+    member = state.member
+    pending_conf = state.pending_conf
 
-    up = alive & active
-    n_active = jnp.sum(active.astype(I32))
-    quorum = n_active // 2 + 1
+    # Per-row membership views: every quorum decision counts over the
+    # deciding row's APPLIED configuration (reference: each node's prs map
+    # materializes conf changes at its own apply point, raft.go:1939).
+    self_mem = jnp.diagonal(member)                              # [N]
+    n_mem = jnp.sum(member.astype(I32), axis=1)                  # [N]
+    quorum_row = n_mem // 2 + 1                                  # [N]
 
     now = state.tick   # pre-increment tick: all wire timestamps key off it
 
     # ---- Phase A: timers + CheckQuorum + campaign start ------------------
-    is_leader = (role == LEADER) & up
-    elapsed = jnp.where(up, elapsed + 1, elapsed)
+    # Liveness splits from membership: crashed rows freeze entirely;
+    # non-member rows still receive and respond (a joiner must be able to
+    # catch up before its own view says it belongs) but never campaign
+    # (etcd promotable()).
+    is_leader = (role == LEADER) & alive
+    elapsed = jnp.where(alive, elapsed + 1, elapsed)
     hb_elapsed = jnp.where(is_leader, hb_elapsed + 1, hb_elapsed)
 
     # CheckQuorum (vendor raft.go:536-560 tickHeartbeat + checkQuorumActive):
@@ -151,13 +180,13 @@ def step(state: SimState, cfg: SimConfig,
     # instead of lingering until a higher term reaches it.
     check_due = is_leader & (elapsed >= cfg.election_tick)
     heard = recent_active | eye
-    n_heard = jnp.sum((heard & active[None, :]).astype(I32), axis=1)
-    cq_fail = check_due & (n_heard < quorum)
+    n_heard = jnp.sum((heard & member).astype(I32), axis=1)
+    cq_fail = check_due & (n_heard < quorum_row)
     role = jnp.where(cq_fail, FOLLOWER, role)
     lead = jnp.where(cq_fail, NONE, lead)
     elapsed = jnp.where(check_due, 0, elapsed)
     recent_active = jnp.where(check_due[:, None], False, recent_active)
-    is_leader = (role == LEADER) & up
+    is_leader = (role == LEADER) & alive
     # a transfer that hasn't completed within an election timeout is
     # aborted so the leader can accept proposals again (vendor raft.go
     # tickHeartbeat abortLeaderTransfer)
@@ -173,8 +202,11 @@ def step(state: SimState, cfg: SimConfig,
     tn_due = (tn_at > 0) & (state.tick + 1 >= tn_at)
     # only followers act on an equal-term TIMEOUT_NOW (stepCandidate has no
     # case for it); a higher-term one first demotes any non-leader to
-    # follower via the Step catch-up, which then campaigns
-    tn_ok = tn_due & up & active & (role != LEADER) & (tn_term >= term) \
+    # follower via the Step catch-up, which then campaigns.  The target must
+    # consider itself a member (promotable(), vendor stepFollower
+    # MsgTimeoutNow) — but the HUP conf gate does NOT apply (transfer
+    # campaigns bypass it by calling campaign directly).
+    tn_ok = tn_due & alive & self_mem & (role != LEADER) & (tn_term >= term) \
         & ((role == FOLLOWER) | (tn_term > term))
     # Step catch-up for a higher-term TIMEOUT_NOW: only the term carries
     # through — role/vote/lead are immediately overwritten by the forced
@@ -183,7 +215,14 @@ def step(state: SimState, cfg: SimConfig,
     term = jnp.where(tn_newer, tn_term, term)
     tn_at = jnp.where(tn_due, 0, tn_at)
 
-    campaign = (up & (role != LEADER) & (elapsed >= timeout)) & ~tn_ok
+    # tickElection fires for any promotable non-leader whose timer expired
+    # (resetting the timer either way); the HUP step then refuses to
+    # campaign while a conf entry sits committed-but-unapplied (vendor
+    # raft.go Step MsgHup numOfPendingConf gate).
+    want_campaign = (alive & self_mem & (role != LEADER)
+                     & (elapsed >= timeout)) & ~tn_ok
+    elapsed = jnp.where(want_campaign, 0, elapsed)
+    campaign = want_campaign & ~state.hup_conf
     if cfg.pre_vote:
         # becomePreCandidate (vendor raft.go): a non-binding poll — no term
         # bump, no vote change, no timeout re-randomization, and the known
@@ -217,7 +256,7 @@ def step(state: SimState, cfg: SimConfig,
     tx_cand = jnp.where(tn_ok, True, tx_cand)
 
     # ---- Phase B: vote exchange ------------------------------------------
-    is_cand = (role == CANDIDATE) & up
+    is_cand = (role == CANDIDATE) & alive
     # CheckQuorum leader lease (vendor raft.go Step, checkQuorum branch): a
     # receiver that heard from a live leader within the last election_tick
     # ignores vote requests entirely — no term catch-up, no response —
@@ -239,7 +278,9 @@ def step(state: SimState, cfg: SimConfig,
         # duplicate-tolerant voters)
         free = (vreq_at == 0) | (vreq_term != term[:, None]) \
             | (vreq_pre != pre[:, None])
-        send_vr = is_cand[:, None] & ~eye & ~drop & free
+        # requests go only to peers in the CANDIDATE's view (etcd campaigns
+        # over its own prs map)
+        send_vr = is_cand[:, None] & member & ~eye & ~drop & free
         vreq_at = jnp.where(send_vr, now + 1 + lat, vreq_at)
         vreq_term = jnp.where(send_vr, term[:, None], vreq_term)
         vreq_pre = jnp.where(send_vr, pre[:, None], vreq_pre)
@@ -249,12 +290,12 @@ def step(state: SimState, cfg: SimConfig,
         due_vr = (vreq_at > 0) & (now + 1 >= vreq_at)
         deliv = due_vr & (role[:, None] == CANDIDATE) \
             & (term[:, None] == vreq_term) & (pre[:, None] == vreq_pre) \
-            & up[None, :] & (~leased[None, :] | tx_cand[:, None])
+            & alive[None, :] & (~leased[None, :] | tx_cand[:, None])
         req = deliv & ~pre[:, None]
         preq = deliv & pre[:, None]
         vreq_at = jnp.where(due_vr, 0, vreq_at)
     else:
-        base_req = is_cand[:, None] & up[None, :] & ~eye & ~drop \
+        base_req = is_cand[:, None] & member & alive[None, :] & ~eye & ~drop \
             & (~leased[None, :] | tx_cand[:, None])
         req = base_req & ~pre[:, None]
         preq = base_req & pre[:, None]
@@ -288,15 +329,22 @@ def step(state: SimState, cfg: SimConfig,
             granted = granted | (rv_pv & vresp_grant)
             rejected = rejected | (rv_pv & ~vresp_grant)
             vresp_at = jnp.where(due_pv, 0, vresp_at)
+            pv_polled = jnp.any(rv_pv, axis=1)
         else:
             granted = granted | (pv_grant & ~drop.T & pre_cand[:, None])
             rejected = rejected | (pv_reject & ~drop.T & pre_cand[:, None])
+            pv_polled = jnp.any((pv_grant | pv_reject) & ~drop.T
+                                & pre_cand[:, None], axis=1)
         # Pre-quorum -> REAL campaign, evaluated BEFORE the real exchange
         # (vendor stepCandidate transitions the moment the poll reaches
         # quorum): bump term, vote self, reset tallies, re-randomize the
         # timeout.  Real vote requests go out next send opportunity.
-        votes_pv = jnp.sum((granted & active[None, :]).astype(I32), axis=1)
-        pre_win = pre_cand & (votes_pv >= quorum)
+        # Evaluated only on POLL EVENTS (fresh candidacy or a response
+        # arrival, core._poll call sites): a conf change shrinking the
+        # quorum must not retro-promote a stale tally between arrivals.
+        votes_pv = jnp.sum((granted & member).astype(I32), axis=1)
+        pre_win = pre_cand & (votes_pv >= quorum_row) \
+            & (campaign | pv_polled)
         term = term + pre_win.astype(I32)
         vote = jnp.where(pre_win, node, vote)
         pre = jnp.where(pre_win, False, pre)
@@ -315,7 +363,7 @@ def step(state: SimState, cfg: SimConfig,
     role = jnp.where(newer, FOLLOWER, role)
     vote = jnp.where(newer, NONE, vote)
     lead = jnp.where(newer, NONE, lead)
-    is_cand = (role == CANDIDATE) & up  # stepped-down candidates drop out
+    is_cand = (role == CANDIDATE) & alive  # stepped-down candidates drop out
 
     # (last_term / log_ok computed above the PreVote block; Phase B never
     # mutates log state, so they stay valid here.)
@@ -349,18 +397,28 @@ def step(state: SimState, cfg: SimConfig,
         granted = granted | (rvalid & vresp_grant)
         rejected = rejected | (rvalid & ~vresp_grant)
         vresp_at = jnp.where(due_vs, 0, vresp_at)
+        v_polled = jnp.any(rvalid & ~vresp_pre, axis=1)
     else:
         real_cand = is_cand & ~pre
         resp_arrive = grant_mat & ~drop.T
         granted = granted | (resp_arrive & real_cand[:, None])
         reject_arrive = cur & ~grant_mat & ~drop.T
         rejected = rejected | (reject_arrive & real_cand[:, None])
+        v_polled = jnp.any((resp_arrive | reject_arrive)
+                           & real_cand[:, None], axis=1)
 
     # (pre-candidacies transitioned in the PreVote block above; a fresh
     # pre-winner has granted=eye here, so with a single active voter it
     # wins immediately — core's _campaign self-poll cascade.)
-    votes = jnp.sum((granted & active[None, :]).astype(I32), axis=1)
-    win = is_cand & ~pre & (votes >= quorum)
+    # Votes (and rejections) count only from peers in the candidate's OWN
+    # view — a grant from a node the candidacy's config no longer contains
+    # is dead weight (modern etcd tallies over the tracker config).
+    # Win/lose evaluate only on POLL EVENTS (candidacy start or response
+    # arrival — core's _poll call sites): a conf change shrinking quorum
+    # between arrivals must not retro-promote a stale tally.
+    fresh_real = tn_ok | (pre_win if cfg.pre_vote else campaign)
+    votes = jnp.sum((granted & member).astype(I32), axis=1)
+    win = is_cand & ~pre & (votes >= quorum_row) & (fresh_real | v_polled)
     # Rejection quorum: the candidate stands down (a REAL candidacy keeps
     # term and vote; a pre-candidacy keeps both untouched by design) and
     # waits out its timeout. A voter that granted earlier in the term never
@@ -368,9 +426,8 @@ def step(state: SimState, cfg: SimConfig,
     # per voter (core._poll), and within one candidacy a grant can only
     # precede a rejection (log/vote checks are monotone), so masking with
     # ~granted reproduces first-response-wins exactly.
-    n_rej = jnp.sum((rejected & ~granted & active[None, :]).astype(I32),
-                    axis=1)
-    lose = is_cand & ~win & (n_rej >= quorum)
+    n_rej = jnp.sum((rejected & ~granted & member).astype(I32), axis=1)
+    lose = is_cand & ~win & (n_rej >= quorum_row) & (fresh_real | v_polled)
     role = jnp.where(lose, FOLLOWER, role)
     lead = jnp.where(lose, NONE, lead)  # become_follower(term, NONE)
     pre = pre & ~lose
@@ -379,6 +436,11 @@ def step(state: SimState, cfg: SimConfig,
     lead = jnp.where(win, node, lead)
     hb_elapsed = jnp.where(win, 0, hb_elapsed)
     elapsed = jnp.where(win, 0, elapsed)
+    # becomeLeader re-derives the propose gate from the uncommitted tail
+    # (vendor becomeLeader numOfPendingConf over (commit, last]); tail_conf
+    # is the end-of-previous-tick scan, still exact here because Phase A/B
+    # never append and propose() carries no conf entries.
+    pending_conf = jnp.where(win, state.tail_conf, pending_conf)
     next_ = jnp.where(win[:, None], (last + 1)[:, None], next_)
     match = jnp.where(win[:, None], 0, match)
     recent_active = jnp.where(win[:, None], eye, recent_active)
@@ -393,7 +455,7 @@ def step(state: SimState, cfg: SimConfig,
     log_data = log_data.at[node, noop_slot].set(
         jnp.where(win, U32(0), log_data[node, noop_slot]))
     last = last + win.astype(I32)
-    is_leader = (role == LEADER) & up
+    is_leader = (role == LEADER) & alive
     match = jnp.where(win[:, None] & eye, last[:, None], match)
 
     # ---- Phase C: append / heartbeat fan-out -----------------------------
@@ -419,7 +481,7 @@ def step(state: SimState, cfg: SimConfig,
         prev_send = next_ - 1
         can_ring_send = prev_send >= snap_idx[:, None]
         has_new = next_ <= last[:, None]
-        send_base = is_leader[:, None] & active[None, :] & ~eye & ~drop \
+        send_base = is_leader[:, None] & member & ~eye & ~drop \
             & snp_free
         # StateProbe: one append at a time, no pipelining; StateReplicate:
         # pipeline while a slot is free (vendor progress.go)
@@ -444,7 +506,7 @@ def step(state: SimState, cfg: SimConfig,
         due_k = (app_at > 0) & (now + 1 >= app_at)
         lead_k = role[:, None, None] == LEADER
         valid_k = due_k & lead_k & (app_term_box == term_k) \
-            & up[None, :, None] & (app_prev >= snap_idx[:, None, None])
+            & alive[None, :, None] & (app_prev >= snap_idx[:, None, None])
         big = jnp.iinfo(jnp.int32).max
         key = jnp.where(valid_k, app_prev, big)
         sel_prev = jnp.min(key, axis=2)                           # [i, j]
@@ -456,13 +518,13 @@ def step(state: SimState, cfg: SimConfig,
         app_at = jnp.where(taken | (due_k & ~valid_k), 0, app_at)
         due_s = (snp_at > 0) & (now + 1 >= snp_at)
         send_snap = due_s & (role[:, None] == LEADER) \
-            & (term_e == snp_term_box) & up[None, :]
+            & (term_e == snp_term_box) & alive[None, :]
         prev_mat = sel_prev
         snp_at = jnp.where(due_s, 0, snp_at)
     else:
         prev_mat = next_ - 1                                     # [i, j]
         can_ring = prev_mat >= snap_idx[:, None]
-        send_base = is_leader[:, None] & up[None, :] & active[None, :] \
+        send_base = is_leader[:, None] & alive[None, :] & member \
             & ~eye & ~drop
         send_app = send_base & can_ring
         send_snap = send_base & ~can_ring
@@ -484,7 +546,7 @@ def step(state: SimState, cfg: SimConfig,
     role = jnp.where(has_lmsg & (role == CANDIDATE), FOLLOWER, role)
     lead = jnp.where(has_lmsg, src, lead)
     elapsed = jnp.where(has_lmsg, 0, elapsed)
-    is_leader = (role == LEADER) & up
+    is_leader = (role == LEADER) & alive
 
     got_app = has_lmsg & send_app[src, node]
     got_snap = has_lmsg & send_snap[src, node]
@@ -566,6 +628,12 @@ def step(state: SimState, cfg: SimConfig,
     snap_term, snap_chk, snap_idx = new_snap_term, new_snap_chk, new_snap_idx
     log_term = jnp.where(do_restore[:, None], 0, log_term)
     log_data = jnp.where(do_restore[:, None], U32(0), log_data)
+    # The snapshot carries the sender's configuration (SnapshotMeta.voters;
+    # core._restore rebuilds prs from it): adopt the sender's view.  Conf
+    # entries in (snap_idx, sender.applied] are re-applied later via the
+    # append path — membership flips are idempotent sets, so the early
+    # adoption is safe.
+    member = jnp.where(do_restore[:, None], member[r_src], member)
 
     # -- responses back to senders (j -> i), may be dropped.
     # A duplicate snapshot (sender watermark <= our commit) still gets an
@@ -654,7 +722,8 @@ def step(state: SimState, cfg: SimConfig,
     # transferee branch).  Single slot per target; concurrent transfers to
     # one target are rare and last-writer-wins.
     tgt = jnp.clip(transferee, 0, n - 1)
-    has_tx = is_leader & (transferee != NONE) & active[tgt] & (tgt != node)
+    tgt_mem = jnp.take_along_axis(member, tgt[:, None], axis=1)[:, 0]
+    has_tx = is_leader & (transferee != NONE) & tgt_mem & (tgt != node)
     caught = has_tx & (match[node, tgt] == last)
     if cfg.mailboxes:
         tn_lat_i = lat[node, tgt]
@@ -675,13 +744,13 @@ def step(state: SimState, cfg: SimConfig,
     # ceil(log2(L))+1 rounds of [N, N] compares) instead of sorting [N, N]
     # every tick.
     match = jnp.where(is_leader[:, None] & eye, last[:, None], match)
-    match_eff = jnp.where(active[None, :], match, -1)
+    match_eff = jnp.where(member, match, -1)
 
     def _bisect(_, lo_hi):
         lo, hi_b = lo_hi
         mid = (lo + hi_b + 1) >> 1
         cnt = jnp.sum((match_eff >= mid[:, None]).astype(I32), axis=1)
-        ok = (cnt >= quorum) & (hi_b >= mid) & (mid > lo)
+        ok = (cnt >= quorum_row) & (hi_b >= mid) & (mid > lo)
         lo = jnp.where(ok, mid, lo)
         hi_b = jnp.where(ok, hi_b, mid - 1)
         return lo, hi_b
@@ -692,16 +761,56 @@ def step(state: SimState, cfg: SimConfig,
     can_commit = is_leader & (mci > commit) & (mci_term == term)
     commit = jnp.where(can_commit, mci, commit)
 
-    # ---- Phase E: apply + checksum accumulation --------------------------
+    # ---- Phase E: apply + checksum accumulation + conf activation --------
     # Entries (applied, new_applied] are summed in place via the slot->index
     # map of the OWN ring; _entry_chk is order-independent so no cumsum ring
-    # is needed.
+    # is needed.  Conf-change entries activate HERE — at apply time, exactly
+    # like the reference's processConfChange (raft.go:1939) — and the batch
+    # is clamped AT the first conf entry so at most one membership flip
+    # lands per row per tick (order within a batch is thereby trivial; the
+    # propose-side one-in-flight gate makes >1 conf per window rare anyway).
     own_idx = _idx_at_slots(cfg, last)                           # [N, L]
-    new_applied = jnp.minimum(commit, applied + cfg.apply_batch)
-    app_mask = (own_idx > applied[:, None]) & (own_idx <= new_applied[:, None])
+    is_conf_ring = _is_conf(log_data)                            # [N, L]
+    base_applied = jnp.minimum(commit, applied + cfg.apply_batch)
+    win_mask = (own_idx > applied[:, None]) \
+        & (own_idx <= base_applied[:, None])
+    conf_in_win = win_mask & is_conf_ring
+    big = jnp.iinfo(jnp.int32).max
+    first_conf = jnp.min(jnp.where(conf_in_win, own_idx, big), axis=1)
+    has_conf = first_conf < big
+    new_applied = jnp.minimum(base_applied,
+                              jnp.where(has_conf, first_conf, big))
+    app_mask = win_mask & (own_idx <= new_applied[:, None])
     contrib = jnp.where(app_mask, _entry_chk(own_idx, log_data), U32(0))
     apply_chk = apply_chk + jnp.sum(contrib, axis=1, dtype=U32)
     applied = new_applied
+
+    # Decode + apply the (single) conf entry at new_applied.
+    cslot = _slot(cfg, jnp.where(has_conf, first_conf, 1))
+    cdata = jnp.take_along_axis(log_data, cslot[:, None], axis=1)[:, 0]
+    ctgt = jnp.clip((cdata & U32(CONF_TARGET_MASK)).astype(I32), 0, n - 1)
+    c_rm = (cdata & U32(CONF_REMOVE)) != 0
+    tgt_onehot = node[None, :] == ctgt[:, None]                  # [N, N]
+    was_member = jnp.take_along_axis(member, ctgt[:, None], axis=1)[:, 0]
+    newly_added = has_conf & ~c_rm & ~was_member
+    member = jnp.where(has_conf[:, None] & tgt_onehot,
+                       ~c_rm[:, None], member)
+    # add_node initializes a fresh Progress(next=last+1, match=0,
+    # recent_active=True) on every row (meaningful on leaders; core add_node
+    # does the same unconditionally).  Re-adding an existing member keeps
+    # its progress (core: early return).
+    reset_pr = newly_added[:, None] & tgt_onehot
+    match = jnp.where(reset_pr, 0, match)
+    next_ = jnp.where(reset_pr, (last + 1)[:, None], next_)
+    recent_active = jnp.where(reset_pr, True, recent_active)
+    if cfg.mailboxes:
+        probing = jnp.where(reset_pr, True, probing)
+    # remove_node aborts an in-flight transfer to the removed peer
+    # (core.remove_node) ...
+    transferee = jnp.where(has_conf & c_rm & (transferee == ctgt),
+                           NONE, transferee)
+    # ... and clears the leader's propose gate (add/remove_node both do).
+    pending_conf = pending_conf & ~has_conf
 
     # ---- Phase F: compaction (ring-pressure driven) ----------------------
     # Compact to applied-keep (mirroring LogEntriesForSlowFollowers=500)
@@ -726,6 +835,15 @@ def step(state: SimState, cfg: SimConfig,
     pre = pre & (role == CANDIDATE)
     tx_cand = tx_cand & (role == CANDIDATE) & ~pre
     transferee = jnp.where(role == LEADER, transferee, NONE)
+
+    # End-of-tick conf-gate scans, carried for the NEXT tick's Phase A/B
+    # (exact there: nothing that runs before them mutates (applied, commit]
+    # or adds conf entries to (commit, last] — propose() masks the tag bit
+    # and propose_conf() updates pending_conf itself).
+    hup_conf = jnp.any((own_idx > applied[:, None])
+                       & (own_idx <= commit[:, None]) & is_conf_ring, axis=1)
+    tail_conf = jnp.any((own_idx > commit[:, None])
+                        & (own_idx <= last[:, None]) & is_conf_ring, axis=1)
     boxes = {}
     if cfg.mailboxes:
         boxes = dict(
@@ -748,27 +866,42 @@ def step(state: SimState, cfg: SimConfig,
         rejected=rejected, recent_active=recent_active, pre=pre,
         transferee=transferee, tx_cand=tx_cand,
         tn_at=tn_at, tn_term=tn_term, tn_from=tn_from,
+        member=member, pending_conf=pending_conf,
+        hup_conf=hup_conf, tail_conf=tail_conf,
         tick=state.tick + 1,
         **boxes,
     )
 
 
+def _leader_ok(state: SimState, cfg: SimConfig, alive=None):
+    """Rows that accept proposals: leaders still in their own applied
+    config (core raises ProposalDropped for a removed proposer), with ring
+    room and no transfer in flight.  `alive` optionally masks crashed
+    claimants (clients cannot reach a crashed process)."""
+    is_leader = (state.role == LEADER) & jnp.diagonal(state.member)
+    room = (state.last + cfg.max_props - state.snap_idx) <= cfg.log_len
+    ok = is_leader & room & (state.transferee == NONE)
+    if alive is not None:
+        ok = ok & alive
+    return ok
+
+
 def propose(state: SimState, cfg: SimConfig, payloads: jax.Array,
-            count) -> SimState:
+            count, alive=None) -> SimState:
     """Append up to `count` payload entries to every node currently acting
     as leader (clients talk to whoever claims leadership; only a real
-    leader's entries can ever commit). payloads: [max_props] uint32."""
+    leader's entries can ever commit). payloads: [max_props] uint32
+    (bit 31 is reserved for conf entries and masked off)."""
     n = cfg.n
     node = jnp.arange(n, dtype=I32)
-    is_leader = (state.role == LEADER) & state.active
-    room = (state.last + cfg.max_props - state.snap_idx) <= cfg.log_len
     # a transferring leader rejects proposals (vendor stepLeader MsgProp:
     # ErrProposalDropped while leadTransferee is set)
-    ok = is_leader & room & (state.transferee == NONE)
+    ok = _leader_ok(state, cfg, alive)
     k = jnp.arange(cfg.max_props, dtype=I32)
     valid = (k[None, :] < count) & ok[:, None]                   # [N, B]
     idx = state.last[:, None] + 1 + k[None, :]
     slot = _slot(cfg, idx)
+    payloads = payloads & U32(0x7FFFFFFF)
     pl = jnp.broadcast_to(payloads[None, :], (n, cfg.max_props))
     log_term = state.log_term.at[node[:, None], slot].set(
         jnp.where(valid, state.term[:, None], state.log_term[node[:, None], slot]))
@@ -783,7 +916,7 @@ def propose(state: SimState, cfg: SimConfig, payloads: jax.Array,
 
 def propose_dense(state: SimState, cfg: SimConfig,
                   payload_fn: Callable[[jax.Array, jax.Array], jax.Array],
-                  count) -> SimState:
+                  count, alive=None) -> SimState:
     """Gather/scatter-free propose for the benchmark hot path: payloads are
     generated ON DEVICE as payload_fn(tick, k) (k = 0..count-1, uint32
     result), written via the slot->index map as elementwise [N, L] masked
@@ -791,15 +924,14 @@ def propose_dense(state: SimState, cfg: SimConfig,
     payloads[k] = payload_fn(tick, k) — asserted by tests/test_raft_sim.py.
     """
     n = cfg.n
-    is_leader = (state.role == LEADER) & state.active
-    room = (state.last + cfg.max_props - state.snap_idx) <= cfg.log_len
-    ok = is_leader & room & (state.transferee == NONE)
+    ok = _leader_ok(state, cfg, alive)
     count = jnp.asarray(count, I32)
     # slot -> new index map anchored one batch ahead of last
     new_idx = _idx_at_slots(cfg, state.last + count)             # [N, L]
     k_of = new_idx - state.last[:, None] - 1                     # [N, L]
     valid = ok[:, None] & (k_of >= 0) & (k_of < count)
-    pl = payload_fn(state.tick, jnp.maximum(k_of, 0).astype(U32))
+    pl = payload_fn(state.tick, jnp.maximum(k_of, 0).astype(U32)) \
+        & U32(0x7FFFFFFF)
     log_term = jnp.where(valid, state.term[:, None], state.log_term)
     log_data = jnp.where(valid, pl, state.log_data)
     new_last = state.last + jnp.where(ok, count, 0).astype(I32)
@@ -819,10 +951,50 @@ def transfer_leadership(state: SimState, cfg: SimConfig, leader,
     leader = jnp.asarray(leader, I32)
     target = jnp.asarray(target, I32)
     is_l = (state.role[leader] == LEADER) & (target != leader) \
-        & state.active[target]
+        & state.member[leader, target]
     changed = is_l & (state.transferee[leader] != target)
     transferee = state.transferee.at[leader].set(
         jnp.where(changed, target, state.transferee[leader]))
     elapsed = state.elapsed.at[leader].set(
         jnp.where(changed, 0, state.elapsed[leader]))
     return dataclasses.replace(state, transferee=transferee, elapsed=elapsed)
+
+
+def propose_conf(state: SimState, cfg: SimConfig, target, remove,
+                 alive=None) -> SimState:
+    """Propose ONE membership change (add/remove `target`) to every node
+    currently accepting proposals.  Mirrors core stepLeader MsgProp with a
+    CONF_CHANGE entry (vendor raft.go:~700): while an earlier conf change
+    is still in flight on that leader (pending_conf), the entry DEGRADES to
+    an empty normal entry — the one-in-flight rule that keeps apply windows
+    to at most one membership flip.  Activation happens at apply time in
+    step() Phase E; reference flow manager/state/raft/raft.go:920-1087
+    (Join/Leave) -> :1939 (processConfChange)."""
+    n = cfg.n
+    node = jnp.arange(n, dtype=I32)
+    # targets outside [0, n) would be clipped to row n-1 by the Phase E
+    # decode (and ghost-voted by the host oracle) — reject at the edge
+    target = jnp.clip(jnp.asarray(target, I32), 0, n - 1)
+    remove = jnp.asarray(remove, bool)
+    ok = _leader_ok(state, cfg, alive)
+    payload = jnp.where(
+        ok & ~state.pending_conf,
+        U32(CONF_TAG)
+        | jnp.where(remove, U32(CONF_REMOVE), U32(0))
+        | target.astype(U32),
+        U32(0))                                   # degraded: empty normal
+    idx = state.last + 1
+    slot = _slot(cfg, idx)
+    log_term = state.log_term.at[node, slot].set(
+        jnp.where(ok, state.term, state.log_term[node, slot]))
+    log_data = state.log_data.at[node, slot].set(
+        jnp.where(ok, payload, state.log_data[node, slot]))
+    new_last = state.last + ok.astype(I32)
+    eye = jnp.eye(n, dtype=bool)
+    match = jnp.where(ok[:, None] & eye, new_last[:, None], state.match)
+    pending_conf = state.pending_conf | ok
+    tail_conf = state.tail_conf | (ok & ~state.pending_conf)
+    return dataclasses.replace(state, log_term=log_term, log_data=log_data,
+                               last=new_last, match=match,
+                               pending_conf=pending_conf,
+                               tail_conf=tail_conf)

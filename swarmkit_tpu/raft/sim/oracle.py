@@ -54,8 +54,23 @@ divergences.
     those rules and drives core CheckQuorum decisions itself (oracle
     Config(check_quorum=False) so core's internal lease stays off).
  D5 proposals go to every node claiming leadership (even a crashed one —
-    kernel propose() masks on role/active only), and apply/compaction run
-    on crashed rows too (kernel phases E/F have no alive mask).
+    kernel propose() masks on role/self-membership only), and apply/
+    compaction run on crashed rows too (kernel phases E/F have no alive
+    mask).
+
+MEMBERSHIP REPLAY (log-driven conf changes): _phase_propose_conf mirrors
+kernel propose_conf (one CONF entry per leader, degraded to an empty
+normal entry while one is pending); the apply loop in _phase_def clamps
+each batch at the first conf entry (kernel's one-flip-per-tick rule) and
+calls core add_node/remove_node at the apply point, with remove_node's
+quorum-lowering commit re-check deferred to the next Phase D
+(recheck=False) and commit advancement HELD during the propose phases
+(SyncRaft._maybe_commit) — both keep commit evaluation at the kernel's
+once-per-tick Phase D position without changing any decision.  Vote
+request/append send loops follow each sender's CURRENT prs view, and
+responses from peers outside the config are dropped exactly as core's
+stepLeader does.  The per-tick comparison includes the full [N, N]
+member-view matrix.
 """
 
 from __future__ import annotations
@@ -69,7 +84,9 @@ from swarmkit_tpu.raft.log import CompactedError, RaftLog, UnavailableError
 from swarmkit_tpu.raft.messages import (
     Entry, EntryType, Message, MsgType, Snapshot, SnapshotMeta,
 )
-from swarmkit_tpu.raft.sim.state import SimConfig
+from swarmkit_tpu.raft.sim.state import (
+    CONF_REMOVE, CONF_TARGET_MASK, SimConfig, conf_payload,
+)
 
 M32 = 0xFFFFFFFF
 
@@ -107,11 +124,23 @@ class SyncRaft(core.Raft):
     windowed side-effect-free appends, and a suppress flag that swallows
     sends triggered while responses are being stepped."""
 
-    def __init__(self, cfg: core.Config, window: int):
-        super().__init__(cfg)
+    def __init__(self, cfg: core.Config, window: int, voters=None):
+        super().__init__(cfg, voters=voters)
         self.window = window
         self.suppress = False
+        self.hold_commit = False
         self.cluster = None   # backref set by OracleCluster (ring clamp)
+
+    def _maybe_commit(self) -> bool:
+        """Commit advancement is HELD during the propose phases: the kernel
+        evaluates commit once per tick in Phase D (after sends), so a
+        propose-time advance — possible when a quorum-lowering removal
+        applied last tick left commit lagging — would leak a newer commit
+        index into this tick's appends.  Held advances land in _phase_def
+        (same decision, kernel timing)."""
+        if self.hold_commit:
+            return False
+        return super()._maybe_commit()
 
     def _ring_limit(self, to: int, prev: int) -> int:
         """Receiver ring headroom (kernel's snap_idx + L - prev clamp):
@@ -169,18 +198,23 @@ class OracleView:
     commit: np.ndarray
     applied: np.ndarray
     apply_chk: np.ndarray
+    member: np.ndarray   # [N, N] per-node applied-config views
 
     FIELDS = ("term", "vote", "role", "lead", "last", "commit", "applied",
-              "apply_chk")
+              "apply_chk", "member")
 
 
 class OracleCluster:
     """N core.Raft nodes stepped with the kernel's phase schedule."""
 
-    def __init__(self, cfg: SimConfig):
+    def __init__(self, cfg: SimConfig, voters=None):
         self.cfg = cfg
         n = cfg.n
         peers = tuple(range(1, n + 1))  # core ids are 1-based (NONE=0)
+        # bootstrap configuration (kernel init_state(voters=...)): every
+        # node knows the same initial member set; non-members stay passive
+        boot = peers if voters is None else tuple(
+            v + 1 for v in sorted(voters))
         self.nodes = [
             SyncRaft(core.Config(id=i + 1, peers=peers,
                                  election_tick=cfg.election_tick,
@@ -190,7 +224,7 @@ class OracleCluster:
                                  check_quorum=False,
                                  pre_vote=cfg.pre_vote,
                                  seed=cfg.seed),
-                     window=cfg.window)
+                     window=cfg.window, voters=boot)
             for i in range(n)
         ]
         for nd in self.nodes:
@@ -256,7 +290,9 @@ class OracleCluster:
             return
         ents = tuple(
             Entry(type=EntryType.NORMAL,
-                  data=int(payloads[k]).to_bytes(4, "big"))
+                  # bit 31 is reserved for conf entries; kernel propose()
+                  # masks it off, so the oracle must store the same value
+                  data=(int(payloads[k]) & 0x7FFFFFFF).to_bytes(4, "big"))
             for k in range(prop_count))
         for nd in self.nodes:
             if nd.state != core.LEADER:
@@ -265,12 +301,39 @@ class OracleCluster:
                     - nd.log.offset) <= cfg.log_len
             if not room:
                 continue
-            nd.suppress = True
+            nd.suppress = nd.hold_commit = True
             try:
                 nd.step(Message(type=MsgType.PROP, frm=nd.id, entries=ents))
             except core.ProposalDropped:
                 pass
-            nd.suppress = False
+            nd.suppress = nd.hold_commit = False
+            nd.take_msgs()
+
+    def _phase_propose_conf(self, conf) -> None:
+        """Phase 0b: one membership-change proposal (kernel propose_conf).
+        conf = (target_row, remove).  Core's stepLeader degrades the entry
+        to an empty normal one while an earlier conf change is pending —
+        the one-in-flight rule."""
+        if conf is None:
+            return
+        cfg = self.cfg
+        tgt, rm = conf
+        ent = Entry(type=EntryType.CONF_CHANGE,
+                    data=conf_payload(int(tgt), bool(rm)).to_bytes(4, "big"))
+        for nd in self.nodes:
+            if nd.state != core.LEADER:
+                continue
+            room = (nd.log.last_index() + cfg.max_props
+                    - nd.log.offset) <= cfg.log_len
+            if not room:
+                continue
+            nd.suppress = nd.hold_commit = True
+            try:
+                nd.step(Message(type=MsgType.PROP, frm=nd.id,
+                                entries=(ent,)))
+            except core.ProposalDropped:
+                pass
+            nd.suppress = nd.hold_commit = False
             nd.take_msgs()
 
     def _phase_a(self, up) -> None:
@@ -284,8 +347,9 @@ class OracleCluster:
             # have heard from a quorum since its last round (kernel Phase A)
             if up[i] and nd.state == core.LEADER \
                     and self.elapsed[i] >= cfg.election_tick:
-                heard = self.recent_active[i] | {i}
-                if len(heard) < (n // 2 + 1):
+                members = {p - 1 for p in nd.prs}
+                heard = (self.recent_active[i] | {i}) & members
+                if len(heard) < nd.quorum():
                     nd.become_follower(nd.term, core.NONE)
                 else:
                     # transfer not completed within an election timeout:
@@ -299,7 +363,11 @@ class OracleCluster:
         for i, nd in enumerate(nodes):
             if not up[i]:
                 continue
-            if nd.state != core.LEADER and self.elapsed[i] >= self.timeout[i]:
+            # tickElection: only promotable nodes fire (the timer resets
+            # either way once it expires); core's HUP step then refuses to
+            # campaign over a committed-but-unapplied conf entry
+            if nd.state != core.LEADER and nd.promotable() \
+                    and self.elapsed[i] >= self.timeout[i]:
                 self.elapsed[i] = 0
                 nd.step(Message(type=MsgType.HUP, frm=nd.id))
                 nd.take_msgs()  # Phase B re-emits vote requests uniformly
@@ -329,12 +397,34 @@ class OracleCluster:
                 self.apply_chk[i] = base
             new_applied = min(nd.log.committed,
                               self.applied[i] + cfg.apply_batch)
+            # at most ONE membership flip lands per node per tick: the
+            # batch clamps at the first conf entry (kernel Phase E clamp)
+            for idx in range(self.applied[i] + 1, new_applied + 1):
+                e = nd.log.entries[idx - nd.log.offset - 1]
+                if e.type == EntryType.CONF_CHANGE:
+                    new_applied = idx
+                    break
             for idx in range(self.applied[i] + 1, new_applied + 1):
                 e = nd.log.entries[idx - nd.log.offset - 1]
                 d = _data_u32(e)
                 self._canon_note(idx, e.term, d)
                 self.apply_chk[i] = (self.apply_chk[i]
                                      + entry_chk_py(idx, d)) & M32
+                if e.type == EntryType.CONF_CHANGE:
+                    # kernel Phase E clips the decoded target into range
+                    tgt = min(d & CONF_TARGET_MASK, self.cfg.n - 1) + 1
+                    if d & CONF_REMOVE:
+                        # quorum-lowering commit re-check waits for the
+                        # next Phase D (the oracle evaluates commit once
+                        # per tick, same decision one tick later)
+                        nd.remove_node(tgt, recheck=False)
+                    else:
+                        newly = tgt not in nd.prs
+                        nd.add_node(tgt)
+                        if newly:
+                            # kernel: a fresh joiner starts recent_active
+                            # (core add_node pr.recent_active analog)
+                            self.recent_active[i].add(tgt - 1)
             self.applied[i] = new_applied
             nd.log.applied_to(new_applied)
         for i, nd in enumerate(nodes):
@@ -377,7 +467,8 @@ class OracleCluster:
                     or nd.lead_transferee == core.NONE:
                 continue
             t = nd.lead_transferee - 1
-            if t == i or not (0 <= t < n) or t in self.tnq:
+            if t == i or not (0 <= t < n) or t in self.tnq \
+                    or nd.lead_transferee not in nd.prs:
                 continue
             if nd.prs[nd.lead_transferee].match != nd.log.last_index():
                 continue
@@ -426,7 +517,8 @@ class OracleCluster:
             if not up[i] or nd.state != core.PRE_CANDIDATE:
                 continue
             for j in range(n):
-                if j == i or not up[j] or drop[i][j] or leased[j]:
+                if j == i or not up[j] or drop[i][j] or leased[j] \
+                        or (j + 1) not in nd.prs:
                     continue
                 pv_requests.append((i, j, Message(
                     type=MsgType.PRE_VOTE, to=j + 1, frm=nd.id,
@@ -466,19 +558,21 @@ class OracleCluster:
             nodes[i].take_msgs()
 
     # -- one kernel-schedule tick -----------------------------------------
-    def tick(self, alive, drop, payloads=(), prop_count: int = 0) -> None:
+    def tick(self, alive, drop, payloads=(), prop_count: int = 0,
+             conf=None) -> None:
         if self.cfg.mailboxes:
-            self._tick_mailbox(alive, drop, payloads, prop_count)
+            self._tick_mailbox(alive, drop, payloads, prop_count, conf)
         else:
-            self._tick_sync(alive, drop, payloads, prop_count)
+            self._tick_sync(alive, drop, payloads, prop_count, conf)
 
-    def _tick_sync(self, alive, drop, payloads=(), prop_count: int = 0
-                   ) -> None:
+    def _tick_sync(self, alive, drop, payloads=(), prop_count: int = 0,
+                   conf=None) -> None:
         cfg, n = self.cfg, self.cfg.n
         nodes = self.nodes
         up = [bool(alive[i]) for i in range(n)]
 
         self._phase_propose(payloads, prop_count)
+        self._phase_propose_conf(conf)
         self._phase_a(up)
 
         # Phase B: vote exchange. Candidates re-request every tick (the
@@ -502,6 +596,7 @@ class OracleCluster:
                 continue
             for j in range(n):
                 if j == i or not up[j] or drop[i][j] \
+                        or (j + 1) not in nd.prs \
                         or (leased[j] and not self._is_tx(i)):
                     continue
                 requests.append((i, j, Message(
@@ -582,8 +677,8 @@ class OracleCluster:
         self._phase_def(up)
         self.now += 1
 
-    def _tick_mailbox(self, alive, drop, payloads=(), prop_count: int = 0
-                      ) -> None:
+    def _tick_mailbox(self, alive, drop, payloads=(), prop_count: int = 0,
+                      conf=None) -> None:
         """Replay of the kernel's mailbox wire (kernel.py Phase B/C under
         cfg.mailboxes): sends fill empty per-edge slots capturing (term,
         prev); deliveries at deliver-tick construct messages from the
@@ -596,6 +691,7 @@ class OracleCluster:
         now = self.now
 
         self._phase_propose(payloads, prop_count)
+        self._phase_propose_conf(conf)
         self._phase_a(up)
 
         # ---- Phase B: vote wire ----
@@ -607,7 +703,7 @@ class OracleCluster:
                 continue
             is_pre = nd.state == core.PRE_CANDIDATE
             for j in range(n):
-                if j == i or drop[i][j]:
+                if j == i or drop[i][j] or (j + 1) not in nd.prs:
                     continue
                 slot = self.vreq.get((i, j))
                 if slot is None or slot[1] != nd.term or slot[2] != is_pre:
@@ -728,7 +824,7 @@ class OracleCluster:
             if not up[i] or nd.state != core.LEADER:
                 continue
             for j in range(n):
-                if j == i or drop[i][j]:
+                if j == i or drop[i][j] or (j + 1) not in nd.prs:
                     continue
                 q = [e for e in self.appq.get((i, j), [])
                      if e[2] == nd.term]
@@ -831,9 +927,14 @@ class OracleCluster:
                 nd.step(resp)
                 nd.suppress = False
                 nd.take_msgs()
-            if rej_hints and nd.state == core.LEADER:
+            if rej_hints and nd.state == core.LEADER \
+                    and (j + 1) in nd.prs:
                 # kernel reject rule + becomeProbe (flush pipelined
-                # same-term appends past the conflict)
+                # same-term appends past the conflict).  Responses from a
+                # peer the config no longer contains are dropped (core
+                # stepLeader: prs.get(m.frm) is None -> return; the kernel
+                # integrates them into progress state that is masked out of
+                # every quorum count and reset wholesale on re-add).
                 pr = nd.prs[j + 1]
                 pr.next = max(1, min(pr.next - 1, min(rej_hints) + 1))
                 pr.state = core.PROBE
@@ -863,4 +964,6 @@ class OracleCluster:
             commit=arr(lambda nd, i: nd.log.committed),
             applied=arr(lambda nd, i: self.applied[i]),
             apply_chk=arr(lambda nd, i: self.apply_chk[i], np.uint32),
+            member=np.array([[(j + 1) in nodes[i].prs for j in range(n)]
+                             for i in range(n)], dtype=bool),
         )

@@ -25,7 +25,7 @@ U32 = jnp.uint32
 
 
 def leader_mask(state: SimState) -> jax.Array:
-    return (state.role == LEADER) & state.active
+    return (state.role == LEADER) & jnp.diagonal(state.member)
 
 
 def has_leader(state: SimState) -> jax.Array:

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 from dataclasses import dataclass
-from typing import Optional
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -25,6 +25,20 @@ CANDIDATE = 1
 LEADER = 2
 
 NONE = -1
+
+# Conf-change entries ride the uint32 payload with a tag bit (the device
+# analog of raftpb EntryConfChange + ConfChange{Add,Remove}Node; reference
+# apply path manager/state/raft/raft.go:1939 processConfChange):
+#   bit 31 = conf entry, bit 30 = remove (else add), low 16 bits = target row.
+# Normal payloads must stay below bit 31 (propose() masks them).
+CONF_TAG = 0x8000_0000
+CONF_REMOVE = 0x4000_0000
+CONF_TARGET_MASK = 0xFFFF
+
+
+def conf_payload(target: int, remove: bool) -> int:
+    """uint32 payload encoding one ConfChange (add/remove of `target`)."""
+    return CONF_TAG | (CONF_REMOVE if remove else 0) | (target & CONF_TARGET_MASK)
 
 
 @dataclass(frozen=True)
@@ -143,8 +157,25 @@ class SimState:
     tn_from: jax.Array     # i32 [N]: sender leader row
     recent_active: jax.Array  # bool: leader i heard from j since the last
                               # CheckQuorum round (Progress.RecentActive)
-    # membership / liveness [N] bool
-    active: jax.Array      # raft membership (conf changes flip these)
+    # membership [N, N] bool: member[i, j] = row i's APPLIED configuration
+    # contains j.  Conf changes travel as committed CONF_TAG log entries and
+    # flip these at apply time (Phase E) — per-node views, exactly like each
+    # etcd node's prs map materializing at its own apply point (reference
+    # raft.go:1939 processConfChange, membership/cluster.go:185).  Every
+    # quorum computation (votes, rejections, CheckQuorum, commit bisection)
+    # counts over the deciding row's view.
+    member: jax.Array
+    # conf-change gates [N] bool (etcd pendingConf + the HUP gate):
+    pending_conf: jax.Array  # leader propose gate: a conf entry this leader
+                             # appended is not yet applied (a second conf
+                             # proposal degrades to an empty normal entry,
+                             # vendor raft.go stepLeader MsgProp)
+    hup_conf: jax.Array      # campaign gate: a conf entry sits in
+                             # (applied, commit] (vendor raft.go HUP case);
+                             # computed end-of-tick for the next Phase A
+    tail_conf: jax.Array     # becomeLeader scan: a conf entry sits in
+                             # (commit, last] (vendor becomeLeader
+                             # numOfPendingConf); computed end-of-tick
     # global tick counter (scalar) — also the PRNG stream position
     tick: jax.Array
     # ---- in-flight mailboxes [N, N], only when cfg.mailboxes ------------
@@ -181,10 +212,22 @@ class SimState:
                                             # match / min reject hint)
 
 
-def init_state(cfg: SimConfig) -> SimState:
+def init_state(cfg: SimConfig,
+               voters: Optional[Sequence[int]] = None) -> SimState:
+    """Fresh cluster state.  `voters` is the bootstrap configuration (row
+    indices); default: all N rows.  Every row starts knowing the same
+    bootstrap config (all nodes are launched with the same --join peer
+    list); non-voter rows stay passive until a committed CONF entry adds
+    them."""
     n, L = cfg.n, cfg.log_len
     i32 = jnp.int32
     z = lambda *s: jnp.zeros(s, i32)  # noqa: E731
+    if voters is None:
+        member_row = jnp.ones((n,), bool)
+    else:
+        member_row = jnp.zeros((n,), bool).at[jnp.asarray(list(voters),
+                                                          i32)].set(True)
+    member = jnp.broadcast_to(member_row, (n, n))
     boxes = {}
     if cfg.mailboxes:
         boxes = dict(
@@ -225,7 +268,10 @@ def init_state(cfg: SimConfig) -> SimState:
         tx_cand=jnp.zeros((n,), jnp.bool_),
         tn_at=z(n), tn_term=z(n), tn_from=z(n),
         recent_active=jnp.zeros((n, n), jnp.bool_),
-        active=jnp.ones((n,), jnp.bool_),
+        member=member,
+        pending_conf=jnp.zeros((n,), jnp.bool_),
+        hup_conf=jnp.zeros((n,), jnp.bool_),
+        tail_conf=jnp.zeros((n,), jnp.bool_),
         tick=jnp.zeros((), i32),
     )
 

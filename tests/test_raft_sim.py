@@ -29,7 +29,8 @@ propose_j = jax.jit(propose, static_argnames=("cfg",))
 
 
 def leaders_of(st):
-    return np.flatnonzero(np.asarray((st.role == LEADER) & st.active))
+    self_mem = np.asarray(st.member).diagonal()
+    return np.flatnonzero(np.asarray(st.role == LEADER) & self_mem)
 
 
 class TraceChecker:
@@ -733,7 +734,8 @@ class TestAllFeaturesSoak:
                 commit = np.asarray(st.commit)
                 role = np.asarray(st.role)
                 for lid in np.flatnonzero(
-                        (role == LEADER) & np.asarray(st.active)):
+                        (role == LEADER)
+                        & np.asarray(st.member).diagonal()):
                     tt = int(term[lid])
                     assert term_leaders.setdefault(tt, int(lid)) \
                         == int(lid), f"two leaders in term {tt}"

@@ -19,11 +19,14 @@ import numpy as np
 import pytest
 
 from swarmkit_tpu.raft.sim import SimConfig, init_state
-from swarmkit_tpu.raft.sim.kernel import propose, step, transfer_leadership
+from swarmkit_tpu.raft.sim.kernel import (
+    propose, propose_conf, step, transfer_leadership,
+)
 from swarmkit_tpu.raft.sim.oracle import OracleCluster
 
 _step = jax.jit(step, static_argnames=("cfg",))
 _propose = jax.jit(propose, static_argnames=("cfg",))
+_propose_conf = jax.jit(propose_conf, static_argnames=("cfg",))
 
 # One compiled config per cluster size (cfg is a static jit arg; varying the
 # schedule, not the shapes, keeps the suite to three compilations).
@@ -45,6 +48,7 @@ def kernel_view(state) -> dict:
         "commit": np.asarray(state.commit),
         "applied": np.asarray(state.applied),
         "apply_chk": np.asarray(state.apply_chk),
+        "member": np.asarray(state.member),
     }
 
 
@@ -52,21 +56,43 @@ def run_differential(cfg: SimConfig, n_ticks: int, seed: int,
                      drop_rate: float = 0.0, crash_prob: float = 0.0,
                      prop_prob: float = 0.5, partition_at: tuple = (),
                      crash_leader_every: int = 0,
-                     transfer_every: int = 0) -> dict:
+                     transfer_every: int = 0,
+                     conf_every: int = 0, voters=None,
+                     min_members: int = 3,
+                     remove_leader_every: int = 0) -> dict:
     """Drive kernel + oracle on one random schedule; assert per-tick equality.
     Returns summary stats (max commit etc.) so callers can assert progress.
+
+    conf_every: every k ticks propose ONE membership change through the
+    replicated log (kernel propose_conf / oracle CONF_CHANGE entry) — a
+    remove of a random non-leader member while the intended config stays
+    above `min_members`, else a re-add of a previously removed row.
+
+    remove_leader_every: every k ticks the SITTING LEADER proposes its own
+    removal (the hardest membership path: self-excluded commit quorum and
+    CheckQuorum, ProposalDropped once applied); the shell then stops the
+    removed process a few ticks later (swarmkit removeMember -> node
+    shutdown, raft.go:2005) so the survivors elect.
     """
     rng = np.random.default_rng(seed)
     n = cfg.n
-    state = init_state(cfg)
-    oracle = OracleCluster(cfg)
+    state = init_state(cfg, voters=voters)
+    oracle = OracleCluster(cfg, voters=voters)
 
     alive = np.ones(n, bool)
     down_until = np.zeros(n, np.int64)
+    # intended config for picking conf targets (actual membership follows
+    # the committed log; this is only the scheduler's bookkeeping)
+    intended = set(range(n) if voters is None else voters)
+    removed = set(range(n)) - intended
+    stop_at: dict = {}   # node -> tick of permanent shell stop
 
     for t in range(n_ticks):
         # -- crash schedule
         alive = down_until <= t
+        for v, at in stop_at.items():
+            if t >= at:
+                alive[v] = False
         if crash_prob and rng.random() < crash_prob:
             victim = int(rng.integers(n))
             down_until[victim] = t + int(rng.integers(3, 25))
@@ -106,17 +132,54 @@ def run_differential(cfg: SimConfig, n_ticks: int, seed: int,
             payloads[:prop_count] = rng.integers(
                 1, 1 << 31, prop_count, dtype=np.uint32)
 
+        # -- membership-change schedule (log-driven conf proposals)
+        conf = None
+        if remove_leader_every and t > 0 and t % remove_leader_every == 0 \
+                and len(intended) > min_members:
+            kv = kernel_view(state)
+            leaders = np.nonzero((kv["role"] == 2) & alive)[0]
+            lset = [int(x) for x in leaders if int(x) in intended]
+            if lset:
+                tgt = lset[0]
+                conf = (tgt, True)
+                intended.discard(tgt)
+                removed.add(tgt)
+                # shell stops the removed process after a grace window
+                # (the entry must replicate first): swarmkit removeMember
+                # -> node shutdown, raft.go:2005
+                stop_at[tgt] = t + 8
+        if conf is None and conf_every and t > 0 and t % conf_every == 0:
+            kv = kernel_view(state)
+            leaders = set(np.nonzero((kv["role"] == 2) & alive)[0].tolist())
+            if removed and (len(intended) <= min_members
+                            or rng.random() < 0.5):
+                tgt = int(rng.choice(sorted(removed)))
+                conf = (tgt, False)
+                removed.discard(tgt)
+                intended.add(tgt)
+            else:
+                cands = sorted(intended - leaders)
+                if len(intended) > min_members and cands:
+                    tgt = int(rng.choice(cands))
+                    conf = (tgt, True)
+                    intended.discard(tgt)
+                    removed.add(tgt)
+
         # -- advance both sides with the identical schedule
         if prop_count:
             state = _propose(state, cfg, payloads,
                              np.asarray(prop_count, np.int32))
+        if conf is not None:
+            state = _propose_conf(state, cfg,
+                                  np.asarray(conf[0], np.int32),
+                                  np.asarray(conf[1], bool))
         state = _step(state, cfg, alive=alive, drop=drop)
-        oracle.tick(alive, drop, payloads, prop_count)
+        oracle.tick(alive, drop, payloads, prop_count, conf)
 
         kv = kernel_view(state)
         ov = oracle.view()
         for f in ("term", "vote", "role", "lead", "last", "commit",
-                  "applied", "apply_chk"):
+                  "applied", "apply_chk", "member"):
             ke, oe = kv[f], getattr(ov, f)
             assert np.array_equal(ke, oe), (
                 f"seed={seed} tick={t} field={f}\n"
@@ -401,3 +464,94 @@ def test_differential_wide_cluster_mailbox(seed):
     drop = [0.0, 0.1][seed % 2]
     run_differential(CFG15, n_ticks=100, seed=seed, drop_rate=drop,
                      crash_prob=0.03)
+
+
+# ---------------------------------------------------------------------------
+# Membership differential: log-driven conf changes (committed CONF entries
+# flipping per-row member views, kernel Phase E) under the schedules of the
+# reference's membership test territory (raft_test.go:63-1025): add/remove
+# churn with drops, crashes, PreVote, the mailbox wire and pipelining.
+# The oracle replays every flip through core add_node/remove_node at apply
+# time, so kernel-vs-core conformance now covers membership.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", range(1000, 1030))
+def test_differential_membership_sync_n5(seed):
+    drop = [0.0, 0.05, 0.15][seed % 3]
+    stats = run_differential(CFG5, n_ticks=140, seed=seed, drop_rate=drop,
+                             conf_every=18, prop_prob=0.5)
+    assert stats["max_commit"] > 0
+
+
+@pytest.mark.parametrize("seed", range(1030, 1055))
+def test_differential_membership_crash_n7(seed):
+    drop = [0.0, 0.1][seed % 2]
+    crash = [0.0, 0.05][(seed // 2) % 2]
+    run_differential(CFG7, n_ticks=140, seed=seed, drop_rate=drop,
+                     crash_prob=crash, conf_every=20, min_members=4)
+
+
+@pytest.mark.parametrize("seed", range(1055, 1075))
+def test_differential_membership_prevote(seed):
+    run_differential(CFG5_PV, n_ticks=150, seed=seed, drop_rate=0.05,
+                     conf_every=22, prop_prob=0.6)
+
+
+@pytest.mark.parametrize("seed", range(1075, 1095))
+def test_differential_membership_mailbox(seed):
+    drop = [0.0, 0.08][seed % 2]
+    run_differential(CFG5_LAT, n_ticks=160, seed=seed, drop_rate=drop,
+                     conf_every=25, crash_prob=0.03)
+
+
+@pytest.mark.parametrize("seed", range(1095, 1110))
+def test_differential_membership_pipelined_jitter(seed):
+    run_differential(CFG5_K4_JIT, n_ticks=160, seed=seed, drop_rate=0.08,
+                     conf_every=28)
+
+
+@pytest.mark.parametrize("seed", range(1110, 1125))
+def test_differential_membership_bootstrap_grow(seed):
+    """Start from a 3-voter bootstrap of 5 rows and grow via committed CONF
+    adds (the joiner catch-up path incl. snapshots carrying the config)."""
+    stats = run_differential(CFG5, n_ticks=160, seed=seed, drop_rate=0.05,
+                             conf_every=15, voters=range(3), prop_prob=0.7,
+                             min_members=3)
+    assert stats["max_commit"] > 0
+
+
+@pytest.mark.parametrize("seed", range(1125, 1140))
+def test_differential_membership_leader_crash_cycles(seed):
+    """Conf churn composed with periodic leader kills — membership changes
+    mid-election are the reference's hardest raft territory."""
+    run_differential(CFG5, n_ticks=160, seed=seed, crash_leader_every=35,
+                     conf_every=24, prop_prob=0.6)
+
+
+@pytest.mark.parametrize("seed", range(1140, 1150))
+def test_differential_membership_transfer(seed):
+    run_differential(CFG5, n_ticks=150, seed=seed, transfer_every=40,
+                     conf_every=26, prop_prob=0.6)
+
+
+@pytest.mark.parametrize("seed", range(1150, 1165))
+def test_differential_membership_remove_leader_sync(seed):
+    """The sitting leader proposes its OWN removal (self-excluded quorums,
+    ProposalDropped after apply), then the shell stops it and the
+    survivors elect — swarmkit's demote-the-leader flow."""
+    stats = run_differential(CFG5, n_ticks=160, seed=seed, drop_rate=0.05,
+                             remove_leader_every=45, prop_prob=0.6,
+                             min_members=3)
+    assert stats["max_commit"] > 0
+
+
+@pytest.mark.parametrize("seed", range(1165, 1175))
+def test_differential_membership_remove_leader_mailbox(seed):
+    run_differential(CFG5_LAT, n_ticks=170, seed=seed, drop_rate=0.05,
+                     remove_leader_every=50, conf_every=27, prop_prob=0.5)
+
+
+@pytest.mark.parametrize("seed", range(1175, 1185))
+def test_differential_membership_remove_leader_prevote(seed):
+    run_differential(CFG5_PV, n_ticks=170, seed=seed, drop_rate=0.05,
+                     remove_leader_every=48, prop_prob=0.5)

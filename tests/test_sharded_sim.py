@@ -1,11 +1,13 @@
 """Sharded-execution correctness for the batched raft kernel (VERDICT r02
 missing #3): the kernel sharded over the 8-virtual-device CPU mesh must
 (a) produce BIT-IDENTICAL results to the unsharded run, (b) actually lower
-to cross-device collectives (not 8 replicas), and (c) handle membership
-(conf-change) flips of `SimState.active` rows mid-run with re-election.
+to cross-device collectives (not 8 replicas), and (c) handle LOG-DRIVEN
+membership changes (committed CONF entries flipping per-row `member`
+views, VERDICT r03 missing #1) mid-run with re-election.
 
 Reference parity bar: membership + replication scenarios of
-manager/state/raft/raft_test.go:63-1025, here at the device-kernel level.
+manager/state/raft/raft_test.go:63-1025 and the conf-change apply path
+raft.go:1939/membership/cluster.go:185, here at the device-kernel level.
 """
 
 import dataclasses
@@ -21,7 +23,7 @@ from swarmkit_tpu.raft.sim import (
     LEADER, SimConfig, committed_entries, init_state, propose, run_ticks,
     run_until_leader, step,
 )
-from swarmkit_tpu.raft.sim.kernel import propose_dense
+from swarmkit_tpu.raft.sim.kernel import propose_conf, propose_dense
 from swarmkit_tpu.raft.sim.run import _payload_at, _payloads
 
 CFG = SimConfig(n=64, log_len=128, window=16, apply_batch=32, max_props=16,
@@ -92,52 +94,67 @@ class TestCollectiveLowering:
 
 
 class TestDeviceConfChange:
-    """Flipping SimState.active rows is the device-kernel analog of raft
-    conf changes (membership mask instead of resizing, SURVEY §7)."""
+    """Membership flows through the replicated log on the device kernel:
+    propose_conf appends a CONF entry, commit + apply flip each row's OWN
+    member view (kernel Phase E), and every quorum computation follows the
+    per-row views (reference processConfChange raft.go:1939)."""
 
     def _elect(self, cfg, state):
         state, ticks = run_until_leader(state, cfg, max_ticks=500)
-        assert bool(jnp.any((state.role == LEADER) & state.active))
+        lm = np.asarray(state.role == LEADER) \
+            & np.asarray(state.member).diagonal()
+        assert lm.any()
         return state
 
-    def test_deactivate_leader_reelects_and_commits(self):
+    def _leader(self, state):
+        return int(np.flatnonzero(
+            np.asarray(state.role == LEADER)
+            & np.asarray(state.member).diagonal())[0])
+
+    def test_remove_leader_via_log_reelects_and_commits(self):
+        """The leader proposes its own removal; once every row applies the
+        committed CONF entry, the cluster's views exclude it.  The node
+        shell then stops the removed process (raft.go:2005) — modeled by
+        the alive mask — and the remaining 7 elect with quorum 4."""
         cfg = SimConfig(n=8, log_len=128, window=16, apply_batch=32,
                         max_props=16, keep=8, seed=5)
         state = self._elect(cfg, init_state(cfg))
-        lead = int(np.argmax(np.asarray((state.role == LEADER)
-                                        & state.active)))
+        lead = self._leader(state)
 
-        # conf change: remove the leader row from membership
-        active = state.active.at[lead].set(False)
-        # a removed leader also stops acting (node shell stops it on
-        # removal, raft.go:2005) — clear its role so the mask is the only
-        # authority on membership
-        role = state.role.at[lead].set(0)
-        state = dataclasses.replace(state, active=active, role=role)
+        state = propose_conf(state, cfg, jnp.asarray(lead, jnp.int32),
+                             jnp.asarray(True))
+        for _ in range(6):
+            state = step(state, cfg)
+        member = np.asarray(state.member)
+        assert not member[:, lead].any(), "removal did not reach every view"
 
-        state = self._elect(cfg, state)
-        new_lead = int(np.argmax(np.asarray((state.role == LEADER)
-                                            & state.active)))
+        # shell stops the removed manager; others re-elect without it
+        alive = jnp.ones((cfg.n,), bool).at[lead].set(False)
+        for _ in range(80):
+            state = step(state, cfg, alive=alive)
+            role = np.asarray(state.role)
+            others = [i for i in range(cfg.n) if i != lead]
+            if (role[others] == LEADER).any():
+                break
+        new_lead = self._leader(state)
         assert new_lead != lead
 
-        # quorum is now over the 7 remaining members; commits advance
         base = int(committed_entries(state))
         state = propose(state, cfg, _payloads(cfg, state.tick, 8),
                         jnp.asarray(8, jnp.int32))
-        state = step(state, cfg)
-        state = step(state, cfg)
+        state = step(state, cfg, alive=alive)
+        state = step(state, cfg, alive=alive)
         assert int(committed_entries(state)) >= base + 8
 
-    def test_membership_shrinks_quorum(self):
-        """With 5 of 8 rows deactivated, the remaining 3 alone elect and
-        commit (quorum = 2 of 3 active, not 5 of 8)."""
+    def test_bootstrap_subset_quorum(self):
+        """A 3-voter bootstrap among 8 rows elects within the subset with
+        quorum 2 (non-members never campaign)."""
         cfg = SimConfig(n=8, log_len=128, window=16, apply_batch=32,
                         max_props=16, keep=8, seed=9)
-        state = init_state(cfg)
-        active = state.active.at[jnp.arange(3, 8)].set(False)
-        state = dataclasses.replace(state, active=active)
+        state = init_state(cfg, voters=range(3))
         state = self._elect(cfg, state)
-        lead_mask = np.asarray((state.role == LEADER) & state.active)
+        lead_mask = np.asarray(state.role == LEADER) \
+            & np.asarray(state.member).diagonal()
         assert lead_mask[:3].any() and not lead_mask[3:].any()
         state = propose(state, cfg, _payloads(cfg, state.tick, 4),
                         jnp.asarray(4, jnp.int32))
@@ -145,33 +162,73 @@ class TestDeviceConfChange:
         state = step(state, cfg)
         assert int(committed_entries(state)) >= 4
 
-    def test_reactivated_row_catches_up(self):
-        """A re-added (reactivated) stale row is caught up by the leader —
-        through appends or a snapshot — and its applied checksum matches."""
+    def test_joiner_catches_up_via_log_add(self):
+        """A row outside the bootstrap config is added by a committed CONF
+        entry after the ring compacted past its position: the leader ships
+        a snapshot (carrying the config), the joiner catches up, and its
+        own view finally includes itself."""
         cfg = SimConfig(n=8, log_len=64, window=8, apply_batch=16,
                         max_props=8, keep=4, seed=13)
-        state = init_state(cfg)
-        victim = 7
-        state = dataclasses.replace(
-            state, active=state.active.at[victim].set(False))
+        joiner = 7
+        state = init_state(cfg, voters=range(7))
         state = self._elect(cfg, state)
-        # commit enough to force ring compaction past the victim's log
+        # commit enough to force ring compaction past the joiner's log
         for _ in range(30):
             state = propose(state, cfg, _payloads(cfg, state.tick, 8),
                             jnp.asarray(8, jnp.int32))
             state = step(state, cfg)
-        state = dataclasses.replace(
-            state, active=state.active.at[victim].set(True))
-        for _ in range(20):
+        assert int(np.asarray(state.snap_idx).max()) > 0
+        assert not bool(np.asarray(state.member)[:, joiner].any())
+
+        state = propose_conf(state, cfg, jnp.asarray(joiner, jnp.int32),
+                             jnp.asarray(False))
+        for _ in range(30):
             state = step(state, cfg)
+        member = np.asarray(state.member)
+        assert member[:, joiner].all(), "add did not reach every view"
+        assert member[joiner, joiner], "joiner never learned it was added"
         commit = np.asarray(state.commit)
         applied = np.asarray(state.applied)
         chk = np.asarray(state.apply_chk)
-        assert applied[victim] >= commit.max() - cfg.max_props
-        # state-machine safety across the rejoin
+        assert applied[joiner] >= commit.max() - cfg.max_props
         by: dict = {}
         for a, c in zip(applied.tolist(), chk.tolist()):
-            assert by.setdefault(a, c) == c, "checksum divergence on rejoin"
+            assert by.setdefault(a, c) == c, "checksum divergence on join"
+
+    def test_one_conf_in_flight(self):
+        """While a CONF entry is in flight, a second conf proposal degrades
+        to an empty normal entry (core stepLeader MsgProp rule); after the
+        first applies, a new one is accepted."""
+        cfg = SimConfig(n=8, log_len=128, window=16, apply_batch=32,
+                        max_props=16, keep=8, seed=21)
+        state = init_state(cfg)
+        state = self._elect(cfg, state)
+        lead = self._leader(state)
+        state = propose_conf(state, cfg, jnp.asarray(6, jnp.int32),
+                             jnp.asarray(True))
+        assert bool(np.asarray(state.pending_conf)[lead])
+        # second proposal before the first commits: degraded
+        state = propose_conf(state, cfg, jnp.asarray(5, jnp.int32),
+                             jnp.asarray(True))
+        for _ in range(8):
+            state = step(state, cfg)
+        member = np.asarray(state.member)
+        # every row but the victim applies the removal (the victim itself
+        # may never learn: once the leader's view drops it, appends stop —
+        # etcd behavior; the shell shuts the node down, raft.go:2005)
+        others = [i for i in range(cfg.n) if i != 6]
+        assert not member[others, 6].any()     # first removal applied
+        assert member[:, 5].all()              # second was degraded
+        assert not bool(np.asarray(state.pending_conf)[lead])
+        # now a fresh conf proposal is accepted
+        state = propose_conf(state, cfg, jnp.asarray(5, jnp.int32),
+                             jnp.asarray(True))
+        for _ in range(8):
+            state = step(state, cfg)
+        # rows still in the cluster apply it; 5 itself and the previously
+        # removed 6 are cut off and keep their frozen views
+        keep = [i for i in range(cfg.n) if i not in (5, 6)]
+        assert not np.asarray(state.member)[keep, 5].any()
 
 
 class TestProposeDense:
@@ -218,7 +275,8 @@ class TestShardedMailboxWire:
         st, ticks = run_until_leader(st, self.MCFG, max_ticks=800)
         assert int(ticks) < 800
         lead = int(np.flatnonzero(
-            np.asarray((st.role == LEADER) & st.active))[0])
+            np.asarray(st.role == LEADER)
+            & np.asarray(st.member).diagonal())[0])
         tgt = (lead + 1) % self.MCFG.n
         st = transfer_leadership(st, self.MCFG, lead, tgt)
         moved = False
