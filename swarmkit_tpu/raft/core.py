@@ -153,6 +153,16 @@ class Raft:
         self.msgs: list[Message] = []
         self.election_elapsed = 0
         self.heartbeat_elapsed = 0
+        # Ticks since last CURRENT-TERM leader contact (append/heartbeat/
+        # snapshot) — the CheckQuorum lease measures THIS, not
+        # election_elapsed.  etcd-3.1 conflates the two (electionElapsed
+        # resets on every campaign attempt, perpetually re-arming the lease
+        # after total leader loss and livelocking PreVote elections when
+        # randomized timeouts land on election_tick); the raft dissertation
+        # (§4.2.3) defines the lease from leader contact.  The reference
+        # never enables PreVote so it cannot hit this; we expose PreVote as
+        # first-class and fix the lease.
+        self.contact_elapsed = 0
         self.randomized_election_timeout = 0
         self.lead_transferee = NONE
         self.pending_conf = False
@@ -207,6 +217,7 @@ class Raft:
 
     def _tick_election(self) -> None:
         self.election_elapsed += 1
+        self.contact_elapsed += 1
         if self.promotable() and self.election_elapsed >= self.randomized_election_timeout:
             self.election_elapsed = 0
             self.step(Message(type=MsgType.HUP, frm=self.id))
@@ -214,6 +225,7 @@ class Raft:
     def _tick_heartbeat(self) -> None:
         self.heartbeat_elapsed += 1
         self.election_elapsed += 1
+        self.contact_elapsed += 1
         if self.election_elapsed >= self.cfg.election_tick:
             self.election_elapsed = 0
             if self.cfg.check_quorum:
@@ -274,6 +286,7 @@ class Raft:
         self._reset(self.term)
         self.lead = self.id
         self.state = LEADER
+        self.contact_elapsed = 0
         ents = self.log.entries_from(self.log.committed + 1)
         if sum(1 for e in ents if e.type == EntryType.CONF_CHANGE) == 1:
             self.pending_conf = True
@@ -391,7 +404,7 @@ class Raft:
             if m.type in (MsgType.VOTE, MsgType.PRE_VOTE):
                 force = m.context == CAMPAIGN_TRANSFER
                 in_lease = (self.cfg.check_quorum and self.lead != NONE and
-                            self.election_elapsed < self.cfg.election_tick)
+                            self.contact_elapsed < self.cfg.election_tick)
                 if not force and in_lease:
                     return  # leader lease not expired; ignore
                 lead = NONE
@@ -437,6 +450,9 @@ class Raft:
         if m.type == MsgType.CHECK_QUORUM:
             if not self._check_quorum_active():
                 self.become_follower(self.term, NONE)
+            else:
+                # quorum contact confirmed: the leader's own lease re-arms
+                self.contact_elapsed = 0
             return
         if m.type == MsgType.PROP:
             assert m.entries, "empty proposal"
@@ -522,12 +538,15 @@ class Raft:
             raise ProposalDropped(f"no leader at term {self.term}")
         if m.type == MsgType.APP:
             self.become_follower(self.term, m.frm)
+            self.contact_elapsed = 0
             self._handle_append(m)
         elif m.type == MsgType.HEARTBEAT:
             self.become_follower(self.term, m.frm)
+            self.contact_elapsed = 0
             self._handle_heartbeat(m)
         elif m.type == MsgType.SNAP:
             self.become_follower(m.term, m.frm)
+            self.contact_elapsed = 0
             self._handle_snapshot(m)
         elif m.type == my_resp:
             # >= (not etcd's ==): identical decisions in the static-config
@@ -553,14 +572,17 @@ class Raft:
             self._send(m)
         elif m.type == MsgType.APP:
             self.election_elapsed = 0
+            self.contact_elapsed = 0
             self.lead = m.frm
             self._handle_append(m)
         elif m.type == MsgType.HEARTBEAT:
             self.election_elapsed = 0
+            self.contact_elapsed = 0
             self.lead = m.frm
             self._handle_heartbeat(m)
         elif m.type == MsgType.SNAP:
             self.election_elapsed = 0
+            self.contact_elapsed = 0
             self.lead = m.frm
             self._handle_snapshot(m)
         elif m.type == MsgType.TRANSFER_LEADER:

@@ -172,6 +172,7 @@ def step(state: SimState, cfg: SimConfig,
     # (etcd promotable()).
     is_leader = (role == LEADER) & alive
     elapsed = jnp.where(alive, elapsed + 1, elapsed)
+    contact = jnp.where(alive, state.contact + 1, state.contact)
     hb_elapsed = jnp.where(is_leader, hb_elapsed + 1, hb_elapsed)
 
     # CheckQuorum (vendor raft.go:536-560 tickHeartbeat + checkQuorumActive):
@@ -185,6 +186,8 @@ def step(state: SimState, cfg: SimConfig,
     role = jnp.where(cq_fail, FOLLOWER, role)
     lead = jnp.where(cq_fail, NONE, lead)
     elapsed = jnp.where(check_due, 0, elapsed)
+    # a quorum-confirmed leader re-arms its own lease (core CHECK_QUORUM)
+    contact = jnp.where(check_due & ~cq_fail, 0, contact)
     recent_active = jnp.where(check_due[:, None], False, recent_active)
     is_leader = (role == LEADER) & alive
     # a transfer that hasn't completed within an election timeout is
@@ -230,7 +233,6 @@ def step(state: SimState, cfg: SimConfig,
         # the vote tallies and the candidacy marker reset.
         pre = jnp.where(campaign, True, pre)
         role = jnp.where(campaign, CANDIDATE, role)
-        elapsed = jnp.where(campaign, 0, elapsed)
         granted = jnp.where(campaign[:, None], eye, granted)
         rejected = jnp.where(campaign[:, None], False, rejected)
     else:
@@ -238,7 +240,6 @@ def step(state: SimState, cfg: SimConfig,
         vote = jnp.where(campaign, node, vote)
         role = jnp.where(campaign, CANDIDATE, role)
         lead = jnp.where(campaign, NONE, lead)
-        elapsed = jnp.where(campaign, 0, elapsed)
         timeout = jnp.where(campaign, rand_timeout(cfg, node, term), timeout)
         granted = jnp.where(campaign[:, None], eye, granted)
         rejected = jnp.where(campaign[:, None], False, rejected)
@@ -261,7 +262,9 @@ def step(state: SimState, cfg: SimConfig,
     # receiver that heard from a live leader within the last election_tick
     # ignores vote requests entirely — no term catch-up, no response —
     # so a rejoining partitioned node cannot depose a healthy leader.
-    leased = (lead != NONE) & (elapsed < cfg.election_tick)      # [j]
+    # Lease from LEADER CONTACT (not the election timer, which re-arms on
+    # every campaign attempt — core.py contact_elapsed rationale)
+    leased = (lead != NONE) & (contact < cfg.election_tick)      # [j]
     if cfg.mailboxes:
         # Device-mailbox wire (SURVEY §7): one in-flight message per class
         # per directed edge; *_at stores deliver-tick+1 (0 = empty).  The
@@ -363,6 +366,10 @@ def step(state: SimState, cfg: SimConfig,
     role = jnp.where(newer, FOLLOWER, role)
     vote = jnp.where(newer, NONE, vote)
     lead = jnp.where(newer, NONE, lead)
+    # become_follower(m.term) runs _reset: timer zeroed, timeout re-rolled
+    # at the new term (deterministic per (node, term))
+    elapsed = jnp.where(newer, 0, elapsed)
+    timeout = jnp.where(newer, rand_timeout(cfg, node, term), timeout)
     is_cand = (role == CANDIDATE) & alive  # stepped-down candidates drop out
 
     # (last_term / log_ok computed above the PreVote block; Phase B never
@@ -417,8 +424,11 @@ def step(state: SimState, cfg: SimConfig,
     # arrival — core's _poll call sites): a conf change shrinking quorum
     # between arrivals must not retro-promote a stale tally.
     fresh_real = tn_ok | (pre_win if cfg.pre_vote else campaign)
+    # pre-candidacies poll on PreVote response arrivals (pv_polled is
+    # nonzero only on pre rows; the win line excludes them via ~pre)
+    polled = v_polled | pv_polled if cfg.pre_vote else v_polled
     votes = jnp.sum((granted & member).astype(I32), axis=1)
-    win = is_cand & ~pre & (votes >= quorum_row) & (fresh_real | v_polled)
+    win = is_cand & ~pre & (votes >= quorum_row) & (fresh_real | polled)
     # Rejection quorum: the candidate stands down (a REAL candidacy keeps
     # term and vote; a pre-candidacy keeps both untouched by design) and
     # waits out its timeout. A voter that granted earlier in the term never
@@ -427,15 +437,17 @@ def step(state: SimState, cfg: SimConfig,
     # precede a rejection (log/vote checks are monotone), so masking with
     # ~granted reproduces first-response-wins exactly.
     n_rej = jnp.sum((rejected & ~granted & member).astype(I32), axis=1)
-    lose = is_cand & ~win & (n_rej >= quorum_row) & (fresh_real | v_polled)
+    lose = is_cand & ~win & (n_rej >= quorum_row) & (fresh_real | polled)
     role = jnp.where(lose, FOLLOWER, role)
     lead = jnp.where(lose, NONE, lead)  # become_follower(term, NONE)
+    elapsed = jnp.where(lose, 0, elapsed)  # _reset zeroes the timer
     pre = pre & ~lose
     # becomeLeader: reset progress, append a no-op entry at the new term.
     role = jnp.where(win, LEADER, role)
     lead = jnp.where(win, node, lead)
     hb_elapsed = jnp.where(win, 0, hb_elapsed)
     elapsed = jnp.where(win, 0, elapsed)
+    contact = jnp.where(win, 0, contact)
     # becomeLeader re-derives the propose gate from the uncommitted tail
     # (vendor becomeLeader numOfPendingConf over (commit, last]); tail_conf
     # is the end-of-previous-tick scan, still exact here because Phase A/B
@@ -537,6 +549,8 @@ def step(state: SimState, cfg: SimConfig,
     role = jnp.where(newer2, FOLLOWER, role)
     vote = jnp.where(newer2, NONE, vote)
     lead = jnp.where(newer2, NONE, lead)
+    elapsed = jnp.where(newer2, 0, elapsed)
+    timeout = jnp.where(newer2, rand_timeout(cfg, node, term), timeout)
 
     # Receiver picks its (unique) current-term leader, judged by the
     # SEND-TIME sender term (a leader deposed this tick sent at its old term).
@@ -546,6 +560,7 @@ def step(state: SimState, cfg: SimConfig,
     role = jnp.where(has_lmsg & (role == CANDIDATE), FOLLOWER, role)
     lead = jnp.where(has_lmsg, src, lead)
     elapsed = jnp.where(has_lmsg, 0, elapsed)
+    contact = jnp.where(has_lmsg, 0, contact)
     is_leader = (role == LEADER) & alive
 
     got_app = has_lmsg & send_app[src, node]
@@ -685,8 +700,17 @@ def step(state: SimState, cfg: SimConfig,
         rej_mat = arrive_back & resp_reject[None, :]
         resp_match_del = resp_match[None, :]
         reject_hint_del = reject_hint[None, :]
-    # any response marks the peer recently-active for CheckQuorum
+    # any response marks the peer recently-active for CheckQuorum (even
+    # from a peer outside the current view: invisible there, since the
+    # CheckQuorum count masks by member and a re-add forces True anyway)
     recent_active = recent_active | ok_mat | rej_mat
+    # ...but progress integration follows core's stepLeader exactly:
+    # responses from peers the config no longer contains are dropped
+    # (prs.get(m.frm) is None -> return).  The rejection path is receiver-
+    # visible (backtrack + pipeline flush change future deliveries), so
+    # this mask is required for core-exactness, not just hygiene.
+    ok_mat = ok_mat & member
+    rej_mat = rej_mat & member
     if cfg.mailboxes:
         # vendor stepLeader MsgAppResp: maybeUpdate advances match (and
         # next to at least m+1); a match ADVANCE on a probing edge enters
@@ -772,6 +796,7 @@ def step(state: SimState, cfg: SimConfig,
     own_idx = _idx_at_slots(cfg, last)                           # [N, L]
     is_conf_ring = _is_conf(log_data)                            # [N, L]
     base_applied = jnp.minimum(commit, applied + cfg.apply_batch)
+    base_applied = jnp.where(alive, base_applied, applied)  # crashed: frozen
     win_mask = (own_idx > applied[:, None]) \
         & (own_idx <= base_applied[:, None])
     conf_in_win = win_mask & is_conf_ring
@@ -819,7 +844,7 @@ def step(state: SimState, cfg: SimConfig,
     # still ahead of it (uint32 wrap-safe).
     pressure = (last - snap_idx) > (cfg.log_len - 2 * cfg.max_props - 1)
     new_snap = jnp.maximum(snap_idx, applied - cfg.keep)
-    do_compact = pressure & (new_snap > snap_idx)
+    do_compact = pressure & (new_snap > snap_idx) & alive
     nst = _term_own(cfg, log_term, snap_idx, snap_term, last, new_snap)
     ahead = (own_idx > new_snap[:, None]) & (own_idx <= applied[:, None])
     ahead_sum = jnp.sum(jnp.where(ahead, _entry_chk(own_idx, log_data),
@@ -857,7 +882,8 @@ def step(state: SimState, cfg: SimConfig,
     return dataclasses.replace(
         state,
         term=term, vote=vote, role=role, lead=lead,
-        elapsed=elapsed, hb_elapsed=hb_elapsed, timeout=timeout,
+        elapsed=elapsed, contact=contact,
+        hb_elapsed=hb_elapsed, timeout=timeout,
         last=last, commit=commit, applied=applied,
         snap_idx=snap_idx, snap_term=snap_term,
         snap_chk=snap_chk, apply_chk=apply_chk,
@@ -972,16 +998,18 @@ def propose_conf(state: SimState, cfg: SimConfig, target, remove,
     (Join/Leave) -> :1939 (processConfChange)."""
     n = cfg.n
     node = jnp.arange(n, dtype=I32)
-    # targets outside [0, n) would be clipped to row n-1 by the Phase E
-    # decode (and ghost-voted by the host oracle) — reject at the edge
-    target = jnp.clip(jnp.asarray(target, I32), 0, n - 1)
+    target = jnp.asarray(target, I32)
+    # a target outside [0, n) degrades to an empty normal entry, exactly
+    # like the pending-conf case (the host validates ids; this is the
+    # last-line guard against retargeting row n-1 via the decode clip)
+    valid_tgt = (target >= 0) & (target < n)
     remove = jnp.asarray(remove, bool)
     ok = _leader_ok(state, cfg, alive)
     payload = jnp.where(
-        ok & ~state.pending_conf,
+        ok & ~state.pending_conf & valid_tgt,
         U32(CONF_TAG)
         | jnp.where(remove, U32(CONF_REMOVE), U32(0))
-        | target.astype(U32),
+        | (target.astype(U32) & U32(CONF_TARGET_MASK)),
         U32(0))                                   # degraded: empty normal
     idx = state.last + 1
     slot = _slot(cfg, idx)
@@ -992,8 +1020,9 @@ def propose_conf(state: SimState, cfg: SimConfig, target, remove,
     new_last = state.last + ok.astype(I32)
     eye = jnp.eye(n, dtype=bool)
     match = jnp.where(ok[:, None] & eye, new_last[:, None], state.match)
-    pending_conf = state.pending_conf | ok
-    tail_conf = state.tail_conf | (ok & ~state.pending_conf)
+    appended_conf = ok & ~state.pending_conf & valid_tgt
+    pending_conf = state.pending_conf | appended_conf
+    tail_conf = state.tail_conf | appended_conf
     return dataclasses.replace(state, log_term=log_term, log_data=log_data,
                                last=new_last, match=match,
                                pending_conf=pending_conf,

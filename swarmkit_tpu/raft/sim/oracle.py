@@ -46,17 +46,19 @@ divergences.
     _tick_mailbox mirrors the kernel's send gating and aggregate-ack
     integration (all due acks per edge per tick: max match, then one
     min-hint rejection fallback).
- D4 timer scope: kernel election timers reset on (a) own campaign,
-    (b) granting a vote, (c) receiving a current-term leader message,
-    (d) a leader's CheckQuorum round, and re-randomize only at campaign
-    time; the CheckQuorum cadence and lease both read this same counter.
-    Mask: the scheduler keeps its own elapsed/timeout arrays with exactly
-    those rules and drives core CheckQuorum decisions itself (oracle
-    Config(check_quorum=False) so core's internal lease stays off).
- D5 proposals go to every node claiming leadership (even a crashed one —
-    kernel propose() masks on role/self-membership only), and apply/
-    compaction run on crashed rows too (kernel phases E/F have no alive
-    mask).
+ D4' CLOSED (round 4): kernel election timers now follow etcd's
+    become_follower/_reset scope exactly — zeroed AND re-randomized (at
+    the deterministic per-(node, term) value) on every term catch-up from
+    vote requests or leader messages, zeroed on a rejection-quorum
+    step-down, plus the original campaign/grant/leader-contact/CheckQuorum
+    resets.  The scheduler's elapsed/timeout arrays replay the same rules
+    (core CheckQuorum decisions still driven externally with
+    Config(check_quorum=False) so core's internal lease stays off — a
+    bookkeeping choice, not a semantic divergence).
+ D5' CLOSED (round 4): propose()/propose_conf() take the alive mask
+    (clients cannot reach a crashed claimant) and kernel phases E/F freeze
+    apply + compaction on crashed rows; _phase_propose*/_phase_def consult
+    `up` identically.
 
 MEMBERSHIP REPLAY (log-driven conf changes): _phase_propose_conf mirrors
 kernel propose_conf (one CONF entry per leader, degraded to an empty
@@ -230,6 +232,9 @@ class OracleCluster:
         for nd in self.nodes:
             nd.cluster = self
         self.elapsed = [0] * n
+        # lease clock: ticks since last current-term leader contact (the
+        # kernel's `contact`; see core.contact_elapsed for the rationale)
+        self.contact = [0] * n
         self.timeout = [rand_timeout_py(cfg, i, 0) for i in range(n)]
         self.applied = [0] * n
         self.apply_chk = [0] * n
@@ -282,9 +287,10 @@ class OracleCluster:
                                 + entry_chk_py(idx, data)) & M32
 
     # -- shared phases -----------------------------------------------------
-    def _phase_propose(self, payloads, prop_count: int) -> None:
-        """Phase 0: propose (run_ticks calls propose() before step(); D5:
-        alive is not consulted, room mirrors kernel propose())."""
+    def _phase_propose(self, up, payloads, prop_count: int) -> None:
+        """Phase 0: propose (run_ticks calls propose() before step()).
+        Clients cannot reach a crashed claimant, so `up` masks leaders the
+        same way kernel propose(alive=...) does."""
         cfg = self.cfg
         if not prop_count:
             return
@@ -294,8 +300,8 @@ class OracleCluster:
                   # masks it off, so the oracle must store the same value
                   data=(int(payloads[k]) & 0x7FFFFFFF).to_bytes(4, "big"))
             for k in range(prop_count))
-        for nd in self.nodes:
-            if nd.state != core.LEADER:
+        for i, nd in enumerate(self.nodes):
+            if not up[i] or nd.state != core.LEADER:
                 continue
             room = (nd.log.last_index() + cfg.max_props
                     - nd.log.offset) <= cfg.log_len
@@ -309,7 +315,7 @@ class OracleCluster:
             nd.suppress = nd.hold_commit = False
             nd.take_msgs()
 
-    def _phase_propose_conf(self, conf) -> None:
+    def _phase_propose_conf(self, up, conf) -> None:
         """Phase 0b: one membership-change proposal (kernel propose_conf).
         conf = (target_row, remove).  Core's stepLeader degrades the entry
         to an empty normal one while an earlier conf change is pending —
@@ -318,10 +324,16 @@ class OracleCluster:
             return
         cfg = self.cfg
         tgt, rm = conf
-        ent = Entry(type=EntryType.CONF_CHANGE,
-                    data=conf_payload(int(tgt), bool(rm)).to_bytes(4, "big"))
-        for nd in self.nodes:
-            if nd.state != core.LEADER:
+        if not (0 <= int(tgt) < cfg.n):
+            # kernel propose_conf degrades an out-of-range target to an
+            # empty normal entry (same as the pending-conf case)
+            ent = Entry(type=EntryType.NORMAL, data=b"")
+        else:
+            ent = Entry(type=EntryType.CONF_CHANGE,
+                        data=conf_payload(int(tgt),
+                                          bool(rm)).to_bytes(4, "big"))
+        for i, nd in enumerate(self.nodes):
+            if not up[i] or nd.state != core.LEADER:
                 continue
             room = (nd.log.last_index() + cfg.max_props
                     - nd.log.offset) <= cfg.log_len
@@ -342,6 +354,7 @@ class OracleCluster:
         for i in range(n):
             if up[i]:
                 self.elapsed[i] += 1
+                self.contact[i] += 1
         for i, nd in enumerate(nodes):
             # CheckQuorum: every election_tick ticks a standing leader must
             # have heard from a quorum since its last round (kernel Phase A)
@@ -353,8 +366,10 @@ class OracleCluster:
                     nd.become_follower(nd.term, core.NONE)
                 else:
                     # transfer not completed within an election timeout:
-                    # abort (kernel Phase A; vendor tickHeartbeat)
+                    # abort (kernel Phase A; vendor tickHeartbeat); a
+                    # quorum-confirmed leader re-arms its own lease
                     nd._abort_leader_transfer()
+                    self.contact[i] = 0
                 self.elapsed[i] = 0
                 self.recent_active[i] = set()
         # TIMEOUT_NOW deliveries land between CheckQuorum and the timeout
@@ -388,6 +403,8 @@ class OracleCluster:
                 nd.suppress = False
                 nd.take_msgs()
         for i, nd in enumerate(nodes):
+            if not up[i]:
+                continue   # crashed rows freeze (apply AND compaction)
             if nd.log.applied > self.applied[i]:  # snapshot restore jumped
                 self.applied[i] = nd.log.applied
                 base = self.chk_at.get(self.applied[i])
@@ -428,6 +445,8 @@ class OracleCluster:
             self.applied[i] = new_applied
             nd.log.applied_to(new_applied)
         for i, nd in enumerate(nodes):
+            if not up[i]:
+                continue
             last, off = nd.log.last_index(), nd.log.offset
             pressure = (last - off) > (cfg.log_len - 2 * cfg.max_props - 1)
             new_snap = max(off, self.applied[i] - cfg.keep)
@@ -442,6 +461,9 @@ class OracleCluster:
         nd = self.nodes[leader]
         if nd.state != core.LEADER or target == leader:
             return
+        if (target + 1) not in nd.prs:
+            return   # kernel gate: member[leader, target] (core would
+            # reject inside stepLeader, but AFTER the timer reset)
         if nd.lead_transferee == target + 1:
             return
         self.elapsed[leader] = 0
@@ -498,6 +520,7 @@ class OracleCluster:
                 self.tx_term[t] = nd.term
             elif nd.state == core.LEADER:   # quorum-of-1 forced cascade
                 self.elapsed[t] = 0
+                self.contact[t] = 0
                 self.timeout[t] = rand_timeout_py(cfg, t, nd.term)
                 self.recent_active[t] = set()
 
@@ -556,6 +579,8 @@ class OracleCluster:
                 continue
             nodes[i].step(resp)
             nodes[i].take_msgs()
+            if nodes[i].state == core.FOLLOWER:   # rejection-quorum lose
+                self.elapsed[i] = 0
 
     # -- one kernel-schedule tick -----------------------------------------
     def tick(self, alive, drop, payloads=(), prop_count: int = 0,
@@ -571,8 +596,8 @@ class OracleCluster:
         nodes = self.nodes
         up = [bool(alive[i]) for i in range(n)]
 
-        self._phase_propose(payloads, prop_count)
-        self._phase_propose_conf(conf)
+        self._phase_propose(up, payloads, prop_count)
+        self._phase_propose_conf(up, conf)
         self._phase_a(up)
 
         # Phase B: vote exchange. Candidates re-request every tick (the
@@ -581,7 +606,7 @@ class OracleCluster:
         # Lease flags snapshot BEFORE any vote is delivered (kernel computes
         # `leased` once from post-Phase-A state).
         leased = [nodes[j].lead != core.NONE
-                  and self.elapsed[j] < cfg.election_tick
+                  and self.contact[j] < cfg.election_tick
                   for j in range(n)]
         # capture candidacies BEFORE any exchange (kernel send sets are
         # fixed from post-Phase-A state: a pre-winner sends real requests
@@ -607,6 +632,9 @@ class OracleCluster:
         grants: list[tuple[int, int, Message]] = []  # (voter, cand, resp)
         rejects: list[tuple[int, int, Message]] = []
         for i, j, msg in requests:
+            if msg.term > nodes[j].term:   # become_follower _reset (D4')
+                self.elapsed[j] = 0
+                self.timeout[j] = rand_timeout_py(self.cfg, j, msg.term)
             nodes[j].step(msg)
             for resp in nodes[j].take_msgs():
                 if resp.type == MsgType.VOTE_RESP and not resp.reject:
@@ -626,6 +654,7 @@ class OracleCluster:
             msgs = nodes[i].take_msgs()
             if not was_leader and nodes[i].state == core.LEADER:
                 self.elapsed[i] = 0
+                self.contact[i] = 0
                 self.recent_active[i] = set()
                 new_leader_msgs.extend(msgs)  # win-cascade appends (Phase C)
         # rejections step in AFTER all grants (kernel: win evaluated before
@@ -635,6 +664,8 @@ class OracleCluster:
                 continue
             nodes[i].step(resp)
             nodes[i].take_msgs()
+            if nodes[i].state == core.FOLLOWER:   # rejection-quorum lose
+                self.elapsed[i] = 0
 
         # Phase C: append/snapshot fan-out from every standing leader.
         out: list[Message] = list(new_leader_msgs)
@@ -655,12 +686,16 @@ class OracleCluster:
         for j, msgs in by_rcpt.items():
             msgs.sort(key=lambda m: (-m.term, m.frm))
             for m in msgs:
+                if m.term > nodes[j].term:   # become_follower _reset (D4')
+                    self.elapsed[j] = 0
+                    self.timeout[j] = rand_timeout_py(self.cfg, j, m.term)
                 nodes[j].step(m)
                 for resp in nodes[j].take_msgs():
                     if resp.type == MsgType.APP_RESP:
                         responses.append((j, m.frm - 1, resp))
                 if m.term == nodes[j].term:
                     self.elapsed[j] = 0
+                    self.contact[j] = 0
         for j, i, resp in responses:
             if drop[j][i] or not up[i]:
                 continue
@@ -690,8 +725,8 @@ class OracleCluster:
         up = [bool(alive[i]) for i in range(n)]
         now = self.now
 
-        self._phase_propose(payloads, prop_count)
-        self._phase_propose_conf(conf)
+        self._phase_propose(up, payloads, prop_count)
+        self._phase_propose_conf(up, conf)
         self._phase_a(up)
 
         # ---- Phase B: vote wire ----
@@ -712,7 +747,7 @@ class OracleCluster:
         # request deliveries (lease snapshot BEFORE any vote is stepped);
         # prevote requests process before real ones (kernel phase order)
         leased = [nodes[j].lead != core.NONE
-                  and self.elapsed[j] < cfg.election_tick
+                  and self.contact[j] < cfg.election_tick
                   for j in range(n)]
         due = sorted(k for k, v in self.vreq.items() if v[0] <= now)
         pv_requests: list[tuple[int, int, Message]] = []
@@ -774,10 +809,16 @@ class OracleCluster:
                     self.elapsed[i] = 0
                     self.timeout[i] = rand_timeout_py(cfg, i, nd.term)
                     if nd.state == core.LEADER:  # quorum-of-1 cascade
+                        self.contact[i] = 0
                         self.recent_active[i] = set()
+                elif nd.state == core.FOLLOWER:  # rejection-quorum lose
+                    self.elapsed[i] = 0
         # real vote exchange
         requests.sort(key=lambda r: (-r[2].term, r[0]))
         for i, j, msg in requests:
+            if msg.term > nodes[j].term:   # become_follower _reset (D4')
+                self.elapsed[j] = 0
+                self.timeout[j] = rand_timeout_py(self.cfg, j, msg.term)
             nodes[j].step(msg)
             for resp in nodes[j].take_msgs():
                 if resp.type != MsgType.VOTE_RESP:
@@ -811,7 +852,10 @@ class OracleCluster:
                 nd.take_msgs()  # win-cascade appends go via the mailbox wire
                 if nd.state == core.LEADER:  # the guard above filtered
                     self.elapsed[i] = 0      # out already-leaders
+                    self.contact[i] = 0
                     self.recent_active[i] = set()
+                elif nd.state == core.FOLLOWER:  # rejection-quorum lose
+                    self.elapsed[i] = 0
 
         # ---- Phase C: append/snapshot wire ----
         # sends: up to cfg.inflight appends pipeline per edge, one NEW one
@@ -895,6 +939,9 @@ class OracleCluster:
         for j, msgs in sorted(by_rcpt.items()):
             msgs.sort(key=lambda im: (-im[1].term, im[1].frm))
             for i, m in msgs:
+                if m.term > nodes[j].term:   # become_follower _reset (D4')
+                    self.elapsed[j] = 0
+                    self.timeout[j] = rand_timeout_py(self.cfg, j, m.term)
                 nodes[j].step(m)
                 for resp in nodes[j].take_msgs():
                     if resp.type == MsgType.APP_RESP and not drop[j][i]:
@@ -902,6 +949,7 @@ class OracleCluster:
                         rq.append((now + self._lat(j, i, now), m.term, resp))
                 if m.term == nodes[j].term:
                     self.elapsed[j] = 0
+                    self.contact[j] = 0
         # response deliveries: ALL due acks integrate, oks first (core's
         # match/next merges are monotone), then ONE aggregate rejection
         # fallback with the min hint (the kernel's conservative order)

@@ -76,7 +76,7 @@ def run_ticks(state: SimState, cfg: SimConfig, n_ticks: int,
                               & (down_left > 0))
         if prop_count:
             st = propose_dense(st, cfg, _payload_at,
-                               jnp.asarray(prop_count, I32))
+                               jnp.asarray(prop_count, I32), alive=alive)
         drop = drop_matrix(cfg, tick, drop_rate) if drop_rate else None
         st = step(st, cfg, alive=alive, drop=drop)
         row = jnp.stack([jnp.sum(leader_mask(st).astype(I32)),

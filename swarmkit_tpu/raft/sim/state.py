@@ -118,7 +118,13 @@ class SimState:
     vote: jax.Array        # voted-for node index, NONE if none
     role: jax.Array        # FOLLOWER / CANDIDATE / LEADER
     lead: jax.Array        # known leader index, NONE if unknown
-    elapsed: jax.Array     # election timer (ticks since last leader contact)
+    elapsed: jax.Array     # election timer (resets on campaign/grant/
+                           # leader contact — vendor electionElapsed)
+    contact: jax.Array     # ticks since last CURRENT-TERM leader contact;
+                           # the CheckQuorum lease measures THIS (raft
+                           # dissertation §4.2.3), NOT elapsed — see
+                           # core.py contact_elapsed for why etcd-3.1's
+                           # conflation livelocks PreVote elections
     hb_elapsed: jax.Array  # leader heartbeat timer
     timeout: jax.Array     # randomized election timeout in ticks
     last: jax.Array        # last log index
@@ -251,6 +257,7 @@ def init_state(cfg: SimConfig,
         role=z(n),
         lead=jnp.full((n,), NONE, i32),
         elapsed=z(n),
+        contact=z(n),
         hb_elapsed=z(n),
         timeout=_initial_timeouts(cfg),
         last=z(n), commit=z(n), applied=z(n),

@@ -165,14 +165,17 @@ def run_differential(cfg: SimConfig, n_ticks: int, seed: int,
                     intended.discard(tgt)
                     removed.add(tgt)
 
-        # -- advance both sides with the identical schedule
+        # -- advance both sides with the identical schedule (proposals
+        # consult liveness: clients cannot reach a crashed claimant)
         if prop_count:
             state = _propose(state, cfg, payloads,
-                             np.asarray(prop_count, np.int32))
+                             np.asarray(prop_count, np.int32),
+                             alive=np.asarray(alive))
         if conf is not None:
             state = _propose_conf(state, cfg,
                                   np.asarray(conf[0], np.int32),
-                                  np.asarray(conf[1], bool))
+                                  np.asarray(conf[1], bool),
+                                  alive=np.asarray(alive))
         state = _step(state, cfg, alive=alive, drop=drop)
         oracle.tick(alive, drop, payloads, prop_count, conf)
 

@@ -10,8 +10,6 @@ manager/state/raft/raft_test.go:63-1025 and the conf-change apply path
 raft.go:1939/membership/cluster.go:185, here at the device-kernel level.
 """
 
-import dataclasses
-
 import numpy as np
 import pytest
 
@@ -300,3 +298,32 @@ class TestShardedMailboxWire:
                    ("all-reduce", "all-gather", "collective-permute",
                     "all-to-all", "reduce-scatter")), \
             "sharded mailbox step must lower to cross-device collectives"
+
+
+class TestContactLease:
+    """The CheckQuorum lease measures LEADER CONTACT, not the election
+    timer (core.contact_elapsed rationale): after total leader loss with
+    survivors at EXACTLY quorum, elections must still converge — under
+    etcd-3.1's campaign-reset lease this regime livelocks permanently
+    whenever any survivor's deterministic timeout equals election_tick."""
+
+    def test_exact_quorum_survivorship_elects(self):
+        cfg = SimConfig(n=16, log_len=256, window=16, apply_batch=64,
+                        max_props=32, keep=16, seed=42, pre_vote=True)
+        state = init_state(cfg)
+        state, ticks = run_until_leader(state, cfg, max_ticks=500)
+        # commit a little traffic, then kill 7 rows incl. the leader —
+        # 9 survivors == quorum of 16
+        lead = int(np.flatnonzero(
+            np.asarray(state.role == LEADER)
+            & np.asarray(state.member).diagonal())[0])
+        kill = ([lead] + [i for i in range(cfg.n) if i != lead])[:7]
+        alive = jnp.ones((cfg.n,), bool).at[jnp.asarray(kill)].set(False)
+        elected = False
+        for _ in range(150):
+            state = step(state, cfg, alive=alive)
+            role = np.asarray(state.role)
+            if any(role[i] == LEADER for i in range(cfg.n) if i not in kill):
+                elected = True
+                break
+        assert elected, "exact-quorum survivors failed to elect (lease livelock)"
