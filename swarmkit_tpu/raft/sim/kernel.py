@@ -68,9 +68,13 @@ ranges).  Win/lose poll decisions evaluate only on poll events (candidacy
 start or response arrival), so a conf change shrinking a quorum between
 arrivals cannot retro-promote a stale tally — mirroring core's _poll call
 sites.
+The mailbox wire carries a REAL HEARTBEAT CLASS (round 4, D1 closed):
+MsgHeartbeat on the heartbeat_tick cadence with send-captured commit,
+event-gated appends, and same-tick rejection re-sends; the synchronous
+wire keeps appends-every-tick (at heartbeat_tick=1 that is etcd's
+cadence with content folded in).
 Deliberately simplified vs the host golden core (swarmkit_tpu.raft.core):
-rejection hints are coarse (hint = follower last index), and the
-synchronous wire keeps its one-round-per-tick resend cadence.
+rejection hints are coarse (hint = follower last index).
 Safety properties (election safety, log matching, leader completeness) are
 preserved and asserted by tests/test_raft_sim.py invariant checks and the
 per-tick differential gate (tests/test_raft_sim_differential.py against the
@@ -481,11 +485,14 @@ def step(state: SimState, cfg: SimConfig,
         # sends: up to K appends pipeline per edge (vendor MaxInflightMsgs)
         # with one NEW message per tick; next_ advances OPTIMISTICALLY by
         # the entries known at send (etcd Replicate-state pipelining) and
-        # backtracks on rejection.  An idle edge (nothing new, nothing of
-        # this term in flight) still gets an empty append — the heartbeat.
+        # backtracks on rejection.  Appends are EVENT-GATED (D1 closed,
+        # round 4): replicate edges send only when there is content;
+        # probe edges establish prev-match with one (possibly empty)
+        # append at a time; idle edges carry HEARTBEATS instead (below).
         free_k = (app_at == 0) | (app_term_box != term_k)         # [i,j,K]
         any_free = jnp.any(free_k, axis=2)
         slot_sel = jnp.argmax(free_k, axis=2)                     # [i, j]
+        kh_idx = jnp.arange(cfg.ack_depth, dtype=I32)[None, None]
         onehot = slot_sel[:, :, None] == jnp.arange(K, dtype=I32)[None, None]
         inflight_same = jnp.any((app_at != 0) & (app_term_box == term_k),
                                 axis=2)
@@ -497,7 +504,7 @@ def step(state: SimState, cfg: SimConfig,
             & snp_free
         # StateProbe: one append at a time, no pipelining; StateReplicate:
         # pipeline while a slot is free (vendor progress.go)
-        may = jnp.where(probing, ~inflight_same, has_new | ~inflight_same)
+        may = jnp.where(probing, ~inflight_same, has_new)
         s_app = send_base & can_ring_send & any_free & may
         s_snp = send_base & ~can_ring_send  # snp_free already in send_base
         put = s_app[:, :, None] & onehot
@@ -509,6 +516,65 @@ def step(state: SimState, cfg: SimConfig,
         next_ = jnp.where(s_app & has_new & ~probing, next_ + n_send, next_)
         snp_at = jnp.where(s_snp, now + 1 + lat, snp_at)
         snp_term_box = jnp.where(s_snp, term_e, snp_term_box)
+
+        # -- heartbeat sends (etcd bcastHeartbeat, vendor raft.go:456-462):
+        # every heartbeat_tick each leader broadcasts MsgHeartbeat with the
+        # commit CAPTURED at send as min(match, commit); ack_depth slots
+        # suffice (one send per tick per edge, lifetime <= latency+jitter).
+        hb_at_box, hb_term_box = state.hb_at, state.hb_term
+        hb_commit_box = state.hb_commit
+        hbr_at_box, hbr_term_box = state.hbr_at, state.hbr_term
+        hb_due_send = is_leader & (hb_elapsed >= cfg.heartbeat_tick)
+        hb_elapsed = jnp.where(hb_due_send, 0, hb_elapsed)
+        send_hb = hb_due_send[:, None] & member & ~eye & ~drop
+        hb_free = hb_at_box == 0
+        hb_slot = jnp.argmax(hb_free, axis=2).astype(I32)
+        put_hb = send_hb[:, :, None] & (hb_slot[:, :, None] == kh_idx)
+        hb_at_box = jnp.where(put_hb, (now + 1 + lat)[:, :, None], hb_at_box)
+        hb_term_box = jnp.where(put_hb, term_k, hb_term_box)
+        hb_commit_box = jnp.where(
+            put_hb, jnp.minimum(match, commit[:, None])[:, :, None],
+            hb_commit_box)
+
+        # -- heartbeat deliveries: processed BEFORE append deliveries (the
+        # oracle steps them first), so append validity below sees any
+        # demotion a higher-term heartbeat causes.  All due heartbeats
+        # integrate, aggregated; stale ones (sender no longer the leader
+        # of the captured term) vanish.
+        due_hb = (hb_at_box > 0) & (now + 1 >= hb_at_box)
+        valid_hb = due_hb & (role[:, None, None] == LEADER) \
+            & (hb_term_box == term_k) & alive[None, :, None]
+        hb_at_box = jnp.where(due_hb, 0, hb_at_box)
+        mt_hb = jnp.max(jnp.where(valid_hb, hb_term_box, -1), axis=(0, 2))
+        newer_hb = mt_hb > term
+        term = jnp.where(newer_hb, mt_hb, term)
+        role = jnp.where(newer_hb, FOLLOWER, role)
+        vote = jnp.where(newer_hb, NONE, vote)
+        lead = jnp.where(newer_hb, NONE, lead)
+        elapsed = jnp.where(newer_hb, 0, elapsed)
+        timeout = jnp.where(newer_hb, rand_timeout(cfg, node, term), timeout)
+        cur_hb = valid_hb & (hb_term_box == term[None, :, None])
+        got_hb = jnp.any(cur_hb, axis=(0, 2))                     # [j]
+        src_hb = jnp.argmax(jnp.any(cur_hb, axis=2), axis=0).astype(I32)
+        role = jnp.where(got_hb & (role == CANDIDATE), FOLLOWER, role)
+        lead = jnp.where(got_hb, src_hb, lead)
+        elapsed = jnp.where(got_hb, 0, elapsed)
+        contact = jnp.where(got_hb, 0, contact)
+        # commit_to(min(m.commit, last)) per message, aggregated as a max
+        hbc = jnp.max(jnp.where(cur_hb, hb_commit_box, -1), axis=(0, 2))
+        commit = jnp.where(got_hb,
+                           jnp.maximum(commit, jnp.minimum(hbc, last)),
+                           commit)
+        # one response per edge per tick (responses only carry liveness)
+        send_hbr = jnp.any(cur_hb, axis=2) & ~drop.T
+        hbr_free = hbr_at_box == 0
+        hbr_slot = jnp.argmax(hbr_free, axis=2).astype(I32)
+        put_hbr = send_hbr[:, :, None] & (hbr_slot[:, :, None] == kh_idx)
+        hbr_at_box = jnp.where(put_hbr, (now + 1 + lat.T)[:, :, None],
+                               hbr_at_box)
+        hbr_term_box = jnp.where(put_hbr, term[None, :, None], hbr_term_box)
+        term_k = term[:, None, None]   # refresh: heartbeats may have
+        term_e = term[:, None]         # caught senders' terms up
         # deliveries: the wire drains AT MOST ONE append per edge per tick
         # — the smallest-prev deliverable one; later-due messages wait
         # their turn.  Sender must still be the same-term leader, so ring
@@ -740,6 +806,30 @@ def step(state: SimState, cfg: SimConfig,
         app_at = jnp.where(
             rej_mat[:, :, None] & (app_term_box == term[:, None, None]),
             0, app_at)
+        # etcd re-sends IMMEDIATELY after maybeDecrTo (stepLeader
+        # APP_RESP reject -> send_append): enqueue the backtracked probe
+        # this tick.  Ring-reachable case only — the snapshot variant
+        # waits for the next send round on both sides.
+        snp_busy = (snp_at != 0) & (snp_term_box == term[:, None])
+        prev_rs = next_ - 1
+        rs = rej_mat & is_leader[:, None] & member & ~eye & ~drop \
+            & ~snp_busy & (prev_rs >= snap_idx[:, None])
+        free_rs = (app_at == 0) | (app_term_box != term[:, None, None])
+        rslot = jnp.argmax(free_rs, axis=2).astype(I32)
+        put_rs = rs[:, :, None] \
+            & (rslot[:, :, None] == jnp.arange(K, dtype=I32)[None, None])
+        app_at = jnp.where(put_rs, (now + 1 + lat)[:, :, None], app_at)
+        app_prev = jnp.where(put_rs, prev_rs[:, :, None], app_prev)
+        app_term_box = jnp.where(put_rs, term[:, None, None], app_term_box)
+        # heartbeat responses: liveness only (the etcd match<last resend
+        # trigger is unnecessary under send-time-drop wire semantics —
+        # nothing in flight can be lost, so slot clearing already
+        # guarantees probe retries)
+        due_hbr = (hbr_at_box > 0) & (now + 1 >= hbr_at_box)
+        val_hbr = due_hbr & is_leader[:, None, None] \
+            & (term[:, None, None] == hbr_term_box)
+        recent_active = recent_active | jnp.any(val_hbr, axis=2)
+        hbr_at_box = jnp.where(due_hbr, 0, hbr_at_box)
 
     # -- leader transfer completion: once the target's log caught up,
     # fire TIMEOUT_NOW on its wire slot (vendor stepLeader MsgAppResp
@@ -878,7 +968,9 @@ def step(state: SimState, cfg: SimConfig,
             app_at=app_at, app_prev=app_prev, app_term=app_term_box,
             snp_at=snp_at, snp_term=snp_term_box, probing=probing,
             aresp_at=aresp_at, aresp_term=aresp_term,
-            aresp_match=aresp_match, aresp_ok=aresp_ok)
+            aresp_match=aresp_match, aresp_ok=aresp_ok,
+            hb_at=hb_at_box, hb_term=hb_term_box, hb_commit=hb_commit_box,
+            hbr_at=hbr_at_box, hbr_term=hbr_term_box)
     return dataclasses.replace(
         state,
         term=term, vote=vote, role=role, lead=lead,

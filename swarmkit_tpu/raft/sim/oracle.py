@@ -20,13 +20,24 @@ on vote requests + periodic step-down of partitioned leaders) are now
 IMPLEMENTED by the kernel and replayed faithfully here — they are no longer
 divergences.
 
- D1 appends-as-heartbeats: the kernel has no heartbeat messages (an idle
-    leader keeps sending possibly-empty appends), and the send cadence is
-    one round per tick on the synchronous wire / one message in flight per
-    edge on the mailbox wire — etcd re-sends immediately on commit
-    advance / rejection. Mask: the scheduler calls _bcast_append each tick
-    (sync) or mirrors the slot-gated sends (_tick_mailbox), never fires
-    BEAT, and suppresses sends while responses are being stepped.
+ D1' CLOSED for the mailbox wire (round 4): a real heartbeat class now
+    exists (hb_*/hbr_* boxes — MsgHeartbeat on the heartbeat_tick cadence
+    with commit CAPTURED at send as min(match, commit), responses feeding
+    CheckQuorum liveness), appends are EVENT-GATED (replicate edges send
+    only content; probe edges one at a time; idle edges carry heartbeats),
+    and a rejection re-sends the backtracked probe within the same tick
+    (etcd stepLeader APP_RESP -> send_append).  Replayed here by
+    _tick_mailbox's hbq/hbrq queues and the post-backtrack enqueue.
+    Two deliberate residues, both argued strictly-fresher-than-etcd:
+    (a) commit-advance-triggered EMPTY append broadcasts are subsumed —
+    content appends read commit at DELIVERY (fresher than etcd's capture
+    at send) and caught-up edges learn commit from next tick's heartbeat;
+    (b) the heartbeat-response match<last append trigger is unnecessary
+    because the wire drops at SEND only (nothing in flight can be lost;
+    freed slots already guarantee probe retries).  The SYNCHRONOUS wire
+    keeps appends-every-tick by definition — at heartbeat_tick=1 that IS
+    etcd's heartbeat cadence with content folded in; the scheduler calls
+    _bcast_append each tick there and never fires BEAT.
  D2' PreVote and leader transfer ARE implemented (cfg.pre_vote;
     kernel.transfer_leadership + the TIMEOUT_NOW wire) and replayed here.
     One wire simplification remains: a PreVote rejection stamped with a
@@ -235,6 +246,7 @@ class OracleCluster:
         # lease clock: ticks since last current-term leader contact (the
         # kernel's `contact`; see core.contact_elapsed for the rationale)
         self.contact = [0] * n
+        self.hb_elapsed = [0] * n
         self.timeout = [rand_timeout_py(cfg, i, 0) for i in range(n)]
         self.applied = [0] * n
         self.apply_chk = [0] * n
@@ -262,6 +274,11 @@ class OracleCluster:
         # arespq: per-edge list of (deliver_at, term, resp) — capacity is
         # unbounded here; the kernel's ack_depth guarantees the same set
         self.arespq: dict[tuple[int, int], list[tuple[int, int, Message]]] = {}
+        # heartbeat wire (kernel hb_*/hbr_* boxes): (deliver_at, term,
+        # captured commit) per i->j edge; responses (deliver_at, term)
+        # keyed [leader, responder]
+        self.hbq: dict[tuple[int, int], list[tuple[int, int, int]]] = {}
+        self.hbrq: dict[tuple[int, int], list[tuple[int, int]]] = {}
 
     def _lat(self, i: int, j: int, tick: int) -> int:
         """Python mirror of state.latency_matrix for one edge."""
@@ -355,6 +372,8 @@ class OracleCluster:
             if up[i]:
                 self.elapsed[i] += 1
                 self.contact[i] += 1
+                if nodes[i].state == core.LEADER:
+                    self.hb_elapsed[i] += 1
         for i, nd in enumerate(nodes):
             # CheckQuorum: every election_tick ticks a standing leader must
             # have heard from a quorum since its last round (kernel Phase A)
@@ -521,6 +540,7 @@ class OracleCluster:
             elif nd.state == core.LEADER:   # quorum-of-1 forced cascade
                 self.elapsed[t] = 0
                 self.contact[t] = 0
+                self.hb_elapsed[t] = 0
                 self.timeout[t] = rand_timeout_py(cfg, t, nd.term)
                 self.recent_active[t] = set()
 
@@ -655,6 +675,7 @@ class OracleCluster:
             if not was_leader and nodes[i].state == core.LEADER:
                 self.elapsed[i] = 0
                 self.contact[i] = 0
+                self.hb_elapsed[i] = 0
                 self.recent_active[i] = set()
                 new_leader_msgs.extend(msgs)  # win-cascade appends (Phase C)
         # rejections step in AFTER all grants (kernel: win evaluated before
@@ -810,6 +831,7 @@ class OracleCluster:
                     self.timeout[i] = rand_timeout_py(cfg, i, nd.term)
                     if nd.state == core.LEADER:  # quorum-of-1 cascade
                         self.contact[i] = 0
+                        self.hb_elapsed[i] = 0
                         self.recent_active[i] = set()
                 elif nd.state == core.FOLLOWER:  # rejection-quorum lose
                     self.elapsed[i] = 0
@@ -853,6 +875,7 @@ class OracleCluster:
                 if nd.state == core.LEADER:  # the guard above filtered
                     self.elapsed[i] = 0      # out already-leaders
                     self.contact[i] = 0
+                    self.hb_elapsed[i] = 0
                     self.recent_active[i] = set()
                 elif nd.state == core.FOLLOWER:  # rejection-quorum lose
                     self.elapsed[i] = 0
@@ -887,13 +910,65 @@ class OracleCluster:
                     if probing:
                         if q:
                             continue
-                    elif len(q) >= K or not (has_new or not q):
+                    elif len(q) >= K or not has_new:
                         continue
                     q.append((now + self._lat(i, j, now), prev, nd.term))
                     if has_new and not probing:  # optimisticUpdate
                         pr.next = prev + min(cfg.window, last - prev) + 1
                 else:
                     self.snpq[(i, j)] = (now + self._lat(i, j, now), nd.term)
+        # -- heartbeat sends (kernel hb wire; etcd bcastHeartbeat): commit
+        # captured at send as min(pr.match, committed)
+        for i, nd in enumerate(nodes):
+            if not up[i] or nd.state != core.LEADER \
+                    or self.hb_elapsed[i] < cfg.heartbeat_tick:
+                continue
+            self.hb_elapsed[i] = 0
+            for j in range(n):
+                if j == i or drop[i][j] or (j + 1) not in nd.prs:
+                    continue
+                self.hbq.setdefault((i, j), []).append(
+                    (now + self._lat(i, j, now), nd.term,
+                     min(nd.prs[j + 1].match, nd.log.committed)))
+        # -- heartbeat deliveries: BEFORE append deliveries (the kernel
+        # computes append validity after heartbeat effects), all due per
+        # tick, stale (sender left the captured term/role) dropped,
+        # stepped per receiver in term-desc order like appends
+        hb_out: list[tuple[int, int, int, int]] = []
+        for (i, j) in sorted(self.hbq):
+            q = self.hbq[(i, j)]
+            due = [e for e in q if e[0] <= now]
+            if not due:
+                continue
+            self.hbq[(i, j)] = [e for e in q if e[0] > now]
+            nd = nodes[i]
+            for (_, tm, cm) in due:
+                if nd.state != core.LEADER or nd.term != tm or not up[j]:
+                    continue
+                hb_out.append((i, j, tm, cm))
+        by_hb: dict[int, list[tuple[int, int, int]]] = {}
+        for i, j, tm, cm in hb_out:
+            by_hb.setdefault(j, []).append((i, tm, cm))
+        for j, msgs in sorted(by_hb.items()):
+            msgs.sort(key=lambda x: (-x[1], x[0]))
+            responded: set[int] = set()
+            for i, tm, cm in msgs:
+                m = Message(type=MsgType.HEARTBEAT, to=j + 1, frm=i + 1,
+                            term=tm, commit=cm)
+                if m.term > nodes[j].term:   # become_follower _reset (D4')
+                    self.elapsed[j] = 0
+                    self.timeout[j] = rand_timeout_py(self.cfg, j, m.term)
+                nodes[j].step(m)
+                for resp in nodes[j].take_msgs():
+                    if resp.type == MsgType.HEARTBEAT_RESP \
+                            and not drop[j][i] and i not in responded:
+                        # one response per edge per tick (liveness only)
+                        responded.add(i)
+                        self.hbrq.setdefault((i, j), []).append(
+                            (now + self._lat(j, i, now), nodes[j].term))
+                if m.term == nodes[j].term:
+                    self.elapsed[j] = 0
+                    self.contact[j] = 0
         # deliveries: the wire drains AT MOST ONE append per edge per tick
         # — the smallest-prev deliverable one; construct messages from the
         # sender's CURRENT state
@@ -979,10 +1054,11 @@ class OracleCluster:
                     and (j + 1) in nd.prs:
                 # kernel reject rule + becomeProbe (flush pipelined
                 # same-term appends past the conflict).  Responses from a
-                # peer the config no longer contains are dropped (core
-                # stepLeader: prs.get(m.frm) is None -> return; the kernel
-                # integrates them into progress state that is masked out of
-                # every quorum count and reset wholesale on re-add).
+                # peer the config no longer contains are dropped on BOTH
+                # sides (core stepLeader: prs.get(m.frm) is None -> return;
+                # kernel: ok_mat/rej_mat &= member before integration —
+                # the rejection path is receiver-visible, so the mask is
+                # required for exactness).
                 pr = nd.prs[j + 1]
                 pr.next = max(1, min(pr.next - 1, min(rej_hints) + 1))
                 pr.state = core.PROBE
@@ -990,6 +1066,31 @@ class OracleCluster:
                 pr.paused = False
                 self.appq[(i, j)] = [e for e in self.appq.get((i, j), [])
                                      if e[2] != nd.term]
+                # etcd re-sends immediately after maybeDecrTo (stepLeader
+                # APP_RESP reject -> send_append): enqueue the backtracked
+                # probe this tick (ring-reachable case only; the snapshot
+                # variant waits for the next send round on both sides)
+                s_ = self.snpq.get((i, j))
+                prev = pr.next - 1
+                if not drop[i][j] \
+                        and not (s_ is not None and s_[1] == nd.term) \
+                        and prev >= nd.log.offset:
+                    self.appq[(i, j)].append(
+                        (now + self._lat(i, j, now), prev, nd.term))
+
+        # heartbeat responses: liveness bookkeeping only (kernel val_hbr;
+        # the etcd match<last resend trigger is unnecessary under
+        # send-time-drop wire semantics)
+        for (i, j) in sorted(self.hbrq):
+            q = self.hbrq[(i, j)]
+            due = [e for e in q if e[0] <= now]
+            if not due:
+                continue
+            self.hbrq[(i, j)] = [e for e in q if e[0] > now]
+            nd = nodes[i]
+            for (_, tm) in due:
+                if up[i] and nd.state == core.LEADER and nd.term == tm:
+                    self.recent_active[i].add(j)
 
         self._transfer_fire(up, drop)
         self._phase_def(up)

@@ -216,6 +216,16 @@ class SimState:
     aresp_match: Optional[jax.Array] = None  # generated per delivery, and
     aresp_ok: Optional[jax.Array] = None    # deliveries aggregate (max
                                             # match / min reject hint)
+    # heartbeat class (etcd MsgHeartbeat/MsgHeartbeatResp; vendor
+    # bcastHeartbeat raft.go:456-462): [N, N, ack_depth] — sent once per
+    # heartbeat_tick per edge, so the ack-style depth bound holds.  The
+    # commit is CAPTURED at send as min(match, commit) (etcd semantics);
+    # appends, by contrast, read commit at delivery.
+    hb_at: Optional[jax.Array] = None       # i -> j heartbeat
+    hb_term: Optional[jax.Array] = None
+    hb_commit: Optional[jax.Array] = None
+    hbr_at: Optional[jax.Array] = None      # j -> i response, indexed
+    hbr_term: Optional[jax.Array] = None    # [leader, responder]
 
 
 def init_state(cfg: SimConfig,
@@ -249,7 +259,12 @@ def init_state(cfg: SimConfig,
             aresp_at=z(n, n, cfg.ack_depth),
             aresp_term=z(n, n, cfg.ack_depth),
             aresp_match=z(n, n, cfg.ack_depth),
-            aresp_ok=jnp.zeros((n, n, cfg.ack_depth), jnp.bool_))
+            aresp_ok=jnp.zeros((n, n, cfg.ack_depth), jnp.bool_),
+            hb_at=z(n, n, cfg.ack_depth),
+            hb_term=z(n, n, cfg.ack_depth),
+            hb_commit=z(n, n, cfg.ack_depth),
+            hbr_at=z(n, n, cfg.ack_depth),
+            hbr_term=z(n, n, cfg.ack_depth))
     return SimState(
         **boxes,
         term=z(n),

@@ -1,0 +1,75 @@
+"""Round-4 verify: D1-closed mailbox wire (heartbeat class, event-gated
+appends) driven via the public sim API."""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+from swarmkit_tpu.raft.sim import (
+    LEADER, SimConfig, committed_entries, init_state, propose,
+    run_until_leader, step, transfer_leadership,
+)
+
+cfg = SimConfig(n=12, log_len=256, window=16, apply_batch=64, max_props=32,
+                keep=16, seed=7, election_tick=16, latency=2,
+                latency_jitter=1, inflight=4, pre_vote=True)
+state = init_state(cfg)
+state, ticks = run_until_leader(state, cfg, max_ticks=800)
+assert int(ticks) < 800
+lead = int(np.flatnonzero(np.asarray(state.role) == LEADER)[0])
+print(f"1. mailbox-wire election in {int(ticks)} ticks (leader {lead})")
+
+# heartbeats in flight: after a few idle ticks the hb wire is active and
+# commit still propagates with NO content appends
+pl = jnp.arange(cfg.max_props, dtype=jnp.uint32) + 1
+state = propose(state, cfg, pl, 16)
+for _ in range(25):
+    state = step(state, cfg)
+    c0 = np.asarray(state.commit)
+    if (c0 >= 16).all():
+        break
+assert (c0 >= 16).all(), f"commit did not reach followers: {c0}"
+assert int(np.asarray(state.hb_at).max()) > 0, "heartbeat wire inactive"
+print(f"2. commit {int(c0.max())} reached ALL 12 rows (heartbeat-carried commit)")
+
+# idle period: leaders send heartbeats, not appends — election must stay
+# stable (no spurious depositions) for many election timeouts
+term0 = int(np.asarray(state.term).max())
+for _ in range(100):
+    state = step(state, cfg)
+assert int(np.asarray(state.term).max()) == term0, "idle leadership unstable"
+print(f"3. 100 idle ticks at term {term0}: leadership stable on heartbeats alone")
+
+# transfer still completes on the reworked wire
+tgt = (lead + 3) % cfg.n
+state = transfer_leadership(state, cfg, lead, tgt)
+moved = False
+for _ in range(150):
+    state = step(state, cfg)
+    if np.asarray(state.role)[tgt] == LEADER:
+        moved = True
+        break
+assert moved, "transfer did not complete"
+print(f"4. leader transfer {lead} -> {tgt} completed")
+
+# crash the new leader; survivors re-elect; commits continue
+alive = jnp.ones((cfg.n,), bool).at[tgt].set(False)
+for _ in range(200):
+    state = step(state, cfg, alive=alive)
+    role = np.asarray(state.role)
+    if any(role[i] == LEADER for i in range(cfg.n) if i != tgt):
+        break
+else:
+    raise AssertionError("no re-election after leader crash")
+base = int(committed_entries(state))
+for _ in range(30):
+    state = propose(state, cfg, pl, 8, alive=alive)
+    state = step(state, cfg, alive=alive)
+    if int(committed_entries(state)) >= base + 8:
+        break
+assert int(committed_entries(state)) >= base + 8
+by = {}
+for a, c in zip(np.asarray(state.applied).tolist(),
+                np.asarray(state.apply_chk).tolist()):
+    assert by.setdefault(a, c) == c
+print("5. crash + re-election + commits + state-machine safety OK")
+print("VERIFY-HEARTBEATS: OK")
