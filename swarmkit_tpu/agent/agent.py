@@ -34,6 +34,10 @@ class AgentConfig:
     # the connection-broker seam: returns a Dispatcher-shaped client
     # (reference: agent/config.go ConnBroker)
     connect: Callable[[], object] = None
+    # LogBroker-shaped client factory (listen_subscriptions/publish_logs);
+    # None disables the agent-side log pipeline (reference:
+    # agent/session.go:249 logSubscriptions over the same connection)
+    connect_logs: Callable[[], object] = None
     addr: str = ""
     db_path: str = ":memory:"
     clock: Optional[Clock] = None
@@ -119,6 +123,21 @@ class Agent:
         self._established = True
         self._ready.set()
 
+        # agent side of `service logs`: subscription intake + publishers,
+        # tied to the session lifetime (reference: session.go:249-273)
+        self.log_loop = None
+        log_buffer = getattr(self.config.executor, "logs", None)
+        if self.config.connect_logs is not None and log_buffer is not None:
+            from swarmkit_tpu.agent.logs import LogSubscriptionLoop
+
+            try:
+                self.log_loop = LogSubscriptionLoop(
+                    self.config.connect_logs(), self.worker, log_buffer,
+                    self.config.node_id)
+                self.log_loop.start()
+            except Exception:
+                log.exception("log subscription loop failed to start")
+
         # absorb the registration message (node object = template context)
         # BEFORE any assignment can race it
         if not session.session_msgs.empty():
@@ -166,6 +185,9 @@ class Agent:
                 log.exception("setting network bootstrap keys failed")
 
     async def _teardown_session(self) -> None:
+        if getattr(self, "log_loop", None) is not None:
+            await self.log_loop.stop()
+            self.log_loop = None
         self.worker.set_reporter(None)
         if self.reporter is not None:
             await self.reporter.close()

@@ -46,3 +46,34 @@ class Dependencies:
     def __init__(self) -> None:
         self.secrets = Secrets()
         self.configs = Configs()
+
+    def templated(self, task, node=None) -> "TemplatedDependencies":
+        """Per-task view whose gets expand templated payloads
+        (reference: template/getter.go NewTemplatedDependencyGetter)."""
+        return TemplatedDependencies(self, task, node)
+
+
+class _TemplatedStore:
+    def __init__(self, store: _DepStore, task, node) -> None:
+        self._store = store
+        self._task = task
+        self._node = node
+
+    def get(self, dep_id: str) -> Optional[object]:
+        from swarmkit_tpu.template import expand_secret_spec
+
+        item = self._store.get(dep_id)
+        if item is None:
+            return None
+        return expand_secret_spec(item, self._task, self._node)
+
+    def __len__(self) -> int:
+        return len(self._store)
+
+
+class TemplatedDependencies:
+    """reference: template/getter.go templatedDependencyGetter."""
+
+    def __init__(self, deps: Dependencies, task, node) -> None:
+        self.secrets = _TemplatedStore(deps.secrets, task, node)
+        self.configs = _TemplatedStore(deps.configs, task, node)

@@ -21,13 +21,42 @@ class TestController(Controller):
         self.exit_evt = asyncio.Event()
         self.fail_msg: Optional[str] = None
 
+    def write_log(self, line: str) -> None:
+        """Test hook: emit a task output line into the executor's buffer."""
+        import time
+
+        from swarmkit_tpu.manager.logbroker import LogStream
+
+        self.executor.logs.publish(
+            self.task.id, LogStream.STDOUT, line.encode(),
+            service_id=self.task.service_id, node_id=self.task.node_id,
+            timestamp=time.time())
+
     async def prepare(self) -> None:
         if self.executor.fail_prepare:
             raise TaskError("prepare failed (test)")
+        # resolve referenced secrets/configs through the per-task templated
+        # view (template/getter.go) so tests can assert expanded payloads
+        deps = getattr(self.executor, "dependencies", None)
+        self.resolved_secrets: dict[str, bytes] = {}
+        self.resolved_configs: dict[str, bytes] = {}
+        if deps is not None and self.task.spec.container is not None:
+            view = deps.templated(self.task,
+                                  (self.executor.configured_nodes or
+                                   [None])[-1])
+            for ref in self.task.spec.container.secrets:
+                item = view.secrets.get(ref.secret_id)
+                if item is not None:
+                    self.resolved_secrets[ref.secret_name] = item.spec.data
+            for ref in self.task.spec.container.configs:
+                item = view.configs.get(ref.config_id)
+                if item is not None:
+                    self.resolved_configs[ref.config_name] = item.spec.data
 
     async def start(self) -> None:
         if self.executor.fail_start:
             raise TaskError("start failed (test)")
+        self.write_log("started")
 
     async def wait(self) -> None:
         await self.exit_evt.wait()
@@ -55,7 +84,10 @@ class TestExecutor(Executor):
         self.hostname = hostname
         self.cpus = cpus
         self.memory = memory
+        from swarmkit_tpu.agent.logs import TaskLogBuffer
+
         self.controllers: dict[str, TestController] = {}
+        self.logs = TaskLogBuffer()
         self.fail_prepare = False
         self.fail_start = False
         self.configured_nodes: list = []

@@ -196,7 +196,10 @@ def parse_program(container) -> tuple[str, dict]:
 
 class TpuController(Controller):
     """One task = one compiled XLA program (reference FSM:
-    dockerapi/controller.go; Prepare/Start/Wait mapping in module doc)."""
+    dockerapi/controller.go; Prepare/Start/Wait mapping in module doc).
+    Lifecycle + result lines go to the executor's TaskLogBuffer — the
+    stdout-equivalent the agent's log publishers serve to `service logs`
+    (reference: the Docker controller's log-driver read-back)."""
 
     def __init__(self, task, executor: "TpuExecutor") -> None:
         self.task = task
@@ -206,12 +209,48 @@ class TpuController(Controller):
         self._run_fut: Optional[asyncio.Future] = None
         self.result = None
 
+    def _log(self, line: str, stream=None) -> None:
+        import time
+
+        from swarmkit_tpu.manager.logbroker import LogStream
+
+        self.executor.logs.publish(
+            self.task.id, stream or LogStream.STDOUT,
+            line.encode(), service_id=self.task.service_id,
+            node_id=self.task.node_id, timestamp=time.time())
+
     async def update(self, task) -> None:
         self.task = task  # spec changes beyond desired-state are rejected
         # upstream by the orchestrator creating a replacement task
 
+    def _dep_params(self) -> dict:
+        """k=v lines from referenced secret/config payloads become program
+        parameters (the runtime's analog of mounting secret files; payloads
+        are template-expanded per task, template/getter.go)."""
+        deps = getattr(self.executor, "dependencies", None)
+        c = self.task.spec.container
+        if deps is None or c is None or (not c.secrets and not c.configs):
+            return {}
+        view = deps.templated(self.task, self.executor._node)
+        out: dict[str, str] = {}
+        for ref, store in ([(r, view.secrets) for r in c.secrets]
+                           + [(r, view.configs) for r in c.configs]):
+            dep_id = getattr(ref, "secret_id", "")                 or getattr(ref, "config_id", "")
+            item = store.get(dep_id)
+            if item is None:
+                raise TaskError(f"missing dependency {dep_id!r}")
+            for line in item.spec.data.decode("utf-8",
+                                              "replace").splitlines():
+                if "=" in line:
+                    k, v = line.split("=", 1)
+                    out[k.strip().lower()] = v.strip()
+        return out
+
     async def prepare(self) -> None:
         name, params = parse_program(self.task.spec.container)
+        public_params = dict(params)   # loggable: image args/env only
+        dep = self._dep_params()
+        params.update(dep)
         builder = PROGRAMS.get(name)
         if builder is None:
             raise TaskRejected(f"unknown TPU program {name!r} "
@@ -228,9 +267,19 @@ class TpuController(Controller):
         try:
             self._compiled, self._args = await loop.run_in_executor(
                 None, build_and_compile)
+            # dependency-sourced params are SECRET material: log their
+            # names only, never values (they would be served cluster-wide
+            # through `service logs`)
+            shown = [f"{k}={v}" for k, v in public_params.items()]
+            shown += [f"{k}=<from-dependency>" for k in dep]
+            self._log(f"compiled tpu://{name} {' '.join(shown)}")
         except TaskRejected:
             raise
         except Exception as e:
+            from swarmkit_tpu.manager.logbroker import LogStream
+
+            self._log(f"compilation of {name!r} failed: {e}",
+                      LogStream.STDERR)
             raise TaskError(f"compilation of {name!r} failed: {e}") from e
 
     async def start(self) -> None:
@@ -246,15 +295,21 @@ class TpuController(Controller):
             return out
 
         self._run_fut = loop.run_in_executor(None, run)
+        self._log("started on device")
 
     async def wait(self) -> None:
         if self._run_fut is None:
             raise TaskError("wait before start")
         try:
             self.result = await asyncio.shield(self._run_fut)
+            self._log(f"result: {self.result}")
+            self._log("task complete")
         except asyncio.CancelledError:
             raise TaskError("task cancelled")
         except Exception as e:
+            from swarmkit_tpu.manager.logbroker import LogStream
+
+            self._log(f"device execution failed: {e}", LogStream.STDERR)
             raise TaskError(f"device execution failed: {e}") from e
 
     async def shutdown(self) -> None:
@@ -277,8 +332,11 @@ class TpuExecutor(Executor):
     dockerapi/executor.go Describe + Controller factory."""
 
     def __init__(self, hostname: str = "") -> None:
+        from swarmkit_tpu.agent.logs import TaskLogBuffer
+
         self.hostname = hostname
         self._node = None
+        self.logs = TaskLogBuffer()   # served via `service logs`
 
     def _devices(self):
         import jax

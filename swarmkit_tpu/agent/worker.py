@@ -32,9 +32,12 @@ class Worker:
     def __init__(self, executor: Executor, db: Optional[TaskDB] = None,
                  clock: Optional[Clock] = None) -> None:
         self.executor = executor
+        # controllers resolve task secrets/configs through this (the
+        # reference wires a DependencyManager into the executor the same
+        # way; template/getter.go wraps it per task)
+        executor.dependencies = self.dependencies = Dependencies()
         self.db = db or TaskDB()
         self.clock = clock or SystemClock()
-        self.dependencies = Dependencies()
         self.node = None   # latest node object from the session stream
         self.task_managers: dict[str, TaskManager] = {}
         # freshest status per task, for re-reporting on reconnection

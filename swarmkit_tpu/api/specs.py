@@ -180,12 +180,17 @@ class SecretSpec(Message):
     annotations: Annotations = field(default_factory=Annotations)
     data: bytes = b""
     driver: Optional[Driver] = None
+    # reference api/specs.proto SecretSpec.Templating: when set (driver
+    # name "golang"), the payload is template-expanded PER TASK when
+    # served to a workload (template/expand.go:132 ExpandSecretSpec)
+    templating: Optional[Driver] = None
 
 
 @dataclass
 class ConfigSpec(Message):
     annotations: Annotations = field(default_factory=Annotations)
     data: bytes = b""
+    templating: Optional[Driver] = None
 
 
 # ---- cluster-level config (api/specs.proto ClusterSpec) -------------------

@@ -70,7 +70,12 @@ class ControlSocketServer:
                     return
                 try:
                     req = json.loads(line)
-                    result = await self._dispatch(req.get("method", ""),
+                    method = req.get("method", "")
+                    if method == "logs.subscribe":
+                        await self._stream_logs(req.get("params", {}),
+                                                writer)
+                        continue
+                    result = await self._dispatch(method,
                                                   req.get("params", {}))
                     resp = {"result": result}
                 except ControlError as e:
@@ -88,6 +93,45 @@ class ControlSocketServer:
             writer.close()
 
     # ------------------------------------------------------------------
+    async def _stream_logs(self, p: dict, writer) -> None:
+        """`service logs` over the socket: one {"stream": msg} line per
+        LogMessage, then {"result": "eof"} (reference: the Logs gRPC
+        server stream, api/logbroker.proto SubscribeLogs)."""
+        from swarmkit_tpu.manager.logbroker import (
+            LogSelector, SubscribeLogsOptions,
+        )
+
+        leader = self._control()
+        lb = getattr(leader, "logbroker", None)
+        if lb is None:
+            raise CtlError("leader has no log broker", "unavailable")
+        selector = LogSelector(service_ids=p.get("service_ids") or [],
+                               node_ids=p.get("node_ids") or [],
+                               task_ids=p.get("task_ids") or [])
+        options = SubscribeLogsOptions(follow=bool(p.get("follow", False)),
+                                       tail=int(p.get("tail", -1)))
+        try:
+            async for m in lb.subscribe_logs(selector, options):
+                writer.write(json.dumps({"stream": {
+                    "service_id": m.context.service_id,
+                    "node_id": m.context.node_id,
+                    "task_id": m.context.task_id,
+                    "timestamp": m.timestamp,
+                    "stream": int(m.stream),
+                    "data": m.data.decode("utf-8", "replace"),
+                }}).encode() + b"\n")
+                await writer.drain()
+        except Exception as e:
+            # terminate with the ERROR, never a clean eof: the client must
+            # see truncation as a failure, and exactly ONE response line
+            # may end the stream (a second would corrupt the next request)
+            writer.write(json.dumps(
+                {"error": str(e), "code": "unavailable"}).encode() + b"\n")
+            await writer.drain()
+            return
+        writer.write(json.dumps({"result": "eof"}).encode() + b"\n")
+        await writer.drain()
+
     async def _dispatch(self, method: str, p: dict):
         leader = self._control()
         if hasattr(leader, "control_call"):
@@ -160,6 +204,9 @@ async def dispatch_control(c, method: str, p: dict):
         spec = ServiceSpec.from_dict(p["spec"])
         return (await c.update_service(
             p["id"], spec, version=p.get("version"))).to_dict()
+    if method == "service.rollback":
+        return (await c.rollback_service(
+            p["id"], version=p.get("version"))).to_dict()
     if method == "service.rm":
         await c.remove_service(p["id"])
         return {}
@@ -213,6 +260,26 @@ class ControlSocketClient:
             self._writer.close()
             self._writer = None
             self._reader = None
+
+    async def stream(self, method: str, **params):
+        """Server-streaming call: yields {"stream": ...} payloads until
+        the terminating {"result": "eof"} line."""
+        if self._writer is None:
+            await self.connect()
+        self._writer.write(json.dumps(
+            {"method": method, "params": params}).encode() + b"\n")
+        await self._writer.drain()
+        while True:
+            line = await self._reader.readline()
+            if not line:
+                raise CtlError("connection closed", "unavailable")
+            resp = json.loads(line)
+            if "error" in resp:
+                raise CtlError(resp["error"], resp.get("code", "unknown"))
+            if "stream" in resp:
+                yield resp["stream"]
+                continue
+            return
 
     async def call(self, method: str, **params):
         if self._writer is None:

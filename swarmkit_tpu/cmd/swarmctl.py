@@ -54,6 +54,36 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("task-ls")
     sp.add_argument("--service", default=None)
 
+    sp = sub.add_parser("service-update")
+    sp.add_argument("id")
+    sp.add_argument("--image", default=None)
+    sp.add_argument("--replicas", type=int, default=None)
+    sp.add_argument("--env", action="append", default=None,
+                    help="replace the env list (repeatable)")
+    sp.add_argument("--force", action="store_true",
+                    help="bump force_update to replace tasks even with an "
+                         "unchanged spec")
+    sp.add_argument("--update-parallelism", type=int, default=None)
+    sp.add_argument("--update-delay", type=float, default=None)
+    sp.add_argument("--update-order", default=None,
+                    choices=["stop-first", "start-first"])
+    sp.add_argument("--update-failure-action", default=None,
+                    choices=["pause", "continue", "rollback"])
+    sp.add_argument("--update-monitor", type=float, default=None)
+    sp.add_argument("--update-max-failure-ratio", type=float, default=None)
+    sp.add_argument("--rollback-parallelism", type=int, default=None)
+    sp.add_argument("--rollback-order", default=None,
+                    choices=["stop-first", "start-first"])
+    sub.add_parser("service-rollback").add_argument("id")
+
+    sp = sub.add_parser("service-logs")
+    sp.add_argument("id", help="service id (or task id with --task)")
+    sp.add_argument("--task", action="store_true",
+                    help="treat id as a task id")
+    sp.add_argument("--follow", "-f", action="store_true")
+    sp.add_argument("--tail", type=int, default=-1,
+                    help="last N buffered lines per task (-1 = all)")
+
     sp = sub.add_parser("network-create")
     sp.add_argument("--name", required=True)
     sub.add_parser("network-ls")
@@ -148,6 +178,58 @@ async def run(args, out=None) -> int:
                 version=svc["meta"]["version"]["index"]))
         elif c == "service-rm":
             await client.call("service.rm", id=args.id)
+        elif c == "service-update":
+            cur = await client.call("service.inspect", id=args.id)
+            spec = cur["spec"]
+            cont = spec.setdefault("task", {}).setdefault("container", {})
+            if args.image is not None:
+                cont["image"] = args.image
+            if args.env is not None:
+                cont["env"] = list(args.env)
+            if args.replicas is not None and spec.get("replicated"):
+                spec["replicated"]["replicas"] = args.replicas
+            if args.force:
+                spec["task"]["force_update"] = \
+                    int(spec["task"].get("force_update", 0)) + 1
+            upd = spec.get("update") or {}
+            for flag, key in (("update_parallelism", "parallelism"),
+                              ("update_delay", "delay"),
+                              ("update_monitor", "monitor"),
+                              ("update_max_failure_ratio",
+                               "max_failure_ratio")):
+                v = getattr(args, flag)
+                if v is not None:
+                    upd[key] = v
+            if args.update_order is not None:
+                upd["order"] = {"stop-first": 0,
+                                "start-first": 1}[args.update_order]
+            if args.update_failure_action is not None:
+                upd["failure_action"] = {
+                    "pause": 0, "continue": 1,
+                    "rollback": 2}[args.update_failure_action]
+            if upd:
+                spec["update"] = upd
+            rb = spec.get("rollback") or {}
+            if args.rollback_parallelism is not None:
+                rb["parallelism"] = args.rollback_parallelism
+            if args.rollback_order is not None:
+                rb["order"] = {"stop-first": 0,
+                               "start-first": 1}[args.rollback_order]
+            if rb:
+                spec["rollback"] = rb
+            show(await client.call(
+                "service.update", id=args.id, spec=spec,
+                version=cur["meta"]["version"]["index"]))
+        elif c == "service-rollback":
+            show(await client.call("service.rollback", id=args.id))
+        elif c == "service-logs":
+            sel = ({"task_ids": [args.id]} if args.task
+                   else {"service_ids": [args.id]})
+            async for m in client.stream("logs.subscribe", follow=args.follow,
+                                         tail=args.tail, **sel):
+                tag = "ERR" if m["stream"] == 2 else "OUT"
+                out.write(f"{m['task_id'][:12]}@{m['node_id'][:12]} "
+                          f"{tag} | {m['data']}\n")
         elif c == "task-ls":
             ids = [args.service] if args.service else None
             for t in await client.call("task.ls", service_ids=ids):

@@ -35,85 +35,169 @@ DYNAMIC_PORT_END = 32767
 INGRESS_NETWORK_NAME = "ingress"
 
 
+def _gateway(subnet: str) -> str:
+    """base address + 1 — correct for non-octet-aligned subnets too
+    (e.g. 192.168.7.128/25 -> 192.168.7.129)."""
+    addr = subnet.split("/")[0]
+    parts = [int(x) for x in addr.split(".")]
+    v = ((parts[0] << 24) | (parts[1] << 16) | (parts[2] << 8)
+         | parts[3]) + 1
+    return f"{(v >> 24) & 255}.{(v >> 16) & 255}.{(v >> 8) & 255}.{v & 255}"
+
+
 class PortConflict(Exception):
     """An explicitly requested published port is already taken
     (reference: portallocator.go allocation error)."""
 
 
 class SubnetExhausted(Exception):
-    """A network's /24 has no free host addresses left."""
+    """A network's subnets have no free host addresses left."""
+
+
+class _Subnet:
+    """One CIDR pool with a sequential cursor (.1 reserved as gateway)."""
+
+    def __init__(self, cidr: str) -> None:
+        self.cidr = cidr
+        addr, prefix = cidr.split("/")
+        self.prefix = int(prefix)
+        parts = [int(x) for x in addr.split(".")]
+        self.base = (parts[0] << 24) | (parts[1] << 16)             | (parts[2] << 8) | parts[3]
+        self.size = 1 << (32 - self.prefix)
+        self.next_host = 2           # .0 network, .1 gateway
+        self.used: set[int] = set()
+
+    def _fmt(self, off: int) -> str:
+        v = self.base + off
+        return (f"{(v >> 24) & 255}.{(v >> 16) & 255}."
+                f"{(v >> 8) & 255}.{v & 255}/{self.prefix}")
+
+    def allocate(self) -> Optional[str]:
+        while self.next_host < self.size - 1:   # last addr = broadcast
+            off = self.next_host
+            self.next_host += 1
+            if off not in self.used:
+                self.used.add(off)
+                return self._fmt(off)
+        return None
+
+    def contains(self, addr: str) -> bool:
+        parts = [int(x) for x in addr.split("/")[0].split(".")]
+        v = (parts[0] << 24) | (parts[1] << 16) | (parts[2] << 8) | parts[3]
+        return self.base <= v < self.base + self.size
+
+    def note(self, addr: str) -> None:
+        parts = [int(x) for x in addr.split("/")[0].split(".")]
+        v = (parts[0] << 24) | (parts[1] << 16) | (parts[2] << 8) | parts[3]
+        self.used.add(v - self.base)
 
 
 class IPAM:
-    """Flat sequential IPAM (cnmallocator analog)."""
+    """Multi-pool IPAM: user-configured subnets (NetworkSpec.ipam configs,
+    reference cnmallocator IPAM options) or auto-assigned 10.<n>.0.0/24
+    pools; a network GROWS an extra auto pool when its subnets fill
+    (reference networks carry multiple IPAMConfig entries)."""
 
     def __init__(self) -> None:
-        self._next_subnet = 1
-        self._next_host: dict[str, int] = {}   # network id -> next host octet
-        self._subnets: dict[str, str] = {}     # network id -> subnet prefix
+        self._next_auto = 1
+        self._pools: dict[str, list[_Subnet]] = {}
 
-    def allocate_subnet(self, network_id: str) -> str:
-        subnet = f"10.{self._next_subnet}.0.0/24"
-        self._next_subnet += 1
-        self._subnets[network_id] = subnet
-        self._next_host[network_id] = 2  # .1 = gateway
-        return subnet
+    def subnets(self, network_id: str) -> list[str]:
+        return [sn.cidr for sn in self._pools.get(network_id, [])]
+
+    def _auto_cidr(self) -> str:
+        cidr = f"10.{self._next_auto}.0.0/24"
+        self._next_auto += 1
+        return cidr
+
+    def allocate_subnet(self, network_id: str,
+                        requested: str = "") -> str:
+        cidr = requested or self._auto_cidr()
+        self._pools.setdefault(network_id, []).append(_Subnet(cidr))
+        return cidr
+
+    def grow(self, network_id: str) -> str:
+        """Append a fresh auto pool once the existing subnets fill."""
+        return self.allocate_subnet(network_id)
 
     def restore_subnet(self, network_id: str, subnet: str) -> None:
-        self._subnets[network_id] = subnet
+        self._pools.setdefault(network_id, []).append(_Subnet(subnet))
         try:
-            octet = int(subnet.split(".")[1])
-            self._next_subnet = max(self._next_subnet, octet + 1)
+            parts = subnet.split("/")[0].split(".")
+            if parts[0] == "10":
+                self._next_auto = max(self._next_auto, int(parts[1]) + 1)
         except (ValueError, IndexError):
             pass
-        self._next_host.setdefault(network_id, 2)
 
     def allocate_address(self, network_id: str) -> str:
-        if network_id not in self._subnets:
+        if network_id not in self._pools:
             self.allocate_subnet(network_id)
-        base = self._subnets[network_id].rsplit(".", 2)[0]
-        host = self._next_host[network_id]
-        if host > 254:  # .255 is broadcast; stay inside the /24
-            raise SubnetExhausted(
-                f"network {network_id}: /24 address space exhausted")
-        self._next_host[network_id] = host + 1
-        return f"{base}.0.{host}/24"
+        for sn in self._pools[network_id]:
+            addr = sn.allocate()
+            if addr is not None:
+                return addr
+        raise SubnetExhausted(
+            f"network {network_id}: all subnets exhausted")
 
     def restore_address(self, network_id: str, addr: str) -> None:
-        try:
-            host_part = addr.split("/")[0].split(".")
-            host = int(host_part[2]) * 256 + int(host_part[3])
-            self._next_host[network_id] = max(
-                self._next_host.get(network_id, 2), host + 1)
-        except (ValueError, IndexError):
-            pass
+        for sn in self._pools.get(network_id, []):
+            if sn.contains(addr):
+                sn.note(addr)
+                return
+
+
+class _PortSpace:
+    """One protocol's port space (reference portallocator.go portSpace):
+    a master set holding every allocation 1-65535 plus a dynamic cursor
+    over [30000, 32767] that wraps, so churned dynamic ports are reusable
+    after release."""
+
+    def __init__(self) -> None:
+        self.master: set[int] = set()
+        self.cursor = DYNAMIC_PORT_START
+
+    def allocate(self, port: int = 0) -> int:
+        if port:
+            if port in self.master:
+                raise PortConflict(f"port {port} is already published")
+            self.master.add(port)
+            return port
+        span = DYNAMIC_PORT_END - DYNAMIC_PORT_START + 1
+        for _ in range(span):
+            cand = self.cursor
+            self.cursor += 1
+            if self.cursor > DYNAMIC_PORT_END:
+                self.cursor = DYNAMIC_PORT_START
+            if cand not in self.master:
+                self.master.add(cand)
+                return cand
+        raise PortConflict("dynamic port space exhausted")
+
+    def release(self, port: int) -> None:
+        self.master.discard(port)
 
 
 class PortAllocator:
-    """Published-port bookkeeping (reference: portallocator.go)."""
+    """Published-port bookkeeping, one space PER PROTOCOL
+    (reference: portallocator.go portSpaces map keyed tcp/udp/sctp)."""
 
     def __init__(self) -> None:
-        self._allocated: set[tuple[str, int]] = set()
-        self._next_dynamic = DYNAMIC_PORT_START
+        self._spaces: dict[str, _PortSpace] = {}
+
+    def _space(self, proto: str) -> _PortSpace:
+        return self._spaces.setdefault(proto or "tcp", _PortSpace())
 
     def allocate(self, proto: str, port: int = 0) -> int:
-        if port:
-            if (proto, port) in self._allocated:
-                raise PortConflict(f"{proto} port {port} is already published")
-            self._allocated.add((proto, port))
-            return port
-        while (proto, self._next_dynamic) in self._allocated:
-            self._next_dynamic += 1
-            if self._next_dynamic > DYNAMIC_PORT_END:
-                raise RuntimeError("dynamic port space exhausted")
-        self._allocated.add((proto, self._next_dynamic))
-        return self._next_dynamic
+        try:
+            return self._space(proto).allocate(port)
+        except PortConflict as e:
+            raise PortConflict(f"{proto} {e}") from None
 
     def restore(self, proto: str, port: int) -> None:
-        self._allocated.add((proto, port))
+        self._space(proto).master.add(port)
 
     def release(self, proto: str, port: int) -> None:
-        self._allocated.discard((proto, port))
+        self._space(proto).release(port)
 
 
 class Allocator:
@@ -138,7 +222,8 @@ class Allocator:
         # restore state from the store (reference: doNetworkInit network.go:70)
         for net in self.store.find("network"):
             if net.ipam is not None and net.ipam.configs:
-                self.ipam.restore_subnet(net.id, net.ipam.configs[0].subnet)
+                for c in net.ipam.configs:
+                    self.ipam.restore_subnet(net.id, c.subnet)
             else:
                 self._pending_networks.add(net.id)
         for svc in self.store.find("service"):
@@ -232,18 +317,47 @@ class Allocator:
         if tasks:
             await self._alloc_tasks(tasks)
 
+    def _address_with_growth(self, tx, network_id: str) -> Optional[str]:
+        """Allocate an address, GROWING the network by a fresh auto subnet
+        when its pools fill (persisted to the network record so restore
+        sees every pool).  None only when growth itself is impossible."""
+        try:
+            return self.ipam.allocate_address(network_id)
+        except SubnetExhausted:
+            pass
+        subnet = self.ipam.grow(network_id)
+        net = tx.get("network", network_id)
+        if net is not None:
+            if net.ipam is None:
+                net.ipam = IPAMOptions(driver="default", configs=[])
+            net.ipam.configs.append(IPAMConfig(
+                subnet=subnet, gateway=_gateway(subnet)))
+            tx.update(net)
+        try:
+            return self.ipam.allocate_address(network_id)
+        except SubnetExhausted:
+            return None
+
     async def _alloc_network(self, network_id: str) -> None:
-        """reference: doNetworkAlloc network.go:164."""
+        """reference: doNetworkAlloc network.go:164 — user-configured
+        subnets (spec.ipam, cnmallocator IPAM options) are honored;
+        otherwise an auto 10.<n>.0.0/24 pool is assigned."""
         def txn(tx):
             net = tx.get("network", network_id)
             if net is None:
                 return
             if net.ipam is not None and net.ipam.configs:
                 return  # already allocated
-            subnet = self.ipam.allocate_subnet(network_id)
+            requested = []
+            if net.spec.ipam is not None:
+                requested = [c.subnet for c in net.spec.ipam.configs
+                             if c.subnet]
+            subnets = ([self.ipam.allocate_subnet(network_id, r)
+                        for r in requested]
+                       or [self.ipam.allocate_subnet(network_id)])
             net.ipam = IPAMOptions(driver="default", configs=[
-                IPAMConfig(subnet=subnet,
-                           gateway=subnet.rsplit(".", 2)[0] + ".0.1")])
+                IPAMConfig(subnet=sn, gateway=_gateway(sn))
+                for sn in subnets])
             tx.update(net)
         await self.store.update(txn)
 
@@ -318,10 +432,10 @@ class Allocator:
             have_vips = {v.network_id for v in ep.virtual_ips}
             for nid in want_nets:
                 if nid not in have_vips:
-                    try:
-                        addr = self.ipam.allocate_address(nid)
-                    except SubnetExhausted as e:
-                        log.warning("service %s VIP: %s", service_id, e)
+                    addr = self._address_with_growth(tx, nid)
+                    if addr is None:
+                        log.warning("service %s VIP: network %s exhausted",
+                                    service_id, nid)
                         continue
                     ep.virtual_ips.append(EndpointVIP(network_id=nid,
                                                       addr=addr))
@@ -353,10 +467,10 @@ class Allocator:
                     net = tx.get("network", nid)
                     if net is None:
                         continue
-                    try:
-                        addr = self.ipam.allocate_address(nid)
-                    except SubnetExhausted as e:
-                        log.warning("task %s: %s", tid, e)
+                    addr = self._address_with_growth(tx, nid)
+                    if addr is None:
+                        log.warning("task %s: network %s exhausted",
+                                    tid, nid)
                         continue
                     t.networks.append(NetworkAttachment(
                         network_id=nid, addresses=[addr]))

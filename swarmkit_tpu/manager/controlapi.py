@@ -224,6 +224,35 @@ class ControlApi:
         except ErrSequenceConflict:
             raise FailedPrecondition("update out of sequence")
 
+    async def rollback_service(self, service_id: str,
+                               version: Optional[int] = None) -> Service:
+        """Manual rollback (reference: UpdateServiceRequest.Rollback,
+        service.go — restore previous_spec; the update supervisor sees
+        ROLLBACK_STARTED and re-runs reconciliation under the rollback
+        config, updater.go:587)."""
+        from swarmkit_tpu.api.objects import UpdateStatus
+
+        def txn(tx):
+            svc = tx.get("service", service_id)
+            if svc is None:
+                raise NotFound(f"service {service_id} not found")
+            self._check_version(svc, version)
+            if svc.previous_spec is None:
+                raise FailedPrecondition(
+                    "service has no previous spec to roll back to")
+            svc = svc.copy()
+            svc.spec = svc.previous_spec
+            svc.previous_spec = None
+            svc.update_status = UpdateStatus(
+                state="rollback_started",
+                message="manually requested rollback")
+            tx.update(svc)
+            return svc
+        try:
+            return await self.store.update(txn)
+        except ErrSequenceConflict:
+            raise FailedPrecondition("rollback out of sequence")
+
     async def remove_service(self, service_id: str) -> None:
         def txn(tx):
             if tx.get("service", service_id) is None:

@@ -50,6 +50,20 @@ class LogSelector:
 
 
 @dataclass
+class SubscribeLogsOptions:
+    """reference: api/logbroker.proto:24-28 SubscribeLogsOptions."""
+
+    follow: bool = True        # keep streaming after the backlog
+    tail: int = -1             # last N buffered messages (-1 = all)
+    streams: tuple = ()        # () = both stdout and stderr
+    # non-follow safety valve: a matching node that never publishes (down,
+    # no agent) must not hang the stream forever — after this many seconds
+    # the backlog collected so far is returned (the reference blocks until
+    # context cancellation; a CLI deserves a bound)
+    max_wait: float = 10.0
+
+
+@dataclass
 class SubscriptionMessage:
     id: str = ""
     selector: LogSelector = field(default_factory=LogSelector)
@@ -58,12 +72,18 @@ class SubscriptionMessage:
 
 
 class Subscription:
-    def __init__(self, selector: LogSelector, store: MemoryStore) -> None:
+    def __init__(self, selector: LogSelector, store: MemoryStore,
+                 options: Optional[SubscribeLogsOptions] = None) -> None:
         self.id = new_id()
         self.selector = selector
+        self.options = options or SubscribeLogsOptions()
         self.store = store
         self.queue: Queue = Queue()
         self.closed = False
+        # non-follow completion (reference: broker.go publisher tracking):
+        # nodes expected to publish a backlog; when every one has sent its
+        # close marker and follow is off, the client stream ends
+        self.pending_nodes: set[str] = set()
 
     def node_ids(self) -> set[str]:
         """Nodes whose agents should feed this subscription
@@ -80,8 +100,11 @@ class Subscription:
         return nodes
 
     def message(self, close: bool = False) -> SubscriptionMessage:
-        return SubscriptionMessage(id=self.id, selector=self.selector,
-                                   close=close)
+        return SubscriptionMessage(
+            id=self.id, selector=self.selector, close=close,
+            options={"follow": self.options.follow,
+                     "tail": self.options.tail,
+                     "streams": [int(x) for x in self.options.streams]})
 
 
 class LogBroker:
@@ -91,13 +114,17 @@ class LogBroker:
         self.subscription_bus: Queue = Queue()  # SubscriptionMessage fan-out
 
     # -- client side -----------------------------------------------------
-    async def subscribe_logs(self, selector: LogSelector
+    async def subscribe_logs(self, selector: LogSelector,
+                             options: Optional[SubscribeLogsOptions] = None
                              ) -> AsyncIterator[LogMessage]:
-        """reference: SubscribeLogs broker.go:224."""
+        """reference: SubscribeLogs broker.go:224.  With follow=False the
+        stream ends once every matching node published its backlog."""
         import asyncio
 
-        sub = Subscription(selector, self.store)
+        sub = Subscription(selector, self.store, options)
         self.subscriptions[sub.id] = sub
+        if not sub.options.follow:
+            sub.pending_nodes = sub.node_ids()
         watcher = sub.queue.watch()
         self.subscription_bus.publish(sub.message())
         # re-announce when the service's tasks land on new nodes, so agents
@@ -105,10 +132,21 @@ class LogBroker:
         # (reference: subscription.Run watches task events)
         refresher = asyncio.get_running_loop().create_task(
             self._refresh_subscription(sub))
+        timer = None
         try:
+            if not sub.options.follow:
+                if not sub.pending_nodes:
+                    return   # nothing runs anywhere: empty backlog
+                timer = asyncio.get_running_loop().call_later(
+                    max(sub.options.max_wait, 0.0),
+                    lambda: sub.queue.publish(_EOF))
             async for msg in watcher:
+                if msg is _EOF:
+                    return
                 yield msg
         finally:
+            if timer is not None:
+                timer.cancel()
             refresher.cancel()
             watcher.close()
             sub.closed = True
@@ -154,10 +192,24 @@ class LogBroker:
             watcher.close()
 
     async def publish_logs(self, subscription_id: str,
-                           messages: list[LogMessage]) -> None:
-        """reference: PublishLogs broker.go:380."""
+                           messages: list[LogMessage],
+                           node_id: str = "", close: bool = False) -> None:
+        """reference: PublishLogs broker.go:380.  `close` marks this
+        node's publisher finished — with follow=False the subscription
+        completes once every pending node closed."""
         sub = self.subscriptions.get(subscription_id)
         if sub is None or sub.closed:
             return
         for m in messages:
             sub.queue.publish(m)
+        if close and not sub.options.follow:
+            sub.pending_nodes.discard(node_id)
+            if not sub.pending_nodes:
+                sub.queue.publish(_EOF)
+
+
+class _Eof:
+    """Stream-end sentinel on a subscription queue."""
+
+
+_EOF = _Eof()

@@ -74,6 +74,12 @@ class ConnectionBroker:
             raise NoManagerError("leader connection not established yet")
         return d
 
+    def select_logbroker(self):
+        lb = getattr(self.select_leader(), "logbroker", None)
+        if lb is None:
+            raise NoManagerError("leader connection not established yet")
+        return lb
+
     def select_control(self):
         c = self.select_leader().control_api
         if c is None:

@@ -262,6 +262,7 @@ class Node:
             node_id=self.node_id,
             executor=self.config.executor,
             connect=self.broker.select_dispatcher,
+            connect_logs=self.broker.select_logbroker,
             addr=self.addr,
             db_path=os.path.join(self.config.state_dir, "tasks.db")
             if self.config.state_dir != ":memory:" else ":memory:",

@@ -35,6 +35,7 @@ from swarmkit_tpu.ca.certificates import MANAGER_ROLE_OU, WORKER_ROLE_OU
 log = logging.getLogger("swarmkit_tpu.rpc")
 
 _DISP = "swarmkit.Dispatcher"
+_LOGS = "swarmkit.LogBroker"
 _CA = "swarmkit.CA"
 _CTL = "swarmkit.Control"
 _INFO = "swarmkit.Manager"
@@ -186,6 +187,57 @@ class ClusterService:
         except Exception as e:
             await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
 
+    # -- LogBroker (agent + client sides of `service logs`) --------------
+    async def listen_subscriptions(self, request: bytes, context):
+        info = await self._authorize(context, WORKER_ROLE_OU,
+                                     MANAGER_ROLE_OU)
+        node_id = msgpack.unpackb(request)
+        await self._bind_identity(context, info, node_id)
+        try:
+            lb = self._leader_manager().logbroker
+            async for m in lb.listen_subscriptions(node_id):
+                yield msgpack.packb(_pack_submsg(m))
+        except RpcError as e:
+            await self._abort(context, e)
+        except Exception as e:
+            await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+
+    async def publish_logs(self, request: bytes, context) -> bytes:
+        info = await self._authorize(context, WORKER_ROLE_OU,
+                                     MANAGER_ROLE_OU)
+        node_id, sub_id, msgs, close = msgpack.unpackb(request)
+        await self._bind_identity(context, info, node_id)
+        try:
+            lb = self._leader_manager().logbroker
+            await lb.publish_logs(sub_id, [_unpack_logmsg(m) for m in msgs],
+                                  node_id=node_id, close=close)
+            return b""
+        except RpcError as e:
+            await self._abort(context, e)
+        except Exception as e:
+            await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+
+    async def subscribe_logs(self, request: bytes, context):
+        await self._authorize(context, MANAGER_ROLE_OU)
+        sel, follow, tail = msgpack.unpackb(request)
+        from swarmkit_tpu.manager.logbroker import (
+            LogSelector, SubscribeLogsOptions,
+        )
+
+        selector = LogSelector(service_ids=list(sel[0]),
+                               node_ids=list(sel[1]),
+                               task_ids=list(sel[2]))
+        try:
+            lb = self._leader_manager().logbroker
+            async for m in lb.subscribe_logs(
+                    selector, SubscribeLogsOptions(follow=follow,
+                                                   tail=tail)):
+                yield msgpack.packb(_pack_logmsg(m))
+        except RpcError as e:
+            await self._abort(context, e)
+        except Exception as e:
+            await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+
     # -- CA --------------------------------------------------------------
     def _ca(self):
         ca = self._leader_manager().ca_server
@@ -275,6 +327,16 @@ class ClusterService:
                 "RenewNodeCertificate": u(self.renew_certificate,
                                           request_deserializer=_IDENT,
                                           response_serializer=_IDENT)}),
+            grpc.method_handlers_generic_handler(_LOGS, {
+                "ListenSubscriptions": s(self.listen_subscriptions,
+                                         request_deserializer=_IDENT,
+                                         response_serializer=_IDENT),
+                "PublishLogs": u(self.publish_logs,
+                                 request_deserializer=_IDENT,
+                                 response_serializer=_IDENT),
+                "SubscribeLogs": s(self.subscribe_logs,
+                                   request_deserializer=_IDENT,
+                                   response_serializer=_IDENT)}),
             grpc.method_handlers_generic_handler(_CTL, {
                 "Call": u(self.control, request_deserializer=_IDENT,
                           response_serializer=_IDENT)}),
@@ -430,6 +492,86 @@ async def fetch_root_ca(addr: str, timeout: float = 5.0) -> bytes:
         await channel.close()
 
 
+def _pack_submsg(m) -> tuple:
+    return (m.id, (list(m.selector.service_ids), list(m.selector.node_ids),
+                   list(m.selector.task_ids)), m.close, m.options)
+
+
+def _unpack_submsg(t):
+    from swarmkit_tpu.manager.logbroker import (
+        LogSelector, SubscriptionMessage,
+    )
+
+    sid, sel, close, options = t
+    return SubscriptionMessage(
+        id=sid, selector=LogSelector(service_ids=list(sel[0]),
+                                     node_ids=list(sel[1]),
+                                     task_ids=list(sel[2])),
+        close=close, options=dict(options))
+
+
+def _pack_logmsg(m) -> tuple:
+    return (m.context.service_id, m.context.node_id, m.context.task_id,
+            m.timestamp, int(m.stream), m.data)
+
+
+def _unpack_logmsg(t):
+    from swarmkit_tpu.manager.logbroker import (
+        LogContext, LogMessage, LogStream,
+    )
+
+    svc, node, task, ts, stream, data = t
+    return LogMessage(context=LogContext(service_id=svc, node_id=node,
+                                         task_id=task),
+                      timestamp=ts, stream=LogStream(stream), data=data)
+
+
+class RemoteLogBroker:
+    """LogBroker duck type over gRPC (surface used by agent/logs.py and
+    the control socket's subscribe-logs)."""
+
+    def __init__(self, channel: grpc.aio.Channel) -> None:
+        self._listen = channel.unary_stream(
+            f"/{_LOGS}/ListenSubscriptions", request_serializer=_IDENT,
+            response_deserializer=_IDENT)
+        self._publish = channel.unary_unary(
+            f"/{_LOGS}/PublishLogs", request_serializer=_IDENT,
+            response_deserializer=_IDENT)
+        self._subscribe = channel.unary_stream(
+            f"/{_LOGS}/SubscribeLogs", request_serializer=_IDENT,
+            response_deserializer=_IDENT)
+
+    async def listen_subscriptions(self, node_id: str):
+        try:
+            async for raw in self._listen(msgpack.packb(node_id)):
+                yield _unpack_submsg(msgpack.unpackb(raw))
+        except grpc.aio.AioRpcError as e:
+            raise _redirectable(e)
+
+    async def publish_logs(self, sub_id: str, messages,
+                           node_id: str = "", close: bool = False) -> None:
+        req = msgpack.packb((node_id, sub_id,
+                             [_pack_logmsg(m) for m in messages], close))
+        try:
+            await self._publish(req)
+        except grpc.aio.AioRpcError as e:
+            raise _redirectable(e)
+
+    async def subscribe_logs(self, selector, options=None):
+        from swarmkit_tpu.manager.logbroker import SubscribeLogsOptions
+
+        options = options or SubscribeLogsOptions()
+        req = msgpack.packb(((list(selector.service_ids),
+                              list(selector.node_ids),
+                              list(selector.task_ids)),
+                             options.follow, options.tail))
+        try:
+            async for raw in self._subscribe(req):
+                yield _unpack_logmsg(msgpack.unpackb(raw))
+        except grpc.aio.AioRpcError as e:
+            raise _redirectable(e)
+
+
 class RemoteManager:
     """Manager duck type over gRPC for the connection broker: cached
     is_leader/leader_addr (refreshed on use) + remote services.
@@ -457,6 +599,7 @@ class RemoteManager:
         self._ctl = None
         self.dispatcher: Optional[RemoteDispatcher] = None
         self.ca_server: Optional[RemoteCA] = None
+        self.logbroker: Optional[RemoteLogBroker] = None
         self._is_leader = False
         self._leader_addr = ""
         self._has_manager = False
@@ -531,6 +674,7 @@ class RemoteManager:
             response_deserializer=_IDENT)
         self.dispatcher = RemoteDispatcher(channel)
         self.ca_server = RemoteCA(channel)
+        self.logbroker = RemoteLogBroker(channel)
 
     def start(self) -> None:
         self._refresher = asyncio.get_running_loop().create_task(

@@ -52,6 +52,21 @@ def expand(text: str, ctx: dict[str, str]) -> str:
     return _VAR_RE.sub(sub, text)
 
 
+def expand_secret_spec(secret, task, node=None):
+    """Per-task expansion of a templated secret/config PAYLOAD
+    (reference: template/expand.go:132 ExpandSecretSpec,
+    template/getter.go templatedSecretGetter).  No templating driver ->
+    returned unchanged; expansion errors raise TemplateError so the task
+    is rejected rather than fed a half-expanded payload."""
+    if getattr(secret.spec, "templating", None) is None:
+        return secret
+    ctx = task_context(task, node)
+    out = secret.copy()
+    out.spec.data = expand(
+        secret.spec.data.decode("utf-8"), ctx).encode("utf-8")
+    return out
+
+
 def expand_container_spec(task, node=None):
     """Return a task copy with its container spec expanded
     (reference: template/expand.go ExpandContainerSpec)."""

@@ -261,3 +261,60 @@ async def test_do_task_state_parks_at_ready_until_promoted():
     task.desired_state = int(TaskState.RUNNING)
     st = await do_task_state(task, ctl, 0.0)
     assert st.state == TaskState.STARTING
+
+
+@async_test
+async def test_templated_secret_payload_expansion():
+    """A secret with the templating driver set has its PAYLOAD expanded
+    per task when resolved through the worker's dependency view
+    (reference: template/expand.go:132 ExpandSecretSpec,
+    template/getter.go:16)."""
+    from swarmkit_tpu.agent.testutils import TestExecutor
+    from swarmkit_tpu.agent.worker import Worker
+    from swarmkit_tpu.api import (
+        Annotations, ContainerSpec, Secret, SecretSpec, Task, TaskSpec,
+        TaskState,
+    )
+    from swarmkit_tpu.api.objects import Node as ApiNode
+    from swarmkit_tpu.api.specs import Driver, SecretReference
+    from swarmkit_tpu.api.types import NodeDescription
+    from swarmkit_tpu.utils.clock import FakeClock
+
+    ex = TestExecutor()
+    clock = FakeClock()
+    w = Worker(ex, clock=clock)
+    await w.init()
+    node = ApiNode(id="n1", description=NodeDescription(hostname="host-a"))
+    w.set_node(node)
+    await ex.configure(node)   # the agent session does this in production
+
+    secret = Secret(id="sec1", spec=SecretSpec(
+        annotations=Annotations(name="dbcreds"),
+        data=b"user={{.Service.Name}}-{{.Task.Slot}}\nhost={{.Node.Hostname}}",
+        templating=Driver(name="golang")))
+    plain = Secret(id="sec2", spec=SecretSpec(
+        annotations=Annotations(name="static"),
+        data=b"value={{.Service.Name}}"))   # NO templating: stays literal
+    w.dependencies.secrets.add(secret, plain)
+
+    task = Task(id="t1", service_id="s1", slot=4, node_id="n1",
+                desired_state=int(TaskState.RUNNING),
+                spec=TaskSpec(container=ContainerSpec(
+                    image="img",
+                    secrets=[SecretReference(secret_id="sec1",
+                                             secret_name="dbcreds"),
+                             SecretReference(secret_id="sec2",
+                                             secret_name="static")])))
+    task.service_annotations = Annotations(name="web")
+    await w._start_manager(task)
+    ctl = ex.controllers["t1"]
+    for _ in range(50):
+        if getattr(ctl, "resolved_secrets", None):
+            break
+        await asyncio.sleep(0.01)
+    assert ctl.resolved_secrets["dbcreds"] == b"user=web-4\nhost=host-a"
+    # un-templated payloads are NEVER expanded
+    assert ctl.resolved_secrets["static"] == b"value={{.Service.Name}}"
+    # the store's own copy is untouched by per-task expansion
+    assert b"{{.Service.Name}}" in w.dependencies.secrets.get("sec1").spec.data
+    await w.close()

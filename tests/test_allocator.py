@@ -2,6 +2,8 @@
 
 import asyncio
 
+import pytest
+
 from swarmkit_tpu.api import (
     Annotations, ContainerSpec, Network, NetworkSpec, ReplicatedService,
     Service, ServiceSpec, TaskSpec, TaskState,
@@ -177,5 +179,117 @@ async def test_endpoint_dynamic_to_explicit_port_change():
     await pump(clock)
     assert store.get("service", svc.id).endpoint.ports[0].published_port == 7777
     # the old dynamic port is free again
-    assert (("tcp", dyn)) not in alloc.ports._allocated
+    assert dyn not in alloc.ports._space("tcp").master
     await alloc.stop()
+
+
+def test_port_spaces_are_per_protocol():
+    """tcp/udp/sctp have independent port spaces (reference:
+    portallocator.go portSpaces map): the same number can be published on
+    every protocol, and dynamic cursors don't interfere."""
+    from swarmkit_tpu.manager.allocator import PortAllocator, PortConflict
+
+    pa = PortAllocator()
+    assert pa.allocate("tcp", 8080) == 8080
+    assert pa.allocate("udp", 8080) == 8080   # different space: no conflict
+    assert pa.allocate("sctp", 8080) == 8080
+    with pytest.raises(PortConflict):
+        pa.allocate("tcp", 8080)
+    # dynamic allocations start at the same base per protocol
+    assert pa.allocate("tcp") == 30000
+    assert pa.allocate("udp") == 30000
+
+
+def test_dynamic_port_space_wraps_after_release():
+    """Released dynamic ports become reusable once the cursor wraps
+    (reference: idm bitmask reuse; the round-3 allocator leaked them
+    permanently)."""
+    from swarmkit_tpu.manager.allocator import (
+        DYNAMIC_PORT_END, DYNAMIC_PORT_START, PortAllocator, PortConflict,
+    )
+
+    pa = PortAllocator()
+    span = DYNAMIC_PORT_END - DYNAMIC_PORT_START + 1
+    for _ in range(span):
+        pa.allocate("tcp")
+    with pytest.raises(PortConflict):
+        pa.allocate("tcp")
+    pa.release("tcp", 31000)
+    assert pa.allocate("tcp") == 31000   # wraps and finds the hole
+
+
+@async_test
+async def test_host_mode_port_not_in_cluster_space():
+    """Host-mode published ports are per-node and never consume the
+    cluster ingress space (api/types.proto:633 PublishMode; reference
+    allocatePorts skips non-ingress)."""
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    alloc = Allocator(store, clock=clock)
+    await alloc.start()
+    try:
+        svc = make_service(name="hostsvc", ports=[
+            PortConfig(protocol="tcp", target_port=80, published_port=8080,
+                       publish_mode="host")])
+        await store.update(lambda tx: tx.create(svc))
+        await pump(clock)
+        ep = store.get("service", svc.id).endpoint
+        assert ep.ports[0].published_port == 8080
+        assert ep.ports[0].publish_mode == "host"
+        # the cluster ingress space still has 8080 free: an ingress
+        # service can publish the same number
+        svc2 = make_service(name="ingsvc", ports=[
+            PortConfig(protocol="tcp", target_port=81, published_port=8080,
+                       publish_mode="ingress")])
+        await store.update(lambda tx: tx.create(svc2))
+        await pump(clock)
+        ep2 = store.get("service", svc2.id).endpoint
+        assert ep2.ports[0].published_port == 8080
+    finally:
+        await alloc.stop()
+
+
+@async_test
+async def test_user_subnet_pool_honored_and_grows():
+    """NetworkSpec.ipam subnets are used as configured (cnmallocator IPAM
+    options); when a small pool fills, the allocator GROWS the network
+    with a fresh auto subnet persisted on the record (round-3 weak #6:
+    one /24 capped everything at 253 addresses)."""
+    from swarmkit_tpu.api import Task, TaskStatus
+    from swarmkit_tpu.api.types import IPAMConfig, IPAMOptions
+
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    alloc = Allocator(store, clock=clock)
+    await alloc.start()
+    try:
+        net = Network(id="tiny-net", spec=NetworkSpec(
+            annotations=Annotations(name="tiny"),
+            ipam=IPAMOptions(configs=[
+                IPAMConfig(subnet="192.168.7.0/29")])))
+        await store.update(lambda tx: tx.create(net))
+        await pump(clock)
+        rec = store.get("network", "tiny-net")
+        assert rec.ipam.configs[0].subnet == "192.168.7.0/29"
+        assert rec.ipam.configs[0].gateway == "192.168.7.1"
+
+        # a /29 holds 5 usable task addresses (8 - network - gateway -
+        # broadcast); the 6th allocation must grow the pool
+        for i in range(7):
+            t = Task(id=f"t{i}", spec=TaskSpec(networks=["tiny-net"]),
+                     status=TaskStatus(state=TaskState.NEW),
+                     desired_state=int(TaskState.RUNNING))
+            await store.update(lambda tx, t=t: tx.create(t))
+        await pump(clock)
+        await pump(clock)
+        tasks = [store.get("task", f"t{i}") for i in range(7)]
+        addrs = [t.networks[0].addresses[0] for t in tasks if t.networks]
+        assert len(addrs) == 7, "growth did not keep allocating"
+        assert len(set(addrs)) == 7
+        in_pool = [a for a in addrs if a.startswith("192.168.7.")]
+        grown = [a for a in addrs if a.startswith("10.")]
+        assert len(in_pool) == 5 and len(grown) == 2, addrs
+        rec = store.get("network", "tiny-net")
+        assert len(rec.ipam.configs) == 2, "grown subnet not persisted"
+    finally:
+        await alloc.stop()

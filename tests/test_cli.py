@@ -245,3 +245,155 @@ async def test_swarmctl_metrics_shows_latency_percentiles():
     finally:
         await node.stop()
         tmp.cleanup()
+
+
+@async_test
+async def test_swarmctl_service_logs():
+    """`swarmctl service-logs` tails task output over the control socket
+    (reference: the swarm-level `docker service logs` workflow)."""
+    from swarmkit_tpu.cmd import swarmctl as ctl_cmd
+    from swarmkit_tpu.cmd import swarmd
+
+    tmp = tempfile.TemporaryDirectory(prefix="swarmd-logs-")
+    sock = os.path.join(tmp.name, "swarmd.sock")
+    args = swarmd.build_parser().parse_args([
+        "--state-dir", os.path.join(tmp.name, "state"),
+        "--listen-control-api", sock,
+        "--node-id", "m1", "--manager",
+        "--election-tick", "4", "--backend", "inproc",
+        "--executor", "test",
+    ])
+    node = await swarmd.run(args)
+    try:
+        for _ in range(200):
+            if node.is_leader():
+                break
+            await asyncio.sleep(0.05)
+
+        async def ctl(*argv):
+            out = io.StringIO()
+            rc = await ctl_cmd.run(
+                ctl_cmd.build_parser().parse_args(
+                    ["--socket", sock, *argv]), out=out)
+            return rc, out.getvalue()
+
+        rc, out = await ctl("service-create", "--name", "logged",
+                            "--image", "img", "--replicas", "1")
+        assert rc == 0
+        svc_id = json.loads(out)["id"]
+        for _ in range(200):
+            rc, out = await ctl("task-ls", "--service", svc_id)
+            if "RUNNING" in out:
+                break
+            await asyncio.sleep(0.05)
+
+        # the TestController wrote "started"; add an app line
+        ex = node.config.executor
+        ctl_obj = next(c for c in ex.controllers.values()
+                       if c.task.service_id == svc_id)
+        ctl_obj.write_log("hello from the task")
+
+        # non-follow returns the backlog and exits
+        rc, out = await ctl("service-logs", svc_id, "--tail", "5")
+        assert rc == 0, out
+        assert "started" in out and "hello from the task" in out
+        assert "OUT |" in out
+
+        # task-id selector works too
+        rc, out = await ctl("service-logs", ctl_obj.task.id, "--task")
+        assert rc == 0 and "hello from the task" in out
+    finally:
+        await node._ctl_server.stop()
+        await node.stop()
+
+
+@async_test
+async def test_swarmctl_service_update_and_rollback():
+    """`swarmctl service-update` drives the update supervisor (update
+    config flags incl. start-first order) and `service-rollback` restores
+    the previous spec (reference: cmd/swarmctl/service update flags;
+    rollback path updater.go:587)."""
+    from swarmkit_tpu.cmd import swarmctl as ctl_cmd
+    from swarmkit_tpu.cmd import swarmd
+
+    tmp = tempfile.TemporaryDirectory(prefix="swarmd-upd-")
+    sock = os.path.join(tmp.name, "swarmd.sock")
+    args = swarmd.build_parser().parse_args([
+        "--state-dir", os.path.join(tmp.name, "state"),
+        "--listen-control-api", sock,
+        "--node-id", "m1", "--manager",
+        "--election-tick", "4", "--backend", "inproc",
+        "--executor", "test",
+    ])
+    node = await swarmd.run(args)
+    try:
+        for _ in range(200):
+            if node.is_leader():
+                break
+            await asyncio.sleep(0.05)
+
+        async def ctl(*argv):
+            out = io.StringIO()
+            rc = await ctl_cmd.run(
+                ctl_cmd.build_parser().parse_args(
+                    ["--socket", sock, *argv]), out=out)
+            return rc, out.getvalue()
+
+        async def wait_running(svc_id, want, image=None, timeout=15.0):
+            store = node.manager.store
+            from swarmkit_tpu.store.by import ByService
+            deadline = asyncio.get_running_loop().time() + timeout
+            while asyncio.get_running_loop().time() < deadline:
+                ts = [t for t in store.find("task", ByService(svc_id))
+                      if t.status.state == TaskState.RUNNING
+                      and int(t.desired_state) == int(TaskState.RUNNING)]
+                if image is not None:
+                    ts = [t for t in ts
+                          if t.spec.container.image == image]
+                if len(ts) == want:
+                    return ts
+                await asyncio.sleep(0.05)
+            raise AssertionError(
+                f"never saw {want} running {image or ''} tasks")
+
+        rc, out = await ctl("service-create", "--name", "web",
+                            "--image", "img1", "--replicas", "3")
+        assert rc == 0
+        svc_id = json.loads(out)["id"]
+        await wait_running(svc_id, 3, "img1")
+
+        # rolling update to img2 with explicit update-config flags
+        rc, out = await ctl(
+            "service-update", svc_id, "--image", "img2",
+            "--update-parallelism", "1", "--update-order", "start-first",
+            "--update-failure-action", "continue",
+            "--update-monitor", "0.2", "--update-delay", "0")
+        assert rc == 0, out
+        updated = json.loads(out)
+        assert updated["spec"]["task"]["container"]["image"] == "img2"
+        assert updated["spec"]["update"]["order"] == 1      # start-first
+        assert updated["spec"]["update"]["parallelism"] == 1
+        await wait_running(svc_id, 3, "img2")
+
+        # update status reaches completed
+        deadline = asyncio.get_running_loop().time() + 10
+        while asyncio.get_running_loop().time() < deadline:
+            rc, out = await ctl("service-inspect", svc_id)
+            st = json.loads(out).get("update_status") or {}
+            if st.get("state") == "completed":
+                break
+            await asyncio.sleep(0.05)
+        assert st.get("state") == "completed", st
+
+        # manual rollback restores img1
+        rc, out = await ctl("service-rollback", svc_id)
+        assert rc == 0, out
+        assert json.loads(out)["spec"]["task"]["container"]["image"] == "img1"
+        await wait_running(svc_id, 3, "img1")
+
+        # a second rollback has nothing to restore (error -> stderr, rc 1)
+        rc, out = await ctl("service-rollback", svc_id)
+        assert rc == 1
+    finally:
+        await node._ctl_server.stop()
+        await node.stop()

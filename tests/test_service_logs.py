@@ -1,0 +1,209 @@
+"""The `service logs` pipeline end to end: executor log capture ->
+agent subscription publishers -> LogBroker relay -> client stream,
+with Follow/Tail options.
+
+Reference: agent/session.go:249-273 (ListenSubscriptions),
+agent/agent.go:207 (subscription handling),
+manager/logbroker/broker.go:224-380 (SubscribeLogs/PublishLogs),
+api/logbroker.proto:24-28 (SubscribeLogsOptions follow/tail).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from swarmkit_tpu.agent.logs import TaskLogBuffer, selector_matches
+from swarmkit_tpu.api import TaskState
+from swarmkit_tpu.manager.logbroker import (
+    LogSelector, LogStream, SubscribeLogsOptions,
+)
+from tests.conftest import async_test
+from tests.integration_harness import TestCluster
+
+
+# ---------------------------------------------------------------------------
+# unit: the agent-side ring buffer
+# ---------------------------------------------------------------------------
+
+def test_task_log_buffer_tail_limits():
+    buf = TaskLogBuffer(maxlen=5)
+    for i in range(8):
+        buf.publish("t1", LogStream.STDOUT, f"line{i}".encode())
+    msgs = buf.tail("t1")
+    assert [m.data for m in msgs] == [b"line3", b"line4", b"line5",
+                                      b"line6", b"line7"]  # ring cap 5
+    assert [m.data for m in buf.tail("t1", 2)] == [b"line6", b"line7"]
+    assert buf.tail("missing") == []
+
+
+@async_test
+async def test_task_log_buffer_watch():
+    buf = TaskLogBuffer()
+    w = buf.watch()
+    buf.publish("t1", LogStream.STDERR, b"oops", service_id="s1")
+    msg = await asyncio.wait_for(w.__anext__(), 2)
+    assert msg.data == b"oops" and msg.stream == LogStream.STDERR
+    assert msg.context.service_id == "s1"
+    w.close()
+
+
+def test_selector_matches_dimensions():
+    class T:
+        id = "t1"
+        service_id = "s1"
+
+    assert selector_matches(LogSelector(task_ids=["t1"]), T, "n1")
+    assert selector_matches(LogSelector(service_ids=["s1"]), T, "n1")
+    assert selector_matches(LogSelector(node_ids=["n1"]), T, "n1")
+    assert not selector_matches(LogSelector(service_ids=["s2"]), T, "n1")
+
+
+# ---------------------------------------------------------------------------
+# integration: full cluster, tail + follow + multi-node
+# ---------------------------------------------------------------------------
+
+async def _cluster_with_service(replicas: int, agents: int = 2):
+    c = TestCluster()
+    await c.add_manager("m1")
+    for i in range(agents):
+        await c.add_agent(f"a{i + 1}")
+    svc = await c.create_service("logged", replicas=replicas)
+    await c.poll(
+        lambda: len([t for t in c.running_tasks(svc.id)
+                     if t.status.state == TaskState.RUNNING]) == replicas
+        or None, "tasks running", timeout=30)
+    return c, svc
+
+
+def _controllers_for(c: TestCluster, svc_id: str):
+    out = []
+    for node_id, ex in c.executors.items():
+        for tid, ctl in ex.controllers.items():
+            if ctl.task.service_id == svc_id:
+                out.append((node_id, ctl))
+    return out
+
+
+@async_test
+async def test_service_logs_follow_across_nodes():
+    """Follow mode tails the backlog then streams live lines from every
+    node running a matching task."""
+    c, svc = await _cluster_with_service(replicas=2, agents=2)
+    try:
+        lead = c.leader()
+        ctls = _controllers_for(c, svc.id)
+        assert len(ctls) == 2
+        nodes = {node_id for node_id, _ in ctls}
+        for node_id, ctl in ctls:
+            ctl.write_log(f"backlog-{node_id}")
+
+        got: list = []
+
+        async def consume():
+            async for m in lead.logbroker.subscribe_logs(
+                    LogSelector(service_ids=[svc.id]),
+                    SubscribeLogsOptions(follow=True)):
+                got.append(m)
+
+        task = asyncio.get_running_loop().create_task(consume())
+        # backlog: the "started" line + our backlog line from BOTH nodes
+        await c.poll(lambda: len(got) >= 4 or None, "backlog", timeout=15)
+        datas = {m.data for m in got}
+        for node_id in nodes:
+            assert f"backlog-{node_id}".encode() in datas
+
+        # live lines keep flowing in follow mode
+        for node_id, ctl in ctls:
+            ctl.write_log(f"live-{node_id}")
+        await c.poll(lambda: len(got) >= 6 or None, "live lines",
+                     timeout=15)
+        datas = {m.data for m in got}
+        for node_id in nodes:
+            assert f"live-{node_id}".encode() in datas
+        # context identifies the task and node
+        assert {m.context.node_id for m in got} == nodes
+        task.cancel()
+    finally:
+        await c.stop_all()
+
+
+@async_test
+async def test_service_logs_no_follow_completes_with_tail():
+    """follow=False returns the backlog (tail-limited) and the stream
+    ENDS once every matching node published its close marker."""
+    c, svc = await _cluster_with_service(replicas=1, agents=1)
+    try:
+        lead = c.leader()
+        (node_id, ctl), = _controllers_for(c, svc.id)
+        for i in range(6):
+            ctl.write_log(f"l{i}")
+
+        got = []
+        async for m in lead.logbroker.subscribe_logs(
+                LogSelector(service_ids=[svc.id]),
+                SubscribeLogsOptions(follow=False, tail=3)):
+            got.append(m)
+        # the iterator ENDED on its own (non-follow completion) with the
+        # last 3 buffered lines
+        assert [m.data for m in got] == [b"l3", b"l4", b"l5"]
+    finally:
+        await c.stop_all()
+
+
+@async_test
+async def test_service_logs_task_selector_and_late_task():
+    """A task-id selector only gets that task's lines; a subscription
+    re-announce picks up tasks scheduled after the subscribe."""
+    c, svc = await _cluster_with_service(replicas=1, agents=2)
+    try:
+        lead = c.leader()
+        (node_id, ctl), = _controllers_for(c, svc.id)
+        ctl.write_log("mine")
+
+        got = []
+
+        async def consume():
+            async for m in lead.logbroker.subscribe_logs(
+                    LogSelector(task_ids=[ctl.task.id]),
+                    SubscribeLogsOptions(follow=True)):
+                got.append(m)
+
+        task = asyncio.get_running_loop().create_task(consume())
+        await c.poll(lambda: any(m.data == b"mine" for m in got) or None,
+                     "task line", timeout=15)
+        assert all(m.context.task_id == ctl.task.id for m in got)
+
+        # scale up: the new task's lines reach a service-selector
+        # subscription opened BEFORE the task existed
+        got2 = []
+
+        async def consume2():
+            async for m in lead.logbroker.subscribe_logs(
+                    LogSelector(service_ids=[svc.id]),
+                    SubscribeLogsOptions(follow=True, tail=0)):
+                got2.append(m)
+
+        task2 = asyncio.get_running_loop().create_task(consume2())
+        await asyncio.sleep(0.2)
+        cur = lead.control_api.get_service(svc.id)
+        spec = cur.spec.copy()
+        spec.replicated.replicas = 2
+        await lead.control_api.update_service(svc.id, spec,
+                                              version=cur.meta.version.index)
+        await c.poll(
+            lambda: len([t for t in c.running_tasks(svc.id)
+                         if t.status.state == TaskState.RUNNING]) == 2
+            or None, "scaled", timeout=30)
+        ctls = _controllers_for(c, svc.id)
+        new = [x for x in ctls if x[1].task.id != ctl.task.id]
+        assert new
+        new[0][1].write_log("from-the-new-task")
+        await c.poll(lambda: any(m.data == b"from-the-new-task"
+                                 for m in got2) or None,
+                     "late task line", timeout=15)
+        task.cancel()
+        task2.cancel()
+    finally:
+        await c.stop_all()

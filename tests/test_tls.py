@@ -735,3 +735,95 @@ async def test_foreign_cluster_certificate_rejected():
                 except Exception:
                     pass
         tmp.cleanup()
+
+
+@async_test
+async def test_service_logs_over_mtls():
+    """The full remote log pipeline: a worker joined over TLS runs a task,
+    its agent hears the subscription via the LogBroker gRPC stream and
+    publishes lines back over mutual TLS; the client tails them from the
+    manager (reference: api/logbroker.proto services over the mTLS mesh)."""
+    from swarmkit_tpu.api import (
+        Annotations, ContainerSpec, Placement, ReplicatedService,
+        ServiceSpec, TaskSpec, TaskState,
+    )
+    from swarmkit_tpu.cmd import swarmd
+    from swarmkit_tpu.manager.logbroker import (
+        LogSelector, SubscribeLogsOptions,
+    )
+    from swarmkit_tpu.store.by import ByService
+
+    tmp = tempfile.TemporaryDirectory(prefix="swarmd-tls-logs-")
+    p1, p2 = free_port(), free_port()
+    args1 = swarmd.build_parser().parse_args([
+        "--state-dir", os.path.join(tmp.name, "m1"),
+        "--listen-control-api", os.path.join(tmp.name, "m1.sock"),
+        "--listen-remote-api", f"127.0.0.1:{p1}",
+        "--node-id", "m1", "--manager", "--election-tick", "4",
+        "--executor", "test",
+    ])
+    m1 = w1 = None
+    try:
+        m1 = await swarmd.run(args1)
+        assert await wait_until(m1.is_leader, timeout=15)
+        assert await wait_until(
+            lambda: m1.manager.store.find("cluster"), timeout=15)
+        token = m1.manager.store.find("cluster")[0].root_ca.join_token_worker
+
+        args2 = swarmd.build_parser().parse_args([
+            "--state-dir", os.path.join(tmp.name, "w1"),
+            "--listen-control-api", os.path.join(tmp.name, "w1.sock"),
+            "--listen-remote-api", f"127.0.0.1:{p2}",
+            "--node-id", "w1",
+            "--join-addr", f"127.0.0.1:{p1}",
+            "--join-token", token, "--election-tick", "4",
+            "--executor", "test",
+        ])
+        w1 = await swarmd.run(args2)
+
+        # constrain the service onto the WORKER so the published lines
+        # must cross the network
+        svc = await m1.manager.control_api.create_service(ServiceSpec(
+            annotations=Annotations(name="tls-logged"),
+            task=TaskSpec(container=ContainerSpec(image="img"),
+                          placement=Placement(
+                              constraints=["node.id==w1"])),
+            replicated=ReplicatedService(replicas=1)))
+
+        def task_running():
+            ts = m1.manager.store.find("task", ByService(svc.id))
+            return any(t.status.state == TaskState.RUNNING and
+                       t.node_id == "w1" for t in ts)
+        assert await wait_until(task_running, timeout=30), \
+            "task never ran on the TLS worker"
+
+        ctl = next(c for c in w1.config.executor.controllers.values()
+                   if c.task.service_id == svc.id)
+        ctl.write_log("over-the-wire")
+
+        got = []
+        deadline = asyncio.get_running_loop().time() + 20
+
+        async def consume():
+            async for m in m1.manager.logbroker.subscribe_logs(
+                    LogSelector(service_ids=[svc.id]),
+                    SubscribeLogsOptions(follow=True)):
+                got.append(m)
+
+        t = asyncio.get_running_loop().create_task(consume())
+        while asyncio.get_running_loop().time() < deadline:
+            if any(m.data == b"over-the-wire" for m in got):
+                break
+            await asyncio.sleep(0.05)
+        t.cancel()
+        datas = {m.data for m in got}
+        assert b"over-the-wire" in datas, f"got only {datas}"
+        assert all(m.context.node_id == "w1" for m in got)
+    finally:
+        for n in (w1, m1):
+            if n is not None:
+                try:
+                    await n.stop()
+                except Exception:
+                    pass
+        tmp.cleanup()

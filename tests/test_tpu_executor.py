@@ -162,3 +162,37 @@ async def test_pmatmul_runs_sharded_over_the_device_mesh():
     await ctl.wait()
     assert ctl.result is not None
     await ctl.close()
+
+
+@async_test
+async def test_tpu_program_params_from_templated_secret():
+    """Secret payload k=v lines (template-expanded per task) feed tpu://
+    program parameters — the runtime analog of mounted secret files."""
+    from swarmkit_tpu.agent.dependency import Dependencies
+    from swarmkit_tpu.api import Annotations, Secret, SecretSpec
+    from swarmkit_tpu.api.specs import Driver, SecretReference
+
+    ex = TpuExecutor()
+    ex.dependencies = Dependencies()
+    ex.dependencies.secrets.add(Secret(id="sec1", spec=SecretSpec(
+        annotations=Annotations(name="tuning"),
+        data=b"n=3{{.Task.Slot}}\nsteps=2",
+        templating=Driver(name="golang"))))
+
+    task = tpu_task("tpu://matmul")
+    task.slot = 2
+    task.service_annotations = Annotations(name="trainer")
+    task.spec.container.secrets = [
+        SecretReference(secret_id="sec1", secret_name="tuning")]
+    ctl = await ex.controller(task)
+    await ctl.prepare()
+    # n expanded to 32 (= "3" + slot "2"); the compiled program ran with it
+    await ctl.start()
+    await ctl.wait()
+    assert ctl.result is not None
+    # the compile log records dependency param NAMES but never their
+    # values (secret material must not reach `service logs`)
+    lines = [m.data.decode() for m in ex.logs.tail(task.id)]
+    assert any("n=<from-dependency>" in l and "steps=<from-dependency>" in l
+               for l in lines), lines
+    assert not any("n=32" in l for l in lines), lines
