@@ -163,9 +163,20 @@ def step(state: SimState, cfg: SimConfig,
     # Per-row membership views: every quorum decision counts over the
     # deciding row's APPLIED configuration (reference: each node's prs map
     # materializes conf changes at its own apply point, raft.go:1939).
-    self_mem = jnp.diagonal(member)                              # [N]
-    n_mem = jnp.sum(member.astype(I32), axis=1)                  # [N]
-    quorum_row = n_mem // 2 + 1                                  # [N]
+    # Under cfg.static_members the config is the full row set forever:
+    # views collapse to constants and every mask below traces away.
+    static_m = cfg.static_members
+    if static_m:
+        self_mem = jnp.ones((n,), bool)
+        quorum_row = n // 2 + 1                                  # scalar
+    else:
+        self_mem = jnp.diagonal(member)                          # [N]
+        n_mem = jnp.sum(member.astype(I32), axis=1)              # [N]
+        quorum_row = n_mem // 2 + 1                              # [N]
+
+    def _mview(x):
+        """Mask an [N, N] tally/flag matrix by the deciding row's view."""
+        return x if static_m else (x & member)
 
     now = state.tick   # pre-increment tick: all wire timestamps key off it
 
@@ -185,7 +196,7 @@ def step(state: SimState, cfg: SimConfig,
     # instead of lingering until a higher term reaches it.
     check_due = is_leader & (elapsed >= cfg.election_tick)
     heard = recent_active | eye
-    n_heard = jnp.sum((heard & member).astype(I32), axis=1)
+    n_heard = jnp.sum(_mview(heard).astype(I32), axis=1)
     cq_fail = check_due & (n_heard < quorum_row)
     role = jnp.where(cq_fail, FOLLOWER, role)
     lead = jnp.where(cq_fail, NONE, lead)
@@ -287,7 +298,7 @@ def step(state: SimState, cfg: SimConfig,
             | (vreq_pre != pre[:, None])
         # requests go only to peers in the CANDIDATE's view (etcd campaigns
         # over its own prs map)
-        send_vr = is_cand[:, None] & member & ~eye & ~drop & free
+        send_vr = _mview(is_cand[:, None] & ~eye & ~drop & free)
         vreq_at = jnp.where(send_vr, now + 1 + lat, vreq_at)
         vreq_term = jnp.where(send_vr, term[:, None], vreq_term)
         vreq_pre = jnp.where(send_vr, pre[:, None], vreq_pre)
@@ -302,8 +313,8 @@ def step(state: SimState, cfg: SimConfig,
         preq = deliv & pre[:, None]
         vreq_at = jnp.where(due_vr, 0, vreq_at)
     else:
-        base_req = is_cand[:, None] & member & alive[None, :] & ~eye & ~drop \
-            & (~leased[None, :] | tx_cand[:, None])
+        base_req = _mview(is_cand[:, None] & alive[None, :] & ~eye & ~drop
+                          & (~leased[None, :] | tx_cand[:, None]))
         req = base_req & ~pre[:, None]
         preq = base_req & pre[:, None]
 
@@ -349,7 +360,7 @@ def step(state: SimState, cfg: SimConfig,
         # Evaluated only on POLL EVENTS (fresh candidacy or a response
         # arrival, core._poll call sites): a conf change shrinking the
         # quorum must not retro-promote a stale tally between arrivals.
-        votes_pv = jnp.sum((granted & member).astype(I32), axis=1)
+        votes_pv = jnp.sum(_mview(granted).astype(I32), axis=1)
         pre_win = pre_cand & (votes_pv >= quorum_row) \
             & (campaign | pv_polled)
         term = term + pre_win.astype(I32)
@@ -431,7 +442,7 @@ def step(state: SimState, cfg: SimConfig,
     # pre-candidacies poll on PreVote response arrivals (pv_polled is
     # nonzero only on pre rows; the win line excludes them via ~pre)
     polled = v_polled | pv_polled if cfg.pre_vote else v_polled
-    votes = jnp.sum((granted & member).astype(I32), axis=1)
+    votes = jnp.sum(_mview(granted).astype(I32), axis=1)
     win = is_cand & ~pre & (votes >= quorum_row) & (fresh_real | polled)
     # Rejection quorum: the candidate stands down (a REAL candidacy keeps
     # term and vote; a pre-candidacy keeps both untouched by design) and
@@ -440,7 +451,7 @@ def step(state: SimState, cfg: SimConfig,
     # per voter (core._poll), and within one candidacy a grant can only
     # precede a rejection (log/vote checks are monotone), so masking with
     # ~granted reproduces first-response-wins exactly.
-    n_rej = jnp.sum((rejected & ~granted & member).astype(I32), axis=1)
+    n_rej = jnp.sum(_mview(rejected & ~granted).astype(I32), axis=1)
     lose = is_cand & ~win & (n_rej >= quorum_row) & (fresh_real | polled)
     role = jnp.where(lose, FOLLOWER, role)
     lead = jnp.where(lose, NONE, lead)  # become_follower(term, NONE)
@@ -500,8 +511,7 @@ def step(state: SimState, cfg: SimConfig,
         prev_send = next_ - 1
         can_ring_send = prev_send >= snap_idx[:, None]
         has_new = next_ <= last[:, None]
-        send_base = is_leader[:, None] & member & ~eye & ~drop \
-            & snp_free
+        send_base = _mview(is_leader[:, None] & ~eye & ~drop) & snp_free
         # StateProbe: one append at a time, no pipelining; StateReplicate:
         # pipeline while a slot is free (vendor progress.go)
         may = jnp.where(probing, ~inflight_same, has_new)
@@ -526,7 +536,7 @@ def step(state: SimState, cfg: SimConfig,
         hbr_at_box, hbr_term_box = state.hbr_at, state.hbr_term
         hb_due_send = is_leader & (hb_elapsed >= cfg.heartbeat_tick)
         hb_elapsed = jnp.where(hb_due_send, 0, hb_elapsed)
-        send_hb = hb_due_send[:, None] & member & ~eye & ~drop
+        send_hb = _mview(hb_due_send[:, None] & ~eye & ~drop)
         hb_free = hb_at_box == 0
         hb_slot = jnp.argmax(hb_free, axis=2).astype(I32)
         put_hb = send_hb[:, :, None] & (hb_slot[:, :, None] == kh_idx)
@@ -602,8 +612,8 @@ def step(state: SimState, cfg: SimConfig,
     else:
         prev_mat = next_ - 1                                     # [i, j]
         can_ring = prev_mat >= snap_idx[:, None]
-        send_base = is_leader[:, None] & alive[None, :] & member \
-            & ~eye & ~drop
+        send_base = _mview(is_leader[:, None] & alive[None, :]
+                           & ~eye & ~drop)
         send_app = send_base & can_ring
         send_snap = send_base & ~can_ring
 
@@ -713,8 +723,9 @@ def step(state: SimState, cfg: SimConfig,
     # core._restore rebuilds prs from it): adopt the sender's view.  Conf
     # entries in (snap_idx, sender.applied] are re-applied later via the
     # append path — membership flips are idempotent sets, so the early
-    # adoption is safe.
-    member = jnp.where(do_restore[:, None], member[r_src], member)
+    # adoption is safe.  (Static members: every view is identical already.)
+    if not static_m:
+        member = jnp.where(do_restore[:, None], member[r_src], member)
 
     # -- responses back to senders (j -> i), may be dropped.
     # A duplicate snapshot (sender watermark <= our commit) still gets an
@@ -775,8 +786,8 @@ def step(state: SimState, cfg: SimConfig,
     # (prs.get(m.frm) is None -> return).  The rejection path is receiver-
     # visible (backtrack + pipeline flush change future deliveries), so
     # this mask is required for core-exactness, not just hygiene.
-    ok_mat = ok_mat & member
-    rej_mat = rej_mat & member
+    ok_mat = _mview(ok_mat)
+    rej_mat = _mview(rej_mat)
     if cfg.mailboxes:
         # vendor stepLeader MsgAppResp: maybeUpdate advances match (and
         # next to at least m+1); a match ADVANCE on a probing edge enters
@@ -812,8 +823,8 @@ def step(state: SimState, cfg: SimConfig,
         # waits for the next send round on both sides.
         snp_busy = (snp_at != 0) & (snp_term_box == term[:, None])
         prev_rs = next_ - 1
-        rs = rej_mat & is_leader[:, None] & member & ~eye & ~drop \
-            & ~snp_busy & (prev_rs >= snap_idx[:, None])
+        rs = _mview(rej_mat & is_leader[:, None] & ~eye & ~drop
+                    & ~snp_busy & (prev_rs >= snap_idx[:, None]))
         free_rs = (app_at == 0) | (app_term_box != term[:, None, None])
         rslot = jnp.argmax(free_rs, axis=2).astype(I32)
         put_rs = rs[:, :, None] \
@@ -836,8 +847,10 @@ def step(state: SimState, cfg: SimConfig,
     # transferee branch).  Single slot per target; concurrent transfers to
     # one target are rare and last-writer-wins.
     tgt = jnp.clip(transferee, 0, n - 1)
-    tgt_mem = jnp.take_along_axis(member, tgt[:, None], axis=1)[:, 0]
-    has_tx = is_leader & (transferee != NONE) & tgt_mem & (tgt != node)
+    has_tx = is_leader & (transferee != NONE) & (tgt != node)
+    if not static_m:
+        tgt_mem = jnp.take_along_axis(member, tgt[:, None], axis=1)[:, 0]
+        has_tx = has_tx & tgt_mem
     caught = has_tx & (match[node, tgt] == last)
     if cfg.mailboxes:
         tn_lat_i = lat[node, tgt]
@@ -858,7 +871,7 @@ def step(state: SimState, cfg: SimConfig,
     # ceil(log2(L))+1 rounds of [N, N] compares) instead of sorting [N, N]
     # every tick.
     match = jnp.where(is_leader[:, None] & eye, last[:, None], match)
-    match_eff = jnp.where(member, match, -1)
+    match_eff = match if static_m else jnp.where(member, match, -1)
 
     def _bisect(_, lo_hi):
         lo, hi_b = lo_hi
@@ -884,48 +897,55 @@ def step(state: SimState, cfg: SimConfig,
     # lands per row per tick (order within a batch is thereby trivial; the
     # propose-side one-in-flight gate makes >1 conf per window rare anyway).
     own_idx = _idx_at_slots(cfg, last)                           # [N, L]
-    is_conf_ring = _is_conf(log_data)                            # [N, L]
     base_applied = jnp.minimum(commit, applied + cfg.apply_batch)
     base_applied = jnp.where(alive, base_applied, applied)  # crashed: frozen
     win_mask = (own_idx > applied[:, None]) \
         & (own_idx <= base_applied[:, None])
-    conf_in_win = win_mask & is_conf_ring
-    big = jnp.iinfo(jnp.int32).max
-    first_conf = jnp.min(jnp.where(conf_in_win, own_idx, big), axis=1)
-    has_conf = first_conf < big
-    new_applied = jnp.minimum(base_applied,
-                              jnp.where(has_conf, first_conf, big))
-    app_mask = win_mask & (own_idx <= new_applied[:, None])
+    if static_m:
+        # No conf entries can exist (propose masks the tag bit and
+        # propose_conf is a trace-time error): apply the whole batch.
+        new_applied = base_applied
+        app_mask = win_mask
+    else:
+        is_conf_ring = _is_conf(log_data)                        # [N, L]
+        conf_in_win = win_mask & is_conf_ring
+        big = jnp.iinfo(jnp.int32).max
+        first_conf = jnp.min(jnp.where(conf_in_win, own_idx, big), axis=1)
+        has_conf = first_conf < big
+        new_applied = jnp.minimum(base_applied,
+                                  jnp.where(has_conf, first_conf, big))
+        app_mask = win_mask & (own_idx <= new_applied[:, None])
     contrib = jnp.where(app_mask, _entry_chk(own_idx, log_data), U32(0))
     apply_chk = apply_chk + jnp.sum(contrib, axis=1, dtype=U32)
     applied = new_applied
 
-    # Decode + apply the (single) conf entry at new_applied.
-    cslot = _slot(cfg, jnp.where(has_conf, first_conf, 1))
-    cdata = jnp.take_along_axis(log_data, cslot[:, None], axis=1)[:, 0]
-    ctgt = jnp.clip((cdata & U32(CONF_TARGET_MASK)).astype(I32), 0, n - 1)
-    c_rm = (cdata & U32(CONF_REMOVE)) != 0
-    tgt_onehot = node[None, :] == ctgt[:, None]                  # [N, N]
-    was_member = jnp.take_along_axis(member, ctgt[:, None], axis=1)[:, 0]
-    newly_added = has_conf & ~c_rm & ~was_member
-    member = jnp.where(has_conf[:, None] & tgt_onehot,
-                       ~c_rm[:, None], member)
-    # add_node initializes a fresh Progress(next=last+1, match=0,
-    # recent_active=True) on every row (meaningful on leaders; core add_node
-    # does the same unconditionally).  Re-adding an existing member keeps
-    # its progress (core: early return).
-    reset_pr = newly_added[:, None] & tgt_onehot
-    match = jnp.where(reset_pr, 0, match)
-    next_ = jnp.where(reset_pr, (last + 1)[:, None], next_)
-    recent_active = jnp.where(reset_pr, True, recent_active)
-    if cfg.mailboxes:
-        probing = jnp.where(reset_pr, True, probing)
-    # remove_node aborts an in-flight transfer to the removed peer
-    # (core.remove_node) ...
-    transferee = jnp.where(has_conf & c_rm & (transferee == ctgt),
-                           NONE, transferee)
-    # ... and clears the leader's propose gate (add/remove_node both do).
-    pending_conf = pending_conf & ~has_conf
+    if not static_m:
+        # Decode + apply the (single) conf entry at new_applied.
+        cslot = _slot(cfg, jnp.where(has_conf, first_conf, 1))
+        cdata = jnp.take_along_axis(log_data, cslot[:, None], axis=1)[:, 0]
+        ctgt = jnp.clip((cdata & U32(CONF_TARGET_MASK)).astype(I32), 0, n - 1)
+        c_rm = (cdata & U32(CONF_REMOVE)) != 0
+        tgt_onehot = node[None, :] == ctgt[:, None]              # [N, N]
+        was_member = jnp.take_along_axis(member, ctgt[:, None], axis=1)[:, 0]
+        newly_added = has_conf & ~c_rm & ~was_member
+        member = jnp.where(has_conf[:, None] & tgt_onehot,
+                           ~c_rm[:, None], member)
+        # add_node initializes a fresh Progress(next=last+1, match=0,
+        # recent_active=True) on every row (meaningful on leaders; core
+        # add_node does the same unconditionally).  Re-adding an existing
+        # member keeps its progress (core: early return).
+        reset_pr = newly_added[:, None] & tgt_onehot
+        match = jnp.where(reset_pr, 0, match)
+        next_ = jnp.where(reset_pr, (last + 1)[:, None], next_)
+        recent_active = jnp.where(reset_pr, True, recent_active)
+        if cfg.mailboxes:
+            probing = jnp.where(reset_pr, True, probing)
+        # remove_node aborts an in-flight transfer to the removed peer
+        # (core.remove_node) ...
+        transferee = jnp.where(has_conf & c_rm & (transferee == ctgt),
+                               NONE, transferee)
+        # ... and clears the leader's propose gate (add/remove_node both do).
+        pending_conf = pending_conf & ~has_conf
 
     # ---- Phase F: compaction (ring-pressure driven) ----------------------
     # Compact to applied-keep (mirroring LogEntriesForSlowFollowers=500)
@@ -955,10 +975,15 @@ def step(state: SimState, cfg: SimConfig,
     # (exact there: nothing that runs before them mutates (applied, commit]
     # or adds conf entries to (commit, last] — propose() masks the tag bit
     # and propose_conf() updates pending_conf itself).
-    hup_conf = jnp.any((own_idx > applied[:, None])
-                       & (own_idx <= commit[:, None]) & is_conf_ring, axis=1)
-    tail_conf = jnp.any((own_idx > commit[:, None])
-                        & (own_idx <= last[:, None]) & is_conf_ring, axis=1)
+    if static_m:
+        hup_conf, tail_conf = state.hup_conf, state.tail_conf  # all-False
+    else:
+        hup_conf = jnp.any((own_idx > applied[:, None])
+                           & (own_idx <= commit[:, None]) & is_conf_ring,
+                           axis=1)
+        tail_conf = jnp.any((own_idx > commit[:, None])
+                            & (own_idx <= last[:, None]) & is_conf_ring,
+                            axis=1)
     boxes = {}
     if cfg.mailboxes:
         boxes = dict(
@@ -1088,6 +1113,9 @@ def propose_conf(state: SimState, cfg: SimConfig, target, remove,
     to at most one membership flip.  Activation happens at apply time in
     step() Phase E; reference flow manager/state/raft/raft.go:920-1087
     (Join/Leave) -> :1939 (processConfChange)."""
+    if cfg.static_members:
+        raise ValueError("propose_conf on a static_members config: "
+                         "membership changes need static_members=False")
     n = cfg.n
     node = jnp.arange(n, dtype=I32)
     target = jnp.asarray(target, I32)

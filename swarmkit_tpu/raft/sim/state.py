@@ -83,6 +83,19 @@ class SimConfig:
     # flapping/partitioned node cannot inflate cluster terms.  Mirrors
     # core.Config.pre_vote.
     pre_vote: bool = False
+    # Compile-time specialization for FIXED membership (the bench configs
+    # and any cluster that never reconfigures): quorum is the constant
+    # n//2+1, every per-row [N, N] membership view collapses to "all rows",
+    # and Phase E's conf-entry decode + the hup/tail conf scans are elided
+    # from the compiled program entirely.  Decision-identical to the dynamic
+    # path when no conf change is ever proposed (asserted by
+    # tests/test_raft_sim.py::test_static_members_equivalence); the
+    # reference analog is etcd allocating its progress tracker per config —
+    # a config that never changes pays nothing for the machinery
+    # (manager/state/raft/raft.go:482-508 documents its perf levers the
+    # same way).  propose_conf() on a static-members config is a trace-time
+    # error.
+    static_members: bool = False
 
     @property
     def ack_depth(self) -> int:
@@ -238,6 +251,9 @@ def init_state(cfg: SimConfig,
     n, L = cfg.n, cfg.log_len
     i32 = jnp.int32
     z = lambda *s: jnp.zeros(s, i32)  # noqa: E731
+    if cfg.static_members and voters is not None:
+        raise ValueError("static_members requires the full bootstrap config "
+                         "(voters=None); partial configs need conf changes")
     if voters is None:
         member_row = jnp.ones((n,), bool)
     else:

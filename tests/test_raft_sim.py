@@ -749,3 +749,81 @@ class TestAllFeaturesSoak:
                     assert by.setdefault(a, c) == c, \
                         f"checksum divergence at applied={a}"
         assert int(np.asarray(st.commit).max()) > 200
+
+
+class TestStaticMembers:
+    """cfg.static_members elides every membership-view op at trace time
+    (PERF.md optimization); with no conf change ever proposed it must be
+    BIT-IDENTICAL to the dynamic path on every schedule — elections,
+    replication, drops, crashes, both wires."""
+
+    CMP_FIELDS = ("term", "vote", "role", "lead", "elapsed", "contact",
+                  "last", "commit", "applied", "snap_idx", "snap_term",
+                  "snap_chk", "apply_chk", "match", "next_", "granted",
+                  "rejected", "recent_active", "pre", "transferee",
+                  "pending_conf", "hup_conf", "tail_conf")
+
+    @pytest.mark.parametrize("wire", ["sync", "mailbox"])
+    def test_equivalence_under_faults(self, wire):
+        base = dict(n=7, log_len=256, window=16, apply_batch=32,
+                    max_props=16, election_tick=14, keep=8, seed=3)
+        if wire == "mailbox":
+            base.update(latency=2, latency_jitter=1, inflight=2)
+        cfg_d = SimConfig(**base)
+        cfg_s = SimConfig(**base, static_members=True)
+        rng = np.random.default_rng(17)
+        sd, ss = init_state(cfg_d), init_state(cfg_s)
+        for t in range(300):
+            cnt = jnp.asarray(int(rng.integers(0, 6)), jnp.int32)
+            pay = jnp.arange(cfg_d.max_props, dtype=jnp.uint32) + t * 131
+            alive = jnp.asarray(rng.random(cfg_d.n) > 0.05)
+            drop = jnp.asarray(rng.random((cfg_d.n, cfg_d.n)) < 0.08)
+            sd = propose_j(sd, cfg_d, pay, cnt, alive=alive)
+            ss = propose_j(ss, cfg_s, pay, cnt, alive=alive)
+            sd = step_j(sd, cfg_d, alive=alive, drop=drop)
+            ss = step_j(ss, cfg_s, alive=alive, drop=drop)
+            for f in self.CMP_FIELDS:
+                a, b = np.asarray(getattr(sd, f)), np.asarray(getattr(ss, f))
+                assert np.array_equal(a, b), f"tick {t}: {f} diverged"
+        assert int(np.asarray(sd.commit).max()) > 0
+
+    def test_transfer_equivalence(self):
+        cfg_d = SimConfig(n=5, log_len=256, window=32, apply_batch=64,
+                          max_props=16, keep=8, seed=9, election_tick=12)
+        cfg_s = SimConfig(n=5, log_len=256, window=32, apply_batch=64,
+                          max_props=16, keep=8, seed=9, election_tick=12,
+                          static_members=True)
+        sd, ss = init_state(cfg_d), init_state(cfg_s)
+        for t in range(120):
+            if t == 40 or t == 80:
+                role = np.asarray(sd.role)
+                leaders = np.flatnonzero(role == LEADER)
+                if len(leaders):
+                    lid = int(leaders[0])
+                    tgt = (lid + 1) % cfg_d.n
+                    sd = transfer_leadership(sd, cfg_d, lid, tgt)
+                    ss = transfer_leadership(ss, cfg_s, lid, tgt)
+            pay = jnp.arange(cfg_d.max_props, dtype=jnp.uint32) + t * 7
+            sd = propose_j(sd, cfg_d, pay, jnp.asarray(4))
+            ss = propose_j(ss, cfg_s, pay, jnp.asarray(4))
+            sd = step_j(sd, cfg_d)
+            ss = step_j(ss, cfg_s)
+            for f in self.CMP_FIELDS:
+                a, b = np.asarray(getattr(sd, f)), np.asarray(getattr(ss, f))
+                assert np.array_equal(a, b), f"tick {t}: {f} diverged"
+        # at least one transfer actually moved leadership
+        assert len({int(x) for x in np.asarray(sd.term).tolist()}) >= 1
+
+    def test_propose_conf_is_a_trace_time_error(self):
+        from swarmkit_tpu.raft.sim import propose_conf
+        cfg = SimConfig(n=5, log_len=256, window=32, apply_batch=64,
+                        max_props=16, keep=8, static_members=True)
+        st = init_state(cfg)
+        with pytest.raises(ValueError, match="static_members"):
+            propose_conf(st, cfg, 2, False)
+
+    def test_partial_bootstrap_config_rejected(self):
+        cfg = SimConfig(n=5, log_len=256, window=32, apply_batch=64,
+                        max_props=16, keep=8, static_members=True)
+        with pytest.raises(ValueError, match="static_members"):
+            init_state(cfg, voters=[0, 1, 2])

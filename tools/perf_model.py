@@ -1,0 +1,161 @@
+"""Per-phase performance model of the raft tick kernel (PERF.md generator).
+
+Measures, on the CPU backend (pin first — the image's sitecustomize
+registers the axon TPU platform and ignores JAX_PLATFORMS):
+
+1. End-to-end steady-state per-tick cost, dynamic-membership vs
+   static_members, at several N — the A/B that localizes the round-4
+   regression (the dynamic path is bit-identical to round 4's kernel; the
+   static path elides every membership-view op).
+2. Standalone micro-kernels for each membership-related phase component,
+   timed in isolation over realistic array shapes, attributing the delta.
+
+Usage: python tools/perf_model.py [--quick]
+Prints a markdown report to stdout (paste into PERF.md).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from swarmkit_tpu.raft.sim import (  # noqa: E402
+    SimConfig, committed_entries, has_leader, init_state, run_ticks,
+    run_until_leader,
+)
+from swarmkit_tpu.raft.sim.kernel import _idx_at_slots, _is_conf  # noqa: E402
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+
+def steady_rate(n: int, ticks: int = 64, static: bool = False, **kw):
+    """Per-tick ms + entries/s for the bench steady-state flow."""
+    cfg = SimConfig(n=n, log_len=8192, window=2048, apply_batch=2048,
+                    max_props=2048, keep=500, seed=42, election_tick=16,
+                    static_members=static, **kw)
+    st = init_state(cfg)
+    st, _ = run_until_leader(st, cfg, max_ticks=512)
+    jax.block_until_ready(st.term)
+    assert bool(has_leader(st)), f"no leader at n={n}"
+    warm, _ = run_ticks(st, cfg, ticks, prop_count=cfg.max_props)
+    jax.block_until_ready(warm.commit)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        fin, _ = run_ticks(st, cfg, ticks, prop_count=cfg.max_props)
+        jax.block_until_ready(fin.commit)
+        best = min(best, time.perf_counter() - t0)
+    ents = int(committed_entries(fin)) - int(committed_entries(st))
+    return best / ticks * 1e3, ents / best
+
+
+def _time_jit(fn, *args, reps: int = 20):
+    """Best-of wall time of a jitted fn in ms (post-warmup)."""
+    f = jax.jit(fn)
+    out = f(*args)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best * 1e3
+
+
+def micro_phases(n: int, L: int = 8192):
+    """Isolated cost of each membership-related component at [N], [N,N],
+    [N,L] shapes (keys match the kernel's phase letters)."""
+    cfg = SimConfig(n=n, log_len=L, window=2048, apply_batch=2048,
+                    max_props=2048, keep=500)
+    member = jnp.ones((n, n), bool)
+    match = jnp.arange(n * n, dtype=I32).reshape(n, n) % 1000
+    granted = (match % 3) == 0
+    log_data = (jnp.arange(n * L, dtype=U32).reshape(n, L) * U32(2654435761))
+    last = jnp.full((n,), L // 2, I32)
+    applied = last - 100
+    commit = last - 50
+
+    rows = {}
+    rows["views: n_mem sum + quorum [N,N]->[N]"] = _time_jit(
+        lambda m: jnp.sum(m.astype(I32), axis=1) // 2 + 1, member)
+    rows["mask: one granted&member reduction [N,N]"] = _time_jit(
+        lambda g, m: jnp.sum((g & m).astype(I32), axis=1), granted, member)
+    rows["unmasked equivalent [N,N]"] = _time_jit(
+        lambda g: jnp.sum(g.astype(I32), axis=1), granted)
+    rows["commit bisect mask: where(member,match,-1) [N,N]"] = _time_jit(
+        lambda m, mm: jnp.where(mm, m, -1), match, member)
+
+    def conf_scan(log_data, last, applied, commit):
+        own_idx = _idx_at_slots(cfg, last)
+        icr = _is_conf(log_data)
+        big = jnp.iinfo(jnp.int32).max
+        first_conf = jnp.min(
+            jnp.where((own_idx > applied[:, None])
+                      & (own_idx <= commit[:, None]) & icr, own_idx, big),
+            axis=1)
+        hup = jnp.any((own_idx > applied[:, None])
+                      & (own_idx <= commit[:, None]) & icr, axis=1)
+        tail = jnp.any((own_idx > commit[:, None])
+                       & (own_idx <= last[:, None]) & icr, axis=1)
+        return first_conf, hup, tail
+
+    rows["Phase E conf decode + hup/tail scans [N,L]x3"] = _time_jit(
+        conf_scan, log_data, last, applied, commit)
+
+    def apply_chk(log_data, last, applied, commit):
+        own_idx = _idx_at_slots(cfg, last)
+        mask = (own_idx > applied[:, None]) & (own_idx <= commit[:, None])
+        from swarmkit_tpu.raft.sim.kernel import _entry_chk
+        return jnp.sum(jnp.where(mask, _entry_chk(own_idx, log_data),
+                                 U32(0)), axis=1, dtype=U32)
+
+    rows["(context) apply+checksum pass [N,L]"] = _time_jit(
+        apply_chk, log_data, last, applied, commit)
+    return rows
+
+
+def main():
+    quick = "--quick" in sys.argv
+    sizes = (256,) if quick else (64, 256, 1024)
+    print("## Steady-state per-tick cost (CPU, synchronous wire, "
+          "2048 props/tick)\n")
+    print("| n | dynamic ms/tick | static ms/tick | dynamic e/s | "
+          "static e/s | static speedup |")
+    print("|---|---|---|---|---|---|")
+    for n in sizes:
+        dm, dr = steady_rate(n, static=False)
+        sm, sr = steady_rate(n, static=True)
+        print(f"| {n} | {dm:.2f} | {sm:.2f} | {dr:,.0f} | {sr:,.0f} | "
+              f"{dm / sm:.2f}x |")
+
+    print("\n## Mailbox wire (lat=2 jitter=1 inflight=4), n=256\n")
+    print("| variant | ms/tick | entries/s |")
+    print("|---|---|---|")
+    for static in (False, True):
+        m, r = steady_rate(256, static=static, latency=2, latency_jitter=1,
+                           inflight=4)
+        print(f"| {'static' if static else 'dynamic'} | {m:.2f} | {r:,.0f} |")
+
+    print("\n## Micro-kernel attribution (isolated jits, best-of-20)\n")
+    for n in sizes:
+        print(f"\n### n={n}, L=8192\n")
+        print("| component | ms |")
+        print("|---|---|")
+        for k, v in micro_phases(n).items():
+            print(f"| {k} | {v:.3f} |")
+
+
+if __name__ == "__main__":
+    main()
