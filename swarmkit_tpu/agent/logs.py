@@ -61,14 +61,16 @@ class TaskLogBuffer:
         self.maxlen = maxlen
         self._rings: dict[str, deque] = {}
         self._bus: Queue = Queue()   # every new LogMessage, all tasks
+        self._seq = 0                # monotonic ring position, all tasks
 
     def publish(self, task_id: str, stream: LogStream, data: bytes,
                 service_id: str = "", node_id: str = "",
                 timestamp: float = 0.0) -> None:
+        self._seq += 1
         msg = LogMessage(
             context=LogContext(service_id=service_id, node_id=node_id,
                                task_id=task_id),
-            timestamp=timestamp, stream=stream, data=data)
+            timestamp=timestamp, stream=stream, data=data, seq=self._seq)
         ring = self._rings.setdefault(task_id, deque(maxlen=self.maxlen))
         ring.append(msg)
         self._bus.publish(msg)
@@ -117,6 +119,7 @@ class SubscriptionPublisher:
         self.follow = bool(sub_msg.options.get("follow", True))
         self.tail_n = int(sub_msg.options.get("tail", -1))
         self._published: set[str] = set()   # task ids whose tail was sent
+        self._tail_seq: dict[str, int] = {}  # last ring seq in that tail
         self._task: Optional[asyncio.Task] = None
         # created HERE, not in _run: a re-announce can arrive before the
         # publisher task ever gets scheduled
@@ -158,7 +161,14 @@ class SubscriptionPublisher:
             if t.id in self._published:
                 continue
             self._published.add(t.id)
-            await self._publish(self.logs.tail(t.id, self.tail_n))
+            msgs = self.logs.tail(t.id, self.tail_n)
+            if msgs:
+                # live lines at or before this position are already in
+                # the snapshot; the follow loop skips them (the watcher
+                # opened BEFORE tail(), so overlap means duplicates, not
+                # gaps)
+                self._tail_seq[t.id] = msgs[-1].seq
+            await self._publish(msgs)
 
     async def _run(self) -> None:
         try:
@@ -185,12 +195,18 @@ class SubscriptionPublisher:
                         msg = get.result()
                         t_id = msg.context.task_id
                         if t_id in self._published:
-                            await self._publish([msg])
+                            if msg.seq > self._tail_seq.get(t_id, 0):
+                                await self._publish([msg])
                         elif any(t.id == t_id
                                  for t in self.matching_tasks()):
                             self._published.add(t_id)
-                            await self._publish(
-                                self.logs.tail(t_id, self.tail_n))
+                            msgs = self.logs.tail(t_id, self.tail_n)
+                            if msgs:
+                                # same dedup as _send_tails: this live
+                                # line (and any later ones already in
+                                # the ring) ride the snapshot
+                                self._tail_seq[t_id] = msgs[-1].seq
+                            await self._publish(msgs)
                         get = asyncio.ensure_future(watcher.__anext__())
             finally:
                 watcher.close()

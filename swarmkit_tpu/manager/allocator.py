@@ -36,12 +36,13 @@ INGRESS_NETWORK_NAME = "ingress"
 
 
 def _gateway(subnet: str) -> str:
-    """base address + 1 — correct for non-octet-aligned subnets too
-    (e.g. 192.168.7.128/25 -> 192.168.7.129)."""
-    addr = subnet.split("/")[0]
+    """NETWORK base address + 1 — the host bits of the spec address are
+    masked off first, so 10.5.0.7/24 -> 10.5.0.1 and non-octet-aligned
+    subnets work too (192.168.7.128/25 -> 192.168.7.129)."""
+    addr, prefix = subnet.split("/")
     parts = [int(x) for x in addr.split(".")]
-    v = ((parts[0] << 24) | (parts[1] << 16) | (parts[2] << 8)
-         | parts[3]) + 1
+    raw = (parts[0] << 24) | (parts[1] << 16) | (parts[2] << 8) | parts[3]
+    v = (raw & ~((1 << (32 - int(prefix))) - 1)) + 1
     return f"{(v >> 24) & 255}.{(v >> 16) & 255}.{(v >> 8) & 255}.{v & 255}"
 
 
@@ -62,8 +63,13 @@ class _Subnet:
         addr, prefix = cidr.split("/")
         self.prefix = int(prefix)
         parts = [int(x) for x in addr.split(".")]
-        self.base = (parts[0] << 24) | (parts[1] << 16)             | (parts[2] << 8) | parts[3]
+        raw = (parts[0] << 24) | (parts[1] << 16) \
+            | (parts[2] << 8) | parts[3]
         self.size = 1 << (32 - self.prefix)
+        # normalize to the network base: a spec subnet like 10.5.0.7/24
+        # means the 10.5.0.0/24 network (reference IPAM parses CIDRs with
+        # net.ParseCIDR, which masks the host bits the same way)
+        self.base = raw & ~(self.size - 1)
         self.next_host = 2           # .0 network, .1 gateway
         self.used: set[int] = set()
 
@@ -105,6 +111,14 @@ class IPAM:
     def subnets(self, network_id: str) -> list[str]:
         return [sn.cidr for sn in self._pools.get(network_id, [])]
 
+    def _overlaps(self, sn: "_Subnet") -> bool:
+        for pools in self._pools.values():
+            for other in pools:
+                if (sn.base < other.base + other.size
+                        and other.base < sn.base + sn.size):
+                    return True
+        return False
+
     def _auto_cidr(self) -> str:
         cidr = f"10.{self._next_auto}.0.0/24"
         self._next_auto += 1
@@ -112,9 +126,41 @@ class IPAM:
 
     def allocate_subnet(self, network_id: str,
                         requested: str = "") -> str:
-        cidr = requested or self._auto_cidr()
-        self._pools.setdefault(network_id, []).append(_Subnet(cidr))
-        return cidr
+        return self.allocate_subnets(network_id,
+                                     [requested] if requested else [])[0]
+
+    def allocate_subnets(self, network_id: str,
+                         requested: list[str]) -> list[str]:
+        """Allocate ALL of `requested` (or one auto pool if empty)
+        atomically: every subnet is validated against existing pools AND
+        each other before any is registered, so a rejection leaks
+        nothing."""
+        new: list[_Subnet] = []
+
+        def clashes(sn: _Subnet) -> bool:
+            return self._overlaps(sn) or any(
+                sn.base < o.base + o.size and o.base < sn.base + sn.size
+                for o in new)
+
+        for cidr in requested:
+            sn = _Subnet(cidr)
+            if clashes(sn):
+                raise ValueError(
+                    f"subnet {cidr} overlaps an allocated pool")
+            new.append(sn)
+        if not new:
+            # auto pools skip over anything a user subnet already covers
+            sn = _Subnet(self._auto_cidr())
+            while clashes(sn):
+                sn = _Subnet(self._auto_cidr())
+            new.append(sn)
+        self._pools.setdefault(network_id, []).extend(new)
+        return [sn.cidr for sn in new]
+
+    def release_network(self, network_id: str) -> None:
+        """Drop every pool the network held (network removal) so its
+        subnets become allocatable again."""
+        self._pools.pop(network_id, None)
 
     def grow(self, network_id: str) -> str:
         """Append a fresh auto pool once the existing subnets fill."""
@@ -279,6 +325,10 @@ class Allocator:
                 for p in ev.object.endpoint.ports:
                     if p.published_port and p.publish_mode == "ingress":
                         self.ports.release(p.protocol, p.published_port)
+            elif ev.kind == "network":
+                # free the network's subnets so an overlapping (or
+                # identical) subnet can be allocated again
+                self.ipam.release_network(ev.object.id)
             return
         if ev.kind == "network":
             self._pending_networks.add(ev.object.id)
@@ -352,9 +402,16 @@ class Allocator:
             if net.spec.ipam is not None:
                 requested = [c.subnet for c in net.spec.ipam.configs
                              if c.subnet]
-            subnets = ([self.ipam.allocate_subnet(network_id, r)
-                        for r in requested]
-                       or [self.ipam.allocate_subnet(network_id)])
+            try:
+                subnets = self.ipam.allocate_subnets(network_id, requested)
+            except ValueError as e:
+                # a bad/overlapping user subnet is THIS network's failure,
+                # not the allocator loop's: leave the network unallocated
+                # and keep serving everyone else (reference: doNetworkAlloc
+                # logs and continues, allocator.go actor loop survives)
+                log.warning("network %s allocation rejected: %s",
+                            network_id, e)
+                return
             net.ipam = IPAMOptions(driver="default", configs=[
                 IPAMConfig(subnet=sn, gateway=_gateway(sn))
                 for sn in subnets])

@@ -40,6 +40,11 @@ class LogMessage:
     timestamp: float = 0.0
     stream: LogStream = LogStream.STDOUT
     data: bytes = b""
+    # producer-local monotonic position (TaskLogBuffer ring sequence);
+    # lets a follow-mode publisher skip live lines already shipped in the
+    # tail snapshot (duplicate suppression) — never crosses the wire as
+    # an identity, purely ordering metadata
+    seq: int = 0
 
 
 @dataclass
@@ -137,11 +142,23 @@ class LogBroker:
             if not sub.options.follow:
                 if not sub.pending_nodes:
                     return   # nothing runs anywhere: empty backlog
+                # on expiry the stream must FAIL, not end with a clean
+                # eof: nodes that never published their backlog mean the
+                # tail is incomplete, and the client cannot otherwise
+                # tell a complete tail from a truncated one
                 timer = asyncio.get_running_loop().call_later(
                     max(sub.options.max_wait, 0.0),
-                    lambda: sub.queue.publish(_EOF))
+                    lambda: sub.queue.publish(_TIMEOUT))
             async for msg in watcher:
                 if msg is _EOF:
+                    return
+                if msg is _TIMEOUT:
+                    if sub.pending_nodes:
+                        raise LogsTruncated(
+                            f"{len(sub.pending_nodes)} node(s) never "
+                            f"published their backlog within "
+                            f"{sub.options.max_wait}s: "
+                            f"{sorted(sub.pending_nodes)}")
                     return
                 yield msg
         finally:
@@ -208,8 +225,19 @@ class LogBroker:
                 sub.queue.publish(_EOF)
 
 
+class LogsTruncated(Exception):
+    """Non-follow subscription timed out with nodes still pending — the
+    returned tail is incomplete and the client must treat it as a failure
+    (ctl._stream_logs turns this into an error line, never a clean eof)."""
+
+
 class _Eof:
     """Stream-end sentinel on a subscription queue."""
 
 
+class _Timeout:
+    """max_wait expiry sentinel: eof if nothing is pending, else error."""
+
+
 _EOF = _Eof()
+_TIMEOUT = _Timeout()

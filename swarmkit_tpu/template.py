@@ -62,8 +62,16 @@ def expand_secret_spec(secret, task, node=None):
         return secret
     ctx = task_context(task, node)
     out = secret.copy()
-    out.spec.data = expand(
-        secret.spec.data.decode("utf-8"), ctx).encode("utf-8")
+    try:
+        text = secret.spec.data.decode("utf-8")
+    except UnicodeDecodeError:
+        # a binary payload with templating enabled is a spec error, not a
+        # crash: surface the documented TemplateError so the task FSM
+        # rejects the task cleanly
+        name = getattr(secret.spec.annotations, "name", "") or secret.id
+        raise TemplateError(
+            f"templated payload of {name} is not valid UTF-8")
+    out.spec.data = expand(text, ctx).encode("utf-8")
     return out
 
 

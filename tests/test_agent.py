@@ -318,3 +318,23 @@ async def test_templated_secret_payload_expansion():
     # the store's own copy is untouched by per-task expansion
     assert b"{{.Service.Name}}" in w.dependencies.secrets.get("sec1").spec.data
     await w.close()
+
+
+def test_templated_binary_secret_raises_template_error():
+    """A binary (non-UTF-8) payload with templating enabled raises the
+    documented TemplateError — not UnicodeDecodeError — so the task FSM
+    rejects the task cleanly (advisor round-4 finding)."""
+    from swarmkit_tpu.api import Annotations, Secret, SecretSpec, Task
+    from swarmkit_tpu.api.specs import Driver
+    from swarmkit_tpu.template import TemplateError, expand_secret_spec
+
+    secret = Secret(id="sb", spec=SecretSpec(
+        annotations=Annotations(name="binblob"),
+        data=b"\xff\xfe\x00binary", templating=Driver(name="golang")))
+    task = Task(id="t1", service_id="s1", slot=1, node_id="n1")
+    try:
+        expand_secret_spec(secret, task)
+    except TemplateError as e:
+        assert "not valid UTF-8" in str(e)
+    else:
+        raise AssertionError("expected TemplateError")

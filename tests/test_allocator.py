@@ -293,3 +293,118 @@ async def test_user_subnet_pool_honored_and_grows():
         assert len(rec.ipam.configs) == 2, "grown subnet not persisted"
     finally:
         await alloc.stop()
+
+
+def test_user_subnet_normalized_to_network_base():
+    """A spec subnet with host bits set (10.5.0.7/24) is the 10.5.0.0/24
+    network: gateway .1, first host .2 (advisor round-4 finding; the
+    reference's net.ParseCIDR masks the same way)."""
+    from swarmkit_tpu.manager.allocator import IPAM, _gateway
+
+    assert _gateway("10.5.0.7/24") == "10.5.0.1"
+    assert _gateway("192.168.7.128/25") == "192.168.7.129"
+    ipam = IPAM()
+    ipam.allocate_subnet("net1", "10.5.0.7/24")
+    addr = ipam.allocate_address("net1")
+    assert addr.startswith("10.5.0."), addr
+    host = int(addr.split("/")[0].split(".")[-1])
+    assert host >= 2
+
+
+def test_auto_pools_skip_user_subnet_overlap():
+    """Auto 10.<n>.0.0/24 pools must not collide with user-configured
+    subnets, and overlapping user subnets are rejected."""
+    import pytest
+
+    from swarmkit_tpu.manager.allocator import IPAM
+
+    ipam = IPAM()
+    ipam.allocate_subnet("usernet", "10.1.0.0/16")   # covers 10.1.*.*
+    auto = ipam.allocate_subnet("othernet")          # must skip 10.1.0.0/24
+    assert not auto.startswith("10.1."), auto
+    with pytest.raises(ValueError, match="overlaps"):
+        ipam.allocate_subnet("third", "10.1.4.0/24")
+
+
+@async_test
+async def test_bad_user_subnet_does_not_kill_allocator_loop():
+    """An overlapping/bad spec subnet fails THAT network's allocation only;
+    the allocator keeps serving other networks (code-review round-5
+    finding: a raised ValueError used to crash the allocator actor)."""
+    from swarmkit_tpu.api.types import IPAMConfig, IPAMOptions
+
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    alloc = Allocator(store, clock=clock)
+    await alloc.start()
+    try:
+        good1 = Network(id="n-base", spec=NetworkSpec(
+            annotations=Annotations(name="base"),
+            ipam=IPAMOptions(configs=[IPAMConfig(subnet="10.9.0.0/16")])))
+        bad = Network(id="n-bad", spec=NetworkSpec(
+            annotations=Annotations(name="bad"),
+            ipam=IPAMOptions(configs=[IPAMConfig(subnet="10.9.4.0/24")])))
+        good2 = Network(id="n-after", spec=NetworkSpec(
+            annotations=Annotations(name="after")))
+        for n in (good1, bad, good2):
+            await store.update(lambda tx, n=n: tx.create(n))
+        await pump(clock)
+        await pump(clock)
+        assert store.get("network", "n-base").ipam is not None
+        # the bad one stays unallocated, the loop stays alive, and the
+        # network created after it still allocates
+        assert store.get("network", "n-bad").ipam is None
+        assert store.get("network", "n-after").ipam is not None
+    finally:
+        await alloc.stop()
+
+
+@async_test
+async def test_network_removal_releases_subnets_for_reuse():
+    """Removing a network frees its IPAM pools: re-creating a network with
+    the same subnet succeeds, and a partially overlapping multi-subnet
+    request leaks nothing when rejected (code-review round-5 findings)."""
+    from swarmkit_tpu.api.types import IPAMConfig, IPAMOptions
+
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    alloc = Allocator(store, clock=clock)
+    await alloc.start()
+    try:
+        n1 = Network(id="nA", spec=NetworkSpec(
+            annotations=Annotations(name="a"),
+            ipam=IPAMOptions(configs=[IPAMConfig(subnet="10.7.0.0/24")])))
+        await store.update(lambda tx: tx.create(n1))
+        await pump(clock)
+        assert store.get("network", "nA").ipam is not None
+
+        await store.update(lambda tx: tx.delete("network", "nA"))
+        await pump(clock)
+        n2 = Network(id="nB", spec=NetworkSpec(
+            annotations=Annotations(name="b"),
+            ipam=IPAMOptions(configs=[IPAMConfig(subnet="10.7.0.0/24")])))
+        await store.update(lambda tx: tx.create(n2))
+        await pump(clock)
+        rec = store.get("network", "nB")
+        assert rec.ipam is not None, "freed subnet was not reusable"
+        assert rec.ipam.configs[0].subnet == "10.7.0.0/24"
+
+        # atomic multi-subnet: second subnet overlaps nB -> NOTHING leaks
+        bad = Network(id="nC", spec=NetworkSpec(
+            annotations=Annotations(name="c"),
+            ipam=IPAMOptions(configs=[IPAMConfig(subnet="10.8.0.0/24"),
+                                      IPAMConfig(subnet="10.7.0.0/24")])))
+        await store.update(lambda tx: tx.create(bad))
+        await pump(clock)
+        assert store.get("network", "nC").ipam is None
+        # the non-overlapping first subnet must NOT be held by nC's
+        # failed attempt
+        good = Network(id="nD", spec=NetworkSpec(
+            annotations=Annotations(name="d"),
+            ipam=IPAMOptions(configs=[IPAMConfig(subnet="10.8.0.0/24")])))
+        await store.update(lambda tx: tx.create(good))
+        await pump(clock)
+        assert store.get("network", "nD").ipam is not None, \
+            "rejected multi-subnet attempt leaked a pool"
+    finally:
+        await alloc.stop()

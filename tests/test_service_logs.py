@@ -207,3 +207,24 @@ async def test_service_logs_task_selector_and_late_task():
         task2.cancel()
     finally:
         await c.stop_all()
+
+
+@async_test
+async def test_no_follow_timeout_is_truncation_error_not_clean_eof():
+    """If max_wait expires while nodes still owe their backlog, the
+    subscription FAILS with LogsTruncated — a silent eof would be
+    indistinguishable from a complete tail (advisor round-4 finding;
+    the 'truncation must be a failure' rule ctl._stream_logs enforces)."""
+    import pytest
+
+    from swarmkit_tpu.manager.logbroker import (
+        LogBroker, LogSelector, LogsTruncated, SubscribeLogsOptions,
+    )
+    from swarmkit_tpu.store.memory import MemoryStore
+
+    lb = LogBroker(MemoryStore())
+    with pytest.raises(LogsTruncated, match="never published"):
+        async for _ in lb.subscribe_logs(
+                LogSelector(node_ids=["ghost-node"]),
+                SubscribeLogsOptions(follow=False, tail=-1, max_wait=0.05)):
+            pass
