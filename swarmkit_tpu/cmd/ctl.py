@@ -190,6 +190,14 @@ async def dispatch_control(c, method: str, p: dict):
             spec.desired_role = NodeRole.WORKER
         if "availability" in p:
             spec.availability = NodeAvailability(p["availability"])
+        if "labels_add" in p or "labels_rm" in p:
+            # node labels live on the SPEC annotations (the operator's
+            # half; reference cmd/swarmctl/node/update.go) — the
+            # constraint language reads them from there.  `spec` is
+            # already a deep copy (Message.copy), so mutate in place.
+            spec.annotations.labels.update(p.get("labels_add") or {})
+            for k in p.get("labels_rm") or []:
+                spec.annotations.labels.pop(k, None)
         node2 = await c.update_node(p["id"], spec,
                                     version=node.meta.version.index)
         return node2.to_dict()

@@ -29,18 +29,28 @@ from swarmkit_tpu.store.by import ByService
 from swarmkit_tpu.store.memory import Event, match
 
 
-async def bench(replicas: int, workers: int, managers: int = 1
-                ) -> dict:
+async def bench(replicas: int, workers: int, managers: int = 1,
+                transport: str = "inproc") -> dict:
     import tempfile
 
-    net = Network(seed=1)
+    transport_factory = None
+    if transport == "device":
+        # manager-quorum consensus over the device-mesh mailbox wire
+        # (SURVEY §7; same path tests/test_integration.py's device-mesh
+        # variant exercises)
+        from swarmkit_tpu.transport import DeviceMeshNet, DeviceMeshTransport
+        net = DeviceMeshNet(seed=1, rows=max(8, managers))
+        transport_factory = DeviceMeshTransport
+    else:
+        net = Network(seed=1)
     tmp = tempfile.TemporaryDirectory(prefix="swarm-bench-")
     mgrs: list[Manager] = []
     for i in range(managers):
         m = Manager(node_id=f"m{i}", addr=f"m{i}:4242", network=net,
                     state_dir=f"{tmp.name}/m{i}",
                     join_addr=mgrs[0].addr if mgrs else "",
-                    tick_interval=0.05, election_tick=4, seed=i)
+                    tick_interval=0.05, election_tick=4, seed=i,
+                    transport_factory=transport_factory)
         await m.start()
         mgrs.append(m)
         if i == 0:
@@ -99,8 +109,11 @@ async def bench(replicas: int, workers: int, managers: int = 1
         await a.stop()
     for m in mgrs:
         await m.stop()
+    close = getattr(net, "close", None)
+    if close is not None:
+        close()
     return {
-        "replicas": replicas, "workers": workers,
+        "replicas": replicas, "workers": workers, "transport": transport,
         "time_to_all_running_s": round(total, 4),
         "tasks_per_s": round(replicas / total, 2),
         "p50_s": round(pct(0.50), 4),
@@ -114,8 +127,13 @@ def main(argv=None) -> int:
     p.add_argument("--replicas", type=int, default=100)
     p.add_argument("--workers", type=int, default=10)
     p.add_argument("--managers", type=int, default=1)
+    p.add_argument("--transport", choices=["inproc", "device"],
+                   default="inproc",
+                   help="raft wire: in-process queues or the device-mesh "
+                        "mailbox backend")
     args = p.parse_args(argv)
-    result = asyncio.run(bench(args.replicas, args.workers, args.managers))
+    result = asyncio.run(bench(args.replicas, args.workers, args.managers,
+                               transport=args.transport))
     json.dump(result, sys.stdout)
     sys.stdout.write("\n")
     return 0

@@ -35,6 +35,15 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("id")
         if name == "node-rm":
             sp.add_argument("--force", action="store_true")
+    # reference: cmd/swarmctl/node/update.go (activate/pause/drain live as
+    # their own verbs there; one verb + --availability covers all three)
+    sp = sub.add_parser("node-update")
+    sp.add_argument("id")
+    sp.add_argument("--availability", choices=["active", "pause", "drain"])
+    sp.add_argument("--label-add", action="append", default=[],
+                    metavar="KEY=VALUE")
+    sp.add_argument("--label-rm", action="append", default=[],
+                    metavar="KEY")
 
     sp = sub.add_parser("service-create")
     sp.add_argument("--name", required=True)
@@ -156,6 +165,25 @@ async def run(args, out=None) -> int:
             await client.call("node.promote", id=args.id)
         elif c == "node-demote":
             await client.call("node.demote", id=args.id)
+        elif c == "node-update":
+            p: dict = {"id": args.id}
+            if args.availability is not None:
+                from swarmkit_tpu.api.types import NodeAvailability
+                p["availability"] = int(
+                    NodeAvailability[args.availability.upper()])
+            if args.label_add:
+                adds = {}
+                for kv in args.label_add:
+                    if "=" not in kv:
+                        print(f"error: --label-add wants KEY=VALUE, "
+                              f"got {kv!r}", file=sys.stderr)
+                        return 1
+                    k, _, v = kv.partition("=")
+                    adds[k] = v
+                p["labels_add"] = adds
+            if args.label_rm:
+                p["labels_rm"] = list(args.label_rm)
+            show(await client.call("node.update", **p))
         elif c == "service-create":
             show(await client.call("service.create",
                                    spec=_service_spec(args)))
@@ -181,16 +209,22 @@ async def run(args, out=None) -> int:
         elif c == "service-update":
             cur = await client.call("service.inspect", id=args.id)
             spec = cur["spec"]
-            cont = spec.setdefault("task", {}).setdefault("container", {})
-            if args.image is not None:
-                cont["image"] = args.image
-            if args.env is not None:
-                cont["env"] = list(args.env)
+            # only materialize task/container sub-objects when a container
+            # flag was actually given — an unrelated update must not
+            # mutate a container-less service spec
+            if args.image is not None or args.env is not None:
+                cont = spec.setdefault("task", {}).setdefault(
+                    "container", {})
+                if args.image is not None:
+                    cont["image"] = args.image
+                if args.env is not None:
+                    cont["env"] = list(args.env)
             if args.replicas is not None and spec.get("replicated"):
                 spec["replicated"]["replicas"] = args.replicas
             if args.force:
-                spec["task"]["force_update"] = \
-                    int(spec["task"].get("force_update", 0)) + 1
+                task_spec = spec.setdefault("task", {})
+                task_spec["force_update"] = \
+                    int(task_spec.get("force_update", 0)) + 1
             upd = spec.get("update") or {}
             for flag, key in (("update_parallelism", "parallelism"),
                               ("update_delay", "delay"),

@@ -58,7 +58,8 @@ class Manager:
                  tick_interval: float = 1.0,
                  election_tick: int = 10, heartbeat_tick: int = 1,
                  seed: int = 0, security=None,
-                 encrypter=None, decrypter=None) -> None:
+                 encrypter=None, decrypter=None,
+                 transport_factory=None) -> None:
         self.node_id = node_id
         self.addr = addr
         self.clock = clock or SystemClock()
@@ -75,7 +76,8 @@ class Manager:
             force_new_cluster=force_new_cluster,
             tick_interval=tick_interval, election_tick=election_tick,
             heartbeat_tick=heartbeat_tick, seed=seed,
-            encrypter=encrypter, decrypter=decrypter))
+            encrypter=encrypter, decrypter=decrypter,
+            transport_factory=transport_factory))
         self.store: MemoryStore = self.raft.store
 
         # always-on services (reference: manager.go:526-548)

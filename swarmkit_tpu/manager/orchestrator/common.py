@@ -84,3 +84,12 @@ def runnable(task) -> bool:
     """Task still wants to run (desired <= RUNNING and not failed out)."""
     return task.desired_state <= TaskState.RUNNING \
         and not in_terminal_state(task)
+
+
+def invalid_node(node) -> bool:
+    """Node cannot host running tasks: gone, down, or drained
+    (reference: orchestrator.InvalidNode task.go:141-145)."""
+    from swarmkit_tpu.api.types import NodeAvailability, NodeState
+    return (node is None
+            or node.status.state == NodeState.DOWN
+            or node.spec.availability == NodeAvailability.DRAIN)

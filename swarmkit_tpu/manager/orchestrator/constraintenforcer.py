@@ -58,9 +58,14 @@ class ConstraintEnforcer:
 
     async def reject_noncompliant(self, node) -> None:
         """reference: rejectNoncompliantTasks constraint_enforcer.go:65."""
+        # Drain is the ORCHESTRATOR's job (its restart supervisor shuts
+        # down AND replaces each task atomically); pause means leave the
+        # tasks alone.  The enforcer only polices ACTIVE nodes
+        # (reference: constraint_enforcer.go:66-72).
+        if node.spec.availability != NodeAvailability.ACTIVE:
+            return
         tasks = self.store.find("task", ByNode(node.id))
         to_shutdown = []
-        drained = node.spec.availability == NodeAvailability.DRAIN
         # remaining capacity for the resource-fit pass (the reference
         # recomputes available resources and evicts tasks whose
         # reservations no longer fit a shrunk node)
@@ -74,9 +79,6 @@ class ConstraintEnforcer:
         for t in sorted(tasks, key=lambda t: t.id):
             if t.desired_state > TaskState.RUNNING \
                     or common.in_terminal_state(t):
-                continue
-            if drained:
-                to_shutdown.append(t)
                 continue
             p = t.spec.placement
             if p is not None and p.constraints:

@@ -18,7 +18,7 @@ from swarmkit_tpu.manager.orchestrator import common
 from swarmkit_tpu.manager.orchestrator.restart import RestartSupervisor
 from swarmkit_tpu.manager.orchestrator.taskinit import check_tasks
 from swarmkit_tpu.manager.orchestrator.update import UpdateSupervisor
-from swarmkit_tpu.store.by import ByService
+from swarmkit_tpu.store.by import ByNode, ByService
 from swarmkit_tpu.store.memory import Event, EventCommit, MemoryStore, match, match_commit
 from swarmkit_tpu.utils.clock import Clock, SystemClock
 
@@ -42,7 +42,7 @@ class ReplicatedOrchestrator:
 
     async def start(self) -> None:
         watcher = self.store.watch(match(kind="service"), match(kind="task"),
-                                   match_commit)
+                                   match(kind="node"), match_commit)
         # initial reconciliation of everything (reference: init via taskinit)
         for s in self.store.find("service"):
             if s.spec.mode == Mode.REPLICATED:
@@ -101,9 +101,28 @@ class ReplicatedOrchestrator:
             if ev.action == "remove":
                 self._dirty_services.add(t.service_id)
                 return
-            # a task reaching a terminal state may need a restart
-            if ev.action == "update" and common.in_terminal_state(t) \
-                    and t.desired_state <= TaskState.RUNNING:
+            # a task reaching a terminal state — or sitting on a node
+            # that can no longer host it — may need a restart
+            # (reference: handleTaskChange tasks.go:118-146)
+            if ev.action == "update" and t.desired_state <= TaskState.RUNNING \
+                    and (common.in_terminal_state(t)
+                         or (t.node_id and common.invalid_node(
+                             self.store.get("node", t.node_id)))):
+                self._restart_queue.append(t)
+        elif ev.kind == "node":
+            # a node going down/drained (or deleted) restarts its tasks
+            # elsewhere (reference: handleNodeChange + restartTasksByNodeID
+            # tasks.go:85-115; InvalidNode task.go:141)
+            n = ev.object
+            if ev.action == "remove" or common.invalid_node(n):
+                self._queue_node_restarts(n.id)
+
+    def _queue_node_restarts(self, node_id: str) -> None:
+        """reference: restartTasksByNodeID tasks.go:85 — every runnable
+        replicated task on the node goes through the restart supervisor,
+        which shuts it down AND creates its replacement in one txn."""
+        for t in self.store.find("task", ByNode(node_id)):
+            if t.desired_state <= TaskState.RUNNING and t.service_id:
                 self._restart_queue.append(t)
 
     # ------------------------------------------------------------------

@@ -83,6 +83,11 @@ class NodeConfig:
     heartbeat_tick: int = 1
     clock: Optional[Clock] = None
     seed: int = 0
+    # raft Transport selection (raft/node.py NodeOpts.transport_factory):
+    # None = in-process Transport; DeviceMeshTransport (with a
+    # DeviceMeshNet network) runs the manager quorum over the device
+    # mailbox wire
+    transport_factory: object = None
 
 
 class Node:
@@ -375,7 +380,8 @@ class Node:
             election_tick=self.config.election_tick,
             heartbeat_tick=self.config.heartbeat_tick,
             seed=self.config.seed, security=self.security,
-            encrypter=encrypter, decrypter=decrypter)
+            encrypter=encrypter, decrypter=decrypter,
+            transport_factory=self.config.transport_factory)
         await self.manager.start()
         # Demotion safety net: the dispatcher session is the primary
         # role-change channel, but during a demotion the session churns

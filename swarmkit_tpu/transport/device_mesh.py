@@ -199,6 +199,11 @@ class DeviceMeshNet(Network):
             if group:
                 await self._flush_group(group)
 
+        # Unreachable reports fire after the exchange (the reference's RPC
+        # error path, peer.go:261).
+        for tr, rid, m in blocked_cb:
+            tr.peer_failed(rid, m)
+
     async def _flush_group(self, packed) -> None:
         rows = self.rows
         max_words = max((len(e[3]) + 3) // 4 for e in packed)
@@ -233,11 +238,6 @@ class DeviceMeshNet(Network):
                 continue  # masked out on device
             payload = d_words[to, frm, k].tobytes()[:nbytes]
             await self._deliver(tr, rid, to_addr, payload, m)
-
-        # Unreachable reports fire after the exchange (the reference's RPC
-        # error path, peer.go:261).
-        for tr, rid, m in blocked_cb:
-            tr.peer_failed(rid, m)
 
     async def _deliver(self, tr: "DeviceMeshTransport", raft_id: int,
                        to_addr: str, payload: bytes, m: Message) -> None:

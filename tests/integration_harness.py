@@ -34,8 +34,10 @@ class TestCluster:
 
     __test__ = False
 
-    def __init__(self, seed: int = 3) -> None:
-        self.network = Network(seed=seed)
+    def __init__(self, seed: int = 3, network=None,
+                 transport_factory=None) -> None:
+        self.network = network if network is not None else Network(seed=seed)
+        self.transport_factory = transport_factory
         self.tmp = tempfile.TemporaryDirectory(prefix="swarmkit-int-")
         self.nodes: dict[str, Node] = {}
         self.executors: dict[str, TestExecutor] = {}
@@ -90,7 +92,8 @@ class TestCluster:
             tick_interval=TICK,
             election_tick=4,
             heartbeat_tick=1,
-            seed=self.seed + self._n)
+            seed=self.seed + self._n,
+            transport_factory=self.transport_factory)
 
     async def add_manager(self, node_id: str = "", executor=None) -> Node:
         """reference: AddManager cluster.go."""
@@ -182,6 +185,9 @@ class TestCluster:
                 await node.stop()
             except Exception:
                 pass
+        close = getattr(self.network, "close", None)
+        if close is not None:   # DeviceMeshNet owns a pump task
+            close()
 
     # ------------------------------------------------------------------
     async def create_service(self, name: str = "web", replicas: int = 2,

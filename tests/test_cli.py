@@ -397,3 +397,127 @@ async def test_swarmctl_service_update_and_rollback():
     finally:
         await node._ctl_server.stop()
         await node.stop()
+
+
+@async_test
+async def test_swarmctl_node_update_availability_and_labels():
+    """`swarmctl node-update --availability drain` evicts the node's tasks
+    (constraint enforcer) and the scheduler re-places them elsewhere;
+    `--availability active` readmits it; `--label-add/--label-rm` edit the
+    spec labels the constraint language reads (reference:
+    cmd/swarmctl/node/update.go drain/activate + label flags)."""
+    from swarmkit_tpu.cmd import swarmctl as ctl_cmd
+    from swarmkit_tpu.cmd import swarmd
+    from tests.test_grpc_transport import free_port
+
+    tmp = tempfile.TemporaryDirectory(prefix="swarmd-drain-")
+    sock = os.path.join(tmp.name, "m1.sock")
+    m_addr = f"127.0.0.1:{free_port()}"
+    m_args = swarmd.build_parser().parse_args([
+        "--state-dir", os.path.join(tmp.name, "m1"),
+        "--listen-control-api", sock,
+        "--listen-remote-api", m_addr,
+        "--node-id", "m1", "--manager", "--election-tick", "4",
+        "--executor", "test",
+    ])
+    manager_node = await swarmd.run(m_args)
+    worker_node = None
+    try:
+        for _ in range(200):
+            if manager_node.is_leader():
+                break
+            await asyncio.sleep(0.05)
+        lead = manager_node._running_manager()
+        for _ in range(200):
+            if lead.store.find("cluster"):
+                break
+            await asyncio.sleep(0.05)
+        token = lead.store.find("cluster")[0].root_ca.join_token_worker
+
+        w_args = swarmd.build_parser().parse_args([
+            "--state-dir", os.path.join(tmp.name, "w1"),
+            "--listen-control-api", os.path.join(tmp.name, "w1.sock"),
+            "--listen-remote-api", f"127.0.0.1:{free_port()}",
+            "--node-id", "w1",
+            "--join-addr", m_addr, "--join-token", token,
+            "--executor", "test",
+        ])
+        worker_node = await swarmd.run(w_args)
+
+        from swarmkit_tpu.api import NodeState
+        for _ in range(400):
+            n = lead.store.get("node", "w1")
+            if n is not None and n.status.state == NodeState.READY:
+                break
+            await asyncio.sleep(0.05)
+        assert lead.store.get("node", "w1").status.state == NodeState.READY
+
+        async def ctl(*argv):
+            out = io.StringIO()
+            rc = await ctl_cmd.run(
+                ctl_cmd.build_parser().parse_args(
+                    ["--socket", sock, *argv]), out=out)
+            return rc, out.getvalue()
+
+        rc, out = await ctl("service-create", "--name", "web",
+                            "--image", "img", "--replicas", "4")
+        assert rc == 0, out
+        svc_id = json.loads(out)["id"]
+
+        from swarmkit_tpu.store.by import ByService
+
+        def running_by_node():
+            by: dict[str, int] = {}
+            for t in lead.store.find("task", ByService(svc_id)):
+                if t.status.state == TaskState.RUNNING \
+                        and int(t.desired_state) == int(TaskState.RUNNING):
+                    by[t.node_id] = by.get(t.node_id, 0) + 1
+            return by
+
+        # tasks spread across both nodes first
+        for _ in range(400):
+            by = running_by_node()
+            if sum(by.values()) == 4 and by.get("w1", 0) > 0:
+                break
+            await asyncio.sleep(0.05)
+        assert by.get("w1", 0) > 0, by
+
+        # DRAIN w1 through the CLI: enforcer evicts, scheduler re-places
+        rc, out = await ctl("node-update", "w1", "--availability", "drain")
+        assert rc == 0, out
+        assert json.loads(out)["spec"]["availability"] == 2  # DRAIN
+        for _ in range(400):
+            by = running_by_node()
+            if by.get("w1", 0) == 0 and by.get("m1", 0) == 4:
+                break
+            await asyncio.sleep(0.05)
+        assert by == {"m1": 4}, by
+
+        # reactivate + labels; scale up so w1 gets work again
+        rc, out = await ctl("node-update", "w1",
+                            "--availability", "active",
+                            "--label-add", "zone=east",
+                            "--label-add", "tier=gpu")
+        assert rc == 0, out
+        spec = json.loads(out)["spec"]
+        assert spec["availability"] == 0
+        assert spec["annotations"]["labels"] == {"zone": "east",
+                                                 "tier": "gpu"}
+        rc, out = await ctl("node-update", "w1", "--label-rm", "tier")
+        assert rc == 0, out
+        assert json.loads(out)["spec"]["annotations"]["labels"] == \
+            {"zone": "east"}
+
+        rc, out = await ctl("service-scale", svc_id, "8")
+        assert rc == 0, out
+        for _ in range(400):
+            by = running_by_node()
+            if sum(by.values()) == 8 and by.get("w1", 0) > 0:
+                break
+            await asyncio.sleep(0.05)
+        assert by.get("w1", 0) > 0, f"reactivated node got no work: {by}"
+    finally:
+        if worker_node is not None:
+            await worker_node.stop()
+        await manager_node._ctl_server.stop()
+        await manager_node.stop()
