@@ -28,7 +28,10 @@ divergences.
     and a rejection re-sends the backtracked probe within the same tick
     (etcd stepLeader APP_RESP -> send_append).  Replayed here by
     _tick_mailbox's hbq/hbrq queues and the post-backtrack enqueue.
-    Two deliberate residues, both argued strictly-fresher-than-etcd:
+    Two deliberate residues, both argued strictly-fresher-than-etcd AND
+    test-backed (round 5): tests/test_oracle_residues.py constructs each
+    scenario and asserts trajectory convergence (same leader/term/commit,
+    bounded extra delay) against an UNMASKED etcd-faithful core replay:
     (a) commit-advance-triggered EMPTY append broadcasts are subsumed —
     content appends read commit at DELIVERY (fresher than etcd's capture
     at send) and caught-up edges learn commit from next tick's heartbeat;
@@ -45,7 +48,10 @@ divergences.
     instead of deposing the pre-candidate (it catches up via appends);
     equal-term rejections count toward the rejection quorum exactly as
     etcd's poll does. Mask: _prevote_exchange_sync/_tick_mailbox enqueue
-    only countable rejections.
+    only countable rejections.  Test-backed (round 5):
+    test_oracle_residues.py::test_d2_* shows the dropped-rejection
+    pre-candidate and etcd's deposed follower converge to the identical
+    (leader, term, commit) trajectory once a real election lands.
  D3' windowed flow control IS implemented on the mailbox wire
     (cfg.inflight = vendor MaxInflightMsgs): up to K appends pipeline per
     edge with optimistic next advance in StateReplicate, becomeReplicate's
