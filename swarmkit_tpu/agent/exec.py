@@ -17,11 +17,17 @@ from swarmkit_tpu.api.types import NodeDescription
 
 
 class TaskError(Exception):
-    """Controller operation failed; the task becomes FAILED."""
+    """Controller operation failed.  The terminal state is chosen by
+    WHERE the failure occurred, not by the exception type (reference
+    fatal() switch controller.go:210-221): before STARTING the task is
+    REJECTED, from STARTING on it is FAILED."""
 
 
 class TaskRejected(TaskError):
-    """The node cannot run this task at all (REJECTED, no restart here)."""
+    """Semantic marker: the node cannot run this task at all.  Raised
+    from update()/prepare() it lands as REJECTED via the same
+    where-it-failed rule above (an escape from start()/wait() would be
+    FAILED like any other error there)."""
 
 
 class Controller:
@@ -121,8 +127,14 @@ async def do_task_state(task, controller: Controller, now: float
         if state == TaskState.RUNNING:
             await controller.wait()
             return _status(task, TaskState.COMPLETE, "finished", now)
-    except TaskRejected as e:
-        return _status(task, TaskState.REJECTED, "rejected", now, e)
     except Exception as e:
+        # The reference's fatal() switch (controller.go:210-221) picks the
+        # terminal state by WHERE the failure was encountered: before
+        # STARTING the node never ran the workload, so the task is
+        # REJECTED; from STARTING on it FAILED.  (Tasks.tla's agent table
+        # encodes the same shape: rejected from assigned..starting, failed
+        # from running.)
+        if state < TaskState.STARTING:
+            return _status(task, TaskState.REJECTED, "rejected", now, e)
         return _status(task, TaskState.FAILED, "failed", now, e)
     return None

@@ -44,6 +44,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--heartbeat-tick", type=int, default=1)
     p.add_argument("--election-tick", type=int, default=10)
     p.add_argument("--unlock-key", default="")
+    p.add_argument("--listen-debug", default="",
+                   help="serve the live diagnostic surface (asyncio task "
+                        "dump, store wedge state, watch-queue depths, "
+                        "metrics) on host:port or a unix socket path "
+                        "(reference: swarmd --listen-debug pprof/expvar, "
+                        "cmd/swarmd/main.go:183)")
     p.add_argument("--backend", choices=["grpc", "inproc"], default="grpc",
                    help="raft/cluster wire: real gRPC sockets (default) or "
                         "in-process (single-node/testing)")
@@ -149,6 +155,12 @@ async def run(args, network=None, executor=None, registry=None) -> Node:
     ctl = ControlSocketServer(node, args.listen_control_api)
     await ctl.start()
     node._ctl_server = ctl
+    node._debug_server = None
+    if args.listen_debug:
+        from swarmkit_tpu.node.debug import DebugServer
+        dbg = DebugServer(node)
+        await dbg.start(args.listen_debug)
+        node._debug_server = dbg
     return node
 
 
@@ -162,6 +174,8 @@ async def main_async(argv=None) -> None:
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
+    if getattr(node, "_debug_server", None) is not None:
+        await node._debug_server.stop()
     await node._ctl_server.stop()
     await node.stop()
 

@@ -1,0 +1,175 @@
+"""Exhaustive task-FSM transition check (the design/tla analog).
+
+The reference model-checks the worker/task state machine
+(design/tla/Tasks.tla `Transitions`, WorkerSpec.tla) — every legal
+(source, target) pair per actor, plus monotonicity over the lamport rank.
+This test enumerates the ENTIRE input space of the agent's one advancer,
+`exec.do_task_state` (every observed state x every desired state x every
+controller outcome), and asserts the produced transition relation equals
+the legal set EXACTLY — nothing illegal reachable, nothing legal missing.
+
+The legal set is Tasks.tla's agent table with the reference Go
+implementation's two documented refinements (agent/exec/controller.go):
+- fatal errors pick the terminal state by WHERE they occurred
+  (fatal() switch :210-221): < STARTING -> REJECTED (Tasks.tla lists
+  these as `rejected` too), >= STARTING -> FAILED (the Go switch sends
+  starting-failures to FAILED where the TLA table only lists
+  running->failed);
+- desired_state >= SHUTDOWN short-circuits ANY non-terminal state to
+  SHUTDOWN (Do's shutdown gate), where the TLA agent table lists only
+  running->shutdown (pre-running shutdowns are modeled inside
+  WorkerSpec.tla's reject/progress interleavings).
+
+ORPHANED transitions (assigned..running -> orphaned) belong to the
+DISPATCHER's down-node path, not the agent advancer — covered by
+tests/test_dispatcher.py.  The reaper's x -> null removals are covered by
+the task reaper tests.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+
+from swarmkit_tpu.agent.exec import (
+    Controller, TaskError, TaskRejected, do_task_state,
+)
+from swarmkit_tpu.api import Task, TaskState, TaskStatus
+from swarmkit_tpu.api.specs import ContainerSpec
+from swarmkit_tpu.api.types import TERMINAL_STATES
+from swarmkit_tpu.manager.orchestrator import common
+
+S = TaskState
+
+ALL_STATES = list(TaskState)
+DESIREDS = [S.READY, S.RUNNING, S.SHUTDOWN, S.REMOVE]
+OUTCOMES = ["ok", "task_error", "task_rejected", "runtime_error"]
+
+NON_TERMINAL = [s for s in ALL_STATES if s < S.COMPLETE]
+
+# -- the legal transition relation (see module docstring for provenance) --
+PROGRESS = {
+    (S.NEW, S.ACCEPTED), (S.PENDING, S.ACCEPTED), (S.ASSIGNED, S.ACCEPTED),
+    (S.ACCEPTED, S.PREPARING),
+    (S.PREPARING, S.READY),
+    (S.READY, S.STARTING),
+    (S.STARTING, S.RUNNING),
+    (S.RUNNING, S.COMPLETE),
+}
+FATAL = {
+    (S.PREPARING, S.REJECTED),    # prepare() is the only pre-STARTING
+                                  # controller call that can fail
+    (S.STARTING, S.FAILED),
+    (S.RUNNING, S.FAILED),
+}
+SHUTDOWNS = {(s, S.SHUTDOWN) for s in NON_TERMINAL}
+LEGAL = PROGRESS | FATAL | SHUTDOWNS
+
+
+class _Ctl(Controller):
+    """Controller whose lifecycle calls share one scripted outcome."""
+
+    def __init__(self, outcome: str):
+        self.outcome = outcome
+
+    def _maybe_raise(self):
+        if self.outcome == "task_error":
+            raise TaskError("boom")
+        if self.outcome == "task_rejected":
+            raise TaskRejected("cannot run here")
+        if self.outcome == "runtime_error":
+            raise RuntimeError("unexpected")
+
+    async def prepare(self):
+        self._maybe_raise()
+
+    async def start(self):
+        self._maybe_raise()
+
+    async def wait(self):
+        self._maybe_raise()
+
+    async def shutdown(self):
+        # shutdown errors are swallowed by the advancer (reference Do's
+        # shutdown path ignores graceful-stop failures)
+        self._maybe_raise()
+
+
+def _task(state: TaskState, desired: TaskState) -> Task:
+    t = Task(id="t1", service_id="s1", slot=1, node_id="n1")
+    t.status = TaskStatus(state=state)
+    t.desired_state = int(desired)
+    return t
+
+
+def test_agent_advancer_transition_relation_is_exactly_the_legal_set():
+    seen: set[tuple[TaskState, TaskState]] = set()
+
+    async def drive():
+        for state, desired, outcome in itertools.product(
+                ALL_STATES, DESIREDS, OUTCOMES):
+            task = _task(state, desired)
+            st = await do_task_state(task, _Ctl(outcome), 0.0)
+            if st is None:
+                # a no-op must only happen on terminal states or the
+                # READY park (stop-first updates hold replacements there)
+                assert state in TERMINAL_STATES or (
+                    state == S.READY and desired <= S.READY), \
+                    (state.name, desired.name, outcome)
+                continue
+            new = TaskState(st.state)
+            if new == state:
+                continue
+            seen.add((state, new))
+            # monotonicity: the lamport rank never decreases (reference
+            # Do's transition() panics on current > state)
+            assert new >= state, (state.name, new.name)
+
+    asyncio.run(drive())
+    missing = LEGAL - seen
+    illegal = seen - LEGAL
+    assert not illegal, {(a.name, b.name) for a, b in illegal}
+    assert not missing, {(a.name, b.name) for a, b in missing}
+
+
+def test_terminal_and_runnable_helpers_agree_with_the_rank():
+    """orchestrator.common's predicates partition the state space the way
+    Tasks.tla's rank order does: terminal states are exactly those at or
+    past COMPLETE, and `runnable` is desired<=RUNNING on a non-terminal
+    observed state."""
+    for s in ALL_STATES:
+        t = _task(s, S.RUNNING)
+        assert common.in_terminal_state(t) == (s in TERMINAL_STATES)
+        assert common.in_terminal_state(t) == (s >= S.COMPLETE)
+        assert common.runnable(t) == (s < S.COMPLETE)
+    # desired past RUNNING makes any task non-runnable
+    t = _task(S.RUNNING, S.SHUTDOWN)
+    assert not common.runnable(t)
+
+
+def test_legal_set_matches_tasks_tla_modulo_documented_refinements():
+    """Pin the relationship to design/tla/Tasks.tla's agent table so a
+    future edit to either side surfaces here."""
+    tla_agent = {
+        (S.ASSIGNED, S.ACCEPTED), (S.ACCEPTED, S.PREPARING),
+        (S.PREPARING, S.READY), (S.READY, S.STARTING),
+        (S.STARTING, S.RUNNING),
+        (S.ASSIGNED, S.REJECTED), (S.ACCEPTED, S.REJECTED),
+        (S.PREPARING, S.REJECTED), (S.READY, S.REJECTED),
+        (S.STARTING, S.REJECTED),
+        (S.RUNNING, S.COMPLETE), (S.RUNNING, S.FAILED),
+        (S.RUNNING, S.SHUTDOWN),
+    }
+    # refinements: Go's fatal() switch sends STARTING failures to FAILED;
+    # pure status moves (no controller call) cannot fail, so several TLA
+    # rejected-edges are unreachable in this implementation; pre-RUNNING
+    # shutdown short-circuits exist (Do's gate); tasks arrive at the
+    # agent before ASSIGNED only in tests.
+    go_only = (LEGAL - tla_agent)
+    assert go_only == (
+        {(S.NEW, S.ACCEPTED), (S.PENDING, S.ACCEPTED),
+         (S.STARTING, S.FAILED)}
+        | {(s, S.SHUTDOWN) for s in NON_TERMINAL if s != S.RUNNING})
+    tla_only = (tla_agent - LEGAL)
+    assert tla_only == {(S.ASSIGNED, S.REJECTED), (S.ACCEPTED, S.REJECTED),
+                        (S.READY, S.REJECTED), (S.STARTING, S.REJECTED)}
