@@ -213,6 +213,10 @@ class NetworkAttachment(Message):
     network_id: str = ""
     addresses: list[str] = field(default_factory=list)
     aliases: list[str] = field(default_factory=list)
+    # resolved network driver name (reference: NetworkAttachment.Network
+    # .DriverState carried into the task so the scheduler's PluginFilter
+    # needs no store lookup); "" = default driver
+    driver: str = ""
 
 
 @dataclass

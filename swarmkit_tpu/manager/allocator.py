@@ -529,8 +529,11 @@ class Allocator:
                         log.warning("task %s: network %s exhausted",
                                     tid, nid)
                         continue
+                    drv = ""
+                    if net.spec.driver_config is not None:
+                        drv = net.spec.driver_config.name
                     t.networks.append(NetworkAttachment(
-                        network_id=nid, addresses=[addr]))
+                        network_id=nid, addresses=[addr], driver=drv))
                 if svc is not None and svc.endpoint is not None:
                     t.endpoint = svc.endpoint.copy()
                 t.status.state = TaskState.PENDING

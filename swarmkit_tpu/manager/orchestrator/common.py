@@ -18,7 +18,9 @@ def new_task(cluster, service, slot: int = 0, node_id: str = "") -> Task:
     """reference: orchestrator/task.go NewTask."""
     log_driver = service.spec.task.log_driver
     if log_driver is None and cluster is not None:
-        log_driver = getattr(cluster.spec, "default_log_driver", None)
+        # cluster-wide default (reference: newTask task.go reads
+        # cluster.Spec.TaskDefaults.LogDriver)
+        log_driver = cluster.spec.task_defaults.log_driver
     t = Task(
         id=new_id(),
         service_id=service.id,

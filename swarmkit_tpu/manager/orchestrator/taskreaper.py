@@ -2,8 +2,11 @@
 
 Reference: manager/orchestrator/taskreaper/task_reaper.go — keeps at most
 TaskHistoryRetentionLimit dead tasks per slot (tick :234), deletes tasks with
-desired_state REMOVE once they reach a terminal state, and cleans up tasks
-orphaned for too long.
+desired_state REMOVE once they reach a terminal state OR while still
+unassigned (task_reaper.go:109-111,181: state < ASSIGNED never reaches an
+agent, so nothing will ever shut it down — the design/tla/Tasks.tla reaper
+exceptions <<new, null>> / <<pending, null>>), and cleans up tasks orphaned
+for too long.
 """
 
 from __future__ import annotations
@@ -21,6 +24,18 @@ from swarmkit_tpu.utils.clock import Clock, SystemClock
 log = logging.getLogger("swarmkit_tpu.orchestrator.taskreaper")
 
 DEFAULT_RETENTION = 5  # reference: defaults.Service TaskHistoryRetentionLimit
+
+
+def _removable(t) -> bool:
+    """Reapable outright: desired REMOVE and either already dead or never
+    assigned (reference task_reaper.go:181: state < ASSIGNED or
+    >= COMPLETE), or a SERVICELESS orphaned task (network-attachment
+    tasks have no service to reconcile them; task_reaper.go:174-175)."""
+    if t.status.state >= TaskState.ORPHANED and not t.service_id:
+        return True
+    return t.desired_state == TaskState.REMOVE \
+        and (t.status.state < TaskState.ASSIGNED
+             or common.in_terminal_state(t))
 
 
 class TaskReaper:
@@ -45,8 +60,7 @@ class TaskReaper:
         watcher = self.store.watch(match(kind="task"), match_commit)
         # startup scan (reference: taskReaper.Run initial pass)
         for t in self.store.find("task"):
-            if t.desired_state == TaskState.REMOVE \
-                    and common.in_terminal_state(t):
+            if _removable(t):
                 self._cleanup.add(t.id)
             elif common.in_terminal_state(t):
                 self._dirty_slots.add(common.slot_tuple(t))
@@ -73,8 +87,7 @@ class TaskReaper:
                     t = ev.object
                     if ev.action == "remove":
                         continue
-                    if t.desired_state == TaskState.REMOVE \
-                            and common.in_terminal_state(t):
+                    if _removable(t):
                         self._cleanup.add(t.id)
                     elif common.in_terminal_state(t):
                         self._dirty_slots.add(common.slot_tuple(t))

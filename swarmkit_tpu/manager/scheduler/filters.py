@@ -173,8 +173,48 @@ class MaxReplicasFilter(Filter):
         return info.count_for_service(self._service) < self._max
 
 
-DEFAULT_FILTERS = (ReadyFilter, ResourceFilter, ConstraintFilter,
-                   PlatformFilter, HostPortFilter, MaxReplicasFilter)
+class PluginFilter(Filter):
+    """Node must carry the network/log driver plugins the task references
+    (filter.go:104-201).  Plugin entries on EngineDescription.plugins are
+    'Type/name' strings ('Network/overlay', 'Log/json-file').  Mirrors the
+    reference's leniencies: no engine description -> pass; a named log
+    driver only filters when the node reports ANY Log/ plugins (older
+    engines didn't report them)."""
+
+    name = "plugin"
+
+    def __init__(self) -> None:
+        self._log_driver = ""
+        self._net_drivers: list[str] = []
+
+    def set_task(self, task) -> bool:
+        # the RESOLVED driver (task.log_driver, populated by new_task from
+        # the spec or the cluster's TaskDefaults) — not the raw spec field
+        ld = task.log_driver if task.log_driver is not None \
+            else getattr(task.spec, "log_driver", None)
+        self._log_driver = ld.name if ld is not None \
+            and ld.name not in ("", "none") else ""
+        self._net_drivers = [a.driver for a in task.networks if a.driver]
+        return bool(self._log_driver or self._net_drivers)
+
+    def check(self, info: NodeInfo) -> bool:
+        desc = info.node.description
+        if desc is None:
+            return True   # not running an engine: plugins unsupported
+        plugins = set(desc.engine.plugins)
+        for d in self._net_drivers:
+            if f"Network/{d}" not in plugins:
+                return False
+        if self._log_driver:
+            reports_log = any(p.startswith("Log/") for p in plugins)
+            if reports_log and f"Log/{self._log_driver}" not in plugins:
+                return False
+        return True
+
+
+DEFAULT_FILTERS = (ReadyFilter, PluginFilter, ResourceFilter,
+                   ConstraintFilter, PlatformFilter, HostPortFilter,
+                   MaxReplicasFilter)
 
 
 class Pipeline:

@@ -313,6 +313,10 @@ async def test_task_reaper_retention():
 
 @async_test
 async def test_task_reaper_remove_desired():
+    """Desired-REMOVE tasks: an ASSIGNED one waits for the agent's
+    shutdown; an UNASSIGNED one (state < ASSIGNED — no agent will ever
+    touch it) is reaped immediately (reference task_reaper.go:181; the
+    Tasks.tla reaper exceptions <<new,null>>/<<pending,null>>)."""
     clock = FakeClock()
     store = MemoryStore(clock=clock.now)
     reaper = TaskReaper(store, clock=clock)
@@ -320,9 +324,11 @@ async def test_task_reaper_remove_desired():
     svc = make_service(replicas=1)
     t = common.new_task(None, svc, slot=1)
     t.desired_state = int(TaskState.REMOVE)
+    t.status.state = TaskState.ASSIGNED
+    t.node_id = "node1"
     await store.update(lambda tx: (tx.create(svc), tx.create(t)))
     await pump(clock)
-    assert store.get("task", t.id) is not None  # not terminal yet
+    assert store.get("task", t.id) is not None  # assigned: not terminal yet
 
     def shutdown(tx):
         cur = tx.get("task", t.id)
@@ -331,6 +337,15 @@ async def test_task_reaper_remove_desired():
     await store.update(shutdown)
     await pump(clock)
     assert store.get("task", t.id) is None
+
+    # unassigned (NEW/PENDING) + desired REMOVE: reaped right away —
+    # previously these leaked forever
+    t2 = common.new_task(None, svc, slot=2)
+    t2.desired_state = int(TaskState.REMOVE)
+    assert t2.status.state < TaskState.ASSIGNED
+    await store.update(lambda tx: tx.create(t2))
+    await pump(clock)
+    assert store.get("task", t2.id) is None
     await reaper.stop()
 
 
@@ -451,3 +466,31 @@ async def test_constraint_enforcer_evicts_on_shrunk_resources():
             if t.desired_state == TaskState.RUNNING]
     assert len(shutdown) == 1 and len(live) == 1
     await enforcer.stop()
+
+
+@async_test
+async def test_task_reaper_serviceless_orphaned():
+    """A serviceless task (network-attachment style) that goes ORPHANED has
+    no service to reconcile it away — the reaper deletes it directly
+    (reference task_reaper.go:174-175)."""
+    from swarmkit_tpu.api import Task, TaskStatus
+
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    reaper = TaskReaper(store, clock=clock)
+    await reaper.start()
+    t = Task(id="att1", service_id="", node_id="node1",
+             status=TaskStatus(state=TaskState.RUNNING),
+             desired_state=int(TaskState.RUNNING))
+    await store.update(lambda tx: tx.create(t))
+    await pump(clock)
+    assert store.get("task", "att1") is not None
+
+    def orphan(tx):
+        cur = tx.get("task", "att1")
+        cur.status.state = TaskState.ORPHANED
+        tx.update(cur)
+    await store.update(orphan)
+    await pump(clock)
+    assert store.get("task", "att1") is None
+    await reaper.stop()

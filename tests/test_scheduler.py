@@ -184,3 +184,68 @@ async def test_node_removal_frees_nothing_but_new_node_triggers_tick():
     await pump(clock)
     assert store.get("task", "task1").status.state == TaskState.ASSIGNED
     await sched.stop()
+
+
+def test_plugin_filter_network_and_log_drivers():
+    """PluginFilter (reference filter.go:104-201): a task attached to a
+    driver-named network only lands on nodes whose engine reports the
+    Network/<driver> plugin; named log drivers filter only when the node
+    reports Log/ plugins at all."""
+    from swarmkit_tpu.api.specs import Driver
+    from swarmkit_tpu.api.types import NetworkAttachment
+    from swarmkit_tpu.manager.scheduler.filters import PluginFilter
+    from swarmkit_tpu.manager.scheduler.nodeinfo import NodeInfo
+
+    def info(plugins, with_desc=True):
+        n = make_node(1)
+        if with_desc:
+            n.description.engine.plugins = list(plugins)
+        else:
+            n.description = None
+        return NodeInfo(node=n)
+
+    f = PluginFilter()
+    t = make_task("svc1")
+    # no plugin references: filter disabled
+    assert f.set_task(t) is False
+
+    t.networks = [NetworkAttachment(network_id="n1", driver="overlay")]
+    assert f.set_task(t) is True
+    assert f.check(info(["Network/overlay"])) is True
+    assert f.check(info(["Network/bridge"])) is False
+    assert f.check(info([])) is False
+    assert f.check(info([], with_desc=False)) is True  # no engine: pass
+
+    t2 = make_task("svc2")
+    t2.spec.log_driver = Driver(name="fluentd")
+    assert f.set_task(t2) is True
+    # node reports no Log/ plugins at all: lenient pass (older engine)
+    assert f.check(info(["Network/overlay"])) is True
+    assert f.check(info(["Log/json-file"])) is False
+    assert f.check(info(["Log/fluentd"])) is True
+
+
+def test_plugin_filter_uses_resolved_cluster_default_log_driver():
+    """new_task resolves ClusterSpec.task_defaults.log_driver onto
+    task.log_driver; the PluginFilter reads the RESOLVED field so
+    cluster-default drivers are filtered too (reference: newTask task.go +
+    filter.go t.LogDriver)."""
+    from swarmkit_tpu.api import Cluster, ClusterSpec, Service, ServiceSpec
+    from swarmkit_tpu.api.specs import Driver, TaskDefaults
+    from swarmkit_tpu.manager.orchestrator import common
+    from swarmkit_tpu.manager.scheduler.filters import PluginFilter
+    from swarmkit_tpu.manager.scheduler.nodeinfo import NodeInfo
+
+    cluster = Cluster(id="c1", spec=ClusterSpec(
+        task_defaults=TaskDefaults(log_driver=Driver(name="fluentd"))))
+    svc = Service(id="s1", spec=ServiceSpec(task=TaskSpec()))
+    t = common.new_task(cluster, svc, slot=1)
+    assert t.log_driver is not None and t.log_driver.name == "fluentd"
+
+    f = PluginFilter()
+    assert f.set_task(t) is True
+    n = make_node(1)
+    n.description.engine.plugins = ["Log/json-file"]
+    assert f.check(NodeInfo(node=n)) is False
+    n.description.engine.plugins = ["Log/fluentd"]
+    assert f.check(NodeInfo(node=n)) is True
