@@ -48,11 +48,19 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("service-create")
     sp.add_argument("--name", required=True)
     sp.add_argument("--image", required=True)
+    sp.add_argument("--mode", choices=["replicated", "global"],
+                    default="replicated")
     sp.add_argument("--replicas", type=int, default=1)
     sp.add_argument("--env", action="append", default=[])
     sp.add_argument("--constraint", action="append", default=[])
     sp.add_argument("--publish", action="append", default=[],
                     help="published:target port, e.g. 8080:80")
+    sp.add_argument("--network", action="append", default=[],
+                    help="attach to network (name or id; repeatable)")
+    sp.add_argument("--secret", action="append", default=[],
+                    help="expose secret to the task (name; repeatable)")
+    sp.add_argument("--config", action="append", default=[],
+                    help="expose config to the task (name; repeatable)")
     sub.add_parser("service-ls")
     for name in ("service-inspect", "service-rm"):
         sub.add_parser(name).add_argument("id")
@@ -62,6 +70,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("task-ls")
     sp.add_argument("--service", default=None)
+    sub.add_parser("task-inspect").add_argument("id")
 
     sp = sub.add_parser("service-update")
     sp.add_argument("id")
@@ -95,6 +104,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("network-create")
     sp.add_argument("--name", required=True)
+    sp.add_argument("--driver", default=None,
+                    help="network driver name (scheduler plugin-filters "
+                         "driver-named networks)")
+    sp.add_argument("--subnet", action="append", default=[],
+                    help="CIDR pool (repeatable; default: auto 10.x.0.0/24)")
     sub.add_parser("network-ls")
     sub.add_parser("network-rm").add_argument("id")
 
@@ -107,13 +121,30 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _service_spec(args) -> dict:
+def _service_spec(args, networks=None, secrets=None, configs=None) -> dict:
+    container = {"image": args.image, "env": args.env}
+    if secrets:
+        container["secrets"] = [
+            {"secret_id": sid, "secret_name": name}
+            for sid, name in secrets]
+    if configs:
+        container["configs"] = [
+            {"config_id": cid, "config_name": name}
+            for cid, name in configs]
+    task = {"container": container,
+            "placement": {"constraints": args.constraint}}
+    if networks:
+        task["networks"] = list(networks)
     spec = {
         "annotations": {"name": args.name},
-        "task": {"container": {"image": args.image, "env": args.env},
-                 "placement": {"constraints": args.constraint}},
-        "replicated": {"replicas": args.replicas},
+        "task": task,
     }
+    if getattr(args, "mode", "replicated") == "global":
+        from swarmkit_tpu.api.specs import Mode
+        spec["mode"] = int(Mode.GLOBAL)
+        spec["global_"] = {}
+    else:
+        spec["replicated"] = {"replicas": args.replicas}
     if args.publish:
         ports = []
         for spec_str in args.publish:
@@ -123,6 +154,24 @@ def _service_spec(args) -> dict:
                           "publish_mode": "ingress"})
         spec["endpoint"] = {"ports": ports}
     return spec
+
+
+async def _resolve(client, kind: str, names: list[str]) -> list:
+    """Resolve names-or-ids to (id, name) pairs via <kind>.ls."""
+    if not names:
+        return []
+    objs = await client.call(f"{kind}.ls")
+    by_key = {}
+    for o in objs:
+        nm = o["spec"]["annotations"]["name"]
+        by_key[nm] = (o["id"], nm)
+        by_key[o["id"]] = (o["id"], nm)
+    out = []
+    for n in names:
+        if n not in by_key:
+            raise CtlError(f"{kind} {n!r} not found", "not_found")
+        out.append(by_key[n])
+    return out
 
 
 async def run(args, out=None) -> int:
@@ -185,8 +234,13 @@ async def run(args, out=None) -> int:
                 p["labels_rm"] = list(args.label_rm)
             show(await client.call("node.update", **p))
         elif c == "service-create":
+            networks = [nid for nid, _ in
+                        await _resolve(client, "network", args.network)]
+            secrets = await _resolve(client, "secret", args.secret)
+            configs = await _resolve(client, "config", args.config)
             show(await client.call("service.create",
-                                   spec=_service_spec(args)))
+                                   spec=_service_spec(args, networks,
+                                                      secrets, configs)))
         elif c == "service-ls":
             for s in await client.call("service.ls"):
                 name = s["spec"]["annotations"]["name"]
@@ -264,14 +318,21 @@ async def run(args, out=None) -> int:
                 tag = "ERR" if m["stream"] == 2 else "OUT"
                 out.write(f"{m['task_id'][:12]}@{m['node_id'][:12]} "
                           f"{tag} | {m['data']}\n")
+        elif c == "task-inspect":
+            show(await client.call("task.inspect", id=args.id))
         elif c == "task-ls":
             ids = [args.service] if args.service else None
             for t in await client.call("task.ls", service_ids=ids):
                 state = TaskState(t.get("status", {}).get("state", 0)).name
                 out.write(f"{t['id']}\t{t.get('node_id','')}\t{state}\n")
         elif c == "network-create":
-            show(await client.call("network.create",
-                                   spec={"annotations": {"name": args.name}}))
+            nspec: dict = {"annotations": {"name": args.name}}
+            if args.driver:
+                nspec["driver_config"] = {"name": args.driver}
+            if args.subnet:
+                nspec["ipam"] = {"configs": [{"subnet": sn}
+                                             for sn in args.subnet]}
+            show(await client.call("network.create", spec=nspec))
         elif c == "network-ls":
             for n in await client.call("network.ls"):
                 out.write(f"{n['id']}\t{n['spec']['annotations']['name']}\n")

@@ -36,6 +36,11 @@ class Scheduler:
         self.clock = clock or SystemClock()
         self.node_set = NodeSet()
         self.unassigned: dict[str, object] = {}  # taskid -> task
+        # PENDING tasks that arrived with a node already chosen (global
+        # services pin one task per node): the scheduler still validates
+        # the fit and flips them to ASSIGNED (reference:
+        # pendingPreassignedTasks + processPreassignedTasks scheduler.go)
+        self.preassigned: dict[str, object] = {}
         self.all_tasks: dict[str, object] = {}
         self.pipeline = Pipeline()
         self._task: Optional[asyncio.Task] = None
@@ -48,8 +53,10 @@ class Scheduler:
         watcher = self.store.watch(match(kind="task"), match(kind="node"),
                                    match_commit)
         for t in self.store.find("task"):
-            if t.status.state < TaskState.ASSIGNED:
-                if t.status.state == TaskState.PENDING:
+            if t.status.state == TaskState.PENDING:
+                if t.node_id:
+                    self.preassigned[t.id] = t
+                else:
                     self.unassigned[t.id] = t
             self.all_tasks[t.id] = t
         for n in self.store.find("node"):
@@ -106,7 +113,8 @@ class Scheduler:
         if isinstance(ev, EventCommit):
             # only retry unassigned work when something actually changed
             # since the last tick — a commit alone can't make progress
-            fire = self._changed_since_tick and bool(self.unassigned)
+            fire = self._changed_since_tick \
+                and bool(self.unassigned or self.preassigned)
             return fire
         if not isinstance(ev, Event):
             return False
@@ -131,6 +139,7 @@ class Scheduler:
             if ev.action == "remove":
                 self.all_tasks.pop(t.id, None)
                 self.unassigned.pop(t.id, None)
+                self.preassigned.pop(t.id, None)
                 if t.node_id:
                     info = self.node_set.get(t.node_id)
                     if info is not None:
@@ -156,11 +165,15 @@ class Scheduler:
                 info = self.node_set.get(t.node_id)
                 if info is not None:
                     info.record_failure(t.service_id, self.clock.now())
-            if t.status.state == TaskState.PENDING and not t.node_id \
+            if t.status.state == TaskState.PENDING \
                     and t.desired_state <= TaskState.RUNNING:
-                self.unassigned[t.id] = t
+                if t.node_id:
+                    self.preassigned[t.id] = t
+                else:
+                    self.unassigned[t.id] = t
                 return True
             self.unassigned.pop(t.id, None)
+            self.preassigned.pop(t.id, None)
             return False
         return False
 
@@ -176,6 +189,8 @@ class Scheduler:
     async def tick(self) -> None:
         """Schedule everything currently unassigned."""
         self._changed_since_tick = False
+        if self.preassigned:
+            await self._process_preassigned()
         groups: dict[tuple, list] = {}
         for t in list(self.unassigned.values()):
             groups.setdefault(self._common_spec_key(t), []).append(t)
@@ -190,6 +205,57 @@ class Scheduler:
         # (reference: noSuitableNode scheduler.go — sets task status message)
         await self._explain_unplaced(
             [t for t in self.unassigned.values() if t.id not in placed])
+
+    async def _process_preassigned(self) -> None:
+        """Validate PENDING tasks whose node is already chosen and flip
+        them to ASSIGNED (reference: processPreassignedTasks + taskFitNode
+        scheduler.go:34-38).  A task whose pinned node fails the pipeline
+        stays pending and is retried when the node changes."""
+        from swarmkit_tpu.store.errors import ErrSequenceConflict
+
+        fits = []
+        for t in list(self.preassigned.values()):
+            info = self.node_set.get(t.node_id)
+            if info is None:
+                continue
+            # the event mirror already booked this task's reservation on
+            # its pinned node — take it out so the task does not compete
+            # with ITSELF (reference: processPreassignedTasks removes the
+            # task from nodeInfo before taskFitNode)
+            had = info.remove_task(t)
+            self.pipeline.set_task(t)
+            if self.pipeline.process(info):
+                fits.append((t, info))
+            elif had:
+                info.add_task(t)
+        if not fits:
+            return
+        batch = self.store.batch()
+        applied: dict[str, bool] = {}
+        for t, info in fits:
+            def txn(tx, t=t):
+                current = tx.get("task", t.id)
+                if current is None \
+                        or current.status.state != TaskState.PENDING \
+                        or current.node_id != t.node_id \
+                        or current.desired_state > TaskState.RUNNING:
+                    return False
+                current.status.state = TaskState.ASSIGNED
+                current.status.message = "scheduler confirmed node fit"
+                current.status.timestamp = self.clock.now()
+                tx.update(current)
+                return True
+
+            try:
+                applied[t.id] = await batch.update(txn)
+            except ErrSequenceConflict:
+                applied[t.id] = False
+        await batch.commit()
+        for t, info in fits:
+            if applied.get(t.id):
+                self.preassigned.pop(t.id, None)
+            # re-book the reservation either way (the fit check removed it)
+            info.add_task(t)
 
     async def _explain_unplaced(self, tasks: list) -> None:
         updates = []

@@ -601,3 +601,77 @@ async def test_swarmd_listen_debug_diagnoses_wedged_store():
         await node._debug_server.stop()
         await node._ctl_server.stop()
         await node.stop()
+
+
+@async_test
+async def test_swarmctl_global_mode_networks_secrets_and_task_inspect():
+    """Round-trip the round-5 CLI additions: network-create --driver
+    --subnet, service-create --mode global / --network / --secret,
+    task-inspect (reference: cmd/swarmctl service flags + task inspect)."""
+    from swarmkit_tpu.cmd import swarmctl as ctl_cmd
+    from swarmkit_tpu.cmd import swarmd
+
+    tmp = tempfile.TemporaryDirectory(prefix="swarmd-cli5-")
+    sock = os.path.join(tmp.name, "swarmd.sock")
+    args = swarmd.build_parser().parse_args([
+        "--state-dir", os.path.join(tmp.name, "state"),
+        "--listen-control-api", sock,
+        "--node-id", "m1", "--manager",
+        "--election-tick", "4", "--backend", "inproc",
+        "--executor", "test",
+    ])
+    node = await swarmd.run(args)
+    try:
+        for _ in range(200):
+            if node.is_leader():
+                break
+            await asyncio.sleep(0.05)
+
+        async def ctl(*argv):
+            out = io.StringIO()
+            rc = await ctl_cmd.run(
+                ctl_cmd.build_parser().parse_args(
+                    ["--socket", sock, *argv]), out=out)
+            return rc, out.getvalue()
+
+        rc, out = await ctl("network-create", "--name", "front",
+                            "--subnet", "10.42.0.0/24")
+        assert rc == 0, out
+        net = json.loads(out)
+        rc, out = await ctl("secret-create", "apikey", "--data", "k3y")
+        assert rc == 0, out
+
+        # unknown network/secret names fail cleanly
+        rc, out = await ctl("service-create", "--name", "bad",
+                            "--image", "img", "--network", "nope")
+        assert rc == 1
+
+        rc, out = await ctl(
+            "service-create", "--name", "g1", "--image", "img",
+            "--mode", "global", "--network", "front",
+            "--secret", "apikey")
+        assert rc == 0, out
+        svc = json.loads(out)
+        assert svc["spec"]["mode"] == 1 and "global_" in svc["spec"]
+        assert svc["spec"]["task"]["networks"] == [net["id"]]
+        refs = svc["spec"]["task"]["container"]["secrets"]
+        assert refs and refs[0]["secret_name"] == "apikey"
+
+        # global mode: one task per node, with the network allocated
+        for _ in range(300):
+            rc, out = await ctl("task-ls", "--service", svc["id"])
+            lines = [l for l in out.splitlines() if "RUNNING" in l]
+            if len(lines) == 1:
+                break
+            await asyncio.sleep(0.05)
+        assert len(lines) == 1, out
+        task_id = lines[0].split("\t")[0]
+        rc, out = await ctl("task-inspect", task_id)
+        assert rc == 0, out
+        t = json.loads(out)
+        assert t["networks"] and t["networks"][0]["network_id"] == net["id"]
+        addr = t["networks"][0]["addresses"][0]
+        assert addr.startswith("10.42.0."), addr
+    finally:
+        await node._ctl_server.stop()
+        await node.stop()

@@ -249,3 +249,65 @@ def test_plugin_filter_uses_resolved_cluster_default_log_driver():
     assert f.check(NodeInfo(node=n)) is False
     n.description.engine.plugins = ["Log/fluentd"]
     assert f.check(NodeInfo(node=n)) is True
+
+
+@async_test
+async def test_preassigned_pending_tasks_confirmed_to_assigned():
+    """Global-service tasks arrive PENDING with the node already pinned;
+    the scheduler validates the fit and flips them to ASSIGNED — and a
+    task pinned to a node that fails the pipeline stays pending until the
+    node changes (reference: pendingPreassignedTasks +
+    processPreassignedTasks scheduler.go)."""
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    sched = Scheduler(store, clock=clock)
+    good = make_node(1)
+    tiny = make_node(2, cpus=1_000_000, mem=1 << 20)   # too small
+    await store.update(lambda tx: [tx.create(good), tx.create(tiny)])
+    await sched.start()
+
+    t_ok = make_task(1)
+    t_ok.node_id = "node1"
+    t_ok.status.state = TaskState.PENDING
+    t_big = make_task(2, cpus=2_000_000_000, mem=1 << 30)
+    t_big.node_id = "node2"
+    t_big.status.state = TaskState.PENDING
+    await store.update(lambda tx: [tx.create(t_ok), tx.create(t_big)])
+    await pump(clock)
+
+    assert store.get("task", t_ok.id).status.state == TaskState.ASSIGNED
+    assert store.get("task", t_ok.id).node_id == "node1"
+    # pinned node lacks resources: stays PENDING (retried on node change)
+    assert store.get("task", t_big.id).status.state == TaskState.PENDING
+
+    # the pinned node grows -> the pending preassigned task is confirmed
+    n2 = store.get("node", "node2")
+    n2.description.resources.nano_cpus = 8_000_000_000
+    n2.description.resources.memory_bytes = 8 << 30
+    await store.update(lambda tx: tx.update(n2))
+    await pump(clock)
+    assert store.get("task", t_big.id).status.state == TaskState.ASSIGNED
+    await sched.stop()
+
+
+@async_test
+async def test_preassigned_task_does_not_compete_with_its_own_reservation():
+    """The event mirror books a pinned PENDING task's reservation onto its
+    node; the fit check must exclude it or a task reserving more than half
+    the node's resources deadlocks itself PENDING forever (reference:
+    processPreassignedTasks removes the task from nodeInfo first)."""
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    sched = Scheduler(store, clock=clock)
+    node = make_node(1, cpus=3_000_000_000, mem=4 << 30)
+    await store.update(lambda tx: tx.create(node))
+    await sched.start()
+    # reserves 2/3 of the node: with the self-competition bug, available
+    # shows 1e9 < 2e9 and the task never leaves PENDING
+    t = make_task(1, cpus=2_000_000_000, mem=1 << 30)
+    t.node_id = "node1"
+    t.status.state = TaskState.PENDING
+    await store.update(lambda tx: tx.create(t))
+    await pump(clock)
+    assert store.get("task", t.id).status.state == TaskState.ASSIGNED
+    await sched.stop()
