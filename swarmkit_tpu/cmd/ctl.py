@@ -155,6 +155,25 @@ async def dispatch_control(c, method: str, p: dict):
         if collector is not None:
             out["gauges"] = collector.snapshot()
         return out
+    if method == "cluster.update":
+        # mutate the live spec (reference: cmd/swarmctl/cluster/update.go
+        # reads-modifies-writes ClusterSpec; components re-read on
+        # EventUpdateCluster — reaper retention, dispatcher heartbeat
+        # period, CA cert expiry)
+        cl = c.get_cluster()
+        spec = cl.spec.copy()
+        if "task_history" in p:
+            spec.orchestration.task_history_retention_limit = \
+                int(p["task_history"])
+        if "heartbeat_period" in p:
+            spec.dispatcher.heartbeat_period = float(p["heartbeat_period"])
+        if "cert_expiry" in p:
+            spec.ca_config.node_cert_expiry = float(p["cert_expiry"])
+        cl2 = await c.update_cluster(
+            cl.id, spec, version=cl.meta.version.index,
+            rotate_worker_token=bool(p.get("rotate_worker_token")),
+            rotate_manager_token=bool(p.get("rotate_manager_token")))
+        return cl2.to_dict()
     if method == "cluster.rotate-ca":
         return await c.rotate_root_ca()
     if method == "cluster.autolock":

@@ -24,6 +24,15 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser("metrics")
     sub.add_parser("cluster-tokens")
     sub.add_parser("cluster-rotate-ca")
+    sp = sub.add_parser("cluster-update")
+    sp.add_argument("--task-history", type=int, default=None,
+                    help="dead tasks retained per slot (reaper)")
+    sp.add_argument("--heartbeat-period", type=float, default=None,
+                    help="agent heartbeat period seconds (dispatcher)")
+    sp.add_argument("--cert-expiry", type=float, default=None,
+                    help="node certificate lifetime seconds (CA)")
+    sp.add_argument("--rotate-worker-token", action="store_true")
+    sp.add_argument("--rotate-manager-token", action="store_true")
     sp = sub.add_parser("cluster-autolock")
     sp.add_argument("enabled", choices=["on", "off"])
     sp = sub.add_parser("cluster-unlock-key")
@@ -50,7 +59,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--image", required=True)
     sp.add_argument("--mode", choices=["replicated", "global"],
                     default="replicated")
-    sp.add_argument("--replicas", type=int, default=1)
+    sp.add_argument("--replicas", type=int, default=None,
+                    help="replica count (replicated mode only; default 1)")
     sp.add_argument("--env", action="append", default=[])
     sp.add_argument("--constraint", action="append", default=[])
     sp.add_argument("--publish", action="append", default=[],
@@ -61,6 +71,15 @@ def build_parser() -> argparse.ArgumentParser:
                     help="expose secret to the task (name; repeatable)")
     sp.add_argument("--config", action="append", default=[],
                     help="expose config to the task (name; repeatable)")
+    sp.add_argument("--reserve-cpu", type=float, default=None,
+                    help="CPUs to reserve per task (cores, e.g. 0.5)")
+    sp.add_argument("--reserve-memory", type=int, default=None,
+                    help="bytes of memory to reserve per task")
+    sp.add_argument("--restart-condition", default=None,
+                    choices=["any", "failure", "none"])
+    sp.add_argument("--restart-delay", type=float, default=None,
+                    help="seconds between restarts")
+    sp.add_argument("--restart-max-attempts", type=int, default=None)
     sub.add_parser("service-ls")
     for name in ("service-inspect", "service-rm"):
         sub.add_parser(name).add_argument("id")
@@ -135,6 +154,22 @@ def _service_spec(args, networks=None, secrets=None, configs=None) -> dict:
             "placement": {"constraints": args.constraint}}
     if networks:
         task["networks"] = list(networks)
+    if args.reserve_cpu is not None or args.reserve_memory is not None:
+        task["resources"] = {"reservations": {
+            "nano_cpus": int((args.reserve_cpu or 0) * 1e9),
+            "memory_bytes": args.reserve_memory or 0}}
+    if args.restart_condition is not None \
+            or args.restart_delay is not None \
+            or args.restart_max_attempts is not None:
+        restart = {}
+        if args.restart_condition is not None:
+            restart["condition"] = {"none": 0, "failure": 1,
+                                    "any": 2}[args.restart_condition]
+        if args.restart_delay is not None:
+            restart["delay"] = args.restart_delay
+        if args.restart_max_attempts is not None:
+            restart["max_attempts"] = args.restart_max_attempts
+        task["restart"] = restart
     spec = {
         "annotations": {"name": args.name},
         "task": task,
@@ -144,7 +179,8 @@ def _service_spec(args, networks=None, secrets=None, configs=None) -> dict:
         spec["mode"] = int(Mode.GLOBAL)
         spec["global_"] = {}
     else:
-        spec["replicated"] = {"replicas": args.replicas}
+        spec["replicated"] = {"replicas": 1 if args.replicas is None
+                              else args.replicas}
     if args.publish:
         ports = []
         for spec_str in args.publish:
@@ -190,6 +226,19 @@ async def run(args, out=None) -> int:
             show(await client.call("cluster.metrics"))
         elif c == "cluster-tokens":
             show(await client.call("cluster.unlock-key"))
+        elif c == "cluster-update":
+            p2: dict = {}
+            if args.task_history is not None:
+                p2["task_history"] = args.task_history
+            if args.heartbeat_period is not None:
+                p2["heartbeat_period"] = args.heartbeat_period
+            if args.cert_expiry is not None:
+                p2["cert_expiry"] = args.cert_expiry
+            if args.rotate_worker_token:
+                p2["rotate_worker_token"] = True
+            if args.rotate_manager_token:
+                p2["rotate_manager_token"] = True
+            show(await client.call("cluster.update", **p2))
         elif c == "cluster-rotate-ca":
             show(await client.call("cluster.rotate-ca"))
         elif c == "cluster-autolock":
@@ -234,6 +283,11 @@ async def run(args, out=None) -> int:
                 p["labels_rm"] = list(args.label_rm)
             show(await client.call("node.update", **p))
         elif c == "service-create":
+            if args.mode == "global" and args.replicas is not None:
+                print("error: --replicas conflicts with --mode global "
+                      "(global services run one task per node)",
+                      file=sys.stderr)
+                return 1
             networks = [nid for nid, _ in
                         await _resolve(client, "network", args.network)]
             secrets = await _resolve(client, "secret", args.secret)

@@ -169,8 +169,10 @@ class Scheduler:
                     and t.desired_state <= TaskState.RUNNING:
                 if t.node_id:
                     self.preassigned[t.id] = t
+                    self.unassigned.pop(t.id, None)
                 else:
                     self.unassigned[t.id] = t
+                    self.preassigned.pop(t.id, None)
                 return True
             self.unassigned.pop(t.id, None)
             self.preassigned.pop(t.id, None)
@@ -202,9 +204,11 @@ class Scheduler:
         if decisions:
             await self._apply(decisions)
         # annotate tasks no filter would place so operators can see why
-        # (reference: noSuitableNode scheduler.go — sets task status message)
+        # (reference: noSuitableNode scheduler.go — sets task status
+        # message; taskFitNode does the same for preassigned misfits)
         await self._explain_unplaced(
-            [t for t in self.unassigned.values() if t.id not in placed])
+            [t for t in self.unassigned.values() if t.id not in placed]
+            + list(self.preassigned.values()))
 
     async def _process_preassigned(self) -> None:
         """Validate PENDING tasks whose node is already chosen and flip
@@ -261,8 +265,15 @@ class Scheduler:
         updates = []
         for t in tasks:
             self.pipeline.set_task(t)
-            reasons = {self.pipeline.explain(i)
-                       for i in self.node_set.nodes.values()} or {"no nodes"}
+            if t.node_id:
+                # pinned (preassigned): explain the fit against ITS node
+                info = self.node_set.get(t.node_id)
+                reasons = {self.pipeline.explain(info)} if info is not None \
+                    else {f"node {t.node_id} not in scheduler view"}
+            else:
+                reasons = {self.pipeline.explain(i)
+                           for i in self.node_set.nodes.values()} \
+                    or {"no nodes"}
             msg = "; ".join(sorted(r for r in reasons if r)) or \
                 "no suitable node"
             if msg != t.status.message:

@@ -675,3 +675,87 @@ async def test_swarmctl_global_mode_networks_secrets_and_task_inspect():
     finally:
         await node._ctl_server.stop()
         await node.stop()
+
+
+def test_service_spec_builder_resources_and_restart():
+    """service-create --reserve-cpu/--reserve-memory/--restart-* flags map
+    onto TaskSpec.resources.reservations and TaskSpec.restart (reference:
+    cmd/swarmctl/service/flagparser flags.go/restart.go)."""
+    from swarmkit_tpu.cmd import swarmctl as ctl_cmd
+
+    args = ctl_cmd.build_parser().parse_args([
+        "service-create", "--name", "r", "--image", "img",
+        "--reserve-cpu", "0.5", "--reserve-memory", "1048576",
+        "--restart-condition", "failure", "--restart-delay", "2.5",
+        "--restart-max-attempts", "3"])
+    spec = ctl_cmd._service_spec(args)
+    res = spec["task"]["resources"]["reservations"]
+    assert res["nano_cpus"] == 500_000_000
+    assert res["memory_bytes"] == 1048576
+    r = spec["task"]["restart"]
+    assert r == {"condition": 1, "delay": 2.5, "max_attempts": 3}
+    # spec round-trips through the typed model
+    from swarmkit_tpu.api import ServiceSpec
+    from swarmkit_tpu.api.specs import RestartCondition
+    typed = ServiceSpec.from_dict(spec)
+    assert typed.task.resources.reservations.nano_cpus == 500_000_000
+    assert typed.task.restart.condition == RestartCondition.ON_FAILURE
+    assert typed.task.restart.max_attempts == 3
+
+
+@async_test
+async def test_swarmctl_cluster_update_settings_flow_to_components():
+    """cluster-update edits the replicated ClusterSpec; components re-read
+    it on EventUpdateCluster (reference: cmd/swarmctl/cluster/update.go;
+    dynamic config per SURVEY §5)."""
+    from swarmkit_tpu.cmd import swarmctl as ctl_cmd
+    from swarmkit_tpu.cmd import swarmd
+
+    tmp = tempfile.TemporaryDirectory(prefix="swarmd-clup-")
+    sock = os.path.join(tmp.name, "swarmd.sock")
+    args = swarmd.build_parser().parse_args([
+        "--state-dir", os.path.join(tmp.name, "state"),
+        "--listen-control-api", sock,
+        "--node-id", "m1", "--manager",
+        "--election-tick", "4", "--backend", "inproc",
+        "--executor", "test",
+    ])
+    node = await swarmd.run(args)
+    try:
+        for _ in range(200):
+            if node.is_leader():
+                break
+            await asyncio.sleep(0.05)
+
+        async def ctl(*argv):
+            out = io.StringIO()
+            rc = await ctl_cmd.run(
+                ctl_cmd.build_parser().parse_args(
+                    ["--socket", sock, *argv]), out=out)
+            return rc, out.getvalue()
+
+        rc, out = await ctl("cluster-update", "--task-history", "9",
+                            "--heartbeat-period", "2.5",
+                            "--cert-expiry", "3600")
+        assert rc == 0, out
+        cl = json.loads(out)
+        assert cl["spec"]["orchestration"][
+            "task_history_retention_limit"] == 9
+        assert cl["spec"]["dispatcher"]["heartbeat_period"] == 2.5
+        assert cl["spec"]["ca_config"]["node_cert_expiry"] == 3600
+
+        # the stored object reflects it (components watch this object)
+        lead = node._running_manager()
+        stored = lead.store.find("cluster")[0]
+        assert stored.spec.orchestration.task_history_retention_limit == 9
+        assert stored.spec.dispatcher.heartbeat_period == 2.5
+
+        # token rotation changes the worker join token
+        old = stored.root_ca.join_token_worker
+        rc, out = await ctl("cluster-update", "--rotate-worker-token")
+        assert rc == 0, out
+        new = lead.store.find("cluster")[0].root_ca.join_token_worker
+        assert new and new != old
+    finally:
+        await node._ctl_server.stop()
+        await node.stop()
