@@ -248,6 +248,8 @@ async def dispatch_control(c, method: str, p: dict):
         return (await c.create_network(spec)).to_dict()
     if method == "network.ls":
         return [n.to_dict() for n in c.list_networks()]
+    if method == "network.inspect":
+        return c.get_network(p["id"]).to_dict()
     if method == "network.rm":
         await c.remove_network(p["id"])
         return {}
@@ -256,12 +258,16 @@ async def dispatch_control(c, method: str, p: dict):
         return (await c.create_secret(spec)).to_dict()
     if method == "secret.ls":
         return [s.to_dict() for s in c.list_secrets()]
+    if method == "secret.inspect":
+        return c.get_secret(p["id"]).to_dict()
     if method == "secret.rm":
         await c.remove_secret(p["id"])
         return {}
     if method == "config.create":
         spec = ConfigSpec.from_dict(p["spec"])
         return (await c.create_config(spec)).to_dict()
+    if method == "config.inspect":
+        return c.get_config(p["id"]).to_dict()
     if method == "config.ls":
         return [s.to_dict() for s in c.list_configs()]
     if method == "config.rm":

@@ -129,6 +129,7 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--subnet", action="append", default=[],
                     help="CIDR pool (repeatable; default: auto 10.x.0.0/24)")
     sub.add_parser("network-ls")
+    sub.add_parser("network-inspect").add_argument("id")
     sub.add_parser("network-rm").add_argument("id")
 
     for kind in ("secret", "config"):
@@ -136,6 +137,7 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("name")
         sp.add_argument("--data", required=True)
         sub.add_parser(f"{kind}-ls")
+        sub.add_parser(f"{kind}-inspect").add_argument("id")
         sub.add_parser(f"{kind}-rm").add_argument("id")
     return p
 
@@ -387,6 +389,8 @@ async def run(args, out=None) -> int:
                 nspec["ipam"] = {"configs": [{"subnet": sn}
                                              for sn in args.subnet]}
             show(await client.call("network.create", spec=nspec))
+        elif c == "network-inspect":
+            show(await client.call("network.inspect", id=args.id))
         elif c == "network-ls":
             for n in await client.call("network.ls"):
                 out.write(f"{n['id']}\t{n['spec']['annotations']['name']}\n")
@@ -402,6 +406,9 @@ async def run(args, out=None) -> int:
                 spec={"annotations": {"name": args.name},
                       "data": {"__b64__": base64.b64encode(
                           args.data.encode()).decode()}}))
+        elif c in ("secret-inspect", "config-inspect"):
+            show(await client.call(f"{c.split('-')[0]}.inspect",
+                                   id=args.id))
         elif c in ("secret-ls", "config-ls"):
             kind = c.split("-")[0]
             for s in await client.call(f"{kind}.ls"):

@@ -94,8 +94,39 @@ class Dispatcher:
         self._running = True
         if mark_unknown:
             await self._mark_nodes_unknown()
+        self._apply_cluster_config()
         self._process_task = asyncio.get_running_loop().create_task(
             self._process_updates_loop())
+        # watcher registered HERE (synchronously) so a cluster update
+        # committed right after start() cannot slip past it; kept on self
+        # so stop() can close it even when the task never got scheduled
+        self._cfg_watcher = self.store.watch(
+            match(kind="cluster", action="update"))
+        self._bg.append(asyncio.get_running_loop().create_task(
+            self._watch_cluster_config(self._cfg_watcher)))
+
+    def _apply_cluster_config(self) -> None:
+        """Adopt DispatcherConfig from the replicated cluster spec
+        (reference: dispatcher.go:242-244 initial read)."""
+        clusters = self.store.find("cluster")
+        if not clusters:
+            return
+        period = clusters[0].spec.dispatcher.heartbeat_period
+        if period > 0 and period != self.nodes.period:
+            log.info("dispatcher heartbeat period -> %.2fs", period)
+            self.nodes.period = period
+
+    async def _watch_cluster_config(self, watcher) -> None:
+        """Re-read DispatcherConfig on cluster updates (reference:
+        dispatcher.go:310-315 — heartbeat period changes apply to every
+        subsequent heartbeat RPC's returned period)."""
+        try:
+            async for _ in watcher:
+                self._apply_cluster_config()
+        except asyncio.CancelledError:
+            pass
+        finally:
+            watcher.close()
 
     async def stop(self) -> None:
         self._running = False
@@ -104,6 +135,9 @@ class Dispatcher:
             t.cancel()
         self._down_nodes.clear()
         self._bg.clear()
+        if getattr(self, "_cfg_watcher", None) is not None:
+            self._cfg_watcher.close()
+            self._cfg_watcher = None
         if self._process_task is not None:
             self._updates_ready.set()
             self._process_task.cancel()

@@ -759,3 +759,57 @@ async def test_swarmctl_cluster_update_settings_flow_to_components():
     finally:
         await node._ctl_server.stop()
         await node.stop()
+
+
+@async_test
+async def test_swarmctl_inspect_verbs():
+    """network/secret/config-inspect round-trip (reference: cmd/swarmctl
+    inspect subcommands; secret payloads stay redacted)."""
+    from swarmkit_tpu.cmd import swarmctl as ctl_cmd
+    from swarmkit_tpu.cmd import swarmd
+
+    tmp = tempfile.TemporaryDirectory(prefix="swarmd-insp-")
+    sock = os.path.join(tmp.name, "swarmd.sock")
+    args = swarmd.build_parser().parse_args([
+        "--state-dir", os.path.join(tmp.name, "state"),
+        "--listen-control-api", sock,
+        "--node-id", "m1", "--manager",
+        "--election-tick", "4", "--backend", "inproc",
+        "--executor", "test",
+    ])
+    node = await swarmd.run(args)
+    try:
+        for _ in range(200):
+            if node.is_leader():
+                break
+            await asyncio.sleep(0.05)
+
+        async def ctl(*argv):
+            out = io.StringIO()
+            rc = await ctl_cmd.run(
+                ctl_cmd.build_parser().parse_args(
+                    ["--socket", sock, *argv]), out=out)
+            return rc, out.getvalue()
+
+        rc, out = await ctl("network-create", "--name", "n1",
+                            "--subnet", "10.77.0.0/24")
+        nid = json.loads(out)["id"]
+        rc, out = await ctl("network-inspect", nid)
+        assert rc == 0, out
+        n = json.loads(out)
+        assert n["spec"]["annotations"]["name"] == "n1"
+
+        rc, out = await ctl("secret-create", "s1", "--data", "topsecret")
+        sid = json.loads(out)["id"]
+        rc, out = await ctl("secret-inspect", sid)
+        assert rc == 0, out
+        assert "topsecret" not in out   # payload redacted on inspect
+
+        rc, out = await ctl("config-create", "c1", "--data", "cfgdata")
+        cid = json.loads(out)["id"]
+        rc, out = await ctl("config-inspect", cid)
+        assert rc == 0, out
+        assert json.loads(out)["spec"]["annotations"]["name"] == "c1"
+    finally:
+        await node._ctl_server.stop()
+        await node.stop()

@@ -372,3 +372,30 @@ async def test_session_wakes_on_peer_broadcast():
     assert [w.peer.node_id for w in msgs[1].managers] == ["m1", "m2"]
     consumer.cancel()
     await d.stop()
+
+
+@async_test
+async def test_heartbeat_period_follows_cluster_spec():
+    """cluster-update --heartbeat-period flows into the period the
+    dispatcher hands agents on every heartbeat (reference:
+    dispatcher.go:310-315 config reload on cluster events)."""
+    from swarmkit_tpu.api import Cluster, ClusterSpec
+    from swarmkit_tpu.api.specs import DispatcherConfig
+
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    d = Dispatcher(store, clock=clock)
+    cl = Cluster(id="c1", spec=ClusterSpec(
+        dispatcher=DispatcherConfig(heartbeat_period=5.0)))
+    await store.update(lambda tx: tx.create(cl))
+    await d.start()
+    try:
+        assert d.nodes.period == 5.0
+        cur = store.get("cluster", "c1")
+        cur.spec.dispatcher.heartbeat_period = 1.25
+        await store.update(lambda tx: tx.update(cur))
+        for _ in range(20):
+            await asyncio.sleep(0)
+        assert d.nodes.period == 1.25
+    finally:
+        await d.stop()
