@@ -358,8 +358,15 @@ def main() -> None:
              {"latency": 2, "latency_jitter": 1, "inflight": 4}),
         ):
             if on_cpu and cn > 256:
-                extra[name] = "skipped (cpu)"
-                continue
+                if "mailbox" in name:
+                    # the mailbox wire must produce a number on EVERY
+                    # platform (it had never been measured anywhere):
+                    # run it reduced rather than skip it
+                    name = f"{name}-reduced-n64"
+                    cn = 64
+                else:
+                    extra[name] = "skipped (cpu)"
+                    continue
             if time.perf_counter() - t_start > budget_s:
                 log(f"budget exhausted; skipping config {name}")
                 extra[name] = "skipped (budget)"

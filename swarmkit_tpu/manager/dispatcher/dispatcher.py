@@ -94,14 +94,15 @@ class Dispatcher:
         self._running = True
         if mark_unknown:
             await self._mark_nodes_unknown()
+        # watch-BEFORE-read so no committed update can fall between the
+        # initial config read and the subscription (an update seen by
+        # both is harmless: _apply_cluster_config is idempotent); kept on
+        # self so stop() can close it even if the task never scheduled
+        self._cfg_watcher = self.store.watch(
+            match(kind="cluster", action="update"))
         self._apply_cluster_config()
         self._process_task = asyncio.get_running_loop().create_task(
             self._process_updates_loop())
-        # watcher registered HERE (synchronously) so a cluster update
-        # committed right after start() cannot slip past it; kept on self
-        # so stop() can close it even when the task never got scheduled
-        self._cfg_watcher = self.store.watch(
-            match(kind="cluster", action="update"))
         self._bg.append(asyncio.get_running_loop().create_task(
             self._watch_cluster_config(self._cfg_watcher)))
 

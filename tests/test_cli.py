@@ -803,7 +803,12 @@ async def test_swarmctl_inspect_verbs():
         sid = json.loads(out)["id"]
         rc, out = await ctl("secret-inspect", sid)
         assert rc == 0, out
-        assert "topsecret" not in out   # payload redacted on inspect
+        # payload redacted on inspect: neither raw nor base64 form present
+        import base64 as _b64
+        b64 = _b64.b64encode(b"topsecret").decode()
+        assert "topsecret" not in out and b64 not in out
+        data = json.loads(out)["spec"].get("data")
+        assert not data or data in ({"__b64__": ""}, "")
 
         rc, out = await ctl("config-create", "c1", "--data", "cfgdata")
         cid = json.loads(out)["id"]

@@ -327,3 +327,40 @@ class TestContactLease:
                 elected = True
                 break
         assert elected, "exact-quorum survivors failed to elect (lease livelock)"
+
+
+class TestShardedStaticMembers:
+    """The bench's static-members specialization sharded over the mesh:
+    bit-identical to the unsharded static run AND to the sharded dynamic
+    run (no conf changes), and the compiled program still contains
+    cross-device collectives.  Guards the exact configuration bench.py
+    compiles on TPU hardware."""
+
+    CFG_S = SimConfig(n=64, log_len=128, window=16, apply_batch=32,
+                      max_props=16, keep=8, seed=11, static_members=True)
+
+    def test_sharded_static_bit_identical(self):
+        mesh = row_mesh(self.CFG_S.n)
+        unsharded, tr_u = run_ticks(init_state(self.CFG_S), self.CFG_S, 50,
+                                    prop_count=8)
+        sharded, tr_s = run_ticks(shard_rows(init_state(self.CFG_S), mesh),
+                                  self.CFG_S, 50, prop_count=8)
+        assert_states_identical(unsharded, sharded)
+        assert (np.asarray(tr_u) == np.asarray(tr_s)).all()
+
+        # ... and static == dynamic on the same sharded schedule
+        dynamic, _ = run_ticks(shard_rows(init_state(CFG), mesh), CFG, 50,
+                               prop_count=8)
+        for f in ("term", "role", "last", "commit", "applied", "apply_chk"):
+            assert (np.asarray(getattr(sharded, f))
+                    == np.asarray(getattr(dynamic, f))).all(), f
+
+    def test_sharded_static_lowering_has_collectives(self):
+        mesh = row_mesh(self.CFG_S.n)
+        st = shard_rows(init_state(self.CFG_S), mesh)
+        lowered = jax.jit(
+            step, static_argnames=("cfg",)).lower(st, self.CFG_S)
+        hlo = lowered.compile().as_text()
+        assert ("all-reduce" in hlo or "all-gather" in hlo
+                or "all-to-all" in hlo or "collective" in hlo), \
+            "sharded static step lowered without cross-device collectives"
