@@ -200,9 +200,19 @@ async def test_service_logs_task_selector_and_late_task():
         new = [x for x in ctls if x[1].task.id != ctl.task.id]
         assert new
         new[0][1].write_log("from-the-new-task")
-        await c.poll(lambda: any(m.data == b"from-the-new-task"
-                                 for m in got2) or None,
-                     "late task line", timeout=15)
+        # two more lines land right behind: the tail snapshot the
+        # publisher ships for the just-discovered task may include them,
+        # and the live bus delivers them too — the seq dedup must keep
+        # exactly one copy of each (advisor round-4 finding)
+        new[0][1].write_log("burst-2")
+        new[0][1].write_log("burst-3")
+        await c.poll(lambda: sum(1 for m in got2
+                                 if m.data == b"burst-3") >= 1 or None,
+                     "late task lines", timeout=15)
+        await asyncio.sleep(0.3)   # give any duplicate time to show up
+        seen = [(m.context.task_id, m.data) for m in got2]
+        assert len(seen) == len(set(seen)), \
+            f"duplicate log lines delivered: {seen}"
         task.cancel()
         task2.cancel()
     finally:

@@ -45,6 +45,8 @@ SYNC64 = SimConfig(n=64, log_len=128, window=16, apply_batch=32,
 MB64 = SimConfig(n=64, log_len=128, window=16, apply_batch=32, max_props=16,
                  keep=8, election_tick=24, seed=6402, latency=2,
                  latency_jitter=1, inflight=2, pre_vote=True)
+SYNC128 = SimConfig(n=128, log_len=128, window=16, apply_batch=32,
+                    max_props=16, keep=8, election_tick=24, seed=12801)
 
 FAMILIES = [
     ("sync5-faults", SYNC5, dict(n_ticks=200, drop_rate=0.1,
@@ -66,6 +68,8 @@ FAMILIES = [
     ("sync64-snapshot", SYNC64, dict(n_ticks=100, prop_prob=0.9,
                                      sleep_node=(3, 20, 70))),
     ("mb64-pipelined", MB64, dict(n_ticks=90, drop_rate=0.03)),
+    ("sync128-faults", SYNC128, dict(n_ticks=80, drop_rate=0.03,
+                                     crash_prob=0.02, prop_prob=0.6)),
 ]
 
 
