@@ -53,10 +53,99 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--backend", choices=["grpc", "inproc"], default="grpc",
                    help="raft/cluster wire: real gRPC sockets (default) or "
                         "in-process (single-node/testing)")
+    def _gnr(value: str) -> str:
+        _parse_generic_resources(value)   # validate at CLI-parse time
+        return value
+
+    p.add_argument("--generic-node-resources", default="", type=_gnr,
+                   help="user-defined generic resources this node offers, "
+                        "e.g. 'fpga=2,gpu=UUID1,gpu=UUID2' — integer "
+                        "values are discrete counts, strings are named "
+                        "ids; a kind is either discrete OR named "
+                        "(reference: cmd/swarmd/main.go:267)")
     p.add_argument("--executor", choices=["tpu", "test"], default="tpu",
                    help="task runtime: compiled JAX programs on the local "
                         "devices (tpu, default) or the instant fake (test)")
     return p
+
+
+def _parse_generic_resources(spec: str):
+    """'fpga=2,gpu=UUID1,gpu=UUID2' -> (discrete counts, named id sets).
+
+    A kind is EITHER discrete or named — mixing ('gpu=2,gpu=UUID1') or
+    duplicate ids are rejected, like the reference's parser
+    (cmd/swarmd/main.go:155-158 + api/genericresource validation):
+    the scheduler sizes a named kind by its id set, so a mixed spec
+    would advertise phantom capacity no task could ever claim."""
+    counts: dict[str, int] = {}
+    named: dict[str, list[str]] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, eq, value = part.partition("=")
+        if not eq or not name or not value:
+            raise ValueError(
+                f"--generic-node-resources wants name=value, got {part!r}")
+        try:
+            n = int(value)
+        except ValueError:
+            if name in counts:
+                raise ValueError(
+                    f"--generic-node-resources: kind {name!r} mixes a "
+                    f"discrete count with named ids")
+            ids = named.setdefault(name, [])
+            if value in ids:
+                raise ValueError(
+                    f"--generic-node-resources: duplicate id "
+                    f"{name}={value}")
+            ids.append(value)
+        else:
+            if name in named:
+                raise ValueError(
+                    f"--generic-node-resources: kind {name!r} mixes a "
+                    f"discrete count with named ids")
+            counts[name] = counts.get(name, 0) + n
+    # named ids are ALSO countable (the scheduler counts, then claims ids)
+    for name, ids in named.items():
+        counts[name] = len(ids)
+    return counts, named
+
+
+class _GenericResourcesExecutor:
+    """Executor wrapper merging operator-declared generic resources into
+    the node description the agent registers with."""
+
+    def __init__(self, inner, parsed) -> None:
+        self._inner = inner
+        self._counts, self._named = parsed
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    async def describe(self):
+        desc = await self._inner.describe()
+        if desc.resources is None:
+            from swarmkit_tpu.api.types import NodeResources
+            desc.resources = NodeResources()
+        for k, v in self._counts.items():
+            if k in self._named:
+                continue  # named kinds get their count from the id set
+            if k in desc.resources.generic_named:
+                # the executor already advertises this kind as NAMED ids
+                # (e.g. tpu-chip): a flat count would be phantom capacity
+                # the scheduler can never claim — drop it loudly
+                logging.getLogger("swarmkit_tpu.swarmd").warning(
+                    "--generic-node-resources: ignoring discrete count "
+                    "for %r — the executor advertises it as named ids", k)
+                continue
+            desc.resources.generic[k] = \
+                desc.resources.generic.get(k, 0) + v
+        for k, ids in self._named.items():
+            have = desc.resources.generic_named.setdefault(k, [])
+            have.extend(i for i in ids if i not in have)
+            desc.resources.generic[k] = len(have)
+        return desc
 
 
 async def run(args, network=None, executor=None, registry=None) -> Node:
@@ -88,6 +177,10 @@ async def run(args, network=None, executor=None, registry=None) -> Node:
             executor = TpuExecutor(hostname=args.hostname or node_id)
         else:
             executor = TestExecutor(hostname=args.hostname or node_id)
+    extra = getattr(args, "generic_node_resources", "")
+    if extra:
+        executor = _GenericResourcesExecutor(
+            executor, _parse_generic_resources(extra))
     nodes = registry if registry is not None else {}
     remote_managers: dict[str, object] = {}
 

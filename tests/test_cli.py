@@ -818,3 +818,71 @@ async def test_swarmctl_inspect_verbs():
     finally:
         await node._ctl_server.stop()
         await node.stop()
+
+
+@async_test
+async def test_swarmd_generic_node_resources_flag():
+    """--generic-node-resources declares operator-defined resources that
+    flow into the registered node description and are schedulable
+    (reference: cmd/swarmd/main.go:267 + api/genericresource)."""
+    from swarmkit_tpu.cmd import swarmd
+
+    tmp = tempfile.TemporaryDirectory(prefix="swarmd-gnr-")
+    sock = os.path.join(tmp.name, "swarmd.sock")
+    args = swarmd.build_parser().parse_args([
+        "--state-dir", os.path.join(tmp.name, "state"),
+        "--listen-control-api", sock,
+        "--node-id", "m1", "--manager",
+        "--election-tick", "4", "--backend", "inproc",
+        "--executor", "test",
+        "--generic-node-resources", "fpga=2,gpu=UUID1,gpu=UUID2",
+    ])
+    node = await swarmd.run(args)
+    try:
+        for _ in range(200):
+            if node.is_leader():
+                break
+            await asyncio.sleep(0.05)
+        lead = node._running_manager()
+        rec = None
+        for _ in range(200):
+            rec = lead.store.get("node", "m1")
+            if rec is not None and rec.description is not None \
+                    and rec.description.resources is not None \
+                    and rec.description.resources.generic.get("fpga"):
+                break
+            await asyncio.sleep(0.05)
+        assert rec is not None and rec.description is not None \
+            and rec.description.resources is not None, \
+            "node never registered with resources"
+        res = rec.description.resources
+        assert res.generic["fpga"] == 2
+        assert res.generic["gpu"] == 2
+        assert sorted(res.generic_named["gpu"]) == ["UUID1", "UUID2"]
+    finally:
+        await node._ctl_server.stop()
+        await node.stop()
+
+
+def test_generic_node_resources_parser_rejects_bad_specs():
+    """Mixed discrete/named kinds, duplicate ids, and empty values are
+    CLI-parse-time errors (reference: api/genericresource validation)."""
+    import pytest as _pytest
+
+    from swarmkit_tpu.cmd.swarmd import (
+        _parse_generic_resources, build_parser,
+    )
+
+    counts, named = _parse_generic_resources("fpga=2,gpu=U1,gpu=U2")
+    assert counts == {"fpga": 2, "gpu": 2}
+    assert named == {"gpu": ["U1", "U2"]}
+
+    for bad in ("gpu=2,gpu=UUID1", "gpu=U1,gpu=U1", "fpga", "fpga=",
+                "=3"):
+        with _pytest.raises(ValueError):
+            _parse_generic_resources(bad)
+
+    # argparse surfaces it at parse time, not mid-run
+    with _pytest.raises(SystemExit):
+        build_parser().parse_args(
+            ["--manager", "--generic-node-resources", "gpu=2,gpu=U1"])

@@ -1,0 +1,143 @@
+"""Control-plane churn soak: the in-process cluster under continuous
+leader kills, drains, scaling and rolling updates for a wall-clock budget.
+
+The aux-subsystem analog of the reference's long-running integration/CI
+passes (SURVEY §5 failure detection/recovery): every cycle asserts the
+cluster converges back to the desired state, and the soak fails loudly on
+any wedge (convergence timeout), crash, or leaked task.
+
+Usage:
+  python tools/soak_controlplane.py [--minutes 20] [--transport inproc|device]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from swarmkit_tpu.api import NodeAvailability, TaskState  # noqa: E402
+from swarmkit_tpu.store.by import ByService  # noqa: E402
+from tests.integration_harness import TestCluster  # noqa: E402
+
+
+async def soak(minutes: float, transport: str) -> int:
+    if transport == "device":
+        from swarmkit_tpu.transport import DeviceMeshNet, DeviceMeshTransport
+        c = TestCluster(network=DeviceMeshNet(seed=9, rows=8),
+                        transport_factory=DeviceMeshTransport)
+    else:
+        c = TestCluster(seed=9)
+    deadline = time.time() + minutes * 60
+    cycles = 0
+    try:
+        await c.add_manager("m1")
+        await c.add_manager("m2")
+        await c.add_manager("m3")
+        await c.add_agent("a1")
+        await c.add_agent("a2")
+        await c.poll_cluster_ready(managers=3, workers=2)
+        svc = await c.create_service("soak", replicas=4)
+
+        async def wait_running(want: int, timeout: float = 60.0) -> None:
+            lead = await c.wait_leader()
+            t0 = time.time()
+            while time.time() - t0 < timeout:
+                ts = [t for t in lead.store.find("task", ByService(svc.id))
+                      if t.status.state == TaskState.RUNNING
+                      and int(t.desired_state) == int(TaskState.RUNNING)]
+                if len(ts) == want:
+                    return
+                await asyncio.sleep(0.1)
+                lead = await c.wait_leader()
+            raise AssertionError(
+                f"cycle {cycles}: never reached {want} running")
+
+        await wait_running(4)
+        while time.time() < deadline:
+            cycles += 1
+            phase = cycles % 4
+            lead = await c.wait_leader()
+            if phase == 0:
+                # kill + restart the leader
+                victim = lead.node_id
+                await c.stop_node(victim)
+                await c.wait_leader(timeout=60)
+                await wait_running(4)
+                await c.restart_node(victim)
+                await c.wait_leader(timeout=60)
+            elif phase == 1:
+                # drain one agent, wait for re-placement, reactivate
+                node = lead.store.get("node", "a1")
+                spec = node.spec.copy()
+                spec.availability = NodeAvailability.DRAIN
+                await lead.control_api.update_node(
+                    "a1", spec, version=node.meta.version.index)
+                await wait_running(4)
+                node = (await c.wait_leader()).store.get("node", "a1")
+                spec = node.spec.copy()
+                spec.availability = NodeAvailability.ACTIVE
+                await (await c.wait_leader()).control_api.update_node(
+                    "a1", spec, version=node.meta.version.index)
+            elif phase == 2:
+                # scale up then back down
+                cur = lead.control_api.get_service(svc.id)
+                spec = cur.spec.copy()
+                spec.replicated.replicas = 7
+                await lead.control_api.update_service(
+                    svc.id, spec, version=cur.meta.version.index)
+                await wait_running(7)
+                lead = await c.wait_leader()
+                cur = lead.control_api.get_service(svc.id)
+                spec = cur.spec.copy()
+                spec.replicated.replicas = 4
+                await lead.control_api.update_service(
+                    svc.id, spec, version=cur.meta.version.index)
+                await wait_running(4)
+            else:
+                # rolling update to a fresh image
+                cur = lead.control_api.get_service(svc.id)
+                spec = cur.spec.copy()
+                spec.task.container.image = f"img-{cycles}"
+                await lead.control_api.update_service(
+                    svc.id, spec, version=cur.meta.version.index)
+                await wait_running(4)
+            if cycles % 5 == 0:
+                lead = await c.wait_leader()
+                n_tasks = len(lead.store.find("task"))
+                print(f"[{time.strftime('%H:%M:%S')}] cycle {cycles} ok "
+                      f"({n_tasks} task records)", flush=True)
+                # leak guard: the reaper must keep history bounded
+                assert n_tasks < 4 * 10 + 40, \
+                    f"task records leaking: {n_tasks}"
+        print(f"SOAK OK: {cycles} cycles on {transport} transport")
+        return 0
+    finally:
+        await c.stop_all()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--minutes", type=float, default=20.0)
+    ap.add_argument("--transport", choices=["inproc", "device"],
+                    default="inproc")
+    args = ap.parse_args()
+    return asyncio.run(soak(args.minutes, args.transport))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
