@@ -67,6 +67,38 @@ def _install_signal_handlers() -> None:
     signal.signal(signal.SIGINT, _die)
 
 
+# --- stall watchdog ---------------------------------------------------------
+# A device call through the axon tunnel can hang forever inside the PJRT
+# client (observed r03-r05: ~25 min at 0% CPU). Signal handlers can't help:
+# they only run on the main thread, which is parked inside the C++ call — a
+# SIGTERM is simply never delivered to Python (verified r05: the handler
+# above produced nothing and the process needed SIGKILL, losing the JSON
+# line). A daemon THREAD still runs (blocking PJRT calls release the GIL),
+# so it can flush the partial results and hard-exit.
+_last_progress = [0.0]
+
+
+def _pet_watchdog() -> None:
+    _last_progress[0] = time.monotonic()
+
+
+def _start_watchdog() -> None:
+    import threading
+
+    stall_s = float(os.environ.get("BENCH_STALL_TIMEOUT_S", "600"))
+    _pet_watchdog()
+
+    def run():
+        while True:
+            time.sleep(15)
+            idle = time.monotonic() - _last_progress[0]
+            if idle > stall_s:
+                _emit(error=f"no progress for {idle:.0f}s "
+                            "(wedged device call?)", hard=True)
+
+    threading.Thread(target=run, daemon=True).start()
+
+
 def init_backend():
     """Initialize the JAX backend, probing first in a SUBPROCESS with a hard
     timeout — backend init can hang inside C++ (not raise) when the TPU
@@ -177,6 +209,7 @@ def measure(jax, n: int, entries: int, seed: int, election_tick: int,
             state, _ = run_ticks(state, cfg, chunk,
                                  prop_count=cfg.max_props, **run_kw)
             jax.block_until_ready(state.commit)
+            _pet_watchdog()
         return state
 
     # Election is chunked for the same single-program-runtime reason.
@@ -192,6 +225,7 @@ def measure(jax, n: int, entries: int, seed: int, election_tick: int,
         while ticks < max_elect_ticks:
             st, t_chunk = run_until_leader(st, cfg, max_ticks=elect_chunk)
             jax.block_until_ready(st.term)
+            _pet_watchdog()
             ticks += int(t_chunk)
             if bool(has_leader(st)):
                 break
@@ -236,6 +270,7 @@ def main() -> None:
     t_start = time.perf_counter()
 
     _install_signal_handlers()
+    _start_watchdog()
     jax, devices, platform = init_backend()
     import numpy as np
 

@@ -54,7 +54,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="raft/cluster wire: real gRPC sockets (default) or "
                         "in-process (single-node/testing)")
     def _gnr(value: str) -> str:
-        _parse_generic_resources(value)   # validate at CLI-parse time
+        try:
+            _parse_generic_resources(value)   # validate at CLI-parse time
+        except ValueError as e:
+            # argparse swallows ValueError's message ("invalid _gnr
+            # value"); ArgumentTypeError's str() is shown to the operator
+            raise argparse.ArgumentTypeError(str(e))
         return value
 
     p.add_argument("--generic-node-resources", default="", type=_gnr,
@@ -84,9 +89,15 @@ def _parse_generic_resources(spec: str):
         if not part:
             continue
         name, eq, value = part.partition("=")
+        name, value = name.strip(), value.strip()
         if not eq or not name or not value:
             raise ValueError(
                 f"--generic-node-resources wants name=value, got {part!r}")
+        if any(ch.isspace() for ch in name) or \
+                any(ch.isspace() for ch in value):
+            raise ValueError(
+                f"--generic-node-resources: whitespace inside "
+                f"name or value: {part!r}")
         try:
             n = int(value)
         except ValueError:
@@ -105,6 +116,10 @@ def _parse_generic_resources(spec: str):
                 raise ValueError(
                     f"--generic-node-resources: kind {name!r} mixes a "
                     f"discrete count with named ids")
+            if n <= 0:
+                raise ValueError(
+                    f"--generic-node-resources: discrete count must be "
+                    f"positive, got {name}={n}")
             counts[name] = counts.get(name, 0) + n
     # named ids are ALSO countable (the scheduler counts, then claims ids)
     for name, ids in named.items():
@@ -142,6 +157,17 @@ class _GenericResourcesExecutor:
             desc.resources.generic[k] = \
                 desc.resources.generic.get(k, 0) + v
         for k, ids in self._named.items():
+            if k in desc.resources.generic \
+                    and k not in desc.resources.generic_named:
+                # mirror of the discrete-over-named guard: the executor
+                # advertises this kind as a DISCRETE count; operator ids
+                # would overwrite real capacity with phantom claimable
+                # ids no runtime backs — drop them loudly
+                logging.getLogger("swarmkit_tpu.swarmd").warning(
+                    "--generic-node-resources: ignoring named ids for "
+                    "%r — the executor advertises it as a discrete "
+                    "count", k)
+                continue
             have = desc.resources.generic_named.setdefault(k, [])
             have.extend(i for i in ids if i not in have)
             desc.resources.generic[k] = len(have)

@@ -878,11 +878,26 @@ def test_generic_node_resources_parser_rejects_bad_specs():
     assert named == {"gpu": ["U1", "U2"]}
 
     for bad in ("gpu=2,gpu=UUID1", "gpu=U1,gpu=U1", "fpga", "fpga=",
-                "=3"):
+                "=3", "fpga=0", "fpga=-2", "fp ga=2", "gpu=U 1"):
         with _pytest.raises(ValueError):
             _parse_generic_resources(bad)
 
-    # argparse surfaces it at parse time, not mid-run
+    # surrounding whitespace is tolerated (split on ',' leaves it)
+    counts, named = _parse_generic_resources(" fpga=2 , gpu=U1 ")
+    assert counts == {"fpga": 2, "gpu": 1}
+
+    # argparse surfaces it at parse time, not mid-run — and shows the
+    # parser's own message, not argparse's generic "invalid value"
+    parser = build_parser()
     with _pytest.raises(SystemExit):
-        build_parser().parse_args(
+        parser.parse_args(
             ["--manager", "--generic-node-resources", "gpu=2,gpu=U1"])
+    import argparse as _argparse
+    for action in parser._actions:
+        if action.dest == "generic_node_resources":
+            with _pytest.raises(_argparse.ArgumentTypeError,
+                                match="mixes a discrete count"):
+                action.type("gpu=2,gpu=U1")
+            break
+    else:
+        _pytest.fail("--generic-node-resources action not found")

@@ -30,8 +30,8 @@ jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from swarmkit_tpu.api import NodeAvailability, TaskState  # noqa: E402
-from swarmkit_tpu.store.by import ByService  # noqa: E402
+from swarmkit_tpu.api import NodeAvailability  # noqa: E402
+from swarmkit_tpu.manager.controlapi import FailedPrecondition  # noqa: E402
 from tests.integration_harness import TestCluster  # noqa: E402
 
 
@@ -53,19 +53,57 @@ async def soak(minutes: float, transport: str) -> int:
         await c.poll_cluster_ready(managers=3, workers=2)
         svc = await c.create_service("soak", replicas=4)
 
-        async def wait_running(want: int, timeout: float = 60.0) -> None:
-            lead = await c.wait_leader()
+        async def wait_running(want: int, timeout: float = 60.0,
+                               pred=None, why: str = "") -> None:
+            """Converge to `want` RUNNING tasks (the harness's notion of
+            running), all additionally satisfying `pred` — the drain and
+            rolling-update phases pass a predicate so the OLD task set
+            cannot satisfy the wait before the orchestrator reacts."""
+            await c.wait_leader()
             t0 = time.time()
             while time.time() - t0 < timeout:
-                ts = [t for t in lead.store.find("task", ByService(svc.id))
-                      if t.status.state == TaskState.RUNNING
-                      and int(t.desired_state) == int(TaskState.RUNNING)]
+                ts = [t for t in c.running_tasks(svc.id)
+                      if pred is None or pred(t)]
                 if len(ts) == want:
                     return
                 await asyncio.sleep(0.1)
-                lead = await c.wait_leader()
+                await c.wait_leader()
             raise AssertionError(
-                f"cycle {cycles}: never reached {want} running")
+                f"cycle {cycles}: never reached {want} running {why}")
+
+        async def retry_update(fetch, update, mutate, what: str) -> None:
+            """Read-modify-write with conflict retry: dispatcher
+            heartbeat/status write-backs bump object versions
+            concurrently, so out-of-sequence is an expected race the
+            operator (here: the soak) retries — reference semantics.
+            `fetch(lead)` returns the current object; `update(lead,
+            spec, version)` awaits the write."""
+            for _ in range(50):
+                lead = await c.wait_leader()
+                cur = fetch(lead)
+                spec = cur.spec.copy()
+                mutate(spec)
+                try:
+                    await update(lead, spec, cur.meta.version.index)
+                    return
+                except FailedPrecondition:
+                    await asyncio.sleep(0.05)
+            raise AssertionError(
+                f"cycle {cycles}: {what} update never won the race")
+
+        async def update_node_retry(node_id: str, mutate) -> None:
+            await retry_update(
+                lambda lead: lead.store.get("node", node_id),
+                lambda lead, spec, ver: lead.control_api.update_node(
+                    node_id, spec, version=ver),
+                mutate, f"node {node_id}")
+
+        async def update_service_retry(mutate) -> None:
+            await retry_update(
+                lambda lead: lead.control_api.get_service(svc.id),
+                lambda lead, spec, ver: lead.control_api.update_service(
+                    svc.id, spec, version=ver),
+                mutate, "service")
 
         await wait_running(4)
         while time.time() < deadline:
@@ -82,40 +120,39 @@ async def soak(minutes: float, transport: str) -> int:
                 await c.wait_leader(timeout=60)
             elif phase == 1:
                 # drain one agent, wait for re-placement, reactivate
-                node = lead.store.get("node", "a1")
-                spec = node.spec.copy()
-                spec.availability = NodeAvailability.DRAIN
-                await lead.control_api.update_node(
-                    "a1", spec, version=node.meta.version.index)
-                await wait_running(4)
-                node = (await c.wait_leader()).store.get("node", "a1")
-                spec = node.spec.copy()
-                spec.availability = NodeAvailability.ACTIVE
-                await (await c.wait_leader()).control_api.update_node(
-                    "a1", spec, version=node.meta.version.index)
+                def _drain(spec):
+                    spec.availability = NodeAvailability.DRAIN
+
+                def _activate(spec):
+                    spec.availability = NodeAvailability.ACTIVE
+
+                await update_node_retry("a1", _drain)
+                await wait_running(4, pred=lambda t: t.node_id != "a1",
+                                   why="off the drained node")
+                await update_node_retry("a1", _activate)
             elif phase == 2:
                 # scale up then back down
-                cur = lead.control_api.get_service(svc.id)
-                spec = cur.spec.copy()
-                spec.replicated.replicas = 7
-                await lead.control_api.update_service(
-                    svc.id, spec, version=cur.meta.version.index)
+                def _scale7(spec):
+                    spec.replicated.replicas = 7
+
+                def _scale4(spec):
+                    spec.replicated.replicas = 4
+
+                await update_service_retry(_scale7)
                 await wait_running(7)
-                lead = await c.wait_leader()
-                cur = lead.control_api.get_service(svc.id)
-                spec = cur.spec.copy()
-                spec.replicated.replicas = 4
-                await lead.control_api.update_service(
-                    svc.id, spec, version=cur.meta.version.index)
+                await update_service_retry(_scale4)
                 await wait_running(4)
             else:
                 # rolling update to a fresh image
-                cur = lead.control_api.get_service(svc.id)
-                spec = cur.spec.copy()
-                spec.task.container.image = f"img-{cycles}"
-                await lead.control_api.update_service(
-                    svc.id, spec, version=cur.meta.version.index)
-                await wait_running(4)
+                img = f"img-{cycles}"
+
+                def _reimage(spec):
+                    spec.task.container.image = img
+
+                await update_service_retry(_reimage)
+                await wait_running(
+                    4, pred=lambda t: t.spec.container.image == img,
+                    why=f"on updated image {img}")
             if cycles % 5 == 0:
                 lead = await c.wait_leader()
                 n_tasks = len(lead.store.find("task"))
