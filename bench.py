@@ -123,6 +123,11 @@ def init_backend():
         except subprocess.TimeoutExpired:
             log(f"backend probe attempt {attempt} timed out "
                 f"({init_timeout}s)")
+        finally:
+            # probing has its own timeout discipline; a long
+            # BENCH_INIT_TIMEOUT_S must not trip the stall watchdog and
+            # kill a run that would have fallen back to CPU
+            _pet_watchdog()
         if attempt == 1:
             time.sleep(10)
 
@@ -154,6 +159,7 @@ def init_backend():
         devs = jax.devices()
     finally:
         done.set()
+        _pet_watchdog()
     return jax, devs, platform
 
 
@@ -380,6 +386,10 @@ def main() -> None:
 
     # --- BASELINE.json configs 3-5 (logged, secondary) ----------------------
     if os.environ.get("BENCH_CONFIGS", "1") != "0":
+        # BENCH_ONLY_CONFIG=<substring> runs just the matching secondary
+        # config — lets a narrow tunnel window capture one missing number
+        # (pair with a tiny BENCH_N/BENCH_ENTRIES headline).
+        only = os.environ.get("BENCH_ONLY_CONFIG", "")
         extra: dict = {}
         RESULT["configs_entries_per_s"] = extra  # by reference: partial
         # results survive a SIGTERM mid-loop
@@ -392,6 +402,10 @@ def main() -> None:
             ("1024-mailbox-lat2-jitter1-inflight4", 1024,
              {"latency": 2, "latency_jitter": 1, "inflight": 4}),
         ):
+            if only and only not in name:
+                extra.setdefault(f"filtered-by-only:{only}",
+                                 "skipped (BENCH_ONLY_CONFIG)")
+                continue
             if on_cpu and cn > 256:
                 if "mailbox" in name:
                     # the mailbox wire must produce a number on EVERY

@@ -73,8 +73,22 @@ class TaskManager:
                 step = asyncio.ensure_future(do_task_state(
                     self.task, self.controller, self.clock.now()))
                 upd = asyncio.ensure_future(self._update_evt.wait())
-                done, _ = await asyncio.wait(
-                    {step, upd}, return_when=asyncio.FIRST_COMPLETED)
+                try:
+                    done, _ = await asyncio.wait(
+                        {step, upd}, return_when=asyncio.FIRST_COMPLETED)
+                except asyncio.CancelledError:
+                    # close() cancelled the runner mid-wait: reap the
+                    # in-flight FSM step too or it leaks (a blocked
+                    # controller.wait() outlives the loop otherwise) —
+                    # and AWAIT it so its unwind finishes before close()
+                    # proceeds to controller.close()
+                    step.cancel()
+                    upd.cancel()
+                    try:
+                        await step
+                    except (asyncio.CancelledError, Exception):
+                        pass
+                    raise
                 if step in done:
                     upd.cancel()
                     status = step.result()

@@ -37,7 +37,22 @@ async def bench(replicas: int, workers: int, managers: int = 1,
     if transport == "device":
         # manager-quorum consensus over the device-mesh mailbox wire
         # (SURVEY §7; same path tests/test_integration.py's device-mesh
-        # variant exercises)
+        # variant exercises).  Pin the JAX platform BEFORE any backend
+        # init: the axon sitecustomize otherwise routes to the TPU tunnel,
+        # which hangs indefinitely when the tunnel is wedged.  Set
+        # SWARM_BENCH_JAX_PLATFORM=tpu to run the quorum on a real chip.
+        import os as _os
+
+        import jax as _jax
+        _jax.config.update(
+            "jax_platforms",
+            _os.environ.get("SWARM_BENCH_JAX_PLATFORM", "cpu"))
+        # the config update is a no-op if a backend is already live (e.g.
+        # a programmatic caller did sim work first); drop cached backends
+        # so the pin takes effect — this is a bench entry point, nothing
+        # long-lived holds device buffers here
+        import jax.extend.backend as _jxb
+        _jxb.clear_backends()
         from swarmkit_tpu.transport import DeviceMeshNet, DeviceMeshTransport
         net = DeviceMeshNet(seed=1, rows=max(8, managers))
         transport_factory = DeviceMeshTransport

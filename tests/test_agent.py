@@ -243,6 +243,41 @@ async def test_agent_survives_dispatcher_restart():
 
 
 @async_test
+async def test_task_manager_close_reaps_inflight_fsm_step():
+    """close() while the FSM step is parked inside controller.wait() must
+    cancel the in-flight do_task_state future — a leaked one outlives the
+    event loop and asyncio warns 'Task was destroyed but it is pending'
+    at teardown (seen in the control-plane soak)."""
+    from swarmkit_tpu.agent.task import TaskManager
+
+    class BlockingController:
+        async def update(self, task): pass
+        async def prepare(self): pass
+        async def start(self): pass
+        async def wait(self):
+            await asyncio.Event().wait()  # blocks forever
+        async def shutdown(self): pass
+        async def close(self): pass
+
+    statuses = []
+
+    async def report(task_id, status):
+        statuses.append(status.state)
+
+    tm = TaskManager(make_task(0), BlockingController(), report,
+                     SystemClock())
+    tm.start()
+    await eventually(lambda: TaskState.RUNNING in statuses)
+    # the runner is now blocked in controller.wait() inside do_task_state
+    await tm.close()
+    await asyncio.sleep(0)
+    leaked = [t for t in asyncio.all_tasks()
+              if t.get_coro() is not None
+              and getattr(t.get_coro(), "__name__", "") == "do_task_state"]
+    assert not leaked, f"in-flight FSM step leaked past close: {leaked}"
+
+
+@async_test
 async def test_do_task_state_parks_at_ready_until_promoted():
     """Stop-first updates create replacements at desired READY; the agent
     must not start them until promoted to RUNNING."""

@@ -109,10 +109,9 @@ async def soak(minutes: float, transport: str) -> int:
         while time.time() < deadline:
             cycles += 1
             phase = cycles % 4
-            lead = await c.wait_leader()
             if phase == 0:
                 # kill + restart the leader
-                victim = lead.node_id
+                victim = (await c.wait_leader()).node_id
                 await c.stop_node(victim)
                 await c.wait_leader(timeout=60)
                 await wait_running(4)
