@@ -156,6 +156,45 @@ def _builtin_pmatmul(params: dict):
     return fn, (x,)
 
 
+def _builtin_pallas_matmul(params: dict):
+    """Hand-tiled Pallas matmul chain (MXU tiles, f32 VMEM accumulation) —
+    the hand-optimized twin of ``tpu://matmul``; kernels in
+    `parallel/pallas_ops.py`, interpreted off-TPU so the image runs on any
+    node."""
+    import jax
+    import jax.numpy as jnp
+
+    from swarmkit_tpu.parallel import pallas_ops
+    from swarmkit_tpu.parallel.pallas_ops import _LANE, _on_tpu
+
+    n = int(params.get("n", 256))
+    steps = int(params.get("steps", 4))
+    if "tile" in params:
+        tile = int(params["tile"])
+        if tile <= 0:
+            raise TaskRejected(f"tile={tile} must be positive")
+    else:
+        # largest MXU-aligned divisor of n, falling back to one whole-array
+        # block (always valid in interpret mode; on TPU the lane check
+        # below rejects unalignable sizes cleanly)
+        tile = next((t for t in (256, _LANE) if n % t == 0), n)
+    if n <= 0 or n % tile:
+        raise TaskRejected(f"n={n} must be positive and a multiple of "
+                           f"tile={tile}")
+    if _on_tpu() and (tile % _LANE or n % _LANE):
+        raise TaskRejected(
+            f"on TPU, n and tile must be multiples of {_LANE} "
+            f"(got n={n}, tile={tile}) — Mosaic lane tiling")
+    key = jax.random.PRNGKey(int(params.get("seed", 0)))
+    a = jax.random.normal(key, (n, n), dtype=jnp.bfloat16)
+
+    def fn(x):
+        out = pallas_ops.matmul_chain(x, a, steps, tile=tile)
+        return jnp.sum(out.astype(jnp.float32))
+
+    return fn, (a,)
+
+
 def _builtin_spin(params: dict):
     """Fixed-length device scan — a long-running task for lifecycle tests."""
     import jax
@@ -173,6 +212,7 @@ def _builtin_spin(params: dict):
 
 
 register_program("matmul", _builtin_matmul)
+register_program("pallas_matmul", _builtin_pallas_matmul)
 register_program("pmatmul", _builtin_pmatmul)
 register_program("axpy", _builtin_axpy)
 register_program("spin", _builtin_spin)

@@ -1,0 +1,172 @@
+"""Hand-tiled Pallas TPU kernels for executor task programs.
+
+The TPU executor's built-in programs (`agent/tpu.py`) are the framework's
+workload analog of the reference's container images (the Docker executor
+runs whatever the image says, agent/exec/dockerapi/controller.go); here the
+runtime is XLA, and the hottest workload class is dense matmul chains on
+the MXU.  XLA already tiles a plain `jnp.dot` well, but a task program that
+owns its schedule — tile sizes matched to the 128x128 systolic array, f32
+accumulation in VMEM scratch, K-innermost grid so each output tile is
+revisited without leaving VMEM — is the TPU-native equivalent of a
+hand-optimized container workload, and exercises the Pallas path the rest
+of the framework reserves for futures profiling shows need it.
+
+Kernels run `interpret=True` off-TPU (and under
+`xla_force_host_platform_device_count` CPU meshes), so the same task image
+(`tpu://pallas_matmul`) is schedulable on any node, exactly like the
+builtins.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# MXU is 128x128; bf16 min tile is (16, 128).  128-multiples keep every
+# block MXU-shaped and lane-aligned for both dtypes we accept.
+_LANE = 128
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _mm_kernel(a_ref, b_ref, o_ref, acc_ref):
+    """One (i, j, k) grid step: acc += A[i,k] @ B[k,j]; flush on last k.
+
+    The grid iterates K innermost, so `acc_ref` (VMEM scratch, f32) carries
+    the partial sum for output tile (i, j) across the K sweep — the MXU
+    consumes bf16/f32 operands but accumulation stays f32 until the final
+    cast, which is the standard mixed-precision contraction discipline.
+    """
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _zero():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jnp.dot(a_ref[:], b_ref[:],
+                          preferred_element_type=jnp.float32)
+
+    @pl.when(k == pl.num_programs(2) - 1)
+    def _flush():
+        o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("tile_m", "tile_n", "tile_k",
+                                             "interpret"))
+def matmul(a: jax.Array, b: jax.Array, *, tile_m: int = 256,
+           tile_n: int = 256, tile_k: int = 256,
+           interpret: bool | None = None) -> jax.Array:
+    """Tiled Pallas matmul: [M, K] @ [K, N] -> [M, N] in `a.dtype`.
+
+    Shapes must divide the tile sizes (task programs pick aligned shapes;
+    this is a kernel, not a frontend).  `interpret=None` auto-selects the
+    interpreter off-TPU.
+    """
+    m, ka = a.shape
+    kb, n = b.shape
+    if ka != kb:
+        raise ValueError(f"contraction mismatch: {a.shape} @ {b.shape}")
+    tile_m, tile_n, tile_k = (min(tile_m, m), min(tile_n, n), min(tile_k, ka))
+    if m % tile_m or n % tile_n or ka % tile_k:
+        raise ValueError(
+            f"shapes ({m},{ka})@({kb},{n}) must divide tiles "
+            f"({tile_m},{tile_n},{tile_k})")
+    if interpret is None:
+        interpret = not _on_tpu()
+    if not interpret and (tile_n % _LANE or tile_k % _LANE):
+        # Mosaic requires the last block dim be a lane multiple; fail with
+        # a readable message instead of a lowering error
+        raise ValueError(
+            f"compiled TPU path needs lane-aligned tiles (multiples of "
+            f"{_LANE}): got tile_k={tile_k}, tile_n={tile_n}")
+
+    grid = (m // tile_m, n // tile_n, ka // tile_k)
+    return pl.pallas_call(
+        _mm_kernel,
+        out_shape=jax.ShapeDtypeStruct((m, n), a.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_m, tile_k), lambda i, j, k: (i, k),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_k, tile_n), lambda i, j, k: (k, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tile_m, tile_n), lambda i, j, k: (i, j),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((tile_m, tile_n), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(a, b)
+
+
+def _rms_kernel(x_ref, o_ref, acc_ref):
+    """Row-tiled sum of squares: one grid step accumulates its tile's
+    f32 square-sum into SMEM; the last step writes the total."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _zero():
+        acc_ref[0] = jnp.float32(0.0)
+
+    x = x_ref[:].astype(jnp.float32)
+    acc_ref[0] += jnp.sum(x * x)
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _flush():
+        o_ref[0, 0] = acc_ref[0]
+
+
+@functools.partial(jax.jit, static_argnames=("tile_m", "interpret"))
+def sumsq(x: jax.Array, *, tile_m: int = 256,
+          interpret: bool | None = None) -> jax.Array:
+    """Sum of squares of a [M, N] array as f32 scalar (pallas-reduced)."""
+    m, n = x.shape
+    tile_m = min(tile_m, m)
+    if m % tile_m:
+        raise ValueError(f"rows {m} must divide tile {tile_m}")
+    if interpret is None:
+        interpret = not _on_tpu()
+    if not interpret and n % _LANE:
+        raise ValueError(
+            f"compiled TPU path needs a lane-aligned last dim (multiple "
+            f"of {_LANE}): got {n}")
+    out = pl.pallas_call(
+        _rms_kernel,
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        grid=(m // tile_m,),
+        in_specs=[pl.BlockSpec((tile_m, n), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, 1), lambda i: (0, 0),
+                               memory_space=pltpu.SMEM),
+        scratch_shapes=[pltpu.SMEM((1,), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(x)
+    return out[0, 0]
+
+
+def matmul_chain(x: jax.Array, a: jax.Array, steps: int, *,
+                 tile: int = 256, interpret: bool | None = None) -> jax.Array:
+    """`steps` rounds of x <- normalize(x @ a), all through the Pallas
+    kernels — the pallas twin of the executor's builtin matmul chain."""
+    def body(carry, _):
+        y = matmul(carry, a, tile_m=tile, tile_n=tile, tile_k=tile,
+                   interpret=interpret)
+        ss = sumsq(y, tile_m=tile, interpret=interpret)
+        denom = jnp.maximum(jnp.sqrt(ss / y.size), 1e-6)
+        y = (y.astype(jnp.float32) / denom).astype(y.dtype)
+        return y, ()
+
+    out, _ = jax.lax.scan(body, x, None, length=steps)
+    return out

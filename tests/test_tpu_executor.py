@@ -141,6 +141,52 @@ async def test_service_of_tpu_tasks_runs_to_completion():
 
 
 @async_test
+async def test_pallas_matmul_program_full_lifecycle():
+    """tpu://pallas_matmul (hand-tiled MXU kernels, interpreted off-TPU)
+    compiles, runs, and finishes like any other task program."""
+    ex = TpuExecutor(hostname="w1")
+    ctl = await ex.controller(tpu_task(
+        image="tpu://pallas_matmul", args=["n=128", "steps=2", "tile=64"]))
+    await ctl.prepare()
+    await ctl.start()
+    await ctl.wait()
+    import numpy as np
+
+    assert np.isfinite(float(np.asarray(ctl.result)))
+    await ctl.close()
+
+
+@async_test
+async def test_pallas_matmul_rejects_misaligned_tile():
+    ex = TpuExecutor()
+    ctl = await ex.controller(tpu_task(
+        image="tpu://pallas_matmul", args=["n=100", "tile=64"]))
+    with pytest.raises(TaskRejected):
+        await ctl.prepare()
+    # non-positive tile is a permanent rejection, not a retryable error
+    ctl = await ex.controller(tpu_task(
+        image="tpu://pallas_matmul", args=["tile=0"]))
+    with pytest.raises(TaskRejected):
+        await ctl.prepare()
+
+
+@async_test
+async def test_pallas_matmul_default_tile_divides_n():
+    """No tile param: the builder picks an MXU-aligned divisor of n
+    (n=384 -> 128), not a blind 256 clamp that would reject the task."""
+    ex = TpuExecutor()
+    ctl = await ex.controller(tpu_task(
+        image="tpu://pallas_matmul", args=["n=384", "steps=1"]))
+    await ctl.prepare()
+    await ctl.start()
+    await ctl.wait()
+    import numpy as np
+
+    assert np.isfinite(float(np.asarray(ctl.result)))
+    await ctl.close()
+
+
+@async_test
 async def test_pmatmul_runs_sharded_over_the_device_mesh():
     """tpu://pmatmul shards its batch over ALL local devices (8 virtual CPU
     devices under the test conftest) and runs cross-device collectives
