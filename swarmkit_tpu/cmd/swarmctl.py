@@ -195,21 +195,76 @@ def _service_spec(args, networks=None, secrets=None, configs=None) -> dict:
 
 
 async def _resolve(client, kind: str, names: list[str]) -> list:
-    """Resolve names-or-ids to (id, name) pairs via <kind>.ls."""
-    if not names:
-        return []
-    objs = await client.call(f"{kind}.ls")
-    by_key = {}
-    for o in objs:
-        nm = o["spec"]["annotations"]["name"]
-        by_key[nm] = (o["id"], nm)
-        by_key[o["id"]] = (o["id"], nm)
-    out = []
-    for n in names:
-        if n not in by_key:
-            raise CtlError(f"{kind} {n!r} not found", "not_found")
-        out.append(by_key[n])
+    """Resolve refs (name | id | unique id prefix) to (id, name) pairs.
+
+    The <kind>.ls scan is fetched at most once per call no matter how many
+    refs miss the direct-Get fast path."""
+    out, objs = [], None
+    for ref in names:
+        try:
+            obj = await client.call(f"{kind}.inspect", id=ref)
+        except CtlError as e:
+            if e.code != "not_found":
+                raise
+            if objs is None:
+                objs = await client.call(f"{kind}.ls")
+            obj = _match_ref(kind, objs, ref)
+        out.append((obj["id"], _display_name(kind, obj)))
     return out
+
+
+def _display_name(kind: str, obj: dict) -> str:
+    if kind == "node":
+        # nodes are addressed by hostname (reference cmd/swarmctl/node/
+        # util.go getNode: ID first, then hostname scan)
+        return (obj.get("description") or {}).get("hostname") or ""
+    return (((obj.get("spec") or {}).get("annotations") or {})
+            .get("name") or "")
+
+
+async def _resolve_obj(client, kind: str, ref: str) -> dict:
+    """Exact id, name (hostname for nodes), or unique id prefix -> object.
+
+    Every positional object argument accepts any of the three, the way the
+    reference CLI does (cmd/swarmctl/service/util.go getService,
+    node/util.go getNode, network/util.go, secret/config util) — ambiguity
+    and absence are CLI errors, never a silent no-match.  Like the
+    reference, the direct Get is tried first; the <kind>.ls scan only runs
+    when the ref is not an exact id.  Returns the fetched object so
+    callers never pay a second inspect for it.
+    """
+    try:
+        return await client.call(f"{kind}.inspect", id=ref)
+    except CtlError as e:
+        if e.code != "not_found":
+            raise
+    return _match_ref(kind, await client.call(f"{kind}.ls"), ref)
+
+
+def _match_ref(kind: str, objs: list, ref: str) -> dict:
+    """Scan a <kind>.ls result for a name or unique-id-prefix match."""
+    by_name: dict[str, list[dict]] = {}
+    for o in objs:
+        nm = _display_name(kind, o)
+        if nm:
+            by_name.setdefault(nm, []).append(o)
+    if ref in by_name:
+        matches = by_name[ref]
+        if len(matches) > 1:
+            raise CtlError(f"{kind} name {ref!r} is ambiguous "
+                           f"({len(matches)} matches)", "ambiguous")
+        return matches[0]
+    pref = [o for o in objs if o["id"].startswith(ref)] if ref else []
+    if len(pref) == 1:
+        return pref[0]
+    if len(pref) > 1:
+        raise CtlError(f"{kind} id prefix {ref!r} is ambiguous "
+                       f"({len(pref)} matches)", "ambiguous")
+    raise CtlError(f"{kind} {ref!r} not found", "not_found")
+
+
+async def _resolve_ref(client, kind: str, ref: str) -> str:
+    return (await _resolve_obj(client, kind, ref))["id"]
 
 
 async def run(args, out=None) -> int:
@@ -222,6 +277,19 @@ async def run(args, out=None) -> int:
 
     try:
         c = args.cmd
+        # Normalize the positional object ref (name | id | unique id
+        # prefix) for every `<kind>-<verb>` command that takes one.
+        kind = c.split("-")[0]
+        resolved = None   # the fetched object; saves handlers a re-inspect
+        if getattr(args, "id", None) is not None and kind in (
+                "service", "node", "network", "secret", "config", "task"):
+            if c == "service-logs" and args.task:
+                kind = "task"
+            resolved = await _resolve_obj(client, kind, args.id)
+            args.id = resolved["id"]
+        if c == "task-ls" and args.service:
+            args.service = await _resolve_ref(client, "service",
+                                              args.service)
         if c == "cluster-inspect":
             show(await client.call("cluster.inspect"))
         elif c == "metrics":
@@ -258,7 +326,7 @@ async def run(args, out=None) -> int:
                     n.get("status", {}).get("state", 0), "?")
                 out.write(f"{n['id']}\t{role}\t{state}\n")
         elif c == "node-inspect":
-            show(await client.call("node.inspect", id=args.id))
+            show(resolved)
         elif c == "node-rm":
             await client.call("node.rm", id=args.id, force=args.force)
         elif c == "node-promote":
@@ -303,9 +371,9 @@ async def run(args, out=None) -> int:
                 replicas = s["spec"].get("replicated", {}).get("replicas", "")
                 out.write(f"{s['id']}\t{name}\t{replicas}\n")
         elif c == "service-inspect":
-            show(await client.call("service.inspect", id=args.id))
+            show(resolved)
         elif c == "service-scale":
-            svc = await client.call("service.inspect", id=args.id)
+            svc = resolved
             if not svc["spec"].get("replicated"):
                 print("error: only replicated services can be scaled",
                       file=sys.stderr)
@@ -317,7 +385,7 @@ async def run(args, out=None) -> int:
         elif c == "service-rm":
             await client.call("service.rm", id=args.id)
         elif c == "service-update":
-            cur = await client.call("service.inspect", id=args.id)
+            cur = resolved
             spec = cur["spec"]
             # only materialize task/container sub-objects when a container
             # flag was actually given — an unrelated update must not
@@ -375,7 +443,7 @@ async def run(args, out=None) -> int:
                 out.write(f"{m['task_id'][:12]}@{m['node_id'][:12]} "
                           f"{tag} | {m['data']}\n")
         elif c == "task-inspect":
-            show(await client.call("task.inspect", id=args.id))
+            show(resolved)
         elif c == "task-ls":
             ids = [args.service] if args.service else None
             for t in await client.call("task.ls", service_ids=ids):
@@ -390,7 +458,7 @@ async def run(args, out=None) -> int:
                                              for sn in args.subnet]}
             show(await client.call("network.create", spec=nspec))
         elif c == "network-inspect":
-            show(await client.call("network.inspect", id=args.id))
+            show(resolved)
         elif c == "network-ls":
             for n in await client.call("network.ls"):
                 out.write(f"{n['id']}\t{n['spec']['annotations']['name']}\n")
@@ -407,8 +475,7 @@ async def run(args, out=None) -> int:
                       "data": {"__b64__": base64.b64encode(
                           args.data.encode()).decode()}}))
         elif c in ("secret-inspect", "config-inspect"):
-            show(await client.call(f"{c.split('-')[0]}.inspect",
-                                   id=args.id))
+            show(resolved)
         elif c in ("secret-ls", "config-ls"):
             kind = c.split("-")[0]
             for s in await client.call(f"{kind}.ls"):

@@ -100,13 +100,19 @@ async def test_swarmd_swarmctl_round_trip():
             await asyncio.sleep(0.05)
         assert len(lines) == 2, out
 
-        rc, out = await ctl("service-scale", svc_id, "4")
+        # positional refs resolve by NAME (reference cmd/swarmctl
+        # service/util.go getService) — scale and poll via "web"
+        rc, out = await ctl("service-scale", "web", "4")
         assert rc == 0
         for _ in range(200):
-            rc, out = await ctl("task-ls", "--service", svc_id)
+            rc, out = await ctl("task-ls", "--service", "web")
             if len([l for l in out.splitlines() if "RUNNING" in l]) == 4:
                 break
             await asyncio.sleep(0.05)
+
+        # ...and by unique id prefix
+        rc, out = await ctl("service-inspect", svc_id[:8])
+        assert rc == 0 and json.loads(out)["id"] == svc_id
 
         rc, out = await ctl("secret-create", "db-pass", "--data", "hunter2")
         assert rc == 0
@@ -126,6 +132,48 @@ async def test_swarmd_swarmctl_round_trip():
     finally:
         await node._ctl_server.stop()
         await node.stop()
+
+
+@async_test
+async def test_resolve_ref_names_prefixes_ambiguity():
+    """_resolve_ref: exact id > name > unique id prefix; ambiguity and
+    absence are CtlErrors (reference cmd/swarmctl/*/util.go)."""
+    from swarmkit_tpu.cmd.swarmctl import CtlError, _resolve_ref
+
+    class FakeClient:
+        def __init__(self, objs):
+            self.objs = objs
+
+        async def call(self, method, **kw):
+            if method.endswith(".inspect"):
+                for o in self.objs:
+                    if o["id"] == kw.get("id"):
+                        return o
+                raise CtlError(f"{kw.get('id')} not found", "not_found")
+            return self.objs
+
+    svc = lambda i, nm: {"id": i,
+                         "spec": {"annotations": {"name": nm}}}
+    c = FakeClient([svc("abc123", "web"), svc("abd456", "api"),
+                    svc("zz9", "abc123x")])
+    assert await _resolve_ref(c, "service", "abc123") == "abc123"   # id
+    assert await _resolve_ref(c, "service", "web") == "abc123"      # name
+    assert await _resolve_ref(c, "service", "api") == "abd456"      # name
+    assert await _resolve_ref(c, "service", "abd") == "abd456"      # prefix
+    with pytest.raises(CtlError, match="ambiguous"):
+        await _resolve_ref(c, "service", "ab")      # abc123 + abd456
+    with pytest.raises(CtlError, match="not found"):
+        await _resolve_ref(c, "service", "nope")
+
+    # nodes resolve by description.hostname
+    nodes = FakeClient([
+        {"id": "n1", "description": {"hostname": "worker-a"}},
+        {"id": "n2", "description": {"hostname": "worker-b"}},
+        {"id": "n3", "description": {"hostname": "worker-b"}},
+    ])
+    assert await _resolve_ref(nodes, "node", "worker-a") == "n1"
+    with pytest.raises(CtlError, match="ambiguous"):
+        await _resolve_ref(nodes, "node", "worker-b")
 
 
 @async_test
