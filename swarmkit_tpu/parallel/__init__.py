@@ -35,19 +35,60 @@ def row_mesh(rows: int, devices: Optional[Sequence] = None,
     return Mesh(devices[:d], axis_names=(axis,))
 
 
-def row_spec(ndim: int, axis: str = MANAGER_AXIS) -> P:
-    """PartitionSpec sharding the leading (row) axis, replicating the rest."""
+DCN_AXIS = "hosts"    # outer: crosses the data-center network
+ICI_AXIS = "chips"    # inner: rides the on-pod interconnect
+
+
+def host_row_mesh(rows: int, hosts: int = 2,
+                  devices: Optional[Sequence] = None) -> Mesh:
+    """2-D hosts x chips mesh for multi-host deployments.
+
+    The row (manager) axis shards over BOTH mesh axes with hosts
+    OUTERMOST: rows living on the same host are contiguous, so the
+    kernel's sender-axis reductions decompose into an ICI-local phase plus
+    one small cross-host (DCN) combine — the standard outer-DCN /
+    inner-ICI layout (reference analog: swarmkit's managers span machines
+    over gRPC; here the placement hierarchy is explicit in the mesh).
+    Degrades gracefully: hosts and chips shrink until they divide the
+    device count and the row count (worst case 1x1).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    d = len(devices)
+    hosts = max(1, min(hosts, d))
+    # hosts must divide the device count AND the row count (the rows shard
+    # over the flattened hosts*chips product, so each factor must divide)
+    while hosts > 1 and (d % hosts or rows % hosts):
+        hosts -= 1
+    chips = d // hosts
+    while chips > 1 and rows % (hosts * chips):
+        chips -= 1
+    import numpy as _np
+
+    arr = _np.array(devices[:hosts * chips]).reshape(hosts, chips)
+    return Mesh(arr, axis_names=(DCN_AXIS, ICI_AXIS))
+
+
+HOST_ROW_AXES = (DCN_AXIS, ICI_AXIS)
+
+
+def row_spec(ndim: int, axis=MANAGER_AXIS) -> P:
+    """PartitionSpec sharding the leading (row) axis, replicating the rest.
+
+    `axis` may be one mesh axis name or a tuple of names (e.g.
+    HOST_ROW_AXES) — a tuple shards the single row dimension across the
+    flattened product of those mesh axes, hosts-major.
+    """
     if ndim == 0:
         return P()
     return P(axis, *([None] * (ndim - 1)))
 
 
-def state_shardings(mesh: Mesh, tree, axis: str = MANAGER_AXIS):
-    """Per-leaf NamedSharding tree: leading axis on the mesh axis."""
+def state_shardings(mesh: Mesh, tree, axis=MANAGER_AXIS):
+    """Per-leaf NamedSharding tree: leading axis on the mesh axis (or axes)."""
     return jax.tree.map(
         lambda leaf: NamedSharding(mesh, row_spec(leaf.ndim, axis)), tree)
 
 
-def shard_rows(tree, mesh: Mesh, axis: str = MANAGER_AXIS):
+def shard_rows(tree, mesh: Mesh, axis=MANAGER_AXIS):
     """device_put a pytree with row-major sharding over the mesh."""
     return jax.device_put(tree, state_shardings(mesh, tree, axis))
