@@ -131,6 +131,7 @@ class GlobalOrchestrator:
                         if tx.get("task", t.id) is not None:
                             tx.delete("task", t.id)
                 await self.store.update(txn)
+            self.restart.clear_service_history(service.id)
 
         restarts, self._restart_queue = self._restart_queue, []
         for task in restarts:

@@ -152,6 +152,8 @@ class ReplicatedOrchestrator:
                     tx.delete("task", t.id)
         if tasks:
             await self.store.update(txn)
+        # forget restart strike counts (reference ClearServiceHistory)
+        self.restart.clear_service_history(service.id)
 
     async def _restart_task(self, task) -> None:
         service = self.store.get("service", task.service_id)

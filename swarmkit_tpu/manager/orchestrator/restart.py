@@ -4,23 +4,35 @@ Reference: manager/orchestrator/restart/restart.go — Restart (:103) shuts
 down the failed task and, when shouldRestart (:195) allows (condition,
 max-attempts within window), creates a replacement in the same slot with
 desired_state READY, then DelayStart (:395) flips it to RUNNING after the
-policy delay.  Restart history is tracked per slot (restartedInstances ring).
+policy delay.  Restart history is tracked per slot (restartedInstances
+ring) and RESETS when the task spec changes (:223 specVersion check), so a
+slot that exhausted max_attempts under a broken spec restarts again after
+a service update.  Before promoting, DelayStart also waits for the old
+task to actually stop (or its node to go down / disappear, or a 1-minute
+timeout) so a slot never runs two tasks concurrently; the restart delay is
+skipped for tasks leaving a drained node (:156).
 """
 
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 from collections import deque
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Optional
 
 from swarmkit_tpu.api import RestartCondition, TaskState
+from swarmkit_tpu.api.types import NodeAvailability, NodeState
 from swarmkit_tpu.manager.orchestrator import common
 from swarmkit_tpu.store.memory import MemoryStore
 from swarmkit_tpu.utils.clock import Clock, SystemClock
 
 log = logging.getLogger("swarmkit_tpu.orchestrator.restart")
+
+# reference defaultOldTaskTimeout (restart.go:20): the longest the
+# replacement waits for the old task to stop before starting anyway
+OLD_TASK_TIMEOUT = 60.0
 
 
 @dataclass
@@ -28,13 +40,28 @@ class _Instance:
     timestamp: float
 
 
+@dataclass
+class _History:
+    """Per-slot restart record (reference restartedInstanceInfo)."""
+    spec_key: int
+    total: int = 0
+    instances: deque = field(default_factory=lambda: deque(maxlen=256))
+
+
+def _spec_key(task) -> int:
+    """Stable fingerprint of the spec a task runs; plays the role of the
+    reference's Task.SpecVersion (restart history resets across updates)."""
+    return hash(json.dumps(task.spec.to_dict(), sort_keys=True, default=str))
+
+
 class RestartSupervisor:
     def __init__(self, store: MemoryStore, clock: Optional[Clock] = None
                  ) -> None:
         self.store = store
         self.clock = clock or SystemClock()
-        # slot tuple -> deque of restart timestamps (restart.go history)
-        self._history: dict[tuple, deque] = {}
+        self.old_task_timeout = OLD_TASK_TIMEOUT
+        # slot tuple -> _History (restart.go historyByService)
+        self._history: dict[tuple, _History] = {}
         self._delays: dict[str, asyncio.Task] = {}  # new task id -> timer
 
     async def stop(self) -> None:
@@ -59,14 +86,16 @@ class RestartSupervisor:
         policy = common.restart_policy(task)
         if policy.max_attempts == 0:
             return True
-        slot = common.slot_tuple(task)
-        history = self._history.get(slot, deque())
+        h = self._history.get(common.slot_tuple(task))
+        if h is None or h.spec_key != _spec_key(task):
+            # no history under THIS spec: a service update wipes the
+            # slot's strike count (restart.go:223)
+            return True
+        if policy.window <= 0:
+            return h.total < policy.max_attempts
         now = self.clock.now()
-        if policy.window > 0:
-            recent = sum(1 for inst in history
-                         if now - inst.timestamp <= policy.window)
-        else:
-            recent = len(history)
+        recent = sum(1 for inst in h.instances
+                     if now - inst.timestamp <= policy.window)
         return recent < policy.max_attempts
 
     def restart(self, tx, cluster, service, task) -> None:
@@ -92,13 +121,34 @@ class RestartSupervisor:
         tx.create(new)
 
         slot = common.slot_tuple(task)
-        self._history.setdefault(slot, deque(maxlen=256)).append(
-            _Instance(timestamp=self.clock.now()))
-        self.delay_start(new.id, policy.delay)
+        key = _spec_key(task)
+        h = self._history.get(slot)
+        if h is None or h.spec_key != key:
+            h = self._history[slot] = _History(spec_key=key)
+        h.total += 1
+        h.instances.append(_Instance(timestamp=self.clock.now()))
+
+        node = tx.get("node", task.node_id) if task.node_id else None
+        # restart delay is not applied to drained nodes (restart.go:156):
+        # evacuation replacements start immediately
+        drained = (node is not None and node.spec is not None
+                   and node.spec.availability == NodeAvailability.DRAIN)
+        delay = 0.0 if drained else policy.delay
+        # wait for the old task to stop before starting the replacement,
+        # unless it is already dead or its node is down (restart.go:169)
+        node_down = (node is not None and node.status is not None
+                     and node.status.state == NodeState.DOWN)
+        wait_stop = not (node_down or task.status.state > TaskState.RUNNING)
+        self.delay_start(new.id, delay,
+                         old_task=task if wait_stop else None)
 
     # ------------------------------------------------------------------
-    def delay_start(self, task_id: str, delay: float) -> None:
-        """reference: DelayStart restart.go:395."""
+    def delay_start(self, task_id: str, delay: float,
+                    old_task=None) -> None:
+        """reference: DelayStart restart.go:395 — sleep the restart delay,
+        then (when `old_task` is given) hold the replacement in READY until
+        the old task stops running, its node goes down or disappears, or
+        `old_task_timeout` elapses, so the slot never runs two tasks."""
         if task_id in self._delays:
             return
 
@@ -106,6 +156,8 @@ class RestartSupervisor:
             try:
                 if delay > 0:
                     await self.clock.sleep(delay)
+                if old_task is not None:
+                    await self._wait_old_task_stopped(old_task)
                 await self.store.update(lambda tx: self._promote(tx, task_id))
             except asyncio.CancelledError:
                 pass
@@ -115,6 +167,52 @@ class RestartSupervisor:
                 self._delays.pop(task_id, None)
 
         self._delays[task_id] = asyncio.get_running_loop().create_task(_timer())
+
+    def _old_task_gone(self, old_task) -> bool:
+        t = self.store.get("task", old_task.id)
+        if t is None or t.status.state > TaskState.RUNNING:
+            return True
+        if old_task.node_id:
+            n = self.store.get("node", old_task.node_id)
+            if n is None or (n.status is not None
+                             and n.status.state == NodeState.DOWN):
+                return True
+        return False
+
+    async def _wait_old_task_stopped(self, old_task) -> None:
+        """Event-driven wait (reference DelayStart's watch on the old
+        task/node, restart.go:420): wake on updates to the old task or its
+        node rather than polling, bounded by old_task_timeout."""
+        def relevant(ev):
+            from swarmkit_tpu.store.memory import Event
+
+            if not isinstance(ev, Event):
+                return False
+            return ((ev.kind == "task" and ev.object.id == old_task.id)
+                    or (old_task.node_id and ev.kind == "node"
+                        and ev.object.id == old_task.node_id))
+
+        watcher = self.store.watch(relevant)
+        try:
+            # subscribe-then-check: an event between the check and the
+            # subscription cannot be missed this way
+            if self._old_task_gone(old_task):
+                return
+            timeout = asyncio.ensure_future(
+                self.clock.sleep(self.old_task_timeout))
+            try:
+                while not self._old_task_gone(old_task):
+                    ev = asyncio.ensure_future(watcher.get())
+                    done, _ = await asyncio.wait(
+                        {ev, timeout}, return_when=asyncio.FIRST_COMPLETED)
+                    if ev not in done:
+                        ev.cancel()
+                    if timeout in done:
+                        return   # waited long enough; start anyway
+            finally:
+                timeout.cancel()
+        finally:
+            watcher.close()
 
     @staticmethod
     def _promote(tx, task_id: str) -> None:
@@ -128,6 +226,12 @@ class RestartSupervisor:
         timer = self._delays.pop(task_id, None)
         if timer is not None:
             timer.cancel()
+
+    def clear_service_history(self, service_id: str) -> None:
+        """reference: ClearServiceHistory restart.go:525 — forget strike
+        counts when a service is removed."""
+        for slot in [s for s in self._history if s[1] == service_id]:
+            del self._history[slot]
 
     def pending_delays(self) -> int:
         return len(self._delays)

@@ -192,6 +192,173 @@ async def test_restart_max_attempts():
 
 
 @async_test
+async def test_restart_history_resets_on_spec_change():
+    """A slot that exhausted max_attempts restarts again once the task
+    spec changes (reference shouldRestart restart.go:223 specVersion
+    check) — otherwise a service update fixing a broken image could never
+    revive the slot."""
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    sup = RestartSupervisor(store, clock=clock)
+    svc = make_service(replicas=1, restart=RestartPolicy(
+        condition=RestartCondition.ANY, delay=0.0, max_attempts=1))
+    t1 = common.new_task(None, svc, slot=1)
+    t1.status.state = TaskState.FAILED
+    await store.update(lambda tx: tx.create(t1))
+    assert sup.should_restart(t1, svc)
+    await store.update(lambda tx: sup.restart(tx, None, svc, t1))
+    await pump(clock)
+
+    t2 = [t for t in store.find("task") if t.id != t1.id][0]
+    t2.status.state = TaskState.FAILED
+    assert not sup.should_restart(t2, svc)   # strike count exhausted
+
+    svc.spec.task.container.image = "nginx:2"   # the operator's fix
+    t3 = common.new_task(None, svc, slot=1)
+    t3.status.state = TaskState.FAILED
+    assert sup.should_restart(t3, svc)       # fresh history under new spec
+
+    # explicit clear (service removal) also wipes the slot's strikes
+    sup.clear_service_history(svc.id)
+    assert sup.should_restart(t2, svc)
+    await sup.stop()
+
+
+@async_test
+async def test_restart_waits_for_old_task_to_stop():
+    """The replacement is held in READY past its delay until the old task
+    actually stops (reference DelayStart waitStop restart.go:169) — a slot
+    never runs two tasks concurrently during a slow shutdown."""
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    sup = RestartSupervisor(store, clock=clock)
+    svc = make_service(replicas=1, restart=RestartPolicy(
+        condition=RestartCondition.ANY, delay=0.0))
+    node = make_node(1)
+    t1 = common.new_task(None, svc, slot=1)
+    t1.node_id = node.id
+    t1.status.state = TaskState.RUNNING   # still up while being replaced
+
+    def setup(tx):
+        tx.create(node)
+        tx.create(t1)
+        sup.restart(tx, None, svc, t1)
+    await store.update(setup)
+    await pump(clock, seconds=0.2)
+
+    repl = [t for t in store.find("task") if t.id != t1.id][0]
+    assert store.get("task", repl.id).desired_state == TaskState.READY
+
+    def stop_old(tx):
+        t = tx.get("task", t1.id)
+        t.status.state = TaskState.SHUTDOWN
+        tx.update(t)
+    await store.update(stop_old)
+    await pump(clock, seconds=0.2)
+    assert store.get("task", repl.id).desired_state == TaskState.RUNNING
+    await sup.stop()
+
+
+@async_test
+async def test_restart_no_wait_when_node_down():
+    """A dead node can't report its task stopped: the replacement starts
+    immediately (reference restart.go:173 waitStop=false)."""
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    sup = RestartSupervisor(store, clock=clock)
+    svc = make_service(replicas=1, restart=RestartPolicy(
+        condition=RestartCondition.ANY, delay=0.0))
+    node = make_node(1)
+    node.status.state = NodeState.DOWN
+    t1 = common.new_task(None, svc, slot=1)
+    t1.node_id = node.id
+    t1.status.state = TaskState.RUNNING   # stale: the node is gone
+
+    def setup(tx):
+        tx.create(node)
+        tx.create(t1)
+        sup.restart(tx, None, svc, t1)
+    await store.update(setup)
+    await pump(clock, seconds=0.1)
+    repl = [t for t in store.find("task") if t.id != t1.id][0]
+    assert store.get("task", repl.id).desired_state == TaskState.RUNNING
+    await sup.stop()
+
+
+@async_test
+async def test_drained_node_skips_restart_delay():
+    """Evacuation replacements are not rate-limited: the restart delay is
+    skipped when the old task's node is drained (reference restart.go:156)."""
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    sup = RestartSupervisor(store, clock=clock)
+    svc = make_service(replicas=1, restart=RestartPolicy(
+        condition=RestartCondition.ANY, delay=30.0))
+    node = make_node(1)
+    node.spec.availability = NodeAvailability.DRAIN
+    t1 = common.new_task(None, svc, slot=1)
+    t1.node_id = node.id
+    t1.status.state = TaskState.SHUTDOWN   # already stopped by the agent
+
+    def setup(tx):
+        tx.create(node)
+        tx.create(t1)
+        sup.restart(tx, None, svc, t1)
+    await store.update(setup)
+    await pump(clock, seconds=0.1)   # far less than the 30s delay
+    repl = [t for t in store.find("task") if t.id != t1.id][0]
+    assert store.get("task", repl.id).desired_state == TaskState.RUNNING
+    await sup.stop()
+
+
+@async_test
+async def test_checktasks_rearm_keeps_old_task_wait_and_credits_delay():
+    """After a leader change, check_tasks re-arms parked READY replacements
+    WITH the slot's still-draining predecessor as the old-task wait (an
+    improvement over reference init.go:94, which passes nil there) and
+    credits time already waited against the restart delay (init.go:74-87)."""
+    from swarmkit_tpu.manager.orchestrator.taskinit import check_tasks
+
+    clock = FakeClock()
+    await clock.advance(10.0)   # a nonzero epoch (0.0 reads as "unset")
+    store = MemoryStore(clock=clock.now)
+    sup = RestartSupervisor(store, clock=clock)
+    svc = make_service(replicas=1, restart=RestartPolicy(
+        condition=RestartCondition.ANY, delay=100.0))
+    node = make_node(1)
+    old = common.new_task(None, svc, slot=1)
+    old.node_id = node.id
+    old.status.state = TaskState.RUNNING       # still draining
+    old.desired_state = int(TaskState.SHUTDOWN)
+    parked = common.new_task(None, svc, slot=1)
+    parked.desired_state = int(TaskState.READY)
+    parked.status.timestamp = clock.now()       # failure happened "now"
+
+    def setup(tx):
+        tx.create(svc)
+        tx.create(node)
+        tx.create(old)
+        tx.create(parked)
+    await store.update(setup)
+
+    await clock.advance(99.9)                   # pre-failover waiting
+    await check_tasks(store, sup, Mode.REPLICATED)
+    # delay is credited: only ~0.1s remains, NOT a fresh 100s
+    await pump(clock, seconds=1.0)
+    # ...but the old task still runs, so the replacement stays READY
+    assert store.get("task", parked.id).desired_state == TaskState.READY
+
+    def stop_old(tx):
+        t = tx.get("task", old.id)
+        t.status.state = TaskState.SHUTDOWN
+        tx.update(t)
+    await store.update(stop_old)
+    await pump(clock, seconds=0.2)
+    assert store.get("task", parked.id).desired_state == TaskState.RUNNING
+    await sup.stop()
+
+
+@async_test
 async def test_rolling_update_stop_first():
     clock = FakeClock()
     store = MemoryStore(clock=clock.now)
