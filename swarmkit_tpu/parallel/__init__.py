@@ -49,19 +49,26 @@ def host_row_mesh(rows: int, hosts: int = 2,
     one small cross-host (DCN) combine — the standard outer-DCN /
     inner-ICI layout (reference analog: swarmkit's managers span machines
     over gRPC; here the placement hierarchy is explicit in the mesh).
-    Degrades gracefully: hosts and chips shrink until they divide the
-    device count and the row count (worst case 1x1).
+    Degrades gracefully: among shapes with hosts <= the request and
+    hosts*chips dividing the row count, the one using the MOST devices
+    wins (ties keep more hosts; worst case 1x1) — hosts need not divide
+    the device count, since only a hosts*chips prefix of devices is used.
     """
     devices = list(devices if devices is not None else jax.devices())
     d = len(devices)
-    hosts = max(1, min(hosts, d))
-    # hosts must divide the device count AND the row count (the rows shard
-    # over the flattened hosts*chips product, so each factor must divide)
-    while hosts > 1 and (d % hosts or rows % hosts):
-        hosts -= 1
-    chips = d // hosts
-    while chips > 1 and rows % (hosts * chips):
-        chips -= 1
+    # rows shard over the FLATTENED hosts*chips product, so the only hard
+    # constraint is hosts*chips | rows (and <= d).  Pick the (h, c) pair
+    # maximizing device usage; ties keep the most hosts (h scans downward
+    # from the request, so the first maximum wins).
+    req = max(1, min(hosts, d))
+    best_h, best_c = 1, 1
+    for h in range(req, 0, -1):
+        c = d // h
+        while c > 1 and rows % (h * c):
+            c -= 1
+        if rows % (h * c) == 0 and h * c > best_h * best_c:
+            best_h, best_c = h, c
+    hosts, chips = best_h, best_c
     import numpy as _np
 
     arr = _np.array(devices[:hosts * chips]).reshape(hosts, chips)

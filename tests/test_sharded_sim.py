@@ -389,7 +389,11 @@ class TestMultiHostMesh:
         assert m3.devices.shape == (1, 7)
         # prime rows > device count: worst case collapses to 1x1
         m4 = host_row_mesh(11, hosts=2)
-        assert 11 % (m4.devices.shape[0] * m4.devices.shape[1]) == 0
+        assert m4.devices.shape == (1, 1)
+        # degradation maximizes device USAGE, not host count: rows=10
+        # can't use 2x(4,3,2) but CAN use 1x5 — prefer the 5-device mesh
+        m5 = host_row_mesh(10, hosts=2)
+        assert m5.devices.shape == (1, 5)
 
     def test_2d_mesh_bit_identical_with_faults(self):
         from swarmkit_tpu.parallel import HOST_ROW_AXES, host_row_mesh
