@@ -144,20 +144,22 @@ class RestartSupervisor:
 
     # ------------------------------------------------------------------
     def delay_start(self, task_id: str, delay: float,
-                    old_task=None) -> None:
+                    old_task=None, old_tasks=None) -> None:
         """reference: DelayStart restart.go:395 — sleep the restart delay,
-        then (when `old_task` is given) hold the replacement in READY until
-        the old task stops running, its node goes down or disappears, or
-        `old_task_timeout` elapses, so the slot never runs two tasks."""
+        then (when old task(s) are given) hold the replacement in READY
+        until EVERY one of them stops running, its node goes down or
+        disappears, or `old_task_timeout` elapses, so the slot never runs
+        two tasks."""
         if task_id in self._delays:
             return
+        olds = list(old_tasks or ([] if old_task is None else [old_task]))
 
         async def _timer():
             try:
                 if delay > 0:
                     await self.clock.sleep(delay)
-                if old_task is not None:
-                    await self._wait_old_task_stopped(old_task)
+                for old in olds:
+                    await self._wait_old_task_stopped(old)
                 await self.store.update(lambda tx: self._promote(tx, task_id))
             except asyncio.CancelledError:
                 pass
@@ -216,8 +218,11 @@ class RestartSupervisor:
 
     @staticmethod
     def _promote(tx, task_id: str) -> None:
+        """reference: StartNow restart.go:487 — any task still desired
+        below RUNNING is started; already-started or re-purposed tasks
+        are left alone."""
         t = tx.get("task", task_id)
-        if t is None or t.desired_state != TaskState.READY:
+        if t is None or t.desired_state >= TaskState.RUNNING:
             return
         t.desired_state = int(TaskState.RUNNING)
         tx.update(t)

@@ -47,6 +47,12 @@ class UpdateSupervisor:
         """Start the updater for a service; a second call with an UNCHANGED
         spec while one is running is a no-op — only a newer spec replaces the
         in-flight updater (reference: Supervisor.Update :50)."""
+        # A paused update stays paused until the OPERATOR acts: a new
+        # service-update resets update_status (controlapi), which is the
+        # only resume path (reference: Updater.Run updater.go:130).
+        if service.update_status is not None \
+                and service.update_status.state in (PAUSED, ROLLBACK_PAUSED):
+            return
         digest = service.spec.to_dict()
         old = self._updates.get(service.id)
         if old is not None and not old.done():
@@ -136,6 +142,52 @@ class UpdateSupervisor:
                            cfg: UpdateConfig) -> bool:
         """Replace one slot's task; True on success
         (reference: updateTask updater.go:411)."""
+        # A half-updated slot may already hold a task matching the new
+        # spec (an earlier updater died between create and cleanup):
+        # finish the slot by removing the others instead of churning the
+        # healthy new task (reference worker/useExistingTask
+        # updater.go:313-485).
+        clean = [t for t in slot if not common.is_task_dirty(service, t)]
+        existing = next(
+            (t for t in clean if t.desired_state == TaskState.RUNNING),
+            None) or next(
+            (t for t in clean if t.desired_state < TaskState.RUNNING), None)
+        if existing is not None:
+            draining: list = []
+            reused = False
+
+            def finish(tx):
+                nonlocal reused
+                draining.clear()
+                # the slot snapshot is stale by the time this batch runs:
+                # re-validate the candidate INSIDE the transaction — a
+                # clean task that died meanwhile must not absorb the slot
+                cur_ex = tx.get("task", existing.id)
+                if cur_ex is None \
+                        or cur_ex.desired_state > TaskState.RUNNING \
+                        or common.in_terminal_state(cur_ex):
+                    return
+                reused = True
+                for old in slot:
+                    if old.id == existing.id:
+                        continue
+                    cur = tx.get("task", old.id)
+                    if cur is not None \
+                            and cur.desired_state <= TaskState.RUNNING:
+                        cur.desired_state = int(TaskState.SHUTDOWN)
+                        tx.update(cur)
+                        if cur.status.state <= TaskState.RUNNING:
+                            draining.append(cur)
+            await self.store.update(finish)
+            if reused:
+                if existing.desired_state >= TaskState.RUNNING:
+                    return True
+                # parked below RUNNING: start it once ALL old tasks drain
+                self.restart.delay_start(existing.id, 0.0,
+                                         old_tasks=draining)
+                return await self._wait_running(existing.id, cfg.monitor)
+            # candidate died under us: fall through and create a fresh task
+
         slot_num = slot[0].slot if slot else 0
         node_id = slot[0].node_id if slot and not slot_num else ""
         new = common.new_task(cluster, service, slot=slot_num,

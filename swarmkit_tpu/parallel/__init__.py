@@ -51,28 +51,53 @@ def host_row_mesh(rows: int, hosts: int = 2,
     over gRPC; here the placement hierarchy is explicit in the mesh).
     Degrades gracefully: among shapes with hosts <= the request and
     hosts*chips dividing the row count, the one using the MOST devices
-    wins (ties keep more hosts; worst case 1x1) — hosts need not divide
-    the device count, since only a hosts*chips prefix of devices is used.
+    wins (ties keep more hosts; worst case 1x1).  On a real multi-process
+    topology the hosts axis follows `device.process_index` and the chips
+    axis never crosses a host boundary; in a single process (CPU
+    simulation) the partition is simulated over a device prefix.
     """
+    import numpy as _np
+
     devices = list(devices if devices is not None else jax.devices())
-    d = len(devices)
-    # rows shard over the FLATTENED hosts*chips product, so the only hard
-    # constraint is hosts*chips | rows (and <= d).  Pick the (h, c) pair
-    # maximizing device usage; ties keep the most hosts (h scans downward
-    # from the request, so the first maximum wins).
-    req = max(1, min(hosts, d))
+    groups: dict[int, list] = {}
+    for dev in devices:
+        groups.setdefault(getattr(dev, "process_index", 0), []).append(dev)
+    if len(groups) > 1:
+        # REAL multi-host topology: the hosts axis follows physical
+        # processes and the chips axis never crosses a host boundary —
+        # otherwise "ICI-local" phases would silently ride the DCN.
+        order = sorted(groups)
+        h, c = pick_host_shape(rows, min(hosts, len(order)),
+                               [len(groups[p]) for p in order])
+        arr = _np.array([groups[p][:c] for p in order[:h]])
+    else:
+        # single process (CPU simulation, or one host): every device is
+        # equidistant, so any prefix reshape is placement-correct and the
+        # hosts axis is a SIMULATED partition
+        h, c = pick_host_shape(rows, min(hosts, len(devices)),
+                               None, total=len(devices))
+        arr = _np.array(devices[:h * c]).reshape(h, c)
+    return Mesh(arr, axis_names=(DCN_AXIS, ICI_AXIS))
+
+
+def pick_host_shape(rows: int, max_hosts: int,
+                    group_sizes: Optional[list] = None,
+                    total: int = 0) -> tuple:
+    """(hosts, chips) maximizing devices used, s.t. hosts*chips | rows.
+
+    With `group_sizes` (real multi-host), chips is bounded by the SMALLEST
+    host's device count so the mesh stays rectangular without crossing
+    host boundaries; without it, any (h, c) with h*c <= total works.
+    Ties prefer more hosts (h scans downward, strict improvement wins).
+    """
     best_h, best_c = 1, 1
-    for h in range(req, 0, -1):
-        c = d // h
+    for h in range(max(1, max_hosts), 0, -1):
+        c = min(g for g in group_sizes[:h]) if group_sizes else total // h
         while c > 1 and rows % (h * c):
             c -= 1
         if rows % (h * c) == 0 and h * c > best_h * best_c:
             best_h, best_c = h, c
-    hosts, chips = best_h, best_c
-    import numpy as _np
-
-    arr = _np.array(devices[:hosts * chips]).reshape(hosts, chips)
-    return Mesh(arr, axis_names=(DCN_AXIS, ICI_AXIS))
+    return best_h, best_c
 
 
 HOST_ROW_AXES = (DCN_AXIS, ICI_AXIS)

@@ -421,6 +421,156 @@ async def test_rolling_update_stop_first():
 
 
 @async_test
+async def test_update_reuses_existing_clean_task_in_half_updated_slot():
+    """If a previous updater died after creating the new-spec task but
+    before cleaning the slot, the next pass finishes the slot — shutting
+    down the dirty task and starting the parked clean one — instead of
+    churning a THIRD task (reference worker/useExistingTask
+    updater.go:313-485)."""
+    from swarmkit_tpu.manager.orchestrator.restart import RestartSupervisor
+    from swarmkit_tpu.manager.orchestrator.update import UpdateSupervisor
+
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    restart_sup = RestartSupervisor(store, clock=clock)
+    upd = UpdateSupervisor(store, restart_sup, clock=clock)
+    svc = make_service(replicas=1, image="nginx:2",
+                       update=UpdateConfig(parallelism=1, monitor=0.3))
+
+    old = common.new_task(None, svc, slot=1)
+    old.spec.container.image = "nginx:1"          # dirty vs the new spec
+    old.status.state = TaskState.RUNNING
+    clean = common.new_task(None, svc, slot=1)     # the stranded new task
+    clean.desired_state = int(TaskState.READY)
+
+    def setup(tx):
+        tx.create(svc)
+        tx.create(old)
+        tx.create(clean)
+    await store.update(setup)
+
+    upd.update(None, svc, [[old, clean]])
+    await pump(clock, seconds=0.1)
+    # old drains; agent reports it stopped
+    assert store.get("task", old.id).desired_state == TaskState.SHUTDOWN
+
+    def agent_stop(tx):
+        t = tx.get("task", old.id)
+        t.status.state = TaskState.SHUTDOWN
+        tx.update(t)
+    await store.update(agent_stop)
+
+    for _ in range(20):
+        await pump(clock, seconds=0.05)
+        c = store.get("task", clean.id)
+        if c.desired_state == TaskState.RUNNING:
+            break
+    assert store.get("task", clean.id).desired_state == TaskState.RUNNING
+    # no third task was created
+    assert len(store.find("task", ByService(svc.id))) == 2
+    await upd.stop()
+    await restart_sup.stop()
+
+
+@async_test
+async def test_paused_update_stays_paused_until_operator_acts():
+    """failure_action=PAUSE halts the rollout AND keeps it halted across
+    later reconciles (reference Updater.Run updater.go:130 refuses paused
+    updates); only the operator's next service-update — which resets
+    update_status (controlapi) — resumes it."""
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    orch = ReplicatedOrchestrator(store, clock=clock)
+    await orch.start()
+    svc = make_service(replicas=3, update=UpdateConfig(
+        parallelism=1, monitor=0.2, max_failure_ratio=0.0))
+    await store.update(lambda tx: tx.create(svc))
+    await pump(clock)
+
+    def run_all(tx):
+        for t in store.find("task", ByService(svc.id)):
+            cur = tx.get("task", t.id)
+            cur.status.state = TaskState.RUNNING
+            tx.update(cur)
+    await store.update(run_all)
+    await pump(clock)
+
+    # dirty the spec; the FIRST replacement task fails -> paused
+    svc2 = store.get("service", svc.id)
+    svc2.spec.task.container.image = "nginx:2"
+    await store.update(lambda tx: tx.update(svc2))
+    for _ in range(40):
+        def agent_fail_new(tx):
+            for t in store.find("task", ByService(svc.id)):
+                cur = tx.get("task", t.id)
+                if cur is None:
+                    continue
+                if cur.spec.container.image == "nginx:2" \
+                        and cur.desired_state >= TaskState.READY \
+                        and not common.in_terminal_state(cur):
+                    cur.status.state = TaskState.FAILED
+                    tx.update(cur)
+                elif cur.desired_state == TaskState.SHUTDOWN \
+                        and cur.status.state < TaskState.SHUTDOWN:
+                    cur.status.state = TaskState.SHUTDOWN
+                    tx.update(cur)
+        await store.update(agent_fail_new)
+        await pump(clock, seconds=0.1)
+        s = store.get("service", svc.id)
+        if s.update_status is not None and s.update_status.state == "paused":
+            break
+    else:
+        raise AssertionError("update never paused")
+
+    # old tasks on the old image are untouched beyond the first slot
+    n_after_pause = len(store.find("task", ByService(svc.id)))
+
+    # later reconciles (task events, ticks) must NOT resume the rollout
+    await pump(clock, seconds=2.0)
+    def poke(tx):   # any store event that wakes the orchestrator
+        s = tx.get("service", svc.id)
+        tx.update(s)
+    await store.update(poke)
+    await pump(clock, seconds=2.0)
+    s = store.get("service", svc.id)
+    assert s.update_status.state == "paused"
+    assert len(store.find("task", ByService(svc.id))) == n_after_pause, \
+        "paused update created more replacement tasks"
+
+    # the operator updates the service again: status resets, rollout runs
+    def operator_update(tx):
+        s = tx.get("service", svc.id)
+        s.spec.task.container.image = "nginx:3"
+        s.update_status = None       # what controlapi.update_service does
+        tx.update(s)
+    await store.update(operator_update)
+    for _ in range(60):
+        def agent_ok(tx):
+            for t in store.find("task", ByService(svc.id)):
+                cur = tx.get("task", t.id)
+                if cur is None:
+                    continue
+                if cur.desired_state == TaskState.SHUTDOWN \
+                        and cur.status.state < TaskState.SHUTDOWN:
+                    cur.status.state = TaskState.SHUTDOWN
+                    tx.update(cur)
+                elif cur.desired_state == TaskState.RUNNING \
+                        and cur.status.state < TaskState.RUNNING \
+                        and cur.spec.container.image == "nginx:3":
+                    cur.status.state = TaskState.RUNNING
+                    tx.update(cur)
+        await store.update(agent_ok)
+        await pump(clock, seconds=0.1)
+        live = live_tasks(store, svc.id)
+        if len(live) == 3 and all(t.spec.container.image == "nginx:3"
+                                  for t in live):
+            break
+    else:
+        raise AssertionError("resumed update did not converge")
+    await orch.stop()
+
+
+@async_test
 async def test_global_orchestrator_one_task_per_node():
     clock = FakeClock()
     store = MemoryStore(clock=clock.now)

@@ -392,8 +392,24 @@ class TestMultiHostMesh:
         assert m4.devices.shape == (1, 1)
         # degradation maximizes device USAGE, not host count: rows=10
         # can't use 2x(4,3,2) but CAN use 1x5 — prefer the 5-device mesh
+        # (legitimate here: one process, so the partition is simulated)
         m5 = host_row_mesh(10, hosts=2)
         assert m5.devices.shape == (1, 5)
+
+    def test_pick_host_shape_respects_physical_groups(self):
+        """On a real multi-process topology the chips axis must not cross
+        a host boundary: shapes are bounded by per-host device counts."""
+        from swarmkit_tpu.parallel import pick_host_shape
+
+        # 2 hosts x 4 chips, rows=10: a simulated prefix could use 1x5,
+        # but 5 chips span hosts — the grouped search picks 2x1 instead
+        assert pick_host_shape(10, 2, [4, 4]) == (2, 1)
+        # rows=64 uses everything
+        assert pick_host_shape(64, 2, [4, 4]) == (2, 4)
+        # uneven hosts: chips bounded by the smallest participating host
+        assert pick_host_shape(64, 2, [4, 2]) == (2, 2)
+        # single host requested on multi-host: stays within host 0
+        assert pick_host_shape(64, 1, [4, 2]) == (1, 4)
 
     def test_2d_mesh_bit_identical_with_faults(self):
         from swarmkit_tpu.parallel import HOST_ROW_AXES, host_row_mesh
