@@ -104,6 +104,12 @@ class Message:
         return json.dumps(self.to_dict(), sort_keys=True,
                           separators=(",", ":")).encode()
 
+    def fingerprint(self) -> int:
+        """Stable per-process fingerprint of the canonical encoding —
+        plays the reference's SpecVersion role wherever spec-change
+        detection is needed (restart history, scheduler failure taints)."""
+        return hash(self.encode())
+
     @classmethod
     def decode(cls, raw: bytes):
         return cls.from_dict(json.loads(raw.decode()))

@@ -16,7 +16,6 @@ skipped for tasks leaving a drained node (:156).
 from __future__ import annotations
 
 import asyncio
-import json
 import logging
 from collections import deque
 from dataclasses import dataclass, field
@@ -51,7 +50,7 @@ class _History:
 def _spec_key(task) -> int:
     """Stable fingerprint of the spec a task runs; plays the role of the
     reference's Task.SpecVersion (restart history resets across updates)."""
-    return hash(json.dumps(task.spec.to_dict(), sort_keys=True, default=str))
+    return task.spec.fingerprint()
 
 
 class RestartSupervisor:
@@ -158,8 +157,12 @@ class RestartSupervisor:
             try:
                 if delay > 0:
                     await self.clock.sleep(delay)
-                for old in olds:
-                    await self._wait_old_task_stopped(old)
+                if olds:
+                    # ONE deadline across all old tasks: N stuck nodes must
+                    # not compound the bound to N x old_task_timeout
+                    deadline = self.clock.now() + self.old_task_timeout
+                    for old in olds:
+                        await self._wait_old_task_stopped(old, deadline)
                 await self.store.update(lambda tx: self._promote(tx, task_id))
             except asyncio.CancelledError:
                 pass
@@ -181,10 +184,13 @@ class RestartSupervisor:
                 return True
         return False
 
-    async def _wait_old_task_stopped(self, old_task) -> None:
+    async def _wait_old_task_stopped(self, old_task,
+                                     deadline: Optional[float] = None
+                                     ) -> None:
         """Event-driven wait (reference DelayStart's watch on the old
         task/node, restart.go:420): wake on updates to the old task or its
-        node rather than polling, bounded by old_task_timeout."""
+        node rather than polling, bounded by `deadline` (default: one
+        old_task_timeout from now)."""
         def relevant(ev):
             from swarmkit_tpu.store.memory import Event
 
@@ -200,8 +206,10 @@ class RestartSupervisor:
             # subscription cannot be missed this way
             if self._old_task_gone(old_task):
                 return
+            if deadline is None:
+                deadline = self.clock.now() + self.old_task_timeout
             timeout = asyncio.ensure_future(
-                self.clock.sleep(self.old_task_timeout))
+                self.clock.sleep(max(0.0, deadline - self.clock.now())))
             try:
                 while not self._old_task_gone(old_task):
                     ev = asyncio.ensure_future(watcher.get())

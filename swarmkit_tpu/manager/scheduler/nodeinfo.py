@@ -14,6 +14,11 @@ from swarmkit_tpu.api import TaskState
 from swarmkit_tpu.api.types import TERMINAL_STATES
 
 
+# reference nodeinfo.go: monitorFailures = 5*time.Minute, maxFailures = 5
+FAILURE_WINDOW = 300.0
+FAILURE_LIMIT = 5
+
+
 def task_reserved(task) -> tuple[int, int, dict]:
     res = task.spec.resources
     if res is None or res.reservations is None:
@@ -49,8 +54,12 @@ class NodeInfo:
             self._advertised_named = {
                 k: frozenset(v)
                 for k, v in desc.resources.generic_named.items()}
-        # service id -> timestamps of recent task failures on this node
-        self.recent_failures: dict[str, list[float]] = {}
+        # (service id, spec fingerprint) -> timestamps of recent task
+        # failures on this node.  Keying by spec too means a service
+        # update escapes the taint (reference versionedService,
+        # nodeinfo.go:153) — failures of the broken old spec must not
+        # penalize the fixed new one.
+        self.recent_failures: dict[tuple, list[float]] = {}
         for t in (tasks or {}).values():
             self.add_task(t)
 
@@ -134,18 +143,37 @@ class NodeInfo:
     def count_for_service(self, service_id: str) -> int:
         return self.active_tasks_per_service.get(service_id, 0)
 
-    def record_failure(self, service_id: str, now: float) -> None:
-        """reference: nodeinfo.go taskFailed — failures keyed by service."""
-        self.recent_failures.setdefault(service_id, []).append(now)
+    @staticmethod
+    def failure_key(task) -> tuple:
+        """reference versionedService: service id + spec fingerprint.
+        Fingerprinting serializes the spec — compute once per failure /
+        per scheduling group, never inside a comparator."""
+        return (task.service_id, task.spec.fingerprint())
 
-    def taint(self, service_id: str, now: float, window: float = 300.0,
-              limit: int = 5) -> bool:
-        """True when this node has failed THIS service's tasks too often
-        lately (reference: nodeinfo.go countRecentFailures + backoff)."""
-        hist = [t for t in self.recent_failures.get(service_id, ())
+    def record_failure(self, task, now: float,
+                       window: float = FAILURE_WINDOW) -> None:
+        """reference: nodeinfo.go taskFailed — failures keyed by the
+        versioned service (service id + spec).  Also sweeps keys whose
+        newest failure left the window (superseded spec revisions would
+        otherwise accumulate forever — the old key is never queried
+        again once a service is updated; reference lastCleanup sweep,
+        nodeinfo.go:181)."""
+        dead = [k for k, ts in self.recent_failures.items()
+                if not ts or now - ts[-1] >= window]
+        for k in dead:
+            del self.recent_failures[k]
+        self.recent_failures.setdefault(self.failure_key(task),
+                                        []).append(now)
+
+    def taint(self, key: tuple, now: float, window: float = FAILURE_WINDOW,
+              limit: int = FAILURE_LIMIT) -> bool:
+        """True when this node has failed tasks of THIS service spec
+        (key = failure_key(task), precomputed by the caller) too often
+        lately (reference: countRecentFailures + backoff)."""
+        hist = [t for t in self.recent_failures.get(key, ())
                 if now - t < window]
         if hist:
-            self.recent_failures[service_id] = hist
+            self.recent_failures[key] = hist
         else:
-            self.recent_failures.pop(service_id, None)
+            self.recent_failures.pop(key, None)
         return len(hist) >= limit

@@ -164,7 +164,7 @@ class Scheduler:
                          or prev.status.state != t.status.state):
                 info = self.node_set.get(t.node_id)
                 if info is not None:
-                    info.record_failure(t.service_id, self.clock.now())
+                    info.record_failure(t, self.clock.now())
             if t.status.state == TaskState.PENDING \
                     and t.desired_state <= TaskState.RUNNING:
                 if t.node_id:
@@ -307,12 +307,13 @@ class Scheduler:
             return a.active_task_count() < b.active_task_count()
 
         now = self.clock.now()
+        fkey = NodeInfo.failure_key(sample)   # once per group, not per cmp
 
         def best(a: NodeInfo, b: NodeInfo) -> bool:
             # nodes that keep failing this service's tasks lose ties
             # (reference: nodeLess + countRecentFailures backoff)
-            ta = a.taint(service_id, now)
-            tb = b.taint(service_id, now)
+            ta = a.taint(fkey, now)
+            tb = b.taint(fkey, now)
             if ta != tb:
                 return tb
             return better(a, b)

@@ -66,7 +66,10 @@ def host_row_mesh(rows: int, hosts: int = 2,
         # REAL multi-host topology: the hosts axis follows physical
         # processes and the chips axis never crosses a host boundary —
         # otherwise "ICI-local" phases would silently ride the DCN.
-        order = sorted(groups)
+        # Hosts are considered LARGEST-first so an uneven small host
+        # cannot cap the whole mesh (a 1+4 topology must be able to pick
+        # the 4-chip host alone).
+        order = sorted(groups, key=lambda p: (-len(groups[p]), p))
         h, c = pick_host_shape(rows, min(hosts, len(order)),
                                [len(groups[p]) for p in order])
         arr = _np.array([groups[p][:c] for p in order[:h]])
@@ -85,10 +88,12 @@ def pick_host_shape(rows: int, max_hosts: int,
                     total: int = 0) -> tuple:
     """(hosts, chips) maximizing devices used, s.t. hosts*chips | rows.
 
-    With `group_sizes` (real multi-host), chips is bounded by the SMALLEST
-    host's device count so the mesh stays rectangular without crossing
-    host boundaries; without it, any (h, c) with h*c <= total works.
-    Ties prefer more hosts (h scans downward, strict improvement wins).
+    With `group_sizes` (real multi-host, pre-sorted LARGEST-first by the
+    caller), a shape of h hosts uses the h largest hosts and chips is
+    bounded by the smallest of those, keeping the mesh rectangular
+    without crossing host boundaries; without it, any (h, c) with
+    h*c <= total works.  Ties prefer more hosts (h scans downward,
+    strict improvement wins).
     """
     best_h, best_c = 1, 1
     for h in range(max(1, max_hosts), 0, -1):

@@ -75,6 +75,62 @@ async def test_basic_assignment_spreads_least_loaded():
 
 
 @async_test
+async def test_failure_taint_steers_placement_and_spec_change_escapes():
+    """A node that keeps failing a service's tasks loses placement ties
+    (reference countRecentFailures backoff), but the taint is keyed by the
+    VERSIONED service — failures of the broken old spec must not penalize
+    the operator's fixed new spec (reference nodeinfo.go versionedService)."""
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    sched = Scheduler(store, clock=clock)
+    await store.update(lambda tx: [tx.create(make_node(0)),
+                                   tx.create(make_node(1))])
+    await sched.start()
+    await pump(clock)
+
+    # 5 tasks fail on node0 under spec A -> node0 is tainted for svc@A
+    failed = []
+    for i in range(5):
+        t = make_task(100 + i)
+        t.node_id = "node0"
+        t.status.state = TaskState.ASSIGNED
+        await store.update(lambda tx, t=t: tx.create(t))
+        await pump(clock, seconds=0.1)
+
+        def fail(tx, tid=t.id):
+            cur = tx.get("task", tid)
+            cur.status.state = TaskState.FAILED
+            cur.desired_state = int(TaskState.SHUTDOWN)
+            tx.update(cur)
+        await store.update(fail)
+        failed.append(t)
+        await pump(clock, seconds=0.1)
+
+    # new tasks of the SAME spec all avoid the tainted node0
+    await store.update(lambda tx: [tx.create(make_task(i))
+                                   for i in range(4)])
+    await pump(clock)
+    await pump(clock)
+    same = [store.get("task", f"task{i}") for i in range(4)]
+    assert all(t.status.state == TaskState.ASSIGNED for t in same)
+    assert all(t.node_id == "node1" for t in same), \
+        [(t.id, t.node_id) for t in same]
+
+    # a CHANGED spec escapes the taint: spreading resumes across BOTH nodes
+    changed = []
+    for i in range(10, 14):
+        t = make_task(i, cpus=1_000_000)   # different spec fingerprint
+        changed.append(t)
+    await store.update(lambda tx: [tx.create(t) for t in changed])
+    await pump(clock)
+    await pump(clock)
+    nodes_used = {store.get("task", t.id).node_id for t in changed}
+    assert "node0" in nodes_used, \
+        "fixed spec still penalized by the old spec's failures"
+    await sched.stop()
+
+
+@async_test
 async def test_resource_filter_blocks_oversubscription():
     clock = FakeClock()
     store = MemoryStore(clock=clock.now)

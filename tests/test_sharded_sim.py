@@ -410,6 +410,9 @@ class TestMultiHostMesh:
         assert pick_host_shape(64, 2, [4, 2]) == (2, 2)
         # single host requested on multi-host: stays within host 0
         assert pick_host_shape(64, 1, [4, 2]) == (1, 4)
+        # a tiny host must not cap the mesh: with sizes sorted
+        # largest-first, 1x4 on the big host beats 2x1 across both
+        assert pick_host_shape(4, 2, [4, 1]) == (1, 4)
 
     def test_2d_mesh_bit_identical_with_faults(self):
         from swarmkit_tpu.parallel import HOST_ROW_AXES, host_row_mesh
