@@ -30,7 +30,8 @@ from swarmkit_tpu.store.memory import Event, match
 
 
 async def bench(replicas: int, workers: int, managers: int = 1,
-                transport: str = "inproc") -> dict:
+                transport: str = "inproc", tick_interval: float = 0.05,
+                election_tick: int = 4) -> dict:
     import tempfile
 
     transport_factory = None
@@ -64,7 +65,8 @@ async def bench(replicas: int, workers: int, managers: int = 1,
         m = Manager(node_id=f"m{i}", addr=f"m{i}:4242", network=net,
                     state_dir=f"{tmp.name}/m{i}",
                     join_addr=mgrs[0].addr if mgrs else "",
-                    tick_interval=0.05, election_tick=4, seed=i,
+                    tick_interval=tick_interval,
+                    election_tick=election_tick, seed=i,
                     transport_factory=transport_factory)
         await m.start()
         mgrs.append(m)
@@ -146,9 +148,15 @@ def main(argv=None) -> int:
                    default="inproc",
                    help="raft wire: in-process queues or the device-mesh "
                         "mailbox backend")
+    p.add_argument("--tick-interval", type=float, default=0.05,
+                   help="raft tick seconds (raise to ~0.5 when the device "
+                        "wire runs on a real chip through a slow tunnel)")
+    p.add_argument("--election-tick", type=int, default=4)
     args = p.parse_args(argv)
     result = asyncio.run(bench(args.replicas, args.workers, args.managers,
-                               transport=args.transport))
+                               transport=args.transport,
+                               tick_interval=args.tick_interval,
+                               election_tick=args.election_tick))
     json.dump(result, sys.stdout)
     sys.stdout.write("\n")
     return 0

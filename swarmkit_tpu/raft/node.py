@@ -151,7 +151,11 @@ class Node(Proposer):
         # awaited with (node_id, addr) before a NEW member's ADD_NODE is
         # proposed; the manager points this at node-record creation
         self.pre_join_hook = None
-        self._JOIN_TIMEOUT_S = 30.0
+        # join budget scales with the tick: a slow wire (device mesh on a
+        # real chip through the axon tunnel, production 1s ticks) makes
+        # the seed's first election take many tick-times, and a joiner
+        # must outlast it rather than give up at a wall-clock constant
+        self._JOIN_TIMEOUT_S = max(30.0, 600 * opts.tick_interval)
 
         self._raw: Optional[RawNode] = None
         self._wait = Wait()
