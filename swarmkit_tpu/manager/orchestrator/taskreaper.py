@@ -52,7 +52,10 @@ class TaskReaper:
         clusters = self.store.find("cluster")
         if clusters:
             orch = clusters[0].spec.orchestration
-            if orch is not None and orch.task_history_retention_limit:
+            if orch is not None:
+                # the configured value verbatim: 0 keeps NO history and
+                # negative disables cleanup (reference reads the cluster
+                # field directly; the dataclass default supplies 5)
                 return orch.task_history_retention_limit
         return DEFAULT_RETENTION
 
@@ -62,7 +65,8 @@ class TaskReaper:
         for t in self.store.find("task"):
             if _removable(t):
                 self._cleanup.add(t.id)
-            elif common.in_terminal_state(t):
+            elif common.in_terminal_state(t) \
+                    or t.desired_state > TaskState.RUNNING:
                 self._dirty_slots.add(common.slot_tuple(t))
         self._running = True
         self._task = asyncio.get_running_loop().create_task(self._run(watcher))
@@ -87,9 +91,15 @@ class TaskReaper:
                     t = ev.object
                     if ev.action == "remove":
                         continue
+                    if ev.action == "create" and t.service_id:
+                        # a new task in a slot is when its history can
+                        # exceed retention (reference EventCreateTask
+                        # dirtying, task_reaper.go:166)
+                        self._dirty_slots.add(common.slot_tuple(t))
                     if _removable(t):
                         self._cleanup.add(t.id)
-                    elif common.in_terminal_state(t):
+                    elif common.in_terminal_state(t) \
+                            or t.desired_state > TaskState.RUNNING:
                         self._dirty_slots.add(common.slot_tuple(t))
                 elif isinstance(ev, EventCommit) \
                         and (self._cleanup or self._dirty_slots):
@@ -108,6 +118,20 @@ class TaskReaper:
         to_delete = set(cleanup)
         for slot in dirty:
             kind, service_id, key = slot
+            service = self.store.get("service", service_id)
+            if service is None:
+                continue   # orchestrator deletes the tasks wholesale
+            hist = retention
+            rp = service.spec.task.restart
+            if rp is not None and rp.max_attempts > 0:
+                # keep one more than max_attempts so restart history can
+                # be reconstructed after a leader change — this OVERRIDES
+                # the cluster retention limit (task_reaper.go:295)
+                hist = rp.max_attempts + 1
+            if hist < 0:
+                # negative retention = never clean history
+                # (task_reaper.go:298)
+                continue
             if kind == "slot":
                 tasks = self.store.find("task", BySlot(service_id, key))
             else:
@@ -115,11 +139,19 @@ class TaskReaper:
                 tasks = [t for t in self.store.find(
                     "task", ByService(service_id)) if t.node_id == key
                     and not t.slot]
+            # cleanable history: reached a terminal state (and already
+            # processed by the restart path: desired > RUNNING), or will
+            # NEVER run — desired terminal while still unassigned, so no
+            # agent will ever move it (taskInTerminalState ||
+            # taskWillNeverRun, task_reaper.go:344-347)
             dead = sorted(
-                (t for t in tasks if common.in_terminal_state(t)
-                 and t.desired_state > TaskState.RUNNING),
+                (t for t in tasks
+                 if (common.in_terminal_state(t)
+                     and t.desired_state > TaskState.RUNNING)
+                 or (t.status.state < TaskState.ASSIGNED
+                     and t.desired_state > TaskState.RUNNING)),
                 key=lambda t: t.status.timestamp)
-            excess = len(dead) - retention
+            excess = len(dead) - hist
             for t in dead[:max(0, excess)]:
                 to_delete.add(t.id)
 

@@ -629,6 +629,111 @@ async def test_task_reaper_retention():
 
 
 @async_test
+async def test_task_reaper_negative_retention_never_cleans():
+    """A negative TaskHistoryRetentionLimit disables history cleanup
+    entirely (reference task_reaper.go:298) — it must not be arithmetic
+    that deletes MORE; an explicit 0 keeps NO history."""
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    reaper = TaskReaper(store, clock=clock)
+    await reaper.start()
+    svc = make_service(replicas=1)
+    cl = make_cluster_with_retention(-1)
+    await store.update(lambda tx: [tx.create(cl), tx.create(svc)])
+
+    def seed(tx):
+        for i in range(8):
+            t = common.new_task(None, svc, slot=1)
+            t.status.state = TaskState.FAILED
+            t.status.timestamp = float(i)
+            t.desired_state = int(TaskState.SHUTDOWN)
+            tx.create(t)
+    await store.update(seed)
+    await pump(clock)
+    assert len(store.find("task", ByService(svc.id))) == 8
+
+    # flip to an explicit 0: ALL dead history goes
+    def zero(tx):
+        c = tx.get("cluster", "c1").copy()
+        c.spec.orchestration.task_history_retention_limit = 0
+        tx.update(c)
+
+    def poke(tx):   # dirty the slot again via a fresh dead task
+        t = common.new_task(None, svc, slot=1)
+        t.status.state = TaskState.FAILED
+        t.desired_state = int(TaskState.SHUTDOWN)
+        tx.create(t)
+    await store.update(zero)
+    await store.update(poke)
+    await pump(clock)
+    assert len(store.find("task", ByService(svc.id))) == 0
+    await reaper.stop()
+
+
+@async_test
+async def test_task_reaper_max_attempts_overrides_retention():
+    """With restart max_attempts set, the reaper keeps max_attempts+1
+    dead tasks regardless of the cluster retention limit, so restart
+    history is reconstructible after a leader change (task_reaper.go:295)."""
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    reaper = TaskReaper(store, clock=clock)
+    await reaper.start()
+    svc = make_service(replicas=1, restart=RestartPolicy(
+        condition=RestartCondition.ANY, max_attempts=6))
+    cl = make_cluster_with_retention(2)
+    await store.update(lambda tx: [tx.create(cl), tx.create(svc)])
+
+    def seed(tx):
+        for i in range(10):
+            t = common.new_task(None, svc, slot=1)
+            t.status.state = TaskState.FAILED
+            t.status.timestamp = float(i)
+            t.desired_state = int(TaskState.SHUTDOWN)
+            tx.create(t)
+    await store.update(seed)
+    await pump(clock)
+    dead = [t for t in store.find("task", ByService(svc.id))
+            if common.in_terminal_state(t)]
+    assert len(dead) == 7   # max_attempts + 1, not the cluster's 2
+    await reaper.stop()
+
+
+@async_test
+async def test_task_reaper_trims_never_assigned_history():
+    """Tasks that will NEVER run (desired terminal while still unassigned
+    — no agent will ever move them) count as cleanable slot history
+    (taskWillNeverRun, task_reaper.go:344)."""
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    reaper = TaskReaper(store, clock=clock)
+    await reaper.start()
+    svc = make_service(replicas=1)
+    await store.update(lambda tx: tx.create(svc))
+
+    def seed(tx):
+        for i in range(8):
+            t = common.new_task(None, svc, slot=1)   # status NEW, no node
+            t.status.timestamp = float(i)
+            t.desired_state = int(TaskState.SHUTDOWN)
+            tx.create(t)
+    await store.update(seed)
+    await pump(clock)
+    assert len(store.find("task", ByService(svc.id))) == 5  # retention
+    await reaper.stop()
+
+
+def make_cluster_with_retention(limit):
+    from swarmkit_tpu.api.objects import Cluster
+    from swarmkit_tpu.api.specs import ClusterSpec, OrchestrationConfig
+
+    return Cluster(id="c1", spec=ClusterSpec(
+        annotations=Annotations(name="default"),
+        orchestration=OrchestrationConfig(
+            task_history_retention_limit=limit)))
+
+
+@async_test
 async def test_task_reaper_remove_desired():
     """Desired-REMOVE tasks: an ASSIGNED one waits for the agent's
     shutdown; an UNASSIGNED one (state < ASSIGNED — no agent will ever
