@@ -62,6 +62,20 @@ class Placement(Message):
 
 
 @dataclass
+class Mount(Message):
+    """Filesystem mount carried on the container spec (reference:
+    api/types.proto Mount — bind/volume/tmpfs/npipe). The TPU executor has
+    no container filesystem, so mounts ride the data model for executor
+    implementations that do (and for API parity); source/target are
+    template-expanded per task like the reference's expandMounts."""
+    type: str = "bind"            # bind | volume | tmpfs | npipe
+    source: str = ""
+    target: str = ""
+    read_only: bool = False
+    volume_labels: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
 class ContainerSpec(Message):
     image: str = ""
     command: list[str] = field(default_factory=list)
@@ -77,6 +91,7 @@ class ContainerSpec(Message):
     pull_options: dict[str, str] = field(default_factory=dict)
     hosts: list[str] = field(default_factory=list)
     healthcheck: Optional[dict] = None
+    mounts: list[Mount] = field(default_factory=list)
 
 
 @dataclass

@@ -63,6 +63,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="replica count (replicated mode only; default 1)")
     sp.add_argument("--env", action="append", default=[])
     sp.add_argument("--constraint", action="append", default=[])
+    sp.add_argument("--mount", action="append", default=[],
+                    help="type=bind|volume|tmpfs,source=...,target=...,"
+                         "[readonly] (repeatable; reference swarmctl "
+                         "--bind/--volume/--tmpfs folded into one flag)")
     sp.add_argument("--publish", action="append", default=[],
                     help="published:target port, e.g. 8080:80")
     sp.add_argument("--network", action="append", default=[],
@@ -142,8 +146,26 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _parse_mount(text: str) -> dict:
+    """type=bind,source=/x,target=/y[,readonly] -> Mount dict."""
+    m: dict = {"type": "bind", "read_only": False}
+    for part in text.split(","):
+        if part == "readonly" or part == "ro":
+            m["read_only"] = True
+        elif "=" in part:
+            k, _, v = part.partition("=")
+            if k not in ("type", "source", "target"):
+                raise CtlError(f"unknown mount option {k!r}", "invalid")
+            m[k] = v
+        elif part:
+            raise CtlError(f"bad mount option {part!r}", "invalid")
+    return m
+
+
 def _service_spec(args, networks=None, secrets=None, configs=None) -> dict:
     container = {"image": args.image, "env": args.env}
+    if getattr(args, "mount", None):
+        container["mounts"] = [_parse_mount(s) for s in args.mount]
     if secrets:
         container["secrets"] = [
             {"secret_id": sid, "secret_name": name}

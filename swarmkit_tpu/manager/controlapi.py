@@ -82,6 +82,22 @@ def _validate_task_spec(task_spec) -> None:
             constraint_mod.parse(task_spec.placement.constraints)
         except constraint_mod.InvalidConstraint as e:
             raise InvalidArgument(f"spec: invalid constraint: {e}")
+    # reference service.go validateMounts: every mount needs a target,
+    # bind mounts need a source, and targets must not collide
+    targets = set()
+    for m in task_spec.container.mounts:
+        if m.type not in ("bind", "volume", "tmpfs", "npipe"):
+            raise InvalidArgument(f"spec: invalid mount type {m.type!r}")
+        if not m.target:
+            raise InvalidArgument("spec: mount target must be provided")
+        if m.target in targets:
+            raise InvalidArgument(
+                f"spec: duplicate mount target {m.target!r}")
+        targets.add(m.target)
+        if m.type == "bind" and not m.source:
+            raise InvalidArgument("spec: bind mount requires a source")
+        if m.type == "tmpfs" and m.source:
+            raise InvalidArgument("spec: tmpfs mount cannot have a source")
 
 
 def _validate_endpoint_spec(ep_spec) -> None:

@@ -86,4 +86,13 @@ def expand_container_spec(task, node=None):
     c.env = [expand(e, ctx) for e in c.env]
     if c.hostname:
         c.hostname = expand(c.hostname, ctx)
+    for m in c.mounts:
+        # reference template/expand.go:expandMounts — per-task volume
+        # sources like "data-{{.Task.Slot}}" and label values expand here
+        if m.source:
+            m.source = expand(m.source, ctx)
+        if m.target:
+            m.target = expand(m.target, ctx)
+        m.volume_labels = {k: expand(v, ctx)
+                           for k, v in m.volume_labels.items()}
     return t

@@ -24,20 +24,31 @@ from tests.conftest import async_test
 
 
 def test_template_expansion():
+    from swarmkit_tpu.api.specs import Mount
+
     task = Task(id="t1", service_id="s1", slot=3, spec=TaskSpec(
         container=ContainerSpec(
             image="img",
             env=["SVC={{.Service.Name}}", "SLOT={{.Task.Slot}}",
                  "NODE={{.Node.Hostname}}"],
-            hostname="{{.Service.Name}}-{{.Task.Slot}}")))
+            hostname="{{.Service.Name}}-{{.Task.Slot}}",
+            mounts=[Mount(type="volume",
+                          source="data-{{.Task.Slot}}",
+                          target="/srv/{{.Service.Name}}",
+                          volume_labels={"svc": "{{.Service.Name}}"})])))
     task.service_annotations = Annotations(name="web", labels={"env": "prod"})
     node = ApiNode(id="n1", description=NodeDescription(
         hostname="host1", platform=Platform(os="linux")))
     out = expand_container_spec(task, node)
     assert out.spec.container.env == ["SVC=web", "SLOT=3", "NODE=host1"]
     assert out.spec.container.hostname == "web-3"
+    # mounts expand source/target/labels (reference expandMounts)
+    m = out.spec.container.mounts[0]
+    assert (m.source, m.target) == ("data-3", "/srv/web")
+    assert m.volume_labels == {"svc": "web"}
     # the original is untouched
     assert task.spec.container.env[0] == "SVC={{.Service.Name}}"
+    assert task.spec.container.mounts[0].source == "data-{{.Task.Slot}}"
 
     ctx = task_context(task, node)
     assert expand("{{.Service.Labels.env}}", ctx) == "prod"
@@ -132,6 +143,16 @@ async def test_swarmd_swarmctl_round_trip():
     finally:
         await node._ctl_server.stop()
         await node.stop()
+
+
+def test_parse_mount():
+    from swarmkit_tpu.cmd.swarmctl import CtlError, _parse_mount
+
+    assert _parse_mount("type=bind,source=/x,target=/y,readonly") == {
+        "type": "bind", "source": "/x", "target": "/y", "read_only": True}
+    assert _parse_mount("target=/y")["type"] == "bind"   # default
+    with pytest.raises(CtlError):
+        _parse_mount("type=bind,bogus=1,target=/y")
 
 
 @async_test

@@ -55,6 +55,28 @@ async def test_create_service_validation():
             PortConfig(protocol="tcp", target_port=81, published_port=8080),
         ])))
 
+    # mount validation (reference service.go validateMounts)
+    from swarmkit_tpu.api.specs import Mount
+
+    def with_mounts(*mounts):
+        s = service_spec()
+        s.task.container.mounts = list(mounts)
+        return s
+    with pytest.raises(InvalidArgument):   # no target
+        await c.create_service(with_mounts(Mount(type="bind", source="/x")))
+    with pytest.raises(InvalidArgument):   # duplicate target
+        await c.create_service(with_mounts(
+            Mount(type="volume", source="v1", target="/d"),
+            Mount(type="volume", source="v2", target="/d")))
+    with pytest.raises(InvalidArgument):   # bind without source
+        await c.create_service(with_mounts(Mount(type="bind", target="/d")))
+    with pytest.raises(InvalidArgument):   # tmpfs with source
+        await c.create_service(with_mounts(
+            Mount(type="tmpfs", source="/x", target="/d")))
+    with pytest.raises(InvalidArgument):   # unknown type
+        await c.create_service(with_mounts(
+            Mount(type="fuse", source="/x", target="/d")))
+
     svc = await c.create_service(service_spec())
     assert c.get_service(svc.id).spec.annotations.name == "web"
     with pytest.raises(AlreadyExists):     # duplicate name
