@@ -67,6 +67,27 @@ def build_parser() -> argparse.ArgumentParser:
                     help="type=bind|volume|tmpfs,source=...,target=...,"
                          "[readonly] (repeatable; reference swarmctl "
                          "--bind/--volume/--tmpfs folded into one flag)")
+    sp.add_argument("--label", action="append", default=[],
+                    metavar="KEY=VALUE", help="service label (repeatable)")
+    sp.add_argument("--hostname", default=None,
+                    help="container hostname (templated, e.g. "
+                         "{{.Service.Name}}-{{.Task.Slot}})")
+    sp.add_argument("--command", action="append", default=[],
+                    help="override entrypoint (repeatable)")
+    sp.add_argument("--arg", action="append", default=[],
+                    help="container arg (repeatable)")
+    sp.add_argument("--restart-window", type=float, default=None,
+                    help="seconds over which restart attempts are counted")
+    sp.add_argument("--generic-resource", action="append", default=[],
+                    metavar="KIND=N",
+                    help="generic resource reservation, e.g. tpu-chip=2")
+    sp.add_argument("--limit-cpu", type=float, default=None,
+                    help="CPU cores limit per task")
+    sp.add_argument("--limit-memory", type=int, default=None,
+                    help="bytes of memory limit per task")
+    sp.add_argument("--log-driver", default=None)
+    sp.add_argument("--log-opt", action="append", default=[],
+                    metavar="KEY=VALUE")
     sp.add_argument("--publish", action="append", default=[],
                     help="published:target port, e.g. 8080:80")
     sp.add_argument("--network", action="append", default=[],
@@ -162,10 +183,26 @@ def _parse_mount(text: str) -> dict:
     return m
 
 
+def _kv_pairs(items: list[str], what: str) -> dict:
+    out = {}
+    for kv in items:
+        if "=" not in kv:
+            raise CtlError(f"{what} wants KEY=VALUE, got {kv!r}", "invalid")
+        k, _, v = kv.partition("=")
+        out[k] = v
+    return out
+
+
 def _service_spec(args, networks=None, secrets=None, configs=None) -> dict:
     container = {"image": args.image, "env": args.env}
-    if getattr(args, "mount", None):
+    if args.mount:
         container["mounts"] = [_parse_mount(s) for s in args.mount]
+    if args.hostname:
+        container["hostname"] = args.hostname
+    if args.command:
+        container["command"] = list(args.command)
+    if args.arg:
+        container["args"] = list(args.arg)
     if secrets:
         container["secrets"] = [
             {"secret_id": sid, "secret_name": name}
@@ -178,13 +215,35 @@ def _service_spec(args, networks=None, secrets=None, configs=None) -> dict:
             "placement": {"constraints": args.constraint}}
     if networks:
         task["networks"] = list(networks)
-    if args.reserve_cpu is not None or args.reserve_memory is not None:
-        task["resources"] = {"reservations": {
+    resources: dict = {}
+    generic = {}
+    for k, v in _kv_pairs(args.generic_resource,
+                          "--generic-resource").items():
+        try:
+            generic[k] = int(v)
+        except ValueError:
+            raise CtlError(
+                f"--generic-resource wants KIND=N, got {k}={v!r}",
+                "invalid")
+        if generic[k] < 0:
+            raise CtlError(
+                f"--generic-resource {k} must be non-negative", "invalid")
+    if args.reserve_cpu is not None or args.reserve_memory is not None \
+            or generic:
+        resources["reservations"] = {
             "nano_cpus": int((args.reserve_cpu or 0) * 1e9),
-            "memory_bytes": args.reserve_memory or 0}}
+            "memory_bytes": args.reserve_memory or 0,
+            "generic": generic}
+    if args.limit_cpu is not None or args.limit_memory is not None:
+        resources["limits"] = {
+            "nano_cpus": int((args.limit_cpu or 0) * 1e9),
+            "memory_bytes": args.limit_memory or 0}
+    if resources:
+        task["resources"] = resources
     if args.restart_condition is not None \
             or args.restart_delay is not None \
-            or args.restart_max_attempts is not None:
+            or args.restart_max_attempts is not None \
+            or args.restart_window is not None:
         restart = {}
         if args.restart_condition is not None:
             restart["condition"] = {"none": 0, "failure": 1,
@@ -193,9 +252,16 @@ def _service_spec(args, networks=None, secrets=None, configs=None) -> dict:
             restart["delay"] = args.restart_delay
         if args.restart_max_attempts is not None:
             restart["max_attempts"] = args.restart_max_attempts
+        if args.restart_window is not None:
+            restart["window"] = args.restart_window
         task["restart"] = restart
+    if args.log_driver:
+        task["log_driver"] = {
+            "name": args.log_driver,
+            "options": _kv_pairs(args.log_opt, "--log-opt")}
     spec = {
-        "annotations": {"name": args.name},
+        "annotations": {"name": args.name,
+                        "labels": _kv_pairs(args.label, "--label")},
         "task": task,
     }
     if getattr(args, "mode", "replicated") == "global":
@@ -362,15 +428,7 @@ async def run(args, out=None) -> int:
                 p["availability"] = int(
                     NodeAvailability[args.availability.upper()])
             if args.label_add:
-                adds = {}
-                for kv in args.label_add:
-                    if "=" not in kv:
-                        print(f"error: --label-add wants KEY=VALUE, "
-                              f"got {kv!r}", file=sys.stderr)
-                        return 1
-                    k, _, v = kv.partition("=")
-                    adds[k] = v
-                p["labels_add"] = adds
+                p["labels_add"] = _kv_pairs(args.label_add, "--label-add")
             if args.label_rm:
                 p["labels_rm"] = list(args.label_rm)
             show(await client.call("node.update", **p))

@@ -82,6 +82,18 @@ def _validate_task_spec(task_spec) -> None:
             constraint_mod.parse(task_spec.placement.constraints)
         except constraint_mod.InvalidConstraint as e:
             raise InvalidArgument(f"spec: invalid constraint: {e}")
+    # resource quantities must be non-negative: a negative reservation
+    # would inflate scheduler availability accounting instead of
+    # constraining it (reference validateResources)
+    res = task_spec.resources
+    for group in ((res.reservations, res.limits) if res is not None
+                  else ()):
+        if group is None:
+            continue
+        if group.nano_cpus < 0 or group.memory_bytes < 0 \
+                or any(v < 0 for v in group.generic.values()):
+            raise InvalidArgument(
+                "spec: resource quantities must be non-negative")
     # reference service.go validateMounts: every mount needs a target,
     # bind mounts need a source, and targets must not collide
     targets = set()

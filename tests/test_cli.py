@@ -95,9 +95,28 @@ async def test_swarmd_swarmctl_round_trip():
         assert rc == 0 and "m1" in out and "manager" in out
 
         rc, out = await ctl("service-create", "--name", "web",
-                            "--image", "nginx", "--replicas", "2")
+                            "--image", "nginx", "--replicas", "2",
+                            "--label", "tier=frontend",
+                            "--hostname", "web-{{.Task.Slot}}",
+                            "--command", "serve", "--arg=--port=80",
+                            "--restart-window", "120",
+                            "--generic-resource", "cpu-chip=0",
+                            "--limit-cpu", "2", "--limit-memory", "1024",
+                            "--log-driver", "json-file",
+                            "--log-opt", "max-size=10m")
         assert rc == 0
         svc_id = json.loads(out)["id"]
+        rc, out = await ctl("service-inspect", "web")
+        spec = json.loads(out)["spec"]
+        assert spec["annotations"]["labels"] == {"tier": "frontend"}
+        cont = spec["task"]["container"]
+        assert cont["hostname"] == "web-{{.Task.Slot}}"
+        assert cont["command"] == ["serve"]
+        assert cont["args"] == ["--port=80"]
+        assert spec["task"]["restart"]["window"] == 120
+        assert spec["task"]["resources"]["limits"]["nano_cpus"] == 2_000_000_000
+        assert spec["task"]["log_driver"] == {
+            "name": "json-file", "options": {"max-size": "10m"}}
 
         rc, out = await ctl("service-ls")
         assert "web" in out
@@ -153,6 +172,24 @@ def test_parse_mount():
     assert _parse_mount("target=/y")["type"] == "bind"   # default
     with pytest.raises(CtlError):
         _parse_mount("type=bind,bogus=1,target=/y")
+
+
+def test_service_spec_generic_resource_errors_are_ctl_errors():
+    """Bad --generic-resource values surface as CtlError (clean CLI
+    message), never a raw traceback; negatives are rejected client-side."""
+    from swarmkit_tpu.cmd.swarmctl import CtlError, _service_spec, build_parser
+
+    def parse(*extra):
+        return build_parser().parse_args([
+            "service-create", "--name", "x", "--image", "img", *extra])
+
+    with pytest.raises(CtlError):
+        _service_spec(parse("--generic-resource", "tpu-chip=two"))
+    with pytest.raises(CtlError):
+        _service_spec(parse("--generic-resource", "tpu-chip=-4"))
+    spec = _service_spec(parse("--generic-resource", "tpu-chip=2"))
+    assert spec["task"]["resources"]["reservations"]["generic"] == {
+        "tpu-chip": 2}
 
 
 @async_test

@@ -77,6 +77,19 @@ async def test_create_service_validation():
         await c.create_service(with_mounts(
             Mount(type="fuse", source="/x", target="/d")))
 
+    # negative resource quantities would INFLATE scheduler availability
+    from swarmkit_tpu.api import ResourceRequirements, Resources
+    with pytest.raises(InvalidArgument):
+        s = service_spec()
+        s.task.resources = ResourceRequirements(
+            reservations=Resources(generic={"tpu-chip": -4}))
+        await c.create_service(s)
+    with pytest.raises(InvalidArgument):
+        s = service_spec()
+        s.task.resources = ResourceRequirements(
+            limits=Resources(nano_cpus=-1))
+        await c.create_service(s)
+
     svc = await c.create_service(service_spec())
     assert c.get_service(svc.id).spec.annotations.name == "web"
     with pytest.raises(AlreadyExists):     # duplicate name
