@@ -255,6 +255,8 @@ def _service_spec(args, networks=None, secrets=None, configs=None) -> dict:
         if args.restart_window is not None:
             restart["window"] = args.restart_window
         task["restart"] = restart
+    if args.log_opt and not args.log_driver:
+        raise CtlError("--log-opt requires --log-driver", "invalid")
     if args.log_driver:
         task["log_driver"] = {
             "name": args.log_driver,

@@ -34,6 +34,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--join-addr", default="",
                    help="address of a manager to join")
     p.add_argument("--join-token", default="", help="cluster join token")
+    p.add_argument("--advertise-remote-api", default="",
+                   help="address peers should dial (defaults to "
+                        "--listen-remote-api; set when binding a "
+                        "wildcard or NAT-internal address)")
     p.add_argument("--listen-remote-api", default="0.0.0.0:4242",
                    help="listen address for raft/dispatcher traffic")
     p.add_argument("--listen-control-api", default="./swarmkitstate/swarmd.sock",
@@ -44,6 +48,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--heartbeat-tick", type=int, default=1)
     p.add_argument("--election-tick", type=int, default=10)
     p.add_argument("--unlock-key", default="")
+    p.add_argument("--autolock", action="store_true",
+                   help="bootstrap the cluster with manager autolock "
+                        "enabled (reference swarmd --autolock); the "
+                        "unlock key prints once on stdout")
+    p.add_argument("--log-level", default="info",
+                   choices=["debug", "info", "warning", "error"])
     p.add_argument("--listen-debug", default="",
                    help="serve the live diagnostic surface (asyncio task "
                         "dump, store wedge state, watch-queue depths, "
@@ -236,17 +246,21 @@ async def run(args, network=None, executor=None, registry=None) -> Node:
             return rm
         return None
 
+    advertise = getattr(args, "advertise_remote_api", "") \
+        or args.listen_remote_api
     if use_grpc:
         # serve dispatcher/CA/control alongside raft on the same port
-        # (reference: manager.go:526-548 service registrations)
+        # (reference: manager.go:526-548 service registrations); services
+        # are keyed by the ADVERTISED address (the node's identity on the
+        # wire) while the sockets bind the listen address
         from swarmkit_tpu.rpc import ClusterService
 
         cluster_service = ClusterService(
             lambda: node_box[0] if node_box else None)
-        network.add_service(args.listen_remote_api,
-                            cluster_service.handlers())
-        network.add_join_service(args.listen_remote_api,
-                                 cluster_service.join_handlers())
+        if advertise != args.listen_remote_api:
+            network.set_bind_addr(advertise, args.listen_remote_api)
+        network.add_service(advertise, cluster_service.handlers())
+        network.add_join_service(advertise, cluster_service.join_handlers())
 
     node = Node(NodeConfig(
         node_id=node_id,
@@ -255,6 +269,7 @@ async def run(args, network=None, executor=None, registry=None) -> Node:
         network=network,
         dialer=dialer,
         listen_addr=args.listen_remote_api,
+        advertise_addr=getattr(args, "advertise_remote_api", ""),
         join_addr=args.join_addr,
         join_token=args.join_token,
         is_manager=args.manager,
@@ -266,6 +281,43 @@ async def run(args, network=None, executor=None, registry=None) -> Node:
     nodes[node_id] = node
     await node.start()
     node._remote_managers = remote_managers
+
+    if getattr(args, "autolock", False) and not (
+            args.manager and not args.join_addr):
+        logging.getLogger("swarmd").warning(
+            "--autolock only applies to the bootstrap (seed) manager; "
+            "use `swarmctl cluster-autolock on` on a running cluster")
+    if getattr(args, "autolock", False) and args.manager \
+            and not args.join_addr:
+        # bootstrap-time autolock (reference swarmd --autolock): enable it
+        # the moment this seed manager leads, and print the unlock key
+        # once — the only time the operator can capture it
+        async def _enable_autolock():
+            # leadership comes first, the seeded cluster object a beat
+            # later — retry the whole read-modify-write until both exist
+            for _ in range(600):
+                m = node._running_manager()
+                if m is not None and node.is_leader():
+                    try:
+                        c = m.control_api
+                        cl = c.get_cluster()
+                        spec = cl.spec.copy()
+                        spec.encryption_config.auto_lock_managers = True
+                        await c.update_cluster(
+                            cl.id, spec, version=cl.meta.version.index)
+                        print(f"cluster autolock enabled; unlock key: "
+                              f"{c.get_unlock_key()['unlock_key']}",
+                              flush=True)
+                        return
+                    except Exception:
+                        pass   # not seeded yet (or lost a version race)
+                await asyncio.sleep(0.1)
+            logging.getLogger("swarmd").error(
+                "autolock bootstrap never completed")
+
+        t = asyncio.get_running_loop().create_task(_enable_autolock())
+        node._autolock_bootstrap = t
+        node._aux_tasks = getattr(node, "_aux_tasks", []) + [t]
 
     os.makedirs(os.path.dirname(args.listen_control_api) or ".",
                 exist_ok=True)
@@ -285,8 +337,9 @@ async def run(args, network=None, executor=None, registry=None) -> Node:
 
 async def main_async(argv=None) -> None:
     args = build_parser().parse_args(argv)
-    logging.basicConfig(level=logging.INFO,
-                        format="%(asctime)s %(name)s %(message)s")
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper()),
+        format="%(asctime)s %(name)s %(message)s")
     node = await run(args)
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()

@@ -73,6 +73,9 @@ class NodeConfig:
     network: object                      # raft transport Network
     dialer: Callable[[str], Optional[Manager]]   # addr -> Manager lookup
     listen_addr: str = ""
+    # address peers should DIAL (reference swarmd --advertise-remote-api):
+    # differs from listen_addr when binding a wildcard/NAT-internal address
+    advertise_addr: str = ""
     join_addr: str = ""
     join_token: str = ""
     is_manager: bool = False             # initial role
@@ -95,7 +98,10 @@ class Node:
         self.config = config
         self.clock = config.clock or SystemClock()
         self.node_id = config.node_id
-        self.addr = config.listen_addr or f"{config.node_id}:4242"
+        # self.addr is what this node ADVERTISES (raft member context, CSR,
+        # manager address book); the listener binds listen_addr separately
+        self.addr = (config.advertise_addr or config.listen_addr
+                     or f"{config.node_id}:4242")
         self.manager: Optional[Manager] = None
         self.security: Optional[SecurityConfig] = None
         self.keyrw: Optional[KeyReadWriter] = None
@@ -281,6 +287,14 @@ class Node:
     async def stop(self) -> None:
         self._running = False
         self._cancel_role_watches()
+        # embedder-attached background tasks (e.g. swarmd's autolock
+        # bootstrap) die with the node instead of outliving it
+        for t in getattr(self, "_aux_tasks", ()):
+            t.cancel()
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
         if getattr(self, "_renewer", None) is not None:
             await self._renewer.stop()
             self._renewer = None

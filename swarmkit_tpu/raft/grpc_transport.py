@@ -243,6 +243,7 @@ class GrpcNetwork:
         self._local: dict[str, Any] = {}
         self._extra_handlers: dict[str, list] = {}
         self._join_handlers: dict[str, list] = {}
+        self._bind_map: dict[str, str] = {}   # advertise -> bind address
         self.delivered = 0   # counters kept for interface parity
         self.dropped = 0
 
@@ -257,11 +258,19 @@ class GrpcNetwork:
         servers only accept handlers before start."""
         self._extra_handlers.setdefault(addr, []).extend(handlers)
 
+    def set_bind_addr(self, advertise: str, listen: str) -> None:
+        """Bind `listen` for the server whose ADVERTISED address is
+        `advertise` (reference --listen-remote-api vs
+        --advertise-remote-api: wildcard/NAT-internal binds with a
+        dialable advertised address). Call before register()."""
+        self._bind_map[advertise] = listen
+
     def register(self, addr: str, node: Any) -> None:
         # gRPC server startup is async; do it lazily-but-synchronously via
         # the running loop (register is called from async context in
         # node.start)
         self._local[addr] = node
+        bind = self._bind_map.get(addr, addr)
         loop = asyncio.get_event_loop()
         server = grpc.aio.server(options=[
             ("grpc.max_send_message_length", GRPC_MAX_MSG_SIZE),
@@ -274,12 +283,12 @@ class GrpcNetwork:
         if self.security is not None:
             from swarmkit_tpu.ca.tlsutil import server_credentials
 
-            bound = server.add_secure_port(addr,
+            bound = server.add_secure_port(bind,
                                            server_credentials(self.security))
         else:
-            bound = server.add_insecure_port(addr)
+            bound = server.add_insecure_port(bind)
         if bound == 0:
-            raise RuntimeError(f"cannot bind raft listener on {addr}")
+            raise RuntimeError(f"cannot bind raft listener on {bind}")
         self._servers[addr] = server
         loop.create_task(server.start())
         if self.security is not None:
@@ -303,7 +312,7 @@ class GrpcNetwork:
             sec = self.security
             return sec.root_ca.cert_pem if sec is not None else b""
 
-        host, port = addr.rsplit(":", 1)
+        host, port = self._bind_map.get(addr, addr).rsplit(":", 1)
         boot = grpc.aio.server()
         boot.add_generic_rpc_handlers((
             grpc.method_handlers_generic_handler(_BOOT, {

@@ -235,6 +235,44 @@ async def test_resolve_ref_names_prefixes_ambiguity():
 
 
 @async_test
+async def test_swarmd_autolock_bootstrap():
+    """`swarmd --autolock` enables manager autolock at bootstrap and
+    mints the unlock key (reference swarmd --autolock flag)."""
+    from swarmkit_tpu.cmd import swarmctl as ctl_cmd
+    from swarmkit_tpu.cmd import swarmd
+
+    tmp = tempfile.TemporaryDirectory(prefix="swarmd-autolock-")
+    sock = os.path.join(tmp.name, "swarmd.sock")
+    args = swarmd.build_parser().parse_args([
+        "--state-dir", os.path.join(tmp.name, "state"),
+        "--listen-control-api", sock,
+        "--node-id", "m1", "--manager", "--autolock",
+        "--election-tick", "4", "--backend", "inproc",
+        "--executor", "test",
+    ])
+    node = await swarmd.run(args)
+    try:
+        async def ctl(*argv):
+            out = io.StringIO()
+            rc = await ctl_cmd.run(
+                ctl_cmd.build_parser().parse_args(
+                    ["--socket", sock, *argv]), out=out)
+            return rc, out.getvalue()
+
+        for _ in range(300):
+            rc, out = await ctl("cluster-unlock-key")
+            if rc == 0 and json.loads(out).get("autolock"):
+                break
+            await asyncio.sleep(0.05)
+        data = json.loads(out)
+        assert data["autolock"] is True
+        assert data["unlock_key"].startswith("SWMKEY-")
+    finally:
+        await node._ctl_server.stop()
+        await node.stop()
+
+
+@async_test
 async def test_rafttool_dump():
     """Write real raft state via a manager, then dump it offline."""
     import io as _io

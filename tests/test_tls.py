@@ -254,6 +254,62 @@ async def test_swarmd_tls_worker_join_by_token():
 
 
 @async_test
+async def test_swarmd_advertise_addr_split_from_listen():
+    """--advertise-remote-api: bind a wildcard address but advertise the
+    dialable one (reference swarmd flag) — the join dance, the raft member
+    context, and the manager address book all carry the ADVERTISED addr."""
+    from swarmkit_tpu.cmd import swarmd
+
+    tmp = tempfile.TemporaryDirectory(prefix="swarmd-adv-")
+    p1, p2 = free_port(), free_port()
+    args1 = swarmd.build_parser().parse_args([
+        "--state-dir", os.path.join(tmp.name, "m1"),
+        "--listen-control-api", os.path.join(tmp.name, "m1.sock"),
+        "--listen-remote-api", f"0.0.0.0:{p1}",
+        "--advertise-remote-api", f"127.0.0.1:{p1}",
+        "--node-id", "m1", "--manager", "--election-tick", "4",
+        "--executor", "test",
+    ])
+    m1 = w1 = None
+    try:
+        m1 = await swarmd.run(args1)
+        assert m1.addr == f"127.0.0.1:{p1}"   # advertise, not the bind
+        assert await wait_until(m1.is_leader, timeout=15)
+        assert await wait_until(
+            lambda: m1.manager.store.find("cluster"), timeout=15)
+        token = m1.manager.store.find(
+            "cluster")[0].root_ca.join_token_worker
+
+        args2 = swarmd.build_parser().parse_args([
+            "--state-dir", os.path.join(tmp.name, "w1"),
+            "--listen-control-api", os.path.join(tmp.name, "w1.sock"),
+            "--listen-remote-api", f"127.0.0.1:{p2}",
+            "--node-id", "w1",
+            "--join-addr", f"127.0.0.1:{p1}",
+            "--join-token", token, "--election-tick", "4",
+            "--executor", "test",
+        ])
+        w1 = await swarmd.run(args2)
+
+        def worker_known():
+            return m1.manager.store.get("node", "w1") is not None
+        assert await wait_until(worker_known, timeout=20)
+        # the address book the worker's session receives must carry the
+        # DIALABLE advertise address, never the 0.0.0.0 bind
+        peers = list(m1.remotes.weights().keys())
+        assert peers and all("0.0.0.0" not in a for a in peers), peers
+        assert f"127.0.0.1:{p1}" in peers
+    finally:
+        for n in (w1, m1):
+            if n is not None:
+                try:
+                    await n.stop()
+                except Exception:
+                    pass
+        tmp.cleanup()
+
+
+@async_test
 async def test_root_ca_rotation_end_to_end():
     """Rotate the cluster root CA with a live manager + worker (reference:
     integration_test.go TestSuccessfulRootRotation + ca/reconciler.go):
