@@ -122,6 +122,23 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--replicas", type=int, default=None)
     sp.add_argument("--env", action="append", default=None,
                     help="replace the env list (repeatable)")
+    sp.add_argument("--command", action="append", default=None,
+                    help="replace the entrypoint (repeatable)")
+    sp.add_argument("--arg", action="append", default=None,
+                    help="replace the args list (repeatable)")
+    sp.add_argument("--hostname", default=None)
+    sp.add_argument("--mount", action="append", default=None,
+                    help="replace the mount list (repeatable; same syntax "
+                         "as service-create)")
+    sp.add_argument("--label-add", action="append", default=[],
+                    metavar="KEY=VALUE")
+    sp.add_argument("--label-rm", action="append", default=[],
+                    metavar="KEY")
+    sp.add_argument("--restart-condition", default=None,
+                    choices=["any", "failure", "none"])
+    sp.add_argument("--restart-delay", type=float, default=None)
+    sp.add_argument("--restart-max-attempts", type=int, default=None)
+    sp.add_argument("--restart-window", type=float, default=None)
     sp.add_argument("--force", action="store_true",
                     help="bump force_update to replace tasks even with an "
                          "unchanged spec")
@@ -183,6 +200,28 @@ def _parse_mount(text: str) -> dict:
     return m
 
 
+_RESTART_CONDITIONS = {"none": 0, "failure": 1, "any": 2}
+
+
+def _restart_flags(args) -> Optional[dict]:
+    """RestartPolicy fields present on `args`, or None if none given
+    (shared by service-create and service-update)."""
+    if args.restart_condition is None and args.restart_delay is None \
+            and args.restart_max_attempts is None \
+            and args.restart_window is None:
+        return None
+    restart: dict = {}
+    if args.restart_condition is not None:
+        restart["condition"] = _RESTART_CONDITIONS[args.restart_condition]
+    if args.restart_delay is not None:
+        restart["delay"] = args.restart_delay
+    if args.restart_max_attempts is not None:
+        restart["max_attempts"] = args.restart_max_attempts
+    if args.restart_window is not None:
+        restart["window"] = args.restart_window
+    return restart
+
+
 def _kv_pairs(items: list[str], what: str) -> dict:
     out = {}
     for kv in items:
@@ -240,20 +279,8 @@ def _service_spec(args, networks=None, secrets=None, configs=None) -> dict:
             "memory_bytes": args.limit_memory or 0}
     if resources:
         task["resources"] = resources
-    if args.restart_condition is not None \
-            or args.restart_delay is not None \
-            or args.restart_max_attempts is not None \
-            or args.restart_window is not None:
-        restart = {}
-        if args.restart_condition is not None:
-            restart["condition"] = {"none": 0, "failure": 1,
-                                    "any": 2}[args.restart_condition]
-        if args.restart_delay is not None:
-            restart["delay"] = args.restart_delay
-        if args.restart_max_attempts is not None:
-            restart["max_attempts"] = args.restart_max_attempts
-        if args.restart_window is not None:
-            restart["window"] = args.restart_window
+    restart = _restart_flags(args)
+    if restart is not None:
         task["restart"] = restart
     if args.log_opt and not args.log_driver:
         raise CtlError("--log-opt requires --log-driver", "invalid")
@@ -472,13 +499,28 @@ async def run(args, out=None) -> int:
             # only materialize task/container sub-objects when a container
             # flag was actually given — an unrelated update must not
             # mutate a container-less service spec
-            if args.image is not None or args.env is not None:
+            cont_flags = {"image": args.image, "env": args.env,
+                          "command": args.command, "args": args.arg,
+                          "hostname": args.hostname}
+            if any(v is not None for v in cont_flags.values()) \
+                    or args.mount is not None:
                 cont = spec.setdefault("task", {}).setdefault(
                     "container", {})
-                if args.image is not None:
-                    cont["image"] = args.image
-                if args.env is not None:
-                    cont["env"] = list(args.env)
+                for key, v in cont_flags.items():
+                    if v is not None:
+                        cont[key] = list(v) if isinstance(v, list) else v
+                if args.mount is not None:
+                    cont["mounts"] = [_parse_mount(s) for s in args.mount]
+            if args.label_add or args.label_rm:
+                labels = spec.setdefault("annotations", {}).setdefault(
+                    "labels", {})
+                labels.update(_kv_pairs(args.label_add, "--label-add"))
+                for k in args.label_rm:
+                    labels.pop(k, None)
+            rflags = _restart_flags(args)
+            if rflags is not None:
+                spec.setdefault("task", {}).setdefault(
+                    "restart", {}).update(rflags)
             if args.replicas is not None and spec.get("replicated"):
                 spec["replicated"]["replicas"] = args.replicas
             if args.force:

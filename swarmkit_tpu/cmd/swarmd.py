@@ -15,6 +15,7 @@ import asyncio
 import logging
 import os
 import signal
+from typing import Optional
 
 from swarmkit_tpu.agent.testutils import TestExecutor
 from swarmkit_tpu.cmd.ctl import ControlSocketServer
@@ -295,6 +296,7 @@ async def run(args, network=None, executor=None, registry=None) -> Node:
         async def _enable_autolock():
             # leadership comes first, the seeded cluster object a beat
             # later — retry the whole read-modify-write until both exist
+            last_err: Optional[Exception] = None
             for _ in range(600):
                 m = node._running_manager()
                 if m is not None and node.is_leader():
@@ -309,11 +311,12 @@ async def run(args, network=None, executor=None, registry=None) -> Node:
                               f"{c.get_unlock_key()['unlock_key']}",
                               flush=True)
                         return
-                    except Exception:
-                        pass   # not seeded yet (or lost a version race)
+                    except Exception as e:
+                        last_err = e   # not seeded yet / version race
                 await asyncio.sleep(0.1)
             logging.getLogger("swarmd").error(
-                "autolock bootstrap never completed")
+                "autolock bootstrap never completed (last error: %r)",
+                last_err)
 
         t = asyncio.get_running_loop().create_task(_enable_autolock())
         node._autolock_bootstrap = t

@@ -538,6 +538,22 @@ async def test_swarmctl_service_update_and_rollback():
         # a second rollback has nothing to restore (error -> stderr, rc 1)
         rc, out = await ctl("service-rollback", svc_id)
         assert rc == 1
+
+        # container/label/restart flags merge into the live spec, leaving
+        # unrelated fields (the image) untouched
+        rc, out = await ctl(
+            "service-update", svc_id, "--label-add", "team=infra",
+            "--command", "run", "--restart-window", "30",
+            "--hostname", "web-{{.Task.Slot}}")
+        assert rc == 0, out
+        upd2 = json.loads(out)["spec"]
+        assert upd2["annotations"]["labels"] == {"team": "infra"}
+        assert upd2["task"]["container"]["command"] == ["run"]
+        assert upd2["task"]["container"]["hostname"] == "web-{{.Task.Slot}}"
+        assert upd2["task"]["restart"]["window"] == 30
+        assert upd2["task"]["container"]["image"] == "img1"  # untouched
+        rc, out = await ctl("service-update", svc_id, "--label-rm", "team")
+        assert json.loads(out)["spec"]["annotations"]["labels"] == {}
     finally:
         await node._ctl_server.stop()
         await node.stop()
