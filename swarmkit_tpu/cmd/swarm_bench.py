@@ -31,7 +31,7 @@ from swarmkit_tpu.store.memory import Event, match
 
 async def bench(replicas: int, workers: int, managers: int = 1,
                 transport: str = "inproc", tick_interval: float = 0.05,
-                election_tick: int = 4) -> dict:
+                election_tick: int = 4, proposals: int = 0) -> dict:
     import tempfile
 
     transport_factory = None
@@ -81,6 +81,41 @@ async def bench(replicas: int, workers: int, managers: int = 1,
             if m.is_leader():
                 return m.dispatcher
         return lead.dispatcher
+
+    if proposals > 0:
+        # BASELINE.json config 2: N-manager quorum, sequential ProposeValue
+        # appends through the leader's replicated store — per-proposal
+        # commit latency through the real raft path (reference
+        # swarm-bench's role for control-plane throughput)
+        from swarmkit_tpu.api import Config as ApiConfig, ConfigSpec
+
+        lat: list[float] = []
+        t0 = time.perf_counter()
+        for i in range(proposals):
+            p0 = time.perf_counter()
+            await lead.store.update(lambda tx, i=i: tx.create(ApiConfig(
+                id=f"bench-cfg-{i}",
+                spec=ConfigSpec(annotations=Annotations(name=f"p{i}"),
+                                data=b"x"))))
+            lat.append(time.perf_counter() - p0)
+        total = time.perf_counter() - t0
+        lat.sort()
+
+        def ppct(p):
+            return lat[min(len(lat) - 1, int(p * len(lat)))]
+
+        for m in mgrs:
+            await m.stop()
+        close = getattr(net, "close", None)
+        if close is not None:
+            close()
+        return {
+            "managers": managers, "transport": transport,
+            "proposals": proposals,
+            "proposals_per_s": round(proposals / total, 1),
+            "propose_p50_ms": round(ppct(0.5) * 1e3, 3),
+            "propose_p99_ms": round(ppct(0.99) * 1e3, 3),
+        }
 
     agents = []
     for i in range(workers):
@@ -152,11 +187,16 @@ def main(argv=None) -> int:
                    help="raft tick seconds (raise to ~0.5 when the device "
                         "wire runs on a real chip through a slow tunnel)")
     p.add_argument("--election-tick", type=int, default=4)
+    p.add_argument("--proposals", type=int, default=0,
+                   help="measure N sequential ProposeValue appends through "
+                        "the manager quorum instead of the task-startup "
+                        "flow (BASELINE config 2)")
     args = p.parse_args(argv)
     result = asyncio.run(bench(args.replicas, args.workers, args.managers,
                                transport=args.transport,
                                tick_interval=args.tick_interval,
-                               election_tick=args.election_tick))
+                               election_tick=args.election_tick,
+                               proposals=args.proposals))
     json.dump(result, sys.stdout)
     sys.stdout.write("\n")
     return 0

@@ -4,6 +4,14 @@ Reference: manager/metrics/collector.go (Collector :42, Run :61) — watches
 store events and maintains object-count gauges (nodes by state, tasks by
 state, services/networks/secrets/configs totals) for scraping; plus the
 ``swarm_manager_leader`` gauge set by the manager on leadership flips.
+
+Accounting is INCREMENTAL off the event stream like the reference's
+(collector.go handleEvent): a full-store recount per commit deep-copies
+every object through the serde layer and was measured at >90% of
+control-plane proposal latency once a few hundred objects exist.  A full
+recount runs only at start and after a bulk store restore (snapshot
+catch-up publishes no per-object events — detected via
+``store.restore_generation``).
 """
 
 from __future__ import annotations
@@ -13,9 +21,19 @@ import logging
 from typing import Optional
 
 from swarmkit_tpu.api import NodeState, TaskState
-from swarmkit_tpu.store.memory import EventCommit, MemoryStore
+from swarmkit_tpu.store.memory import Event, MemoryStore
 
 log = logging.getLogger("swarmkit_tpu.metrics")
+
+_TOTAL_KINDS = ("service", "network", "secret", "config")
+
+
+def _node_key(obj) -> str:
+    return f"swarm_node_{NodeState(obj.status.state).name.lower()}"
+
+
+def _task_key(obj) -> str:
+    return f"swarm_task_{TaskState(obj.status.state).name.lower()}"
 
 
 class Collector:
@@ -24,6 +42,7 @@ class Collector:
         self.gauges: dict[str, float] = {"swarm_manager_leader": 0.0}
         self._task: Optional[asyncio.Task] = None
         self._running = False
+        self._restore_gen = -1
 
     def set_leader(self, leader: bool) -> None:
         self.gauges["swarm_manager_leader"] = 1.0 if leader else 0.0
@@ -32,8 +51,9 @@ class Collector:
         return dict(self.gauges)
 
     async def start(self) -> None:
-        # one recount per committed transaction, not per object event
-        watcher = self.store.watch(lambda e: isinstance(e, EventCommit))
+        watcher = self.store.watch(
+            lambda e: isinstance(e, Event)
+            and e.kind in ("node", "task") + _TOTAL_KINDS)
         self._recount()
         self._running = True
         self._task = asyncio.get_running_loop().create_task(self._run(watcher))
@@ -53,23 +73,61 @@ class Collector:
             async for ev in watcher:
                 if not self._running:
                     return
-                # incremental gauges would mirror the reference; a recount
-                # per commit is simpler and the store is in-memory
-                self._recount()
+                if self.store.restore_generation != self._restore_gen:
+                    self._resync(watcher)   # bulk restore: from scratch
+                elif not self._apply(ev):
+                    self._resync(watcher)   # unknown prior state
         except asyncio.CancelledError:
             raise
         except Exception:
             log.exception("metrics collector crashed")
 
+    def _resync(self, watcher) -> None:
+        """Full recount that DISCARDS everything the watcher has buffered:
+        the store applies all of a commit's table mutations before
+        publishing its events, so any event buffered when the recount runs
+        is already reflected in the tables — applying it afterwards would
+        double-count (and nothing can commit between poll and recount:
+        both are synchronous)."""
+        watcher.poll()
+        self._recount()
+
+    def _apply(self, ev: Event) -> bool:
+        """O(1) gauge adjustment per object event (reference handleEvent).
+        Returns False when the event cannot be applied incrementally (an
+        update without its previous state) and a resync is required."""
+        g = self.gauges
+        if ev.kind == "node":
+            keyfn = _node_key
+        elif ev.kind == "task":
+            keyfn = _task_key
+        else:
+            g[f"swarm_{ev.kind}_total"] = g.get(
+                f"swarm_{ev.kind}_total", 0) + (
+                1 if ev.action == "create"
+                else -1 if ev.action == "remove" else 0)
+            return True
+        if ev.action == "update" and ev.old_object is None:
+            return False   # unknown previous state
+        if ev.action in ("update", "remove"):
+            old = ev.old_object if ev.action == "update" else ev.object
+            k = keyfn(old)
+            g[k] = g.get(k, 0) - 1
+        if ev.action in ("create", "update"):
+            k = keyfn(ev.object)
+            g[k] = g.get(k, 0) + 1
+        return True
+
     def _recount(self) -> None:
+        self._restore_gen = self.store.restore_generation
         g = self.gauges
         for state in NodeState:
             g[f"swarm_node_{state.name.lower()}"] = 0
         for n in self.store.find("node"):
-            g[f"swarm_node_{NodeState(n.status.state).name.lower()}"] += 1
+            g[_node_key(n)] += 1
         for state in TaskState:
             g[f"swarm_task_{state.name.lower()}"] = 0
         for t in self.store.find("task"):
-            g[f"swarm_task_{TaskState(t.status.state).name.lower()}"] += 1
-        for kind in ("service", "network", "secret", "config"):
+            g[_task_key(t)] += 1
+        for kind in _TOTAL_KINDS:
             g[f"swarm_{kind}_total"] = len(self.store.find(kind))

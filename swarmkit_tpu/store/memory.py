@@ -375,6 +375,10 @@ class MemoryStore:
         self._clock = clock or time.time
         self.queue = Queue()
         self._local_version = 0
+        # bumped by restore(): bulk rebuilds publish no per-object events,
+        # so incremental consumers (metrics collector) resync when they
+        # see the generation move
+        self.restore_generation = 0
         self._in_flight: dict[int, float] = {}  # update id -> start time
         self._in_flight_seq = 0
         # Serializes write transactions ACROSS the proposal round-trip
@@ -563,6 +567,7 @@ class MemoryStore:
             for data in objs:
                 self._tables[kind].put(cls.from_dict(data))
         self._local_version = max(self._local_version, version)
+        self.restore_generation += 1
 
     @property
     def version(self) -> int:
