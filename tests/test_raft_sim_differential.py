@@ -678,3 +678,22 @@ def cfg_snapshot_floor(cfg) -> int:
     ring capacity (log_len) — if commit exceeds this while a node slept
     from early on, its catch-up HAD to go through a snapshot."""
     return cfg.log_len
+
+
+def test_differential_slow_luck_schedule_eventually_commits():
+    """Fresh-seed sweep find (seed 2009343, 2026-07-31): a 5-node PreVote
+    mailbox schedule with crash_prob=0.04 + drop=0.08 elected through
+    term 7 with ZERO commits in 220 ticks — every leader died before its
+    first commit.  Kernel==oracle the whole way; the same schedule run
+    longer commits hundreds of entries.  Pins both facts: no divergence
+    at the short horizon, and liveness at the long one (the sweep tool's
+    no-progress check now extends the horizon before calling a stall)."""
+    cfg = SimConfig(n=5, log_len=64, window=8, apply_batch=16, max_props=8,
+                    keep=4, election_tick=14, seed=5, latency=2,
+                    latency_jitter=1, inflight=2, pre_vote=True)
+    short = run_differential(cfg, seed=2009343, n_ticks=220,
+                             drop_rate=0.08, crash_prob=0.04)
+    assert short["max_term"] >= 5        # elections churned...
+    long_ = run_differential(cfg, seed=2009343, n_ticks=600,
+                             drop_rate=0.08, crash_prob=0.04)
+    assert long_["max_commit"] > 100     # ...but the cluster is live
