@@ -15,10 +15,32 @@ import hashlib
 from dataclasses import dataclass
 from typing import Optional
 
-from cryptography import x509
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.x509.oid import NameOID
+try:
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # pragma: no cover - depends on the environment
+    # x509 certificates cannot be faked in pure python; gate the import so
+    # the package (and everything that merely transits it) stays importable
+    # and fail at the point of actual use instead.
+    HAVE_CRYPTOGRAPHY = False
+
+    class _MissingCryptography:
+        def __init__(self, name: str) -> None:
+            self._name = name
+
+        def __getattr__(self, attr: str):
+            raise ModuleNotFoundError(
+                f"{self._name}.{attr} needs the 'cryptography' package, "
+                "which is not installed; TLS identities are unavailable")
+
+    x509 = _MissingCryptography("cryptography.x509")
+    hashes = _MissingCryptography("cryptography...hashes")
+    serialization = _MissingCryptography("cryptography...serialization")
+    ec = _MissingCryptography("cryptography...ec")
+    NameOID = _MissingCryptography("cryptography...NameOID")
 
 # reference: ca/certificates.go role OU values
 MANAGER_ROLE_OU = "swarm-manager"

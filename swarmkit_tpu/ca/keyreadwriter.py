@@ -19,7 +19,12 @@ import json
 import os
 from typing import Optional
 
-from cryptography.fernet import Fernet, InvalidToken
+try:
+    from cryptography.fernet import Fernet, InvalidToken
+except ImportError:  # pragma: no cover - depends on the environment
+    # kek-sealed key storage falls back to the hashlib-backed Fernet
+    # stand-in (swarmkit_tpu.encryption); plaintext storage is unaffected.
+    from swarmkit_tpu.encryption.encryption import Fernet, InvalidToken
 
 
 class KeyReadWriter:

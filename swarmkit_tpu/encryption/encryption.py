@@ -19,9 +19,81 @@ import os
 from dataclasses import dataclass, field
 from typing import Iterable, Optional
 
+import hashlib
+import hmac as _hmac
+
 import msgpack
-from cryptography.fernet import Fernet, InvalidToken
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+try:
+    from cryptography.fernet import Fernet, InvalidToken
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # pragma: no cover - depends on the environment
+    HAVE_CRYPTOGRAPHY = False
+
+    class InvalidToken(Exception):
+        pass
+
+    class _HashlibAead:
+        """Stand-in AEAD when the ``cryptography`` package is absent:
+        SHA-256-CTR keystream + truncated HMAC-SHA256 tag, domain-separated
+        per algorithm.  Same encrypt/decrypt surface as ChaCha20Poly1305.
+        Records it writes are only readable by this fallback (and vice
+        versa) — fine for a self-contained store, not for interop."""
+
+        _TAG = 16
+
+        def __init__(self, key: bytes, domain: bytes) -> None:
+            self._key = key
+            self._domain = domain
+
+        def _stream(self, nonce: bytes, n: int) -> bytes:
+            out = bytearray()
+            ctr = 0
+            while len(out) < n:
+                out += hashlib.sha256(
+                    self._domain + self._key + nonce
+                    + ctr.to_bytes(8, "big")).digest()
+                ctr += 1
+            return bytes(out[:n])
+
+        def _mac(self, nonce: bytes, ct: bytes) -> bytes:
+            return _hmac.new(self._key, self._domain + nonce + ct,
+                             hashlib.sha256).digest()[:self._TAG]
+
+        def encrypt(self, nonce: bytes, data: bytes, aad: bytes) -> bytes:
+            ct = bytes(a ^ b for a, b in
+                       zip(data, self._stream(nonce, len(data))))
+            return ct + self._mac(nonce, ct)
+
+        def decrypt(self, nonce: bytes, data: bytes, aad: bytes) -> bytes:
+            if len(data) < self._TAG:
+                raise InvalidToken("ciphertext too short")
+            ct, tag = data[:-self._TAG], data[-self._TAG:]
+            if not _hmac.compare_digest(tag, self._mac(nonce, ct)):
+                raise InvalidToken("tag mismatch")
+            return bytes(a ^ b for a, b in
+                         zip(ct, self._stream(nonce, len(ct))))
+
+    def ChaCha20Poly1305(key: bytes):  # noqa: N802 - drop-in name
+        return _HashlibAead(key, b"secretbox:")
+
+    class Fernet:
+        """Token-level stand-in for ``cryptography.fernet.Fernet`` backed
+        by the same hashlib AEAD (nonce is prepended to the token)."""
+
+        def __init__(self, b64_key: bytes) -> None:
+            self._aead = _HashlibAead(base64.urlsafe_b64decode(b64_key),
+                                      b"fernet:")
+
+        def encrypt(self, data: bytes) -> bytes:
+            nonce = os.urandom(16)
+            return nonce + self._aead.encrypt(nonce, data, b"")
+
+        def decrypt(self, token: bytes) -> bytes:
+            if len(token) < 16:
+                raise InvalidToken("token too short")
+            return self._aead.decrypt(token[:16], token[16:], b"")
 
 
 class Algorithm(enum.IntEnum):

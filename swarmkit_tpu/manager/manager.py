@@ -137,11 +137,20 @@ class Manager:
         """reference: manager.Run manager.go:427."""
         self._running = True
         self.raft.pre_join_hook = self._create_joiner_node_record
+        # promote our HealthServer onto the wire BEFORE the raft listener
+        # starts, so peer probes read real per-service statuses
+        # (reference: health service registration manager.go:526-548)
+        network = self.raft.opts.network
+        if hasattr(network, "set_health"):
+            network.set_health(self.addr, lambda: self.health)
         leadership = self.raft.leadership.watch()
         await self.raft.start()
         await self.metrics.start()
         self.health.set_serving_status("Raft", HealthStatus.SERVING)
         self.health.set_serving_status("ControlAPI", HealthStatus.SERVING)
+        self.health.set_serving_status("Watch", HealthStatus.SERVING)
+        self.health.set_serving_status("ResourceAllocator",
+                                       HealthStatus.SERVING)
         self._leadership_task = asyncio.get_running_loop().create_task(
             self._handle_leadership_events(leadership))
         # we may already be the leader (single-node bootstrap elects fast)
@@ -332,17 +341,23 @@ class Manager:
         self.ca_server = None
         self.control_api.ca_server = None
 
-    def _bootstrap_root_ca(self) -> RootCA:
+    def _bootstrap_root_ca(self) -> Optional[RootCA]:
         if self.security is not None and self.security.root_ca.can_sign:
             return self.security.root_ca
+        from swarmkit_tpu.ca.certificates import HAVE_CRYPTOGRAPHY
+        if not HAVE_CRYPTOGRAPHY:
+            # No x509 stack in this environment: seed the cluster object
+            # without CA material (join tokens / TLS identities disabled).
+            log.warning("cryptography unavailable; bootstrapping cluster "
+                        "without a root CA")
+            return None
         return RootCA.create()
 
     async def _seed_defaults(self) -> None:
         """Seed the default cluster object and our own node record
         (reference: becomeLeader manager.go:931-983)."""
-        root_ca = None
-        if not self.store.find("cluster"):
-            root_ca = self._bootstrap_root_ca()
+        seed_cluster = not self.store.find("cluster")
+        root_ca = self._bootstrap_root_ca() if seed_cluster else None
 
         # bootstrap cluster id = the certificate org (reference:
         # manager.go uses securityConfig's Organization as the cluster id)
@@ -351,16 +366,17 @@ class Manager:
 
         def txn(tx):
             clusters = tx.find("cluster")
-            if not clusters and root_ca is not None:
+            if not clusters and seed_cluster:
                 cluster = Cluster(
                     id=cluster_id,
                     spec=ClusterSpec(
                         annotations=Annotations(name=DEFAULT_CLUSTER_NAME)))
-                cluster.root_ca.ca_cert = root_ca.cert_pem
-                cluster.root_ca.ca_key = root_ca.key_pem or b""
-                cluster.root_ca.ca_cert_hash = root_ca.digest()
-                cluster.root_ca.join_token_worker = ca_token(root_ca)
-                cluster.root_ca.join_token_manager = ca_token(root_ca)
+                if root_ca is not None:
+                    cluster.root_ca.ca_cert = root_ca.cert_pem
+                    cluster.root_ca.ca_key = root_ca.key_pem or b""
+                    cluster.root_ca.ca_cert_hash = root_ca.digest()
+                    cluster.root_ca.join_token_worker = ca_token(root_ca)
+                    cluster.root_ca.join_token_manager = ca_token(root_ca)
                 tx.create(cluster)
             if tx.get("node", self.node_id) is None:
                 tx.create(ApiNode(

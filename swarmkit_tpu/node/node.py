@@ -242,6 +242,11 @@ class Node:
             # bootstrap: self-signed root CA; the manager seeds the cluster
             # from it and the org becomes the cluster id (reference:
             # node.go bootstrap path in loadSecurityConfig)
+            from swarmkit_tpu.ca.certificates import HAVE_CRYPTOGRAPHY
+            if not HAVE_CRYPTOGRAPHY:
+                log.warning("manager %s: cryptography unavailable; running "
+                            "without a certificate identity", self.node_id)
+                return
             root = RootCA.create()
             org = "cluster-" + new_id()
             issued = root.issue_node_certificate(

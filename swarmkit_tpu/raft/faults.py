@@ -1,0 +1,157 @@
+"""Shared transport-seam fault surface + declarative fault plans.
+
+Every raft wire in this repo (in-process asyncio ``Network``, the real-socket
+``GrpcNetwork`` and the device-mesh mailbox ``DeviceMeshNet``) implements the
+same injectable fault vocabulary, mirroring what the reference achieves with
+real sockets in tests (WrappedListener drops, iptables partitions in BASELINE
+configs):
+
+- ``set_down(addr)``        — the node at `addr` is unreachable
+- ``set_drop(frm, to, p)``  — probabilistic loss on a directed edge
+- ``partition(*groups)``    — only nodes in the same group can talk
+- ``set_delay(frm, to, s)`` — added latency on a directed edge
+- ``crash_restart(addr)``   — sever wire-level state for a bounced process
+                              (cached channels, staged mailbox slots)
+- ``heal()``                — clear partitions, drops and delays
+
+``FaultSurface`` holds the mutable fault state and decision helpers; wires
+inherit it and consult ``_fault_blocked`` / ``lossy`` / ``delay_for`` on
+their delivery paths (the in-process queue drain, the gRPC stub gate, the
+device mailbox ``keep`` mask).  ``FaultPlan`` is the declarative form the
+fault sweep (tools/fault_sweep.py) replays against each wire: a named list
+of inject actions plus the repair actions that undo them.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Iterable
+
+
+class FaultSurface:
+    """Mutable fault state shared by every Network implementation."""
+
+    def __init__(self, seed: int = 0) -> None:
+        self._down: set[str] = set()
+        self._drop: dict[tuple[str, str], float] = {}
+        self._partitions: list[set[str]] = []
+        self._delay: dict[tuple[str, str], float] = {}
+        self._rng = random.Random(seed)
+        self.delivered = 0
+        self.dropped = 0
+
+    # -- injection ---------------------------------------------------------
+    def set_down(self, addr: str, down: bool = True) -> None:
+        if down:
+            self._down.add(addr)
+        else:
+            self._down.discard(addr)
+
+    def set_drop(self, frm: str, to: str, p: float) -> None:
+        if p <= 0:
+            self._drop.pop((frm, to), None)
+        else:
+            self._drop[(frm, to)] = p
+
+    def partition(self, *groups: Iterable[str]) -> None:
+        self._partitions = [set(g) for g in groups]
+
+    def set_delay(self, frm: str, to: str, seconds: float) -> None:
+        if seconds <= 0:
+            self._delay.pop((frm, to), None)
+        else:
+            self._delay[(frm, to)] = seconds
+
+    def crash_restart(self, addr: str) -> None:
+        """Sever wire-level state for a process bounce at `addr`.
+
+        The base surface holds no per-connection state; wires that cache
+        channels (GrpcNetwork) or stage undelivered payloads (DeviceMeshNet)
+        override this to drop them, so a restarted process never receives
+        traffic addressed to its previous incarnation."""
+
+    def heal(self) -> None:
+        self._partitions = []
+        self._drop = {}
+        self._delay = {}
+
+    # -- decisions (consulted by delivery paths) ---------------------------
+    def _fault_blocked(self, frm: str, to: str) -> bool:
+        if to in self._down:
+            return True
+        for group in self._partitions:
+            if (frm in group) != (to in group):
+                return True
+        return False
+
+    def lossy(self, frm: str, to: str) -> bool:
+        p = self._drop.get((frm, to), 0.0)
+        return p > 0 and self._rng.random() < p
+
+    def delay_for(self, frm: str, to: str) -> float:
+        return self._delay.get((frm, to), 0.0)
+
+    def faults_active(self) -> bool:
+        return bool(self._down or self._drop or self._partitions
+                    or self._delay)
+
+
+class FaultPlan:
+    """A named, replayable fault schedule: inject actions + repair actions.
+
+    Actions are (method-name, args) pairs applied to any FaultSurface, so
+    one plan definition runs identically against all three wires.  ``heal``
+    runs the plan's repair actions (e.g. un-downing a node) and then the
+    surface-wide ``heal()``.
+    """
+
+    def __init__(self, name: str, inject=(), repair=()) -> None:
+        self.name = name
+        self._inject = list(inject)
+        self._repair = list(repair)
+
+    def __repr__(self) -> str:
+        return f"FaultPlan({self.name!r})"
+
+    def inject(self, net: FaultSurface) -> None:
+        for method, args in self._inject:
+            getattr(net, method)(*args)
+
+    def heal(self, net: FaultSurface) -> None:
+        for method, args in self._repair:
+            getattr(net, method)(*args)
+        net.heal()
+
+    # -- the five primitives ----------------------------------------------
+    @classmethod
+    def down(cls, addr: str) -> "FaultPlan":
+        return cls(f"down({addr})",
+                   inject=[("set_down", (addr, True))],
+                   repair=[("set_down", (addr, False))])
+
+    @classmethod
+    def drop(cls, frm: str, to: str, p: float = 0.5,
+             symmetric: bool = True) -> "FaultPlan":
+        inject = [("set_drop", (frm, to, p))]
+        if symmetric:
+            inject.append(("set_drop", (to, frm, p)))
+        return cls(f"drop({frm}<->{to},p={p})", inject=inject)
+
+    @classmethod
+    def split(cls, *groups: Iterable[str]) -> "FaultPlan":
+        groups = tuple(tuple(g) for g in groups)
+        return cls(f"partition({groups})",
+                   inject=[("partition", groups)])
+
+    @classmethod
+    def delay(cls, frm: str, to: str, seconds: float,
+              symmetric: bool = True) -> "FaultPlan":
+        inject = [("set_delay", (frm, to, seconds))]
+        if symmetric:
+            inject.append(("set_delay", (to, frm, seconds)))
+        return cls(f"delay({frm}<->{to},{seconds}s)", inject=inject)
+
+    @classmethod
+    def crash(cls, addr: str) -> "FaultPlan":
+        return cls(f"crash_restart({addr})",
+                   inject=[("crash_restart", (addr,))])

@@ -24,6 +24,7 @@ from typing import Any, Optional
 import grpc
 import msgpack
 
+from swarmkit_tpu.raft.faults import FaultSurface
 from swarmkit_tpu.raft.messages import (
     ConfChange, ConfChangeType, Entry, EntryType, Message, MsgType, Snapshot,
     SnapshotMeta,
@@ -213,18 +214,101 @@ def _map_rpc_error(e: grpc.aio.AioRpcError) -> Exception:
 
 
 # --------------------------------------------------------------------------
+# active peer health probing
+
+class _PeerProber:
+    """Active health probe for one peer address.
+
+    Serves ``GrpcNetwork.healthy``/``reachable`` the way the reference's
+    raft transport consumes manager/health (health.go:21, raft.go:1422):
+    a loop Checks the peer's Health service; ``failure_threshold``
+    consecutive failures flip the peer unhealthy, redials back off
+    exponentially with jitter, and recovery requires sustained success
+    spanning ``grace_period`` so a flapping peer does not oscillate the
+    vote-health gate."""
+
+    def __init__(self, net: "GrpcNetwork", addr: str) -> None:
+        self.net = net
+        self.addr = addr
+        self.failures = 0          # consecutive probe failures
+        self._healthy = True       # optimistic until proven otherwise
+        self._first_ok: Optional[float] = None
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    @property
+    def healthy(self) -> bool:
+        return self._healthy
+
+    def reset(self) -> None:
+        """Forget accumulated failure state (peer process bounced)."""
+        self.failures = 0
+        self._first_ok = None
+
+    def stop(self) -> None:
+        self._task.cancel()
+
+    async def _probe_once(self) -> bool:
+        if self.addr in self.net._down:
+            return False
+        try:
+            raw = await asyncio.wait_for(
+                self.net._health_call(self.addr)(msgpack.packb("Raft")),
+                timeout=self.net.probe_timeout)
+            return msgpack.unpackb(raw) == 1   # HealthStatus.SERVING
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            return False
+
+    async def _loop(self) -> None:
+        net = self.net
+        while True:
+            ok = await self._probe_once()
+            now = asyncio.get_running_loop().time()
+            if ok:
+                self.failures = 0
+                if not self._healthy:
+                    if self._first_ok is None:
+                        self._first_ok = now
+                    if now - self._first_ok >= net.grace_period:
+                        self._healthy = True
+                        self._first_ok = None
+                await asyncio.sleep(
+                    net.probe_interval * (0.75 + 0.5 * net._rng.random()))
+            else:
+                self.failures += 1
+                self._first_ok = None
+                if self.failures >= net.failure_threshold:
+                    self._healthy = False
+                base, cap = net.dial_backoff
+                delay = min(cap, base * (2 ** min(self.failures - 1, 8)))
+                await asyncio.sleep(delay * (0.5 + 0.5 * net._rng.random()))
+
+
+# --------------------------------------------------------------------------
 # the Network-shaped seam
 
-class GrpcNetwork:
+class GrpcNetwork(FaultSurface):
     """Drop-in for raft.transport.Network over real sockets.
 
     Addresses are host:port listen addresses.  ``register`` starts a
-    grpc.aio server for the node; ``server(frm, to)`` returns a cached
-    remote stub.  Reachability is what the sockets say (no fault-injection
-    knobs — use the in-process Network for partition tests).
+    grpc.aio server for the node (raft + a gRPC health service);
+    ``server(frm, to)`` returns a cached remote stub, refusing the dial
+    when fault injection blocks the edge — the same down/drop/partition/
+    delay vocabulary as the in-process Network (FaultSurface).
+    ``healthy``/``reachable`` are backed by active peer probing
+    (_PeerProber) instead of the seed's hardcoded True, so vote-health
+    gating and the CanRemoveMember quorum precheck operate for real
+    across processes.
     """
 
-    def __init__(self, security=None) -> None:
+    def __init__(self, security=None, seed: int = 0,
+                 probe_interval: float = 0.5,
+                 probe_timeout: float = 1.0,
+                 failure_threshold: int = 3,
+                 grace_period: float = 1.0,
+                 redial_backoff: float = 0.05,
+                 redial_backoff_max: float = 2.0) -> None:
         # security: a ca.SecurityConfig or a zero-arg callable returning one
         # (late-bound: swarmd loads its identity after the network object
         # exists). When set, the listener serves with TLS from the node
@@ -236,6 +320,7 @@ class GrpcNetwork:
         # python-grpc analog of the reference's InsecureSkipVerify +
         # digest-pin GetRemoteCA, ca/certificates.go).
         # None = plaintext, for in-process tests only.
+        super().__init__(seed=seed)
         self._security_arg = security
         self._servers: dict[str, grpc.aio.Server] = {}
         self._channels: dict[str, grpc.aio.Channel] = {}
@@ -244,8 +329,19 @@ class GrpcNetwork:
         self._extra_handlers: dict[str, list] = {}
         self._join_handlers: dict[str, list] = {}
         self._bind_map: dict[str, str] = {}   # advertise -> bind address
-        self.delivered = 0   # counters kept for interface parity
-        self.dropped = 0
+        # health-probe knobs (see _PeerProber)
+        self.probe_interval = probe_interval
+        self.probe_timeout = probe_timeout
+        self.failure_threshold = failure_threshold
+        self.grace_period = grace_period
+        # redial backoff (base, cap): consumed both by _PeerProber and by
+        # the shared transport's per-peer drain loop (_Peer._redial_backoff)
+        self.dial_backoff = (redial_backoff, redial_backoff_max)
+        self._probers: dict[str, _PeerProber] = {}
+        self._health_rpcs: dict[str, Any] = {}
+        # addr -> HealthServer (or zero-arg callable returning one); set by
+        # the manager before its raft node registers (Manager.start)
+        self._health_refs: dict[str, Any] = {}
 
     @property
     def security(self):
@@ -265,11 +361,37 @@ class GrpcNetwork:
         dialable advertised address). Call before register()."""
         self._bind_map[advertise] = listen
 
+    def set_health(self, addr: str, health_ref) -> None:
+        """Point the wire health service for `addr` at a HealthServer (or a
+        zero-arg callable returning one). The manager calls this before its
+        raft node registers, promoting manager/health.py onto the wire
+        (reference: the HealthServer registration manager.go:526-548)."""
+        self._health_refs[addr] = health_ref
+
+    def _health_check_fn(self, addr: str, node: Any):
+        """Per-service status for this listener: the manager's HealthServer
+        when one is wired, else derived from the raft node's liveness (bare
+        raft-node clusters in tests/tools have no manager)."""
+        def check(service: str) -> int:
+            ref = self._health_refs.get(addr)
+            h = ref() if callable(ref) else ref
+            if h is not None:
+                status = int(h.check(service))
+                if status != 0:       # not UNKNOWN
+                    return status
+            current = self._local.get(addr)
+            target = current if current is not None else node
+            return 1 if getattr(target, "running", True) else 2
+        return check
+
     def register(self, addr: str, node: Any) -> None:
         # gRPC server startup is async; do it lazily-but-synchronously via
         # the running loop (register is called from async context in
         # node.start)
+        from swarmkit_tpu.rpc import health_handlers
+
         self._local[addr] = node
+        self._down.discard(addr)
         bind = self._bind_map.get(addr, addr)
         loop = asyncio.get_event_loop()
         server = grpc.aio.server(options=[
@@ -277,6 +399,8 @@ class GrpcNetwork:
             ("grpc.max_receive_message_length", GRPC_MAX_MSG_SIZE),
         ])
         for h in _RaftService(node, security=self.security).handlers():
+            server.add_generic_rpc_handlers((h,))
+        for h in health_handlers(self._health_check_fn(addr, node)):
             server.add_generic_rpc_handlers((h,))
         for h in self._extra_handlers.get(addr, ()):
             server.add_generic_rpc_handlers((h,))
@@ -349,9 +473,9 @@ class GrpcNetwork:
                 asyncio.get_event_loop().create_task(server.stop(grace=0.1))
 
     # -- dialing -----------------------------------------------------------
-    def server(self, frm: str, to: str) -> _RemoteStub:
-        stub = self._stubs.get(to)
-        if stub is None:
+    def _channel(self, to: str) -> grpc.aio.Channel:
+        channel = self._channels.get(to)
+        if channel is None:
             options = [
                 ("grpc.max_send_message_length", GRPC_MAX_MSG_SIZE),
                 ("grpc.max_receive_message_length", GRPC_MAX_MSG_SIZE),
@@ -367,25 +491,82 @@ class GrpcNetwork:
             else:
                 channel = grpc.aio.insecure_channel(to, options=options)
             self._channels[to] = channel
-            stub = _RemoteStub(channel)
+        return channel
+
+    def server(self, frm: str, to: str) -> _RemoteStub:
+        """Dial: connection-level fault interception happens HERE — this is
+        called per delivery attempt (the per-peer drain loop and the join
+        flow), so an injected down/partition refuses the edge immediately,
+        without touching the socket."""
+        if self._fault_blocked(frm, to):
+            raise Unreachable(f"{to} blocked from {frm} by fault injection")
+        self._ensure_prober(to)
+        stub = self._stubs.get(to)
+        if stub is None:
+            stub = _RemoteStub(self._channel(to))
             self._stubs[to] = stub
         return stub
 
-    # -- reachability (best effort over real sockets) ----------------------
+    # -- health probing ----------------------------------------------------
+    def _health_call(self, addr: str):
+        call = self._health_rpcs.get(addr)
+        if call is None:
+            from swarmkit_tpu.rpc import HEALTH_SVC
+
+            call = self._channel(addr).unary_unary(
+                f"/{HEALTH_SVC}/Check",
+                request_serializer=_IDENT, response_deserializer=_IDENT)
+            self._health_rpcs[addr] = call
+        return call
+
+    def _ensure_prober(self, addr: str) -> Optional[_PeerProber]:
+        p = self._probers.get(addr)
+        if p is None:
+            try:
+                p = _PeerProber(self, addr)
+            except RuntimeError:
+                return None   # no running loop (sync caller): stay optimistic
+            self._probers[addr] = p
+        return p
+
+    # -- reachability (fault injection + live probe state) -----------------
     def reachable(self, frm: str, to: str) -> bool:
-        return True   # the RPC itself reports unreachable peers
+        if self._fault_blocked(frm, to):
+            return False
+        p = self._probers.get(to)
+        return True if p is None else p.healthy
 
     def healthy(self, addr: str) -> bool:
-        return True
+        if addr in self._down:
+            return False
+        p = self._probers.get(addr) or self._ensure_prober(addr)
+        return True if p is None else p.healthy
 
-    def lossy(self, frm: str, to: str) -> bool:
-        return False
+    def crash_restart(self, addr: str) -> None:
+        """Sever cached wire state for a bounced process at `addr`: close
+        its channel (in-flight RPCs fail, the next dial reconnects) and
+        reset the prober's accumulated failure window."""
+        self._stubs.pop(addr, None)
+        self._health_rpcs.pop(addr, None)
+        channel = self._channels.pop(addr, None)
+        if channel is not None:
+            try:
+                asyncio.get_running_loop().create_task(channel.close())
+            except RuntimeError:
+                pass
+        p = self._probers.get(addr)
+        if p is not None:
+            p.reset()
 
     async def close(self) -> None:
+        for p in self._probers.values():
+            p.stop()
+        self._probers = {}
         for ch in self._channels.values():
             await ch.close()
         self._channels = {}
         self._stubs = {}
+        self._health_rpcs = {}
         for server in self._servers.values():
             await server.stop(grace=0.1)
         self._servers = {}

@@ -171,6 +171,7 @@ class Node(Proposer):
         self._removed = False
         self._ticks_until_campaign = 0
         self._wedge_transfer_at = float("-inf")
+        self._peer_failures: dict[int, int] = {}
         self.running = False
 
     # ------------------------------------------------------------------
@@ -834,7 +835,15 @@ class Node(Proposer):
 
     # Raft callback interface for the Transport
     # (reference: transport.Raft transport.go:26)
-    def report_unreachable(self, raft_id: int) -> None:
+    def report_unreachable(self, raft_id: int, failures: int = 1) -> None:
+        """`failures` is the transport's consecutive-failure count for the
+        peer (drives its redial backoff); tracked here so operators see
+        which peers are flapping via status().  A count of 0 signals
+        recovery — the first successful delivery after a failure streak."""
+        if failures <= 0:
+            self._peer_failures.pop(raft_id, None)
+            return
+        self._peer_failures[raft_id] = failures
         if self._raw is not None and self.running:
             self._raw.report_unreachable(raft_id)
             self._wake.set()
@@ -882,6 +891,8 @@ class Node(Proposer):
         st["removed"] = sorted(self.cluster.removed)
         st["applied_index"] = self._applied
         st["snapshot_index"] = self._snapshot_index
+        st["peer_failures"] = {rid: n for rid, n in
+                               self._peer_failures.items() if n > 0}
         return st
 
     def subscribe_leadership(self):

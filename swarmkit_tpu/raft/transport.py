@@ -18,9 +18,9 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import random
 from typing import Any, Optional, Protocol
 
+from swarmkit_tpu.raft.faults import FaultSurface
 from swarmkit_tpu.raft.messages import Message, MsgType
 
 log = logging.getLogger("swarmkit_tpu.raft.transport")
@@ -33,7 +33,7 @@ class RaftHandlers(Protocol):
     (reference: transport.Raft transport.go:26)."""
 
     async def process_raft_message(self, m: Message) -> None: ...
-    def report_unreachable(self, raft_id: int) -> None: ...
+    def report_unreachable(self, raft_id: int, failures: int = 1) -> None: ...
     def report_snapshot(self, raft_id: int, ok: bool) -> None: ...
     def is_id_removed(self, raft_id: int) -> bool: ...
     def update_node(self, raft_id: int, addr: str) -> None: ...
@@ -49,22 +49,17 @@ class PeerRemoved(Exception):
     (reference: ErrMemberRemoved grpc error)."""
 
 
-class Network:
+class Network(FaultSurface):
     """In-process wire: addr -> server object, with fault injection.
 
-    Fault injection mirrors what the reference achieves with real sockets in
-    tests (WrappedListener drops, iptables partitions in BASELINE configs):
-    per-edge drop probability and partition groups.
+    The fault vocabulary (down/drop/partition/delay + crash_restart + heal)
+    lives on the shared FaultSurface so the gRPC and device-mesh wires
+    expose the identical surface; see swarmkit_tpu/raft/faults.py.
     """
 
     def __init__(self, seed: int = 0) -> None:
+        super().__init__(seed=seed)
         self._servers: dict[str, Any] = {}
-        self._down: set[str] = set()
-        self._drop: dict[tuple[str, str], float] = {}
-        self._partitions: list[set[str]] = []
-        self._rng = random.Random(seed)
-        self.delivered = 0
-        self.dropped = 0
 
     # -- topology ----------------------------------------------------------
     def register(self, addr: str, server: Any) -> None:
@@ -74,33 +69,9 @@ class Network:
     def unregister(self, addr: str) -> None:
         self._servers.pop(addr, None)
 
-    def set_down(self, addr: str, down: bool = True) -> None:
-        if down:
-            self._down.add(addr)
-        else:
-            self._down.discard(addr)
-
-    def set_drop(self, frm: str, to: str, p: float) -> None:
-        if p <= 0:
-            self._drop.pop((frm, to), None)
-        else:
-            self._drop[(frm, to)] = p
-
-    def partition(self, *groups: set[str]) -> None:
-        self._partitions = [set(g) for g in groups]
-
-    def heal(self) -> None:
-        self._partitions = []
-        self._drop = {}
-
     # -- reachability ------------------------------------------------------
     def _blocked(self, frm: str, to: str) -> bool:
-        if to in self._down or to not in self._servers:
-            return True
-        for group in self._partitions:
-            if (frm in group) != (to in group):
-                return True
-        return False
+        return to not in self._servers or self._fault_blocked(frm, to)
 
     def reachable(self, frm: str, to: str) -> bool:
         return not self._blocked(frm, to)
@@ -114,10 +85,6 @@ class Network:
             raise Unreachable(f"{to} unreachable from {frm}")
         return self._servers[to]
 
-    def lossy(self, frm: str, to: str) -> bool:
-        p = self._drop.get((frm, to), 0.0)
-        return p > 0 and self._rng.random() < p
-
 
 class _Peer:
     """One remote: bounded queue + drain task
@@ -129,6 +96,7 @@ class _Peer:
         self.addr = addr
         self.queue: asyncio.Queue = asyncio.Queue(maxsize=MAX_PEER_QUEUE)
         self.active_since: float = 0.0
+        self.failures = 0   # consecutive delivery failures
         self._task = asyncio.get_running_loop().create_task(self._drain())
 
     def send(self, m: Message) -> bool:
@@ -141,7 +109,24 @@ class _Peer:
     async def _drain(self) -> None:
         while True:
             m = await self.queue.get()
+            if self.failures:
+                await self._redial_backoff()
             await self._deliver(m)
+
+    async def _redial_backoff(self) -> None:
+        """Bounded exponential backoff + jitter between redials of a failing
+        peer (reference: peer.go resolve/redial backoff). Only wires that
+        opt in via a ``dial_backoff = (base, cap)`` attribute pay it — the
+        in-process Network keeps immediate retry so fake-clock tests keep
+        their exact tick schedules."""
+        bk = getattr(self.tr.network, "dial_backoff", None)
+        if bk is None:
+            return
+        base, cap = bk
+        delay = min(cap, base * (2 ** min(self.failures - 1, 8)))
+        rng = getattr(self.tr.network, "_rng", None)
+        jitter = rng.random() if rng is not None else 0.5
+        await self.tr.clock.sleep(delay * (0.5 + 0.5 * jitter))
 
     async def _deliver(self, m: Message) -> None:
         net, tr = self.tr.network, self.tr
@@ -149,9 +134,17 @@ class _Peer:
             if net.lossy(tr.local_addr, self.addr):
                 net.dropped += 1
                 return  # silent loss: raft retries; not "unreachable"
+            delay = net.delay_for(tr.local_addr, self.addr) \
+                if hasattr(net, "delay_for") else 0.0
+            if delay > 0:
+                await tr.clock.sleep(delay)
             server = net.server(tr.local_addr, self.addr)
             await server.process_raft_message(m)
             net.delivered += 1
+            if self.failures:
+                self.failures = 0
+                # recovery signal: clears the peer's failure count in status
+                tr.handlers.report_unreachable(self.raft_id, 0)
             if self.active_since == 0.0:
                 self.active_since = tr.clock.now() or 1e-9
             if m.type == MsgType.SNAP:
@@ -166,9 +159,10 @@ class _Peer:
                 log.warning("raft message delivery %s -> %s failed: %r",
                             tr.local_addr, self.addr, e)
             self.active_since = 0.0
+            self.failures += 1
             if m.type == MsgType.SNAP:
                 tr.handlers.report_snapshot(self.raft_id, False)
-            tr.handlers.report_unreachable(self.raft_id)
+            tr.handlers.report_unreachable(self.raft_id, self.failures)
 
     def stop(self) -> None:
         self._task.cancel()

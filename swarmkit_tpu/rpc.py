@@ -39,12 +39,55 @@ _LOGS = "swarmkit.LogBroker"
 _CA = "swarmkit.CA"
 _CTL = "swarmkit.Control"
 _INFO = "swarmkit.Manager"
+_WATCH = "swarmkit.Watch"
+_RES = "swarmkit.ResourceAllocator"
+HEALTH_SVC = "swarmkit.Health"
 
 _IDENT = lambda b: b
 
 
 class RpcError(Exception):
     pass
+
+
+# --------------------------------------------------------------------------
+# health on the wire (reference: manager/health/health.go served as the gRPC
+# health-checking protocol, manager.go:526; consumed by the raft transport's
+# peer probing and by `swarmctl`-style liveness checks)
+
+def health_handlers(check: Callable[[str], int]) -> list:
+    """Generic handlers serving the health Check RPC from `check(service)`,
+    a callable returning a HealthStatus int (manager/health.py). The raft
+    listener registers these so every manager answers health probes on the
+    same port its raft service lives on."""
+
+    async def check_rpc(request: bytes, context) -> bytes:
+        service = msgpack.unpackb(request) if request else ""
+        try:
+            status = int(check(service))
+        except Exception:          # a crashing backend reads as NOT_SERVING
+            status = 2
+        return msgpack.packb(status)
+
+    return [grpc.method_handlers_generic_handler(HEALTH_SVC, {
+        "Check": grpc.unary_unary_rpc_method_handler(
+            check_rpc, request_deserializer=_IDENT,
+            response_serializer=_IDENT)})]
+
+
+async def check_health(channel: grpc.aio.Channel, service: str = "",
+                       timeout: float = 2.0) -> int:
+    """Client side of the health protocol: returns the HealthStatus int
+    (1 = SERVING). Raises RpcError when the endpoint is unreachable."""
+    call = channel.unary_unary(f"/{HEALTH_SVC}/Check",
+                               request_serializer=_IDENT,
+                               response_deserializer=_IDENT)
+    try:
+        raw = await asyncio.wait_for(call(msgpack.packb(service)),
+                                     timeout=timeout)
+    except (grpc.aio.AioRpcError, asyncio.TimeoutError) as e:
+        raise RpcError(f"health check failed: {e!r}")
+    return msgpack.unpackb(raw)
 
 
 # --------------------------------------------------------------------------
@@ -301,6 +344,66 @@ class ClusterService:
             return json.dumps({"error": str(e),
                                "code": "internal"}).encode()
 
+    # -- Watch (reference: manager/watchapi/server.go served over gRPC) --
+    async def watch(self, request: bytes, context):
+        # watch is manager-only, like the reference's watchapi
+        # tls_authorization (operators and control loops, not workers)
+        await self._authorize(context, MANAGER_ROLE_OU)
+        from swarmkit_tpu.manager.watchapi import WatchSelector
+
+        selectors_raw, resume_from, include_old = msgpack.unpackb(request)
+        selectors = [WatchSelector(kind=k, id_prefix=p, name=n,
+                                   actions=tuple(a))
+                     for k, p, n, a in selectors_raw]
+        try:
+            # any manager serves watches from its replicated store (the
+            # reference's watchapi is not leader-only either)
+            ws = self._manager().watch_server
+            async for msg in ws.watch(selectors=selectors,
+                                      resume_from=resume_from,
+                                      include_old_object=include_old):
+                yield msgpack.packb(_pack_watchmsg(msg))
+        except RpcError as e:
+            await self._abort(context, e)
+        except Exception as e:
+            await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+
+    # -- ResourceAllocator (reference: manager/resourceapi/allocator.go) -
+    async def attach_network(self, request: bytes, context) -> bytes:
+        from swarmkit_tpu.manager.resourceapi import ResourceError
+
+        info = await self._authorize(context, WORKER_ROLE_OU,
+                                     MANAGER_ROLE_OU)
+        node_id, network_id, container_id = msgpack.unpackb(request)
+        await self._bind_identity(context, info, node_id)
+        try:
+            attachment_id = await self._leader_manager() \
+                .resource_api.attach_network(node_id, network_id,
+                                             container_id)
+            return msgpack.packb(attachment_id)
+        except RpcError as e:
+            await self._abort(context, e)
+        except ResourceError as e:
+            await context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        except Exception as e:
+            await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+
+    async def detach_network(self, request: bytes, context) -> bytes:
+        from swarmkit_tpu.manager.resourceapi import ResourceError
+
+        await self._authorize(context, WORKER_ROLE_OU, MANAGER_ROLE_OU)
+        (attachment_id,) = msgpack.unpackb(request)
+        try:
+            await self._leader_manager().resource_api.detach_network(
+                attachment_id)
+            return b""
+        except RpcError as e:
+            await self._abort(context, e)
+        except ResourceError as e:
+            await context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        except Exception as e:
+            await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+
     # -- registration ----------------------------------------------------
     def handlers(self) -> list:
         u = grpc.unary_unary_rpc_method_handler
@@ -340,6 +443,16 @@ class ClusterService:
             grpc.method_handlers_generic_handler(_CTL, {
                 "Call": u(self.control, request_deserializer=_IDENT,
                           response_serializer=_IDENT)}),
+            grpc.method_handlers_generic_handler(_WATCH, {
+                "Watch": s(self.watch, request_deserializer=_IDENT,
+                           response_serializer=_IDENT)}),
+            grpc.method_handlers_generic_handler(_RES, {
+                "AttachNetwork": u(self.attach_network,
+                                   request_deserializer=_IDENT,
+                                   response_serializer=_IDENT),
+                "DetachNetwork": u(self.detach_network,
+                                   request_deserializer=_IDENT,
+                                   response_serializer=_IDENT)}),
         ]
 
     def join_handlers(self) -> list:
@@ -526,6 +639,85 @@ def _unpack_logmsg(t):
                       timestamp=ts, stream=LogStream(stream), data=data)
 
 
+def _pack_watchmsg(m) -> tuple:
+    from swarmkit_tpu.api.objects import kind_of
+
+    def enc(obj):
+        return (kind_of(obj), obj.encode()) if obj is not None else ("", b"")
+
+    return (m.action, m.kind, enc(m.object), enc(m.old_object), m.version)
+
+
+def _unpack_watchmsg(t):
+    from swarmkit_tpu.api.objects import OBJECT_KINDS
+    from swarmkit_tpu.manager.watchapi import WatchMessage
+
+    def dec(pair):
+        kind, raw = pair
+        return OBJECT_KINDS[kind].decode(raw) if kind else None
+
+    action, kind, obj, old, version = t
+    return WatchMessage(action=action, kind=kind, object=dec(obj),
+                        old_object=dec(old), version=version)
+
+
+class RemoteWatch:
+    """WatchServer duck type over gRPC (reference: watchapi client)."""
+
+    def __init__(self, channel: grpc.aio.Channel) -> None:
+        self._watch = channel.unary_stream(
+            f"/{_WATCH}/Watch", request_serializer=_IDENT,
+            response_deserializer=_IDENT)
+
+    async def watch(self, selectors=None, resume_from=None,
+                    include_old_object: bool = False):
+        req = msgpack.packb((
+            [(s.kind, s.id_prefix, s.name, list(s.actions))
+             for s in (selectors or [])],
+            resume_from, include_old_object))
+        try:
+            async for raw in self._watch(req):
+                yield _unpack_watchmsg(msgpack.unpackb(raw))
+        except grpc.aio.AioRpcError as e:
+            raise _redirectable(e)
+
+
+class RemoteResourceAllocator:
+    """ResourceApi duck type over gRPC (reference: resourceapi client used
+    by the engine for network attachments)."""
+
+    def __init__(self, channel: grpc.aio.Channel) -> None:
+        self._attach = channel.unary_unary(
+            f"/{_RES}/AttachNetwork", request_serializer=_IDENT,
+            response_deserializer=_IDENT)
+        self._detach = channel.unary_unary(
+            f"/{_RES}/DetachNetwork", request_serializer=_IDENT,
+            response_deserializer=_IDENT)
+
+    async def attach_network(self, node_id: str, network_id: str,
+                             container_id: str = "") -> str:
+        from swarmkit_tpu.manager.resourceapi import ResourceError
+
+        try:
+            raw = await self._attach(msgpack.packb(
+                (node_id, network_id, container_id)))
+        except grpc.aio.AioRpcError as e:
+            if e.code() == grpc.StatusCode.NOT_FOUND:
+                raise ResourceError(e.details())
+            raise _redirectable(e)
+        return msgpack.unpackb(raw)
+
+    async def detach_network(self, attachment_id: str) -> None:
+        from swarmkit_tpu.manager.resourceapi import ResourceError
+
+        try:
+            await self._detach(msgpack.packb((attachment_id,)))
+        except grpc.aio.AioRpcError as e:
+            if e.code() == grpc.StatusCode.NOT_FOUND:
+                raise ResourceError(e.details())
+            raise _redirectable(e)
+
+
 class RemoteLogBroker:
     """LogBroker duck type over gRPC (surface used by agent/logs.py and
     the control socket's subscribe-logs)."""
@@ -600,6 +792,8 @@ class RemoteManager:
         self.dispatcher: Optional[RemoteDispatcher] = None
         self.ca_server: Optional[RemoteCA] = None
         self.logbroker: Optional[RemoteLogBroker] = None
+        self.watch_server: Optional[RemoteWatch] = None
+        self.resource_api: Optional[RemoteResourceAllocator] = None
         self._is_leader = False
         self._leader_addr = ""
         self._has_manager = False
@@ -675,6 +869,8 @@ class RemoteManager:
         self.dispatcher = RemoteDispatcher(channel)
         self.ca_server = RemoteCA(channel)
         self.logbroker = RemoteLogBroker(channel)
+        self.watch_server = RemoteWatch(channel)
+        self.resource_api = RemoteResourceAllocator(channel)
 
     def start(self) -> None:
         self._refresher = asyncio.get_running_loop().create_task(

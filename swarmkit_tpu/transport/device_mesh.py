@@ -63,10 +63,13 @@ class DeviceMeshNet(Network):
         self._mesh = mesh  # built lazily so tests control jax init order
         self._row_of: dict[str, int] = {}
         # (frm_row, to_row) -> list of (raw, msg, transport, to_raft_id,
-        #                               frm_addr, to_addr)
+        #                               frm_addr, to_addr, ready_at)
+        # ready_at: clock time before which an injected delay holds the
+        # message back from the exchange (0.0 = deliver on next flush)
         self._staged: dict[tuple[int, int], list] = {}
         self._event: Optional[asyncio.Event] = None
         self._task: Optional[asyncio.Task] = None
+        self._delay_task: Optional[asyncio.Task] = None
         self._exchange_cache: dict = {}
         self.device_flushes = 0
         self.device_messages = 0
@@ -106,8 +109,10 @@ class DeviceMeshNet(Network):
         q = self._staged.setdefault((frm, to), [])
         if len(q) >= K_BUCKETS[-1]:
             return False  # mailbox full: drop (reference peer.go:82-89)
+        delay = self.delay_for(tr.local_addr, to_addr)
+        ready_at = (tr.clock.now() or 0.0) + delay if delay > 0 else 0.0
         q.append((encode_message(m), m, tr, to_raft_id, tr.local_addr,
-                  to_addr))
+                  to_addr, ready_at))
         self._ensure_pump()
         self._event.set()
         return True
@@ -132,6 +137,33 @@ class DeviceMeshNet(Network):
         if self._task is not None:
             self._task.cancel()
             self._task = None
+        if self._delay_task is not None:
+            self._delay_task.cancel()
+            self._delay_task = None
+
+    def crash_restart(self, addr: str) -> None:
+        """A process bounce at `addr`: everything staged to or from it in
+        the mailbox dies with the old incarnation."""
+        for key, q in list(self._staged.items()):
+            q[:] = [e for e in q if addr not in (e[4], e[5])]
+            if not q:
+                del self._staged[key]
+
+    def _arm_delay_wake(self, ready_at: float, clock) -> None:
+        """Re-trigger a flush once the earliest held-back message matures.
+        Uses the transports' (possibly fake) clock so delayed delivery is
+        deterministic under test harness ticks."""
+        if self._delay_task is not None and not self._delay_task.done():
+            return  # the pending wake's flush re-arms for later messages
+
+        async def wake():
+            dt = ready_at - (clock.now() or 0.0)
+            if dt > 0:
+                await clock.sleep(dt)
+            if self._event is not None:
+                self._event.set()
+
+        self._delay_task = asyncio.get_running_loop().create_task(wake())
 
     # -- the device exchange ----------------------------------------------
     def _exchange_fn(self, kb: int, wb: int):
@@ -166,8 +198,13 @@ class DeviceMeshNet(Network):
         blocked_cb = []      # (tr, raft_id, msg): masked edges -> unreachable
         packed = []          # (frm, to, _, raw, msg, tr, raft_id, to_addr,
                              #  deliverable) — slot index assigned per group
+        deferred = []        # injected delay: not yet mature, re-stage
         for (frm, to), q in staged.items():
-            for raw, m, tr, rid, frm_addr, to_addr in q:
+            for entry in q:
+                raw, m, tr, rid, frm_addr, to_addr, ready_at = entry
+                if ready_at > 0 and (tr.clock.now() or 0.0) < ready_at:
+                    deferred.append(((frm, to), entry))
+                    continue
                 words = (len(raw) + 3) // 4
                 if words > W_BUCKETS[-1]:
                     oversize.append((tr, rid, m))
@@ -185,6 +222,12 @@ class DeviceMeshNet(Network):
                     self.dropped += 1
                 packed.append((frm, to, 0, raw, m, tr, rid, to_addr,
                                deliverable))
+
+        if deferred:
+            for key, entry in deferred:
+                self._staged.setdefault(key, []).append(entry)
+            earliest = min(e[6] for _, e in deferred)
+            self._arm_delay_wake(earliest, deferred[0][1][2].clock)
 
         for tr, rid, m in oversize:
             tr.peer_failed(rid, m)
@@ -278,6 +321,7 @@ class DeviceMeshTransport:
         self.clock = clock
         self._peers: dict[int, str] = {}
         self._active_since: dict[int, float] = {}
+        self._fail_counts: dict[int, int] = {}   # consecutive failures
         self.stopped = False
         network.row_for(local_addr)
 
@@ -316,6 +360,7 @@ class DeviceMeshTransport:
 
     # -- callbacks from the net after the device exchange ------------------
     def peer_delivered(self, raft_id: int, m: Message) -> None:
+        self._fail_counts.pop(raft_id, None)
         if raft_id not in self._active_since:
             self._active_since[raft_id] = self.clock.now() or 1e-9
         if m.type == MsgType.SNAP:
@@ -323,9 +368,11 @@ class DeviceMeshTransport:
 
     def peer_failed(self, raft_id: int, m: Message) -> None:
         self._active_since.pop(raft_id, None)
+        failures = self._fail_counts.get(raft_id, 0) + 1
+        self._fail_counts[raft_id] = failures
         if m.type == MsgType.SNAP:
             self.handlers.report_snapshot(raft_id, False)
-        self.handlers.report_unreachable(raft_id)
+        self.handlers.report_unreachable(raft_id, failures)
 
     # -- views -------------------------------------------------------------
     def longest_active(self) -> Optional[int]:
@@ -344,3 +391,4 @@ class DeviceMeshTransport:
         self.stopped = True
         self._peers = {}
         self._active_since = {}
+        self._fail_counts = {}

@@ -25,6 +25,15 @@ import functools
 import pytest
 
 
+from swarmkit_tpu.ca.certificates import HAVE_CRYPTOGRAPHY  # noqa: E402
+
+# x509/TLS tests cannot run where the `cryptography` package is absent;
+# everything else runs against the hashlib-backed encryption fallback.
+requires_cryptography = pytest.mark.skipif(
+    not HAVE_CRYPTOGRAPHY,
+    reason="needs the 'cryptography' package (x509/TLS identities)")
+
+
 def async_test(fn):
     """Run an async test function to completion on a fresh event loop."""
 

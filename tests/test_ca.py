@@ -19,9 +19,10 @@ from swarmkit_tpu.ca import (
 from swarmkit_tpu.api.types import IssuanceState
 from swarmkit_tpu.store.memory import MemoryStore
 from swarmkit_tpu.utils.clock import FakeClock
-from tests.conftest import async_test
+from tests.conftest import async_test, requires_cryptography
 
 
+@requires_cryptography
 def test_root_ca_create_and_issue():
     root = RootCA.create()
     assert root.can_sign
@@ -38,6 +39,7 @@ def test_root_ca_create_and_issue():
         root.validate_cert_chain(foreign.cert_pem)
 
 
+@requires_cryptography
 def test_csr_signing_round_trip():
     root = RootCA.create()
     csr_pem, key_pem = create_csr("node9")
@@ -48,6 +50,7 @@ def test_csr_signing_round_trip():
     assert parse_identity(issued.cert_pem)[0] == "node9"
 
 
+@requires_cryptography
 def test_join_token_format_and_parse():
     root = RootCA.create()
     token = generate_join_token(root)
@@ -60,6 +63,7 @@ def test_join_token_format_and_parse():
         parse_join_token("garbage")
 
 
+@requires_cryptography
 def test_authorization():
     root = RootCA.create()
     mgr = root.issue_node_certificate("m1", MANAGER_ROLE_OU, "org1")
@@ -97,6 +101,7 @@ def test_keyreadwriter_kek_lock():
 
 
 @async_test
+@requires_cryptography
 async def test_ca_server_token_join_and_renewal():
     clock = FakeClock()
     store = MemoryStore(clock=clock.now)
@@ -154,6 +159,7 @@ async def test_ca_server_token_join_and_renewal():
 
 
 @async_test
+@requires_cryptography
 async def test_security_config_role_change_event():
     root = RootCA.create()
     issued = root.issue_node_certificate("n1", WORKER_ROLE_OU, "org1")

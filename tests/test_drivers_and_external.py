@@ -19,13 +19,14 @@ from swarmkit_tpu.api.types import NodeDescription, NodeResources
 from swarmkit_tpu.ca.certificates import (
     MANAGER_ROLE_OU, WORKER_ROLE_OU, RootCA, create_csr, parse_identity,
 )
-from tests.conftest import async_test
+from tests.conftest import async_test, requires_cryptography
 
 
 # ---------------------------------------------------------------------------
 # external CA
 
 @async_test
+@requires_cryptography
 async def test_external_ca_signs_for_keyless_cluster():
     """The CA server holds NO signing key; issuance goes through the
     external-ca-example CFSSL endpoint and the result chains to the cluster
@@ -51,6 +52,7 @@ async def test_external_ca_signs_for_keyless_cluster():
 
 
 @async_test
+@requires_cryptography
 async def test_external_ca_refusal_is_an_error():
     from swarmkit_tpu.ca.external import ExternalCAClient, ExternalCAError
     from swarmkit_tpu.cmd.external_ca_example import serve
@@ -68,6 +70,7 @@ async def test_external_ca_refusal_is_an_error():
 
 
 @async_test
+@requires_cryptography
 async def test_ca_server_uses_external_when_keyless():
     """CAServer._sign delegates to the cluster-spec external CA when the
     local root cannot sign (reference: server.go signNodeCert path)."""

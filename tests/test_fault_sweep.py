@@ -1,0 +1,25 @@
+"""Slow wrapper around the fault-injection sweep (tools/fault_sweep.py).
+
+Runs every fault plan on every wire with the pinned seed and asserts the
+differential oracle held (no store divergence, no post-heal liveness
+stall) for each scenario.  Excluded from tier-1 by the ``slow`` marker;
+run with::
+
+    pytest tests/test_fault_sweep.py -m slow -q
+"""
+
+import pytest
+
+from tools.fault_sweep import PLANS, WIRES, run_sweep
+
+PINNED_SEEDS = (2009343,)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("wire", WIRES)
+def test_fault_sweep_wire(wire):
+    results = run_sweep(wires=(wire,), plans=PLANS, seeds=PINNED_SEEDS,
+                        verbose=False)
+    assert len(results) == len(PLANS) * len(PINNED_SEEDS)
+    failed = [r for r in results if not r["ok"]]
+    assert not failed, f"fault sweep scenarios failed on {wire}: {failed}"

@@ -1,0 +1,221 @@
+"""The shared fault surface (FaultSurface/FaultPlan) and its behavior on
+the in-process and device-mesh wires: delay injection, crash_restart wire
+purging, failure-count surfacing, and vote-health gating under partition.
+
+Reference bar: manager/state/raft/testutils (partition/restart helpers)
+and raft.go:1422 (health gating on votes); the gRPC-wire equivalents live
+in tests/test_transport_health.py.
+"""
+
+import pytest
+
+from swarmkit_tpu.api import Annotations, Node as ApiNode, NodeSpec
+from swarmkit_tpu.raft.faults import FaultPlan, FaultSurface
+from tests.conftest import async_test
+from tests.node_harness import RaftHarness
+from tests.test_device_transport import DeviceRaftHarness
+
+
+def _obj(tag):
+    return ApiNode(id=f"id-{tag}",
+                   spec=NodeSpec(annotations=Annotations(name=f"o-{tag}")))
+
+
+async def propose(node, tag):
+    await node.store.update(lambda tx: tx.create(_obj(tag)))
+
+
+def has_obj(node, tag):
+    return node.store.get("node", f"id-{tag}") is not None
+
+
+# --------------------------------------------------------------------------
+# FaultSurface / FaultPlan unit semantics
+
+
+def test_fault_surface_primitives():
+    s = FaultSurface(seed=1)
+    assert not s.faults_active()
+
+    s.set_down("a")
+    assert s._fault_blocked("b", "a")       # down blocks deliveries TO a
+    assert not s._fault_blocked("a", "b")   # a can still send outward
+    s.set_down("a", down=False)
+    assert not s._fault_blocked("b", "a")
+
+    s.partition(["a", "b"], ["c"])
+    assert s._fault_blocked("a", "c") and s._fault_blocked("c", "b")
+    assert not s._fault_blocked("a", "b")
+
+    s.set_delay("a", "b", 2.5)
+    assert s.delay_for("a", "b") == 2.5 and s.delay_for("b", "a") == 0.0
+    s.set_drop("a", "b", 1.0)
+    assert s.lossy("a", "b") and not s.lossy("b", "a")
+
+    s.set_down("x")
+    s.heal()   # clears partitions/drops/delays, NOT down (plans repair it)
+    assert not s._fault_blocked("a", "c")
+    assert s.delay_for("a", "b") == 0.0 and not s.lossy("a", "b")
+    assert s._fault_blocked("a", "x")
+
+
+def test_fault_plan_inject_and_repair():
+    s = FaultSurface(seed=1)
+    plan = FaultPlan.down("v")
+    plan.inject(s)
+    assert s._fault_blocked("a", "v")
+    plan.heal(s)   # the down plan's repair un-downs the victim
+    assert not s._fault_blocked("a", "v")
+
+    split = FaultPlan.split(["v"], ["a", "b"])
+    split.inject(s)
+    assert s._fault_blocked("v", "a")
+    split.heal(s)
+    assert not s.faults_active()
+
+    delay = FaultPlan.delay("a", "b", 1.5)
+    delay.inject(s)
+    assert s.delay_for("a", "b") == 1.5 and s.delay_for("b", "a") == 1.5
+    delay.heal(s)
+    assert not s.faults_active()
+
+
+# --------------------------------------------------------------------------
+# in-process wire
+
+
+@async_test
+async def test_delay_defers_replication_until_clock_advances():
+    """An injected edge delay holds replication back deterministically:
+    the follower only sees the entry once the fake clock passes the
+    latency, and heal() restores immediate delivery."""
+    h = RaftHarness()
+    try:
+        n1 = await h.add_node()
+        await h.wait_for_leader()
+        n2 = await h.add_node(join_from=n1)
+        n3 = await h.add_node(join_from=n1)
+        lead = await h.wait_for_cluster()
+
+        FaultPlan.delay(lead.addr, n2.addr, 2.0).inject(h.network)
+        FaultPlan.delay(lead.addr, n3.addr, 2.0).inject(h.network)
+        import asyncio
+
+        t = asyncio.ensure_future(propose(lead, "slow"))
+        await h.pump(4)
+        # delivery is parked on the clock: nobody has the entry yet
+        assert not has_obj(n2, "slow") and not has_obj(n3, "slow")
+        await h.wait_for(lambda: t.done() and has_obj(n2, "slow")
+                         and has_obj(n3, "slow"))
+        await t
+
+        # heal clears the injected latency, but peer drains already parked
+        # on the clock only wake on ticks — keep ticking while proposing
+        h.network.heal()
+        t2 = asyncio.ensure_future(propose(lead, "fast"))
+        await h.wait_for(lambda: t2.done() and has_obj(n2, "fast")
+                         and has_obj(n3, "fast"))
+        await t2
+    finally:
+        await h.close()
+
+
+@async_test
+async def test_unreachable_peer_failure_counts_surface_in_status():
+    """Consecutive delivery failures reach raft Node.status() through
+    report_unreachable, and clear once the peer is reachable again."""
+    h = RaftHarness()
+    try:
+        n1 = await h.add_node()
+        await h.wait_for_leader()
+        n2 = await h.add_node(join_from=n1)
+        n3 = await h.add_node(join_from=n1)
+        lead = await h.wait_for_cluster()
+        victim = n2 if lead is not n2 else n3
+
+        h.network.set_down(victim.addr)
+        await h.wait_for(lambda: lead.status()["peer_failures"].get(
+            victim.raft_id, 0) >= 2)
+
+        h.network.set_down(victim.addr, down=False)
+        await h.wait_for(lambda: victim.raft_id
+                         not in lead.status()["peer_failures"])
+    finally:
+        await h.close()
+
+
+# --------------------------------------------------------------------------
+# device-mesh wire
+
+
+@async_test
+async def test_vote_gating_partition_device_mesh():
+    """A partitioned minority must not win elections on the mailbox wire;
+    the majority keeps committing, and heal() restores the victim."""
+    h = DeviceRaftHarness()
+    try:
+        n1 = await h.add_node()
+        await h.wait_for_leader()
+        n2 = await h.add_node(join_from=n1)
+        n3 = await h.add_node(join_from=n1)
+        lead = await h.wait_for_cluster()
+        victim = n2 if lead is not n2 else n3
+        majority = [n for n in (n1, n2, n3) if n is not victim]
+
+        FaultPlan.split(
+            [victim.addr], [n.addr for n in majority]).inject(h.network)
+
+        # several election timeouts: the isolated node campaigns but its
+        # votes cannot cross the partition (wire block + vote-health gate)
+        await h.tick(20)
+        assert not victim.is_leader()
+        lead = h.leader()
+        assert lead is not None and lead in majority
+
+        await propose(lead, "during")
+        await h.wait_for(lambda: all(has_obj(n, "during") for n in majority))
+        assert not has_obj(victim, "during")
+
+        h.network.heal()
+        lead = await h.wait_for_cluster()
+        await h.wait_for(lambda: has_obj(victim, "during"))
+        await propose(lead, "after")
+        await h.wait_for(lambda: all(has_obj(n, "after")
+                                     for n in (n1, n2, n3)))
+    finally:
+        await h.close()
+
+
+@async_test
+async def test_crash_restart_purges_staged_mailbox_entries():
+    """crash_restart on the device wire kills payloads staged to/from the
+    bounced address (the old incarnation's traffic), without breaking
+    liveness for the cluster afterwards."""
+    h = DeviceRaftHarness()
+    try:
+        n1 = await h.add_node()
+        await h.wait_for_leader()
+        n2 = await h.add_node(join_from=n1)
+        n3 = await h.add_node(join_from=n1)
+        lead = await h.wait_for_cluster()
+        victim = n2 if lead is not n2 else n3
+
+        # hold deliveries to the victim on the clock so they sit staged
+        h.network.set_delay(lead.addr, victim.addr, 50.0)
+        import asyncio
+
+        t = asyncio.ensure_future(propose(lead, "wedged"))
+        await h.pump(4)
+        victim_row = h.network.row_for(victim.addr)
+        assert any(victim_row in (frm, to) and q
+                   for (frm, to), q in h.network._staged.items())
+
+        FaultPlan.crash(victim.addr).inject(h.network)
+        assert not any(victim_row in (frm, to) and q
+                       for (frm, to), q in h.network._staged.items())
+
+        h.network.heal()
+        await h.wait_for(lambda: t.done() and has_obj(victim, "wedged"))
+        await t
+    finally:
+        await h.close()

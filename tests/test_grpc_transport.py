@@ -22,7 +22,7 @@ from swarmkit_tpu.raft.grpc_transport import (
 from swarmkit_tpu.raft.messages import (
     Entry, EntryType, Message, MsgType, Snapshot, SnapshotMeta,
 )
-from tests.conftest import async_test
+from tests.conftest import async_test, requires_cryptography
 
 
 def free_port() -> int:
@@ -139,6 +139,7 @@ async def test_snapshot_streams_in_chunks_over_grpc():
 
 
 @async_test
+@requires_cryptography
 async def test_worker_joins_manager_over_grpc_rpc_layer():
     """Full node-level join across the gRPC cluster services: a worker
     node with only an address + token reaches the manager's CA, dispatcher

@@ -12,7 +12,7 @@ import pytest
 
 from swarmkit_tpu.api import NodeRole, NodeState, TaskState
 from swarmkit_tpu.store.by import ByService
-from tests.conftest import async_test
+from tests.conftest import async_test, requires_cryptography
 from tests.integration_harness import TestCluster
 
 
@@ -190,6 +190,7 @@ async def test_worker_restart_rejoins_and_resumes():
 
 
 @async_test
+@requires_cryptography
 async def test_join_with_token_full_ca_flow():
     """reference: TestNodeJoinWithSecret / wrong-cert join rejection — a
     worker joins with the real join token (no harness-seeded node record);
@@ -249,6 +250,7 @@ async def test_join_with_token_full_ca_flow():
 
 
 @async_test
+@requires_cryptography
 async def test_manager_join_with_manager_token():
     """A second manager joins purely via the manager join token."""
     from swarmkit_tpu.node import Node, NodeConfig

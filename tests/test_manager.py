@@ -23,7 +23,7 @@ from swarmkit_tpu.manager.manager import Manager
 from swarmkit_tpu.raft.transport import Network
 from swarmkit_tpu.store.by import ByService
 from swarmkit_tpu.utils.clock import FakeClock
-from tests.conftest import async_test
+from tests.conftest import async_test, requires_cryptography
 
 TICK = 1.0
 
@@ -84,6 +84,7 @@ def service_spec(name="web", replicas=2):
 
 
 @async_test
+@requires_cryptography
 async def test_single_manager_bootstrap_seeds_defaults():
     h = ManagerHarness()
     m = h.new_manager(1)

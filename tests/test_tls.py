@@ -23,7 +23,9 @@ from swarmkit_tpu.ca.certificates import (
 from swarmkit_tpu.ca.config import SecurityConfig, generate_join_token
 from swarmkit_tpu.raft.grpc_transport import GrpcNetwork
 from swarmkit_tpu.raft.node import Node, NodeOpts
-from tests.conftest import async_test
+from tests.conftest import async_test, requires_cryptography
+
+pytestmark = requires_cryptography
 
 ORG = "cluster-tls-test"
 

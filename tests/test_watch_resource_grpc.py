@@ -1,0 +1,155 @@
+"""Watch + ResourceAllocator served cross-process over gRPC.
+
+A swarmd manager and a joined swarmd worker on real loopback sockets:
+the worker reaches the manager's resourceapi (network attach/detach)
+through its RemoteManager, and an operator-side RemoteManager streams
+store events through the watchapi Watch RPC.
+
+Reference: manager/watchapi/server.go and manager/resourceapi/allocator.go
+— both registered on the manager's gRPC server in manager.go:526-548; the
+clients here are the duck types in swarmkit_tpu/rpc.py.
+"""
+
+import asyncio
+import os
+import socket
+import tempfile
+
+import pytest
+
+from swarmkit_tpu.api import (
+    Annotations, MembershipState, NetworkSpec, NodeSpec, NodeState,
+)
+from swarmkit_tpu.api.objects import Node as ApiNode, NodeStatus
+from swarmkit_tpu.ca.certificates import HAVE_CRYPTOGRAPHY
+from tests.conftest import async_test
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+async def _poll(fn, what: str, timeout: float = 20.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while True:
+        val = fn()
+        if val:
+            return val
+        if asyncio.get_running_loop().time() > deadline:
+            raise AssertionError(f"timeout waiting for {what}")
+        await asyncio.sleep(0.05)
+
+
+@async_test
+@pytest.mark.skipif(
+    HAVE_CRYPTOGRAPHY,
+    reason="exercises the identityless wire; the mTLS join path is covered "
+           "by tests/test_grpc_transport.py")
+async def test_worker_reaches_watch_and_resourceapi_over_grpc():
+    from swarmkit_tpu.cmd import swarmd
+    from swarmkit_tpu.manager.resourceapi import ResourceError
+    from swarmkit_tpu.manager.watchapi import WatchSelector
+    from swarmkit_tpu.rpc import RemoteManager
+
+    tmp = tempfile.TemporaryDirectory(prefix="grpc-watchres-")
+    m_addr = f"127.0.0.1:{_free_port()}"
+    m_args = swarmd.build_parser().parse_args([
+        "--state-dir", os.path.join(tmp.name, "m1"),
+        "--listen-control-api", os.path.join(tmp.name, "m1.sock"),
+        "--listen-remote-api", m_addr,
+        "--node-id", "m1", "--manager", "--election-tick", "4",
+        "--executor", "test",
+    ])
+    manager_node = await swarmd.run(m_args)
+    worker_node = None
+    operator = None
+    try:
+        await _poll(manager_node.is_leader, "manager leadership")
+        lead = manager_node._running_manager()
+        await _poll(lambda: lead.store.find("cluster"), "cluster object")
+
+        # identityless worker join: the operator pre-creates the node
+        # record (node/node.py: "legacy identityless worker")
+        await lead.store.update(lambda tx: tx.create(ApiNode(
+            id="w1",
+            spec=NodeSpec(annotations=Annotations(name="w1"),
+                          membership=MembershipState.ACCEPTED),
+            status=NodeStatus())))
+        w_addr = f"127.0.0.1:{_free_port()}"
+        w_args = swarmd.build_parser().parse_args([
+            "--state-dir", os.path.join(tmp.name, "w1"),
+            "--listen-control-api", os.path.join(tmp.name, "w1.sock"),
+            "--listen-remote-api", w_addr,
+            "--node-id", "w1", "--join-addr", m_addr,
+            "--executor", "test",
+        ])
+        worker_node = await swarmd.run(w_args)
+
+        # the dispatcher session marks the worker READY in the manager's
+        # store — proof the join went over the sockets
+        await _poll(
+            lambda: (n := lead.store.get("node", "w1")) is not None
+            and n.status.state == NodeState.READY, "worker READY")
+
+        # -- resourceapi through the worker's own RemoteManager ----------
+        net_obj = await lead.control_api.create_network(
+            NetworkSpec(annotations=Annotations(name="overlay1")))
+        rm = await _poll(
+            lambda: next((r for r in worker_node._remote_managers.values()
+                          if r.resource_api is not None), None),
+            "worker's RemoteManager connected")
+
+        attachment_id = await rm.resource_api.attach_network(
+            "w1", net_obj.id)
+        task = lead.store.get("task", attachment_id)
+        assert task is not None and task.node_id == "w1"
+        assert net_obj.id in task.spec.networks
+
+        # unknown network id is a typed ResourceError across the wire
+        try:
+            await rm.resource_api.attach_network("w1", "no-such-network")
+        except ResourceError:
+            pass
+        else:
+            raise AssertionError("attach of unknown network must raise "
+                                 "ResourceError")
+
+        await rm.resource_api.detach_network(attachment_id)
+        await _poll(lambda: lead.store.get("task", attachment_id) is None,
+                    "attachment removed")
+
+        # -- watchapi from an operator-side RemoteManager ----------------
+        operator = RemoteManager(m_addr)
+        operator.start()
+        await operator.refresh()
+        assert operator.watch_server is not None
+
+        stream = operator.watch_server.watch(
+            selectors=[WatchSelector(kind="network", actions=("create",))])
+        first = asyncio.ensure_future(stream.__anext__())
+        await asyncio.sleep(0.3)   # let the server-side subscription arm
+        created = await lead.control_api.create_network(
+            NetworkSpec(annotations=Annotations(name="overlay2")))
+        msg = await asyncio.wait_for(first, timeout=10)
+        assert msg.action == "create" and msg.kind == "network"
+        assert msg.object.id == created.id
+        first = asyncio.ensure_future(stream.__anext__())
+        first.cancel()
+    finally:
+        if operator is not None:
+            await operator.close()
+        if worker_node is not None:
+            await worker_node._ctl_server.stop()
+            await worker_node.stop()
+            for r in getattr(worker_node, "_remote_managers", {}).values():
+                await r.close()
+        await manager_node._ctl_server.stop()
+        await manager_node.stop()
+        for r in getattr(manager_node, "_remote_managers", {}).values():
+            await r.close()
+        net = manager_node.config.network
+        if hasattr(net, "close"):
+            await net.close()
+        tmp.cleanup()

@@ -1,0 +1,456 @@
+"""Fault-injection sweep: every fault primitive on every raft wire.
+
+For each (wire, fault plan, seed) triple this tool boots a 3-node raft
+cluster, drives one full robustness schedule
+
+    converge -> commit "pre" -> inject fault -> (tolerated) commit under
+    fault -> heal (+ process restart for the crash plan) -> converge ->
+    commit "post" -> leader transfer -> member removal -> commit "final"
+
+and then runs the cross-member oracle: every surviving member's store must
+hold exactly the same object set, including all committed markers.  A
+divergence or a liveness stall after ``heal()`` is a failure.
+
+Wires (the three Transport implementations behind one Network seam):
+  inproc   in-process asyncio Network, fake clock
+  devmesh  DeviceMeshNet mailbox exchange over the 8-device CPU mesh,
+           fake clock
+  grpc     GrpcNetwork over real sockets, system clock, active health
+           probing (tools-level proof that vote-health gating and the
+           CanRemoveMember precheck operate across processes)
+
+Plans (swarmkit_tpu.raft.faults.FaultPlan): down, drop, partition, delay,
+crash — the crash plan also genuinely stops the victim process and
+restarts it from its state dir after ``heal()``.
+
+Usage:
+    python tools/fault_sweep.py                       # full sweep
+    python tools/fault_sweep.py --wires grpc --plans crash,partition
+    python tools/fault_sweep.py --seeds 2009343,7
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+import tempfile
+import time
+from typing import Optional
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from swarmkit_tpu.api import Annotations, Node as ApiNode, NodeSpec  # noqa: E402
+from swarmkit_tpu.raft.faults import FaultPlan  # noqa: E402
+from swarmkit_tpu.raft.node import Node, NodeOpts  # noqa: E402
+from swarmkit_tpu.raft.transport import Network  # noqa: E402
+from swarmkit_tpu.utils.clock import FakeClock, SystemClock  # noqa: E402
+
+WIRES = ("inproc", "devmesh", "grpc")
+PLANS = ("down", "drop", "partition", "delay", "crash")
+DEFAULT_SEEDS = (2009343,)
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# --------------------------------------------------------------------------
+# per-wire cluster harnesses
+
+
+class _Cluster:
+    """3-node raft cluster driven tick-by-tick (fake clock wires)."""
+
+    wire = "inproc"
+    TICK = 1.0
+    delay_s = 2.0          # injected edge latency (spans >1 raft tick)
+    MAX_STEPS = 300
+
+    def __init__(self, seed: int) -> None:
+        self.seed = seed
+        self.clock = self._make_clock()
+        self.network = self._make_network(seed)
+        self.nodes: dict[str, Node] = {}
+        self.tmp = tempfile.TemporaryDirectory(
+            prefix=f"fault-sweep-{self.wire}-")
+        self._n = 0
+
+    # wire-specific bits --------------------------------------------------
+    def _make_clock(self):
+        return FakeClock()
+
+    def _make_network(self, seed: int):
+        return Network(seed=seed)
+
+    def _addr(self, node_id: str) -> str:
+        return f"{node_id}.sweep:4242"
+
+    def _decorate_opts(self, opts: NodeOpts) -> NodeOpts:
+        return opts
+
+    async def settle(self) -> None:
+        """One scheduling step: a raft tick plus delivery pumping."""
+        await self.clock.advance(self.TICK)
+        for _ in range(8):
+            await asyncio.sleep(0)
+
+    # cluster lifecycle ---------------------------------------------------
+    def _opts(self, node_id: str, addr: str, join_addr: str = "") -> NodeOpts:
+        self._n += 1
+        return self._decorate_opts(NodeOpts(
+            node_id=node_id,
+            addr=addr,
+            network=self.network,
+            state_dir=os.path.join(self.tmp.name, node_id),
+            clock=self.clock,
+            join_addr=join_addr,
+            tick_interval=self.TICK,
+            election_tick=4,
+            heartbeat_tick=1,
+            seed=self.seed + self._n,
+        ))
+
+    async def add_node(self, join_from: Optional[Node] = None) -> Node:
+        node_id = f"node-{len(self.nodes) + 1}"
+        addr = self._addr(node_id)
+        join_addr = join_from.addr if join_from is not None else ""
+        node = Node(self._opts(node_id, addr, join_addr=join_addr))
+        self.nodes[node_id] = node
+        await node.start()
+        await asyncio.sleep(0)
+        return node
+
+    async def stop_node(self, node: Node) -> None:
+        await node.stop()
+        self.network.unregister(node.addr)
+
+    async def restart_node(self, node: Node) -> Node:
+        """Fresh Node object over the same state dir and address."""
+        opts = self._opts(node.node_id, node.addr)
+        opts.seed = node.opts.seed
+        new = Node(opts)
+        self.nodes[node.node_id] = new
+        await new.start()
+        await asyncio.sleep(0)
+        return new
+
+    # waiting -------------------------------------------------------------
+    def leader(self) -> Optional[Node]:
+        leaders = [n for n in self.nodes.values()
+                   if n.running and n.is_leader()]
+        return leaders[0] if leaders else None
+
+    async def wait_for(self, pred, what: str, max_steps: int = 0) -> None:
+        for _ in range(max_steps or self.MAX_STEPS):
+            if pred():
+                return
+            await self.settle()
+        raise TimeoutError(f"[{self.wire}] timed out waiting for {what}")
+
+    async def wait_for_cluster(self) -> Node:
+        """One leader; every running member on its term and applied up to
+        its commit (tests/node_harness.py wait_for_cluster)."""
+        def converged() -> bool:
+            lead = self.leader()
+            if lead is None:
+                return False
+            members = [n for n in self.nodes.values() if n.running]
+            lt = lead._raw.raft.term
+            lc = lead._raw.raft.log.committed
+            return all(n._raw is not None
+                       and n._raw.raft.term == lt
+                       and n._raw.raft.log.applied >= lc
+                       for n in members)
+        await self.wait_for(converged, "cluster convergence")
+        return self.leader()
+
+    async def close(self) -> None:
+        for n in list(self.nodes.values()):
+            if n.running:
+                try:
+                    await n.stop()
+                except Exception:
+                    pass
+        closer = getattr(self.network, "close", None)
+        if closer is not None:
+            r = closer()
+            if asyncio.iscoroutine(r):
+                await r
+        self.tmp.cleanup()
+
+
+class _DeviceMeshCluster(_Cluster):
+    wire = "devmesh"
+
+    def _make_network(self, seed: int):
+        from swarmkit_tpu.transport import DeviceMeshNet
+
+        return DeviceMeshNet(seed=seed, rows=8)
+
+    def _decorate_opts(self, opts: NodeOpts) -> NodeOpts:
+        from swarmkit_tpu.transport import DeviceMeshTransport
+
+        opts.transport_factory = DeviceMeshTransport
+        return opts
+
+
+class _GrpcCluster(_Cluster):
+    wire = "grpc"
+    TICK = 0.05            # real seconds per settle step
+    delay_s = 0.2
+    MAX_STEPS = 600        # 30s wall-clock ceiling per wait
+
+    def _make_clock(self):
+        return SystemClock()
+
+    def _make_network(self, seed: int):
+        from swarmkit_tpu.raft.grpc_transport import GrpcNetwork
+
+        return GrpcNetwork(seed=seed, probe_interval=0.1, probe_timeout=0.5,
+                           failure_threshold=2, grace_period=0.2,
+                           redial_backoff=0.05, redial_backoff_max=0.4)
+
+    def _addr(self, node_id: str) -> str:
+        return f"127.0.0.1:{_free_port()}"
+
+    async def settle(self) -> None:
+        await asyncio.sleep(self.TICK)
+
+    async def close(self) -> None:
+        await super().close()
+        # let grpc.aio's poller thread drain its completion queue before
+        # asyncio.run() closes the loop, or it logs spurious
+        # "Event loop is closed" callbacks during teardown
+        await asyncio.sleep(0.2)
+
+
+_CLUSTERS = {
+    "inproc": _Cluster,
+    "devmesh": _DeviceMeshCluster,
+    "grpc": _GrpcCluster,
+}
+
+
+# --------------------------------------------------------------------------
+# schedule pieces
+
+
+def _marker(i: int, tag: str) -> ApiNode:
+    return ApiNode(id=f"mark-{tag}",
+                   spec=NodeSpec(annotations=Annotations(name=tag)))
+
+
+async def _commit(node: Node, tag: str) -> None:
+    await node.store.update(lambda tx: tx.create(_marker(0, tag)))
+
+
+def _has(node: Node, tag: str) -> bool:
+    return node.store.get("node", f"mark-{tag}") is not None
+
+
+async def _commit_while_stepping(h: _Cluster, lead: Node, tag: str,
+                                 max_steps: int = 120) -> bool:
+    """Propose from the leader while the harness keeps ticking (lost
+    messages are only retried on heartbeats, which need clock advancement
+    on the fake-clock wires).  Under an injected fault the commit MAY time
+    out — the sweep only demands liveness after heal()."""
+    task = asyncio.ensure_future(_commit(lead, tag))
+    for _ in range(max_steps):
+        if task.done():
+            break
+        await h.settle()
+    if not task.done():
+        task.cancel()
+    try:
+        await task
+        return True
+    except Exception:
+        return False
+
+
+def _build_plan(name: str, h: _Cluster, lead: Node, victim: Node
+                ) -> FaultPlan:
+    others = [n.addr for n in h.nodes.values()
+              if n.running and n.addr != victim.addr]
+    if name == "down":
+        return FaultPlan.down(victim.addr)
+    if name == "drop":
+        return FaultPlan.drop(lead.addr, victim.addr, p=0.6)
+    if name == "partition":
+        return FaultPlan.split([victim.addr], others)
+    if name == "delay":
+        return FaultPlan.delay(lead.addr, victim.addr, h.delay_s)
+    if name == "crash":
+        return FaultPlan.crash(victim.addr)
+    raise ValueError(f"unknown fault plan {name!r}")
+
+
+# --------------------------------------------------------------------------
+# one scenario
+
+
+async def _run_scenario(wire: str, plan_name: str, seed: int) -> dict:
+    h = _CLUSTERS[wire](seed)
+    tag = f"{wire}-{plan_name}-{seed}"
+    notes: list[str] = []
+    try:
+        n1 = await h.add_node()
+        await h.wait_for(lambda: h.leader() is not None, "first leader")
+        await h.add_node(join_from=n1)
+        await h.add_node(join_from=n1)
+        lead = await h.wait_for_cluster()
+
+        await _commit_while_stepping(h, lead, f"pre-{tag}")
+        await h.wait_for(
+            lambda: all(_has(n, f"pre-{tag}")
+                        for n in h.nodes.values() if n.running),
+            "pre-marker replication")
+
+        # -- inject -------------------------------------------------------
+        lead = h.leader()
+        victim = next(n for n in sorted(h.nodes.values(),
+                                        key=lambda n: n.node_id)
+                      if n.running and n.raft_id != lead.raft_id)
+        plan = _build_plan(plan_name, h, lead, victim)
+        plan.inject(h.network)
+        if plan_name == "crash":
+            # the crash plan is a real process death, not just wire state
+            await h.stop_node(victim)
+
+        committed = await _commit_while_stepping(h, lead, f"mid-{tag}")
+        notes.append(f"commit under fault: "
+                     f"{'ok' if committed else 'timed out (tolerated)'}")
+
+        # -- heal + liveness ----------------------------------------------
+        plan.heal(h.network)
+        if plan_name == "crash":
+            victim = await h.restart_node(victim)
+        lead = await h.wait_for_cluster()
+
+        await _commit_while_stepping(h, lead, f"post-{tag}")
+        await h.wait_for(
+            lambda: all(_has(n, f"post-{tag}")
+                        for n in h.nodes.values() if n.running),
+            "post-heal replication (liveness)")
+
+        # -- leader transfer ----------------------------------------------
+        old_rid = lead.raft_id
+        await lead.transfer_leadership()
+        await h.wait_for(
+            lambda: h.leader() is not None
+            and h.leader().raft_id != old_rid,
+            "leadership transfer")
+        lead = await h.wait_for_cluster()
+        notes.append(f"leader moved {old_rid:x} -> {lead.raft_id:x}")
+
+        # -- member removal: drop the non-victim follower so the final
+        # commit can only succeed with the recovered victim's ack ---------
+        candidates = [rid for rid, m in lead.cluster.members.items()
+                      if rid != lead.raft_id and m.addr != victim.addr]
+        if not candidates:   # the victim became leader: remove any follower
+            candidates = [rid for rid in lead.cluster.members
+                          if rid != lead.raft_id]
+        removed_rid = candidates[0]
+        removed_addr = lead.cluster.members[removed_rid].addr
+        removal = asyncio.ensure_future(lead.remove_member(removed_rid))
+        await h.wait_for(lambda: removal.done(), "member removal")
+        removal.result()
+        gone = next(n for n in h.nodes.values() if n.addr == removed_addr)
+        await h.stop_node(gone)
+        notes.append(f"removed member {removed_rid:x} ({removed_addr})")
+
+        lead = await h.wait_for_cluster()
+        await _commit_while_stepping(h, lead, f"final-{tag}")
+        await h.wait_for(
+            lambda: all(_has(n, f"final-{tag}")
+                        for n in h.nodes.values() if n.running),
+            "final replication after removal")
+
+        # -- differential oracle: surviving stores must agree -------------
+        survivors = [n for n in h.nodes.values() if n.running]
+        contents = {n.node_id: sorted(o.id for o in n.store.find("node"))
+                    for n in survivors}
+        baseline = next(iter(contents.values()))
+        diverged = {nid: ids for nid, ids in contents.items()
+                    if ids != baseline}
+        if diverged:
+            raise AssertionError(
+                f"store divergence across members: {contents}")
+        for phase in ("pre", "post", "final"):
+            if f"mark-{phase}-{tag}" not in baseline:
+                raise AssertionError(f"{phase} marker missing: {baseline}")
+        return {"wire": wire, "plan": plan_name, "seed": seed, "ok": True,
+                "notes": "; ".join(notes)}
+    except Exception as e:
+        return {"wire": wire, "plan": plan_name, "seed": seed, "ok": False,
+                "notes": "; ".join(notes), "error": f"{type(e).__name__}: {e}"}
+    finally:
+        await h.close()
+
+
+# --------------------------------------------------------------------------
+# sweep driver
+
+
+def run_sweep(wires=WIRES, plans=PLANS, seeds=DEFAULT_SEEDS,
+              verbose: bool = True) -> list[dict]:
+    """Run each (wire, plan, seed) scenario on a fresh event loop and
+    return one result dict per scenario (importable from tests)."""
+    results = []
+    for wire in wires:
+        for plan in plans:
+            for seed in seeds:
+                t0 = time.monotonic()
+                res = asyncio.run(_run_scenario(wire, plan, seed))
+                res["secs"] = round(time.monotonic() - t0, 2)
+                results.append(res)
+                if verbose:
+                    state = "ok  " if res["ok"] else "FAIL"
+                    line = (f"{state} {wire:8s} {plan:10s} seed={seed} "
+                            f"({res['secs']}s)")
+                    if not res["ok"]:
+                        line += f"  {res.get('error', '')}"
+                    print(line, flush=True)
+    return results
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--wires", default=",".join(WIRES),
+                    help=f"comma list from {WIRES}")
+    ap.add_argument("--plans", default=",".join(PLANS),
+                    help=f"comma list from {PLANS}")
+    ap.add_argument("--seeds", default=",".join(map(str, DEFAULT_SEEDS)),
+                    help="comma list of seeds")
+    args = ap.parse_args(argv)
+
+    wires = [w for w in args.wires.split(",") if w]
+    plans = [p for p in args.plans.split(",") if p]
+    seeds = [int(s) for s in args.seeds.split(",") if s]
+    for w in wires:
+        if w not in _CLUSTERS:
+            ap.error(f"unknown wire {w!r}")
+    for p in plans:
+        if p not in PLANS:
+            ap.error(f"unknown plan {p!r}")
+
+    results = run_sweep(wires, plans, seeds)
+    failed = [r for r in results if not r["ok"]]
+    print(f"\n{len(results) - len(failed)}/{len(results)} scenarios passed")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
