@@ -34,10 +34,33 @@ RESULT: dict = {
 BASELINE_RATE = 1_000_000 / 60.0
 
 
+def _dump_metrics() -> None:
+    """BENCH_METRICS_OUT=<path>: write the merged metrics snapshot (typed
+    registry + legacy timers) as JSON next to the BENCH_*.json line, so a
+    bench run leaves the same introspection data a live manager scrape
+    serves. Best-effort — a metrics failure must never cost the bench
+    number."""
+    path = os.environ.get("BENCH_METRICS_OUT", "")
+    if not path:
+        return
+    try:
+        from swarmkit_tpu.metrics import exposition
+        from swarmkit_tpu.metrics import registry as obs_registry
+        from swarmkit_tpu.utils import metrics as legacy
+        with open(path, "w") as f:
+            json.dump(exposition.snapshot_all(
+                registry=obs_registry.DEFAULT,
+                legacy_registry=legacy.REGISTRY), f,
+                indent=2, sort_keys=True, default=str)
+    except Exception as e:
+        log(f"metrics dump failed: {e}")
+
+
 def _emit(error: str | None = None, hard: bool = False) -> None:
     """Single exit point: print the one JSON line and leave. A run whose
     headline number already exists stays a success even if an error arrives
     later (e.g. SIGTERM during the secondary configs)."""
+    _dump_metrics()
     if error is not None:
         if RESULT.get("value"):
             RESULT.setdefault("note", error)
@@ -196,25 +219,32 @@ def measure(jax, n: int, entries: int, seed: int, election_tick: int,
         SimConfig, committed_entries, has_leader, init_state, run_ticks,
         run_until_leader,
     )
+    from swarmkit_tpu.raft.sim.run import KernelObs
 
+    obs = KernelObs()
     # static_members: every bench config runs a fixed quorum (crashes and
     # drops are liveness faults, not membership changes), so the kernel's
     # static-membership specialization applies — the dynamic path is gated
     # by the differential suite and test_static_members_equivalence.
+    # collect_stats: four O(N) reduces per tick against O(N^2) phases —
+    # negligible, but BENCH_COLLECT_STATS=0 restores the bare program.
     cfg = SimConfig(n=n, log_len=8192, window=2048, apply_batch=2048,
                     max_props=2048, keep=500, seed=seed,
                     election_tick=election_tick,
                     latency=latency, latency_jitter=latency_jitter,
-                    inflight=inflight, static_members=True)
+                    inflight=inflight, static_members=True,
+                    collect_stats=os.environ.get(
+                        "BENCH_COLLECT_STATS", "1") != "0")
     ticks_needed = max(1, (entries + cfg.max_props - 1) // cfg.max_props)
     chunk = int(os.environ.get("BENCH_CHUNK_TICKS", "64"))
     n_chunks = (ticks_needed + chunk - 1) // chunk
 
     def run_chunks(state):
         for _ in range(n_chunks):
-            state, _ = run_ticks(state, cfg, chunk,
-                                 prop_count=cfg.max_props, **run_kw)
-            jax.block_until_ready(state.commit)
+            with obs.timed("run_ticks"):
+                state, _ = run_ticks(state, cfg, chunk,
+                                     prop_count=cfg.max_props, **run_kw)
+                jax.block_until_ready(state.commit)
             _pet_watchdog()
         return state
 
@@ -229,8 +259,9 @@ def measure(jax, n: int, entries: int, seed: int, election_tick: int,
         t0 = time.perf_counter()
         ticks = 0
         while ticks < max_elect_ticks:
-            st, t_chunk = run_until_leader(st, cfg, max_ticks=elect_chunk)
-            jax.block_until_ready(st.term)
+            with obs.timed("run_until_leader"):
+                st, t_chunk = run_until_leader(st, cfg, max_ticks=elect_chunk)
+                jax.block_until_ready(st.term)
             _pet_watchdog()
             ticks += int(t_chunk)
             if bool(has_leader(st)):
@@ -265,8 +296,25 @@ def measure(jax, n: int, entries: int, seed: int, election_tick: int,
         "cfg": cfg, "final": final, "committed": committed, "dt": dt,
         "rate": committed / dt, "election_ticks": ticks,
         "t_elect": t_elect, "t_elect_post": t_elect_post,
-        "t_compile": t_compile,
+        "t_compile": t_compile, "kernel_stats": obs.publish(final),
     }
+
+
+def _bench_gauges(config: str, m: dict) -> None:
+    """Fold one measure() result into the swarm_bench_* gauge families
+    (best-effort: gauges must never cost the bench number)."""
+    try:
+        from swarmkit_tpu.metrics import catalog as obs_catalog
+        from swarmkit_tpu.metrics import registry as obs_registry
+        r = obs_registry.DEFAULT
+        obs_catalog.get(r, "swarm_bench_entries_per_second").labels(
+            config=config).set(m["rate"])
+        obs_catalog.get(r, "swarm_bench_compile_seconds").labels(
+            config=config).set(m["t_compile"])
+        obs_catalog.get(r, "swarm_bench_election_seconds").labels(
+            config=config).set(m["t_elect_post"])
+    except Exception as e:
+        log(f"bench gauges failed: {e}")
 
 
 def main() -> None:
@@ -342,6 +390,9 @@ def main() -> None:
         emit_and_exit()
         return
 
+    _bench_gauges(f"headline-n{n}", m)
+    if m.get("kernel_stats"):
+        RESULT["kernel_stats"] = m["kernel_stats"]
     RESULT["election_ticks"] = m["election_ticks"]
     RESULT["election_s_incl_compile"] = round(m["t_elect"], 2)
     RESULT["election_s_post_compile"] = round(m["t_elect_post"], 3)
@@ -423,6 +474,7 @@ def main() -> None:
             try:
                 cm = measure(jax, cn, target_entries, seed=7,
                              election_tick=election_tick_for(cn), **kw)
+                _bench_gauges(name, cm)
                 extra[name] = round(cm["rate"], 1)
                 log(f"config {name}: {cm['rate']:,.0f} entries/s "
                     f"(election {cm['election_ticks']} ticks)")

@@ -122,7 +122,10 @@ def _builtin_pmatmul(params: dict):
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # pre-0.5 jax keeps it under experimental
+        from jax.experimental.shard_map import shard_map
 
     n = int(params.get("n", 256))
     steps = int(params.get("steps", 4))

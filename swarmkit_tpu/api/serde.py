@@ -14,16 +14,45 @@ import base64
 import dataclasses
 import enum
 import json
+import sys
 import typing
 from typing import Any, Optional, Union, get_args, get_origin
 
 _HINTS_CACHE: dict[type, dict[str, Any]] = {}
 
 
+def _resolve_refs(tp: Any, globalns: dict) -> Any:
+    """Resolve forward references `get_type_hints` leaves behind.
+
+    Quoted args inside builtin generics — ``list["PortConfig"]`` — survive
+    hint resolution as bare strings (the subscript value is never
+    evaluated), so decoding would silently hand back raw dicts instead of
+    rehydrated dataclasses.  Walk the hint tree and look such strings up in
+    the defining module's namespace.
+    """
+    if isinstance(tp, str):
+        return globalns.get(tp, tp)
+    if type(tp) is typing.ForwardRef:
+        return globalns.get(tp.__forward_arg__, tp)
+    origin = get_origin(tp)
+    if origin is None:
+        return tp
+    args = get_args(tp)
+    new = tuple(_resolve_refs(a, globalns) for a in args)
+    if new == args:
+        return tp
+    if origin is Union:
+        return Union[new]
+    return origin[new]
+
+
 def _hints(cls: type) -> dict[str, Any]:
     h = _HINTS_CACHE.get(cls)
     if h is None:
-        h = typing.get_type_hints(cls)
+        g = vars(sys.modules.get(cls.__module__, typing)) \
+            if cls.__module__ in sys.modules else {}
+        h = {k: _resolve_refs(v, g)
+             for k, v in typing.get_type_hints(cls).items()}
         _HINTS_CACHE[cls] = h
     return h
 

@@ -38,6 +38,9 @@ from swarmkit_tpu.api.dispatcher_msgs import (
     AssignmentsMessage, AssignmentsType, HeartbeatResponse, SessionMessage,
 )
 from swarmkit_tpu.manager.dispatcher.assignments import AssignmentSet
+from swarmkit_tpu.metrics import catalog as obs_catalog
+from swarmkit_tpu.metrics import registry as obs_registry
+from swarmkit_tpu.metrics import trace as obs_trace
 from swarmkit_tpu.manager.dispatcher.nodes import (
     ErrNodeNotRegistered, ErrSessionInvalid, NodeStore,
 )
@@ -71,10 +74,20 @@ class Dispatcher:
                  clock: Optional[Clock] = None,
                  peers_queue=None,
                  rng: Optional[random.Random] = None,
-                 drivers=None) -> None:
+                 drivers=None,
+                 obs: Optional[obs_registry.MetricsRegistry] = None) -> None:
         self.store = store
         self.drivers = drivers
         self.clock = clock or SystemClock()
+        self.obs = obs or obs_registry.DEFAULT
+        self._m_sessions = obs_catalog.get(
+            self.obs, "swarm_dispatcher_sessions_total")
+        self._m_heartbeats = obs_catalog.get(
+            self.obs, "swarm_dispatcher_heartbeats_total")
+        self._m_hb_rtt = obs_catalog.get(
+            self.obs, "swarm_dispatcher_heartbeat_rtt_seconds")
+        self._m_task_updates = obs_catalog.get(
+            self.obs, "swarm_dispatcher_task_updates_total")
         self.managers_fn = managers_fn or (lambda: [])
         # raft membership broadcast (membership.Cluster.broadcast /
         # PeersBroadcast cluster.go:38): wakes session streams so agents
@@ -252,6 +265,7 @@ class Dispatcher:
         await self._mark_node_ready(node_id, description, addr)
         rn = self.nodes.add(node_id, description, addr,
                             self._heartbeat_expired)
+        self._m_sessions.inc()
         return rn.session_id
 
     async def _mark_node_ready(self, node_id: str, description, addr: str
@@ -279,7 +293,13 @@ class Dispatcher:
     async def heartbeat(self, node_id: str, session_id: str
                         ) -> HeartbeatResponse:
         self._check_running()
-        period = self.nodes.heartbeat(node_id, session_id)
+        with self._m_hb_rtt.time():
+            try:
+                period = self.nodes.heartbeat(node_id, session_id)
+            except Exception:
+                self._m_heartbeats.labels(result="invalid").inc()
+                raise
+        self._m_heartbeats.labels(result="ok").inc()
         return HeartbeatResponse(period=period)
 
     async def update_task_status(self, node_id: str, session_id: str,
@@ -300,6 +320,8 @@ class Dispatcher:
                 raise PermissionError(
                     "cannot update a task not assigned this node")
             valid.append((task_id, status))
+        if valid:
+            self._m_task_updates.inc(len(valid))
         for task_id, status in valid:
             self._task_updates[task_id] = status
         if self._task_updates:
@@ -360,9 +382,12 @@ class Dispatcher:
         an existing session) and streams SessionMessages until the session is
         superseded or expires."""
         self._check_running()
-        if not session_id:
-            session_id = await self.register(node_id, description, addr)
-        rn = self.nodes.get_with_session(node_id, session_id)
+        with obs_trace.DEFAULT.span("dispatcher.session", node=node_id,
+                                    resumed=bool(session_id)) as sp:
+            if not session_id:
+                session_id = await self.register(node_id, description, addr)
+            rn = self.nodes.get_with_session(node_id, session_id)
+            sp.set(session=session_id)
 
         watcher = self.store.watch(match(kind="node"), match(kind="cluster"))
         peers_w = (self.peers_queue.watch()

@@ -59,7 +59,7 @@ class Manager:
                  election_tick: int = 10, heartbeat_tick: int = 1,
                  seed: int = 0, security=None,
                  encrypter=None, decrypter=None,
-                 transport_factory=None) -> None:
+                 transport_factory=None, obs=None) -> None:
         self.node_id = node_id
         self.addr = addr
         self.clock = clock or SystemClock()
@@ -69,8 +69,13 @@ class Manager:
         self.ca_server: Optional[CAServer] = None
         from swarmkit_tpu.utils.metrics import Registry
         self.metrics_registry = Registry()
+        # typed observability registry: per-manager by default so multi-
+        # manager test clusters don't mix counters (pass obs= to share one)
+        from swarmkit_tpu.metrics import registry as obs_registry
+        self.obs = obs or obs_registry.MetricsRegistry()
         self.raft = RaftNode(NodeOpts(
             metrics_registry=self.metrics_registry,
+            obs_registry=self.obs,
             node_id=node_id, addr=addr, network=network,
             state_dir=state_dir, clock=self.clock, join_addr=join_addr,
             force_new_cluster=force_new_cluster,
@@ -90,7 +95,8 @@ class Manager:
         self.drivers = DriverProvider()
         self.dispatcher = Dispatcher(
             self.store, managers_fn=self._weighted_peers, clock=self.clock,
-            peers_queue=self.raft.cluster.broadcast, drivers=self.drivers)
+            peers_queue=self.raft.cluster.broadcast, drivers=self.drivers,
+            obs=self.obs)
         self.logbroker = LogBroker(self.store)
         self.watch_server = WatchServer(self.store, proposer=self.raft)
         self.health = HealthServer()
@@ -122,6 +128,27 @@ class Manager:
     def leader_addr(self) -> str:
         return self.raft.leader_addr()
 
+    # ------------------------------------------------------------------
+    # observability: the /metrics-equivalent scrape surface.  One page
+    # merges the typed registry (raft/transport/scheduler/dispatcher/store
+    # families), the legacy latency timers, and the store-object gauges
+    # (reference: manager.go registers the prometheus handler next to the
+    # health service).
+    def metrics_text(self) -> str:
+        from swarmkit_tpu.metrics import exposition
+        return exposition.render_all(
+            registry=self.obs,
+            legacy_registry=self.metrics_registry,
+            collector_gauges=self.metrics.snapshot())
+
+    def metrics_snapshot(self) -> dict:
+        from swarmkit_tpu.metrics import exposition, trace as obs_trace
+        return exposition.snapshot_all(
+            registry=self.obs,
+            legacy_registry=self.metrics_registry,
+            collector_gauges=self.metrics.snapshot(),
+            tracer=obs_trace.DEFAULT)
+
     def is_state_dirty(self) -> bool:
         """reference: manager/dirty.go IsStateDirty — any object beyond the
         cluster + own node means this store has real state."""
@@ -143,6 +170,11 @@ class Manager:
         network = self.raft.opts.network
         if hasattr(network, "set_health"):
             network.set_health(self.addr, lambda: self.health)
+        # the metrics scrape service rides the same listener, registered
+        # before raft starts for the same reason as health above
+        if hasattr(network, "add_service"):
+            from swarmkit_tpu.rpc import metrics_handlers
+            network.add_service(self.addr, metrics_handlers(self.metrics_text))
         leadership = self.raft.leadership.watch()
         await self.raft.start()
         await self.metrics.start()
@@ -218,7 +250,7 @@ class Manager:
                 org=cluster.id, clock=self.clock)
         self.control_api.ca_server = self.ca_server
 
-        sched = Scheduler(self.store, clock=self.clock)
+        sched = Scheduler(self.store, clock=self.clock, obs=self.obs)
         replicated = ReplicatedOrchestrator(self.store, clock=self.clock)
         global_ = GlobalOrchestrator(self.store, clock=self.clock)
         reaper = TaskReaper(self.store, clock=self.clock)

@@ -16,6 +16,8 @@ import logging
 from typing import Optional
 
 from swarmkit_tpu.api import TaskState
+from swarmkit_tpu.metrics import catalog as obs_catalog
+from swarmkit_tpu.metrics import registry as obs_registry
 from swarmkit_tpu.manager.scheduler.filters import Pipeline
 from swarmkit_tpu.manager.scheduler.nodeinfo import NodeInfo, task_reserved
 from swarmkit_tpu.manager.scheduler.nodeset import NodeSet
@@ -30,10 +32,18 @@ MAX_LATENCY = 1.0         # reference: scheduler.go:124
 
 
 class Scheduler:
-    def __init__(self, store: MemoryStore, clock: Optional[Clock] = None
-                 ) -> None:
+    def __init__(self, store: MemoryStore, clock: Optional[Clock] = None,
+                 obs: Optional[obs_registry.MetricsRegistry] = None) -> None:
         self.store = store
         self.clock = clock or SystemClock()
+        self.obs = obs or obs_registry.DEFAULT
+        self._m_latency = obs_catalog.get(
+            self.obs, "swarm_scheduler_latency_seconds")
+        self._m_decisions = obs_catalog.get(
+            self.obs, "swarm_scheduler_decisions_total")
+        obs_catalog.get(self.obs, "swarm_scheduler_pending_tasks") \
+            .set_function(lambda: float(len(self.unassigned)
+                                        + len(self.preassigned)))
         self.node_set = NodeSet()
         self.unassigned: dict[str, object] = {}  # taskid -> task
         # PENDING tasks that arrived with a node already chosen (global
@@ -190,25 +200,30 @@ class Scheduler:
 
     async def tick(self) -> None:
         """Schedule everything currently unassigned."""
-        self._changed_since_tick = False
-        if self.preassigned:
-            await self._process_preassigned()
-        groups: dict[tuple, list] = {}
-        for t in list(self.unassigned.values()):
-            groups.setdefault(self._common_spec_key(t), []).append(t)
+        with self._m_latency.time():
+            self._changed_since_tick = False
+            if self.preassigned:
+                await self._process_preassigned()
+            groups: dict[tuple, list] = {}
+            for t in list(self.unassigned.values()):
+                groups.setdefault(self._common_spec_key(t), []).append(t)
 
-        decisions = []  # (task, node_id, mirrored copy)
-        for group in groups.values():
-            decisions.extend(self._schedule_group(group))
-        placed = {t.id for t, _, _ in decisions}
-        if decisions:
-            await self._apply(decisions)
-        # annotate tasks no filter would place so operators can see why
-        # (reference: noSuitableNode scheduler.go — sets task status
-        # message; taskFitNode does the same for preassigned misfits)
-        await self._explain_unplaced(
-            [t for t in self.unassigned.values() if t.id not in placed]
-            + list(self.preassigned.values()))
+            decisions = []  # (task, node_id, mirrored copy)
+            for group in groups.values():
+                decisions.extend(self._schedule_group(group))
+            placed = {t.id for t, _, _ in decisions}
+            if decisions:
+                await self._apply(decisions)
+            # annotate tasks no filter would place so operators can see why
+            # (reference: noSuitableNode scheduler.go — sets task status
+            # message; taskFitNode does the same for preassigned misfits)
+            unplaced = [t for t in self.unassigned.values()
+                        if t.id not in placed] \
+                + list(self.preassigned.values())
+            if unplaced:
+                self._m_decisions.labels(result="unassigned") \
+                    .inc(len(unplaced))
+            await self._explain_unplaced(unplaced)
 
     async def _process_preassigned(self) -> None:
         """Validate PENDING tasks whose node is already chosen and flip
@@ -258,6 +273,7 @@ class Scheduler:
         for t, info in fits:
             if applied.get(t.id):
                 self.preassigned.pop(t.id, None)
+                self._m_decisions.labels(result="preassigned").inc()
             # re-book the reservation either way (the fit check removed it)
             info.add_task(t)
 
@@ -368,7 +384,9 @@ class Scheduler:
         await batch.commit()
         for task, node_id, assigned in decisions:
             self.unassigned.pop(task.id, None)
-            if not applied.get(task.id):
+            if applied.get(task.id):
+                self._m_decisions.labels(result="assigned").inc()
+            else:
                 # roll the phantom copy back out of the node mirror
                 # (reference: applySchedulingDecisions failure path)
                 info = self.node_set.get(node_id)

@@ -1,0 +1,41 @@
+"""Framework-wide observability: metrics registry, catalog, tracing,
+and Prometheus exposition.
+
+Quick tour::
+
+    from swarmkit_tpu import metrics as obs
+
+    reg = obs.MetricsRegistry()              # or obs.default_registry()
+    c = obs.catalog_get(reg, "swarm_raft_elections_won_total")
+    c.labels(node="m1").inc()
+    text = reg.render()                      # Prometheus text format
+    data = reg.snapshot()                    # JSON-able dict
+
+    with obs.default_tracer().span("raft.propose", node="m1") as sp:
+        ...                                  # sp.span_id propagates via
+                                             # contextvars to nested spans
+
+Components accept an optional registry/tracer and fall back to the
+process-global defaults, so tests can hand each cluster a fresh registry
+while production shares one scrape surface per process.
+"""
+
+from .catalog import CATALOG, MetricSpec
+from .catalog import get as catalog_get
+from .exposition import render_all, snapshot_all
+from .registry import (DEFAULT_BUCKETS, MAX_LABEL_SETS, Counter, Gauge,
+                       Histogram, LabelCardinalityError, MetricError,
+                       MetricsRegistry, default_registry)
+from .trace import (Span, Tracer, current_span, current_span_id,
+                    default_tracer, iter_ancestry)
+
+__all__ = [
+    "CATALOG", "MetricSpec", "catalog_get",
+    "render_all", "snapshot_all",
+    "DEFAULT_BUCKETS", "MAX_LABEL_SETS",
+    "Counter", "Gauge", "Histogram",
+    "LabelCardinalityError", "MetricError", "MetricsRegistry",
+    "default_registry",
+    "Span", "Tracer", "current_span", "current_span_id", "default_tracer",
+    "iter_ancestry",
+]

@@ -1,0 +1,183 @@
+"""Central catalog of every metric the framework emits.
+
+One spec per metric name: kind, help text, label schema, and (for
+histograms) bucket edges.  Components never hand-declare families — they
+call :func:`get`, which instantiates the family in the target registry from
+the spec.  This gives ``tools/metrics_lint.py`` a single ground truth: a
+name emitted anywhere but absent here, a duplicate registration with a
+different schema, or a spec with empty help text is a lint failure.
+
+Naming follows the reference's prometheus namespace (``swarm_``) with a
+subsystem segment per layer: raft / transport / kernel / scheduler /
+dispatcher / store / bench.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+from .registry import DEFAULT_BUCKETS, MetricsRegistry
+
+
+@dataclass(frozen=True)
+class MetricSpec:
+    kind: str                       # "counter" | "gauge" | "histogram"
+    help: str
+    labels: tuple = ()
+    buckets: Optional[tuple] = None
+
+
+# Bucket ladders: RPC-ish latencies use the prometheus defaults; device
+# ticks span 0.1 ms (tiny CPU shapes) to tens of seconds (XLA compile).
+_TICK_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+CATALOG: dict[str, MetricSpec] = {
+    # ---- raft node (L3) --------------------------------------------------
+    "swarm_raft_elections_started_total": MetricSpec(
+        "counter", "Campaigns this node started (entered candidate or "
+        "pre-candidate state).", ("node",)),
+    "swarm_raft_elections_won_total": MetricSpec(
+        "counter", "Elections this node won (became leader).", ("node",)),
+    "swarm_raft_leader_changes_total": MetricSpec(
+        "counter", "Observed leadership changes, from any role.", ("node",)),
+    "swarm_raft_term": MetricSpec(
+        "gauge", "Current raft term.", ("node",)),
+    "swarm_raft_commit_index": MetricSpec(
+        "gauge", "Highest committed log index.", ("node",)),
+    "swarm_raft_applied_index": MetricSpec(
+        "gauge", "Highest applied log index.", ("node",)),
+    "swarm_raft_is_leader": MetricSpec(
+        "gauge", "1 while this node is the raft leader, else 0.", ("node",)),
+    "swarm_raft_proposal_latency_seconds": MetricSpec(
+        "histogram", "ProposeValue wall time: submit to quorum commit "
+        "(the reference's proposeLatencyTimer span).", ("node",)),
+    "swarm_raft_proposals_total": MetricSpec(
+        "counter", "Proposals submitted, by outcome.", ("node", "result")),
+    "swarm_raft_peer_sends_total": MetricSpec(
+        "counter", "Raft messages handed to the transport, per peer.",
+        ("node", "peer")),
+    "swarm_raft_peer_send_failures_total": MetricSpec(
+        "counter", "Per-peer delivery failures reported back to the node "
+        "(feeds Node.status()['peer_failures']).", ("node", "peer")),
+
+    # ---- transports (L2) -------------------------------------------------
+    "swarm_transport_delivery_latency_seconds": MetricSpec(
+        "histogram", "Queue-to-delivered wall time per raft message on the "
+        "sending side.", ("wire",)),
+    "swarm_transport_redials_total": MetricSpec(
+        "counter", "Backoff redial sleeps taken by per-peer drain loops "
+        "after delivery failures.", ("wire",)),
+    "swarm_transport_send_failures_total": MetricSpec(
+        "counter", "Message delivery failures across all peers.", ("wire",)),
+    "swarm_transport_probe_transitions_total": MetricSpec(
+        "counter", "gRPC health-prober state flips, by new state "
+        "(healthy / unhealthy).", ("peer", "state")),
+    "swarm_transport_probe_healthy": MetricSpec(
+        "gauge", "Current prober verdict per peer: 1 healthy, 0 unhealthy.",
+        ("peer",)),
+    "swarm_transport_probes_total": MetricSpec(
+        "counter", "Health probes sent, by result (ok / fail).",
+        ("peer", "result")),
+    "swarm_transport_mailbox_depth": MetricSpec(
+        "gauge", "Device-mesh messages staged and awaiting the next "
+        "all-to-all flush.", ()),
+    "swarm_transport_device_flushes_total": MetricSpec(
+        "counter", "Device-mesh all-to-all exchange invocations.", ()),
+    "swarm_transport_device_messages_total": MetricSpec(
+        "counter", "Raft messages moved through device-mesh exchanges.", ()),
+    "swarm_transport_exchange_seconds": MetricSpec(
+        "histogram", "Wall time of one device-mesh exchange flush "
+        "(host-side, around the jitted all-to-all).", (),
+        _TICK_BUCKETS),
+
+    # ---- device tick kernel (L4) -----------------------------------------
+    "swarm_kernel_tick_seconds": MetricSpec(
+        "histogram", "Host-side wall time around jitted kernel calls, by "
+        "driver (step / run_ticks chunk / run_until_leader).", ("call",),
+        _TICK_BUCKETS),
+    "swarm_kernel_phase_ms": MetricSpec(
+        "gauge", "Isolated per-phase A-F cost in ms from the micro-kernel "
+        "model (tools/perf_model.py), keyed by PERF.md's phase table.",
+        ("phase",)),
+    "swarm_kernel_elections_started_total": MetricSpec(
+        "counter", "On-device cumulative campaigns across all rows "
+        "(SimState.stats[0]).", ()),
+    "swarm_kernel_elections_won_total": MetricSpec(
+        "counter", "On-device cumulative election wins across all rows "
+        "(SimState.stats[1]).", ()),
+    "swarm_kernel_commit_advance_total": MetricSpec(
+        "counter", "On-device cumulative commit-index advance summed over "
+        "rows (SimState.stats[2]).", ()),
+    "swarm_kernel_apply_advance_total": MetricSpec(
+        "counter", "On-device cumulative applied-index advance summed over "
+        "rows (SimState.stats[3]).", ()),
+
+    # ---- scheduler / dispatcher / store (L5) -----------------------------
+    "swarm_scheduler_latency_seconds": MetricSpec(
+        "histogram", "One scheduler tick: snapshot, score, and commit of "
+        "all pending assignments.", ()),
+    "swarm_scheduler_decisions_total": MetricSpec(
+        "counter", "Task placement decisions, by outcome "
+        "(assigned / preassigned / unassigned).", ("result",)),
+    "swarm_scheduler_pending_tasks": MetricSpec(
+        "gauge", "Tasks currently awaiting placement.", ()),
+    "swarm_dispatcher_sessions_total": MetricSpec(
+        "counter", "Agent sessions opened against this dispatcher.", ()),
+    "swarm_dispatcher_heartbeats_total": MetricSpec(
+        "counter", "Heartbeats processed, by result (ok / invalid).",
+        ("result",)),
+    "swarm_dispatcher_heartbeat_rtt_seconds": MetricSpec(
+        "histogram", "Server-side heartbeat handling time (store round "
+        "trip included).", ()),
+    "swarm_dispatcher_task_updates_total": MetricSpec(
+        "counter", "Task status updates accepted from agents.", ()),
+    "swarm_store_commits_total": MetricSpec(
+        "counter", "Store transactions committed, by kind "
+        "(read / write / batch).", ("kind",)),
+
+    # ---- bench / tools (L6) ----------------------------------------------
+    "swarm_bench_entries_per_second": MetricSpec(
+        "gauge", "Steady-state committed entries/sec, by bench config.",
+        ("config",)),
+    "swarm_bench_compile_seconds": MetricSpec(
+        "gauge", "XLA compile+first-call wall time, by bench config.",
+        ("config",)),
+    "swarm_bench_election_seconds": MetricSpec(
+        "gauge", "Election wall time on the cached program, by bench "
+        "config.", ("config",)),
+}
+
+
+# Legacy exposition series rendered NEXT TO the typed families by
+# exposition.render_all: the reservoir timers (utils.metrics, reference-
+# compatible summary names) and the store-event Collector's object gauges
+# (dynamic swarm_task_<state> / swarm_node_<state> names).  Allowlisted so
+# tools/metrics_lint.py accepts them without a MetricSpec — they are not
+# typed families and never instantiate through get().
+LEGACY_SERIES = frozenset({
+    "swarm_raft_propose_latency_seconds",
+    "swarm_raft_snapshot_latency_seconds",
+    "swarm_store_read_tx_latency_seconds",
+    "swarm_store_write_tx_latency_seconds",
+    "swarm_store_batch_latency_seconds",
+    "swarm_manager_leader",
+})
+LEGACY_PREFIXES = ("swarm_task_", "swarm_node_")
+
+
+def get(registry: MetricsRegistry, name: str):
+    """Instantiate (or fetch) `name` in `registry` from its catalog spec."""
+    spec = CATALOG.get(name)
+    if spec is None:
+        raise KeyError(f"metric {name!r} is not in the catalog; add a "
+                       f"MetricSpec to swarmkit_tpu/metrics/catalog.py")
+    if spec.kind == "counter":
+        return registry.counter(name, spec.help, spec.labels)
+    if spec.kind == "gauge":
+        return registry.gauge(name, spec.help, spec.labels)
+    if spec.kind == "histogram":
+        return registry.histogram(name, spec.help, spec.labels,
+                                  buckets=spec.buckets or DEFAULT_BUCKETS)
+    raise ValueError(f"unknown metric kind {spec.kind!r} for {name!r}")

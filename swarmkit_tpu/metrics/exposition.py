@@ -1,0 +1,81 @@
+"""Merged Prometheus text exposition across the three metric surfaces.
+
+A manager scrape must show one coherent page built from:
+
+1. the typed registry (:mod:`swarmkit_tpu.metrics.registry`) — counters,
+   gauges, histograms declared through the catalog;
+2. the legacy latency timers (:mod:`swarmkit_tpu.utils.metrics`) — rendered
+   as Prometheus *summaries* (quantile series from the reservoir, plus
+   exact ``_sum``/``_count``), keeping their reference-compatible names;
+3. the store-object gauges (``manager.metrics.Collector.snapshot()``) —
+   rendered as plain gauges.
+
+:func:`render_all` is what ``Manager.metrics_text()`` and the gRPC
+``swarmkit.Metrics/Scrape`` service serve; :func:`snapshot_all` is the
+JSON-able equivalent consumed by tools/ and tests.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .registry import MetricsRegistry, format_value
+
+_QUANTILES = ((50, "0.5"), (90, "0.9"), (99, "0.99"))
+
+
+def render_timers(legacy_registry) -> str:
+    """Render a utils.metrics.Registry's timers as summary families."""
+    lines: list[str] = []
+    snap = getattr(legacy_registry, "_timers", {})
+    for name in sorted(snap):
+        t = snap[name]
+        lines.append(f"# HELP {name} Latency timer "
+                     f"(reservoir quantiles over recent observations).")
+        lines.append(f"# TYPE {name} summary")
+        for p, q in _QUANTILES:
+            lines.append(f'{name}{{quantile="{q}"}} '
+                         f"{format_value(t.percentile(p))}")
+        lines.append(f"{name}_sum {format_value(t.sum)}")
+        lines.append(f"{name}_count {t.count}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def render_plain_gauges(gauges: dict, help_prefix: str = "Cluster object "
+                        "gauge maintained by the store-event collector."
+                        ) -> str:
+    lines: list[str] = []
+    for name in sorted(gauges):
+        lines.append(f"# HELP {name} {help_prefix}")
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {format_value(gauges[name])}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def render_all(registry: Optional[MetricsRegistry] = None,
+               legacy_registry=None,
+               collector_gauges: Optional[dict] = None) -> str:
+    parts = []
+    if registry is not None:
+        parts.append(registry.render())
+    if legacy_registry is not None:
+        parts.append(render_timers(legacy_registry))
+    if collector_gauges:
+        parts.append(render_plain_gauges(collector_gauges))
+    return "".join(p for p in parts if p)
+
+
+def snapshot_all(registry: Optional[MetricsRegistry] = None,
+                 legacy_registry=None,
+                 collector_gauges: Optional[dict] = None,
+                 tracer=None) -> dict:
+    out: dict = {}
+    if registry is not None:
+        out["metrics"] = registry.snapshot()
+    if legacy_registry is not None:
+        out["timers"] = legacy_registry.snapshot()
+    if collector_gauges is not None:
+        out["objects"] = dict(collector_gauges)
+    if tracer is not None:
+        out["spans"] = tracer.snapshot()
+    return out

@@ -30,6 +30,17 @@ from jax.experimental.pallas import tpu as pltpu
 # block MXU-shaped and lane-aligned for both dtypes we accept.
 _LANE = 128
 
+# jax renamed TPUCompilerParams -> CompilerParams across 0.4.x/0.5.x;
+# accept either so the kernels run on whichever jax the image bakes in.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams", None)
+
+
+def _compiler_params(dimension_semantics):
+    if _CompilerParams is None:
+        return None
+    return _CompilerParams(dimension_semantics=dimension_semantics)
+
 
 def _on_tpu() -> bool:
     try:
@@ -103,8 +114,8 @@ def matmul(a: jax.Array, b: jax.Array, *, tile_m: int = 256,
         out_specs=pl.BlockSpec((tile_m, tile_n), lambda i, j, k: (i, j),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[pltpu.VMEM((tile_m, tile_n), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        compiler_params=_compiler_params(
+            ("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(a, b)
 
@@ -149,8 +160,7 @@ def sumsq(x: jax.Array, *, tile_m: int = 256,
         out_specs=pl.BlockSpec((1, 1), lambda i: (0, 0),
                                memory_space=pltpu.SMEM),
         scratch_shapes=[pltpu.SMEM((1,), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",)),
+        compiler_params=_compiler_params(("arbitrary",)),
         interpret=interpret,
     )(x)
     return out[0, 0]

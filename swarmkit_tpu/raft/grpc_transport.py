@@ -24,6 +24,8 @@ from typing import Any, Optional
 import grpc
 import msgpack
 
+from swarmkit_tpu.metrics import catalog as obs_catalog
+from swarmkit_tpu.metrics import registry as obs_registry
 from swarmkit_tpu.raft.faults import FaultSurface
 from swarmkit_tpu.raft.messages import (
     ConfChange, ConfChangeType, Entry, EntryType, Message, MsgType, Snapshot,
@@ -233,11 +235,27 @@ class _PeerProber:
         self.failures = 0          # consecutive probe failures
         self._healthy = True       # optimistic until proven otherwise
         self._first_ok: Optional[float] = None
+        obs = net.obs
+        self._m_probes = obs_catalog.get(
+            obs, "swarm_transport_probes_total")
+        self._m_transitions = obs_catalog.get(
+            obs, "swarm_transport_probe_transitions_total")
+        self._m_healthy = obs_catalog.get(
+            obs, "swarm_transport_probe_healthy").labels(peer=addr)
+        self._m_healthy.set(1.0)
         self._task = asyncio.get_running_loop().create_task(self._loop())
 
     @property
     def healthy(self) -> bool:
         return self._healthy
+
+    def _set_healthy(self, healthy: bool) -> None:
+        if healthy == self._healthy:
+            return
+        self._healthy = healthy
+        state = "healthy" if healthy else "unhealthy"
+        self._m_transitions.labels(peer=self.addr, state=state).inc()
+        self._m_healthy.set(1.0 if healthy else 0.0)
 
     def reset(self) -> None:
         """Forget accumulated failure state (peer process bounced)."""
@@ -249,6 +267,12 @@ class _PeerProber:
 
     async def _probe_once(self) -> bool:
         if self.addr in self.net._down:
+            return False
+        # Injected partitions block at the dial seam, not the socket, so the
+        # health RPC itself would still succeed — mirror the block here so
+        # probe state flips the way a real severed link would make it.
+        frms = [a for a in self.net._local if a != self.addr]
+        if frms and all(self.net._fault_blocked(f, self.addr) for f in frms):
             return False
         try:
             raw = await asyncio.wait_for(
@@ -264,6 +288,8 @@ class _PeerProber:
         net = self.net
         while True:
             ok = await self._probe_once()
+            self._m_probes.labels(
+                peer=self.addr, result="ok" if ok else "fail").inc()
             now = asyncio.get_running_loop().time()
             if ok:
                 self.failures = 0
@@ -271,7 +297,7 @@ class _PeerProber:
                     if self._first_ok is None:
                         self._first_ok = now
                     if now - self._first_ok >= net.grace_period:
-                        self._healthy = True
+                        self._set_healthy(True)
                         self._first_ok = None
                 await asyncio.sleep(
                     net.probe_interval * (0.75 + 0.5 * net._rng.random()))
@@ -279,7 +305,7 @@ class _PeerProber:
                 self.failures += 1
                 self._first_ok = None
                 if self.failures >= net.failure_threshold:
-                    self._healthy = False
+                    self._set_healthy(False)
                 base, cap = net.dial_backoff
                 delay = min(cap, base * (2 ** min(self.failures - 1, 8)))
                 await asyncio.sleep(delay * (0.5 + 0.5 * net._rng.random()))
@@ -302,13 +328,16 @@ class GrpcNetwork(FaultSurface):
     across processes.
     """
 
+    wire_name = "grpc"   # transport metric label (see metrics/catalog.py)
+
     def __init__(self, security=None, seed: int = 0,
                  probe_interval: float = 0.5,
                  probe_timeout: float = 1.0,
                  failure_threshold: int = 3,
                  grace_period: float = 1.0,
                  redial_backoff: float = 0.05,
-                 redial_backoff_max: float = 2.0) -> None:
+                 redial_backoff_max: float = 2.0,
+                 obs: Optional[obs_registry.MetricsRegistry] = None) -> None:
         # security: a ca.SecurityConfig or a zero-arg callable returning one
         # (late-bound: swarmd loads its identity after the network object
         # exists). When set, the listener serves with TLS from the node
@@ -321,6 +350,7 @@ class GrpcNetwork(FaultSurface):
         # digest-pin GetRemoteCA, ca/certificates.go).
         # None = plaintext, for in-process tests only.
         super().__init__(seed=seed)
+        self.obs = obs or obs_registry.DEFAULT
         self._security_arg = security
         self._servers: dict[str, grpc.aio.Server] = {}
         self._channels: dict[str, grpc.aio.Channel] = {}

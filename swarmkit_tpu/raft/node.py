@@ -24,6 +24,7 @@ import asyncio
 import itertools
 import logging
 import random
+import time
 from dataclasses import dataclass, field
 from typing import Iterable, Optional, Sequence
 
@@ -36,7 +37,12 @@ from swarmkit_tpu.raft.messages import (
     NONE, ConfChange, ConfChangeType, Entry, EntryType, HardState, Message,
     MsgType, Snapshot, SnapshotMeta,
 )
-from swarmkit_tpu.raft.core import Config as RaftConfig, LEADER, ProposalDropped
+from swarmkit_tpu.metrics import catalog as obs_catalog
+from swarmkit_tpu.metrics import registry as obs_registry
+from swarmkit_tpu.metrics import trace as obs_trace
+from swarmkit_tpu.raft.core import (
+    CANDIDATE, Config as RaftConfig, LEADER, PRE_CANDIDATE, ProposalDropped,
+)
 from swarmkit_tpu.raft.rawnode import RawNode, Ready
 from swarmkit_tpu.raft.storage import EncryptedRaftLogger
 from swarmkit_tpu.raft.transport import Network, PeerRemoved, Transport
@@ -126,6 +132,12 @@ class NodeOpts:
     # multi-node deployments pass one per node so latency percentiles do
     # not mix across members.
     metrics_registry: object = None
+    # Typed observability registry (swarmkit_tpu.metrics.MetricsRegistry);
+    # None = the process-global default. Same per-node sharing rule as
+    # metrics_registry.
+    obs_registry: object = None
+    # Trace collector (swarmkit_tpu.metrics.Tracer); None = global default.
+    tracer: object = None
 
 
 class Node(Proposer):
@@ -144,8 +156,10 @@ class Node(Proposer):
         self.storage = EncryptedRaftLogger(
             opts.state_dir, encrypter=opts.encrypter, decrypter=opts.decrypter)
         self.metrics = opts.metrics_registry or metrics.REGISTRY
+        self.obs = opts.obs_registry or obs_registry.DEFAULT
         self.store = MemoryStore(proposer=None, clock=self.clock.now,
-                                 metrics_registry=self.metrics)
+                                 metrics_registry=self.metrics,
+                                 obs=self.obs)
         self.transport: Optional[Transport] = None
         self.leadership = Queue()   # publishes LeadershipState
         # awaited with (node_id, addr) before a NEW member's ADD_NODE is
@@ -171,8 +185,38 @@ class Node(Proposer):
         self._removed = False
         self._ticks_until_campaign = 0
         self._wedge_transfer_at = float("-inf")
-        self._peer_failures: dict[int, int] = {}
+        # per-peer {"count": consecutive failures, "last_failure": clock ts}
+        self._peer_failures: dict[int, dict] = {}
         self.running = False
+
+        self.tracer = opts.tracer or obs_trace.DEFAULT
+        self._last_role: Optional[str] = None
+        nid = self.node_id
+        self._m_elections_started = obs_catalog.get(
+            self.obs, "swarm_raft_elections_started_total").labels(node=nid)
+        self._m_elections_won = obs_catalog.get(
+            self.obs, "swarm_raft_elections_won_total").labels(node=nid)
+        self._m_leader_changes = obs_catalog.get(
+            self.obs, "swarm_raft_leader_changes_total").labels(node=nid)
+        self._m_proposal_latency = obs_catalog.get(
+            self.obs, "swarm_raft_proposal_latency_seconds").labels(node=nid)
+        self._m_proposals = obs_catalog.get(
+            self.obs, "swarm_raft_proposals_total")
+        self._m_peer_sends = obs_catalog.get(
+            self.obs, "swarm_raft_peer_sends_total")
+        self._m_peer_send_failures = obs_catalog.get(
+            self.obs, "swarm_raft_peer_send_failures_total")
+        obs_catalog.get(self.obs, "swarm_raft_term").labels(
+            node=nid).set_function(
+            lambda: self._raw.raft.term if self._raw is not None else 0)
+        obs_catalog.get(self.obs, "swarm_raft_commit_index").labels(
+            node=nid).set_function(
+            lambda: self._raw.raft.log.committed
+            if self._raw is not None else 0)
+        obs_catalog.get(self.obs, "swarm_raft_applied_index").labels(
+            node=nid).set_function(lambda: self._applied)
+        obs_catalog.get(self.obs, "swarm_raft_is_leader").labels(
+            node=nid).set_function(lambda: 1.0 if self.is_leader() else 0.0)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -469,15 +513,28 @@ class Node(Proposer):
 
         # 3. fan out messages (raft.go:608-613; async, never blocks)
         for m in rd.messages:
+            self._m_peer_sends.labels(node=self.node_id,
+                                      peer=str(m.to)).inc()
             self.transport.send(m)
 
         # 4. leadership flips (raft.go:638-664)
         if rd.soft_state is not None:
-            is_leader = rd.soft_state.state == LEADER
+            role = rd.soft_state.state
+            if role != self._last_role:
+                campaigning = (CANDIDATE, PRE_CANDIDATE)
+                # a pre-vote that graduates to a real vote is ONE campaign
+                if role in campaigning \
+                        and self._last_role not in campaigning:
+                    self._m_elections_started.inc()
+                elif role == LEADER:
+                    self._m_elections_won.inc()
+                self._last_role = role
+            is_leader = role == LEADER
             if self._was_leader and not is_leader:
                 self._wait.cancel_all()
             if is_leader != self._was_leader:
                 self._was_leader = is_leader
+                self._m_leader_changes.inc()
                 self.leadership.publish(LeadershipState(is_leader=is_leader))
 
         # 5. apply committed entries (raft.go:667 → processCommitted :1889)
@@ -680,9 +737,22 @@ class Node(Proposer):
         self._wake.set()
         # reference: proposeLatencyTimer wraps exactly this wait
         # (raft.go:69-71, observed at :1589)
-        with metrics.timed(metrics.RAFT_PROPOSE_LATENCY,
-                           registry=self.metrics):
-            return await self._await_with_timeout(fut, timeout, r.id)
+        with self.tracer.span("raft.propose", node=self.node_id,
+                              req_id=r.id, actions=len(actions)) as sp:
+            t0 = time.perf_counter()
+            try:
+                with metrics.timed(metrics.RAFT_PROPOSE_LATENCY,
+                                   registry=self.metrics):
+                    index = await self._await_with_timeout(fut, timeout, r.id)
+            except BaseException:
+                self._m_proposals.labels(node=self.node_id,
+                                         result="error").inc()
+                raise
+            finally:
+                self._m_proposal_latency.observe(time.perf_counter() - t0)
+            sp.set(index=index)
+            self._m_proposals.labels(node=self.node_id, result="ok").inc()
+            return index
 
     async def _await_with_timeout(self, fut: asyncio.Future, timeout: float,
                                   wait_id: Optional[int] = None):
@@ -843,7 +913,10 @@ class Node(Proposer):
         if failures <= 0:
             self._peer_failures.pop(raft_id, None)
             return
-        self._peer_failures[raft_id] = failures
+        self._peer_failures[raft_id] = {"count": failures,
+                                        "last_failure": self.clock.now()}
+        self._m_peer_send_failures.labels(node=self.node_id,
+                                          peer=str(raft_id)).inc()
         if self._raw is not None and self.running:
             self._raw.report_unreachable(raft_id)
             self._wake.set()
@@ -891,8 +964,9 @@ class Node(Proposer):
         st["removed"] = sorted(self.cluster.removed)
         st["applied_index"] = self._applied
         st["snapshot_index"] = self._snapshot_index
-        st["peer_failures"] = {rid: n for rid, n in
-                               self._peer_failures.items() if n > 0}
+        st["peer_failures"] = {rid: dict(info) for rid, info in
+                               self._peer_failures.items()
+                               if info["count"] > 0}
         return st
 
     def subscribe_leadership(self):

@@ -984,6 +984,18 @@ def step(state: SimState, cfg: SimConfig,
         tail_conf = jnp.any((own_idx > commit[:, None])
                             & (own_idx <= last[:, None]) & is_conf_ring,
                             axis=1)
+    # Cumulative event counters (cfg.collect_stats): cheap reduces appended
+    # to the program so host metrics can read kernel activity from a [4]
+    # vector instead of diffing full states (see metrics/catalog.py
+    # swarm_kernel_* families).
+    stats = state.stats
+    if cfg.collect_stats and stats is not None:
+        stats = stats + jnp.stack([
+            jnp.sum((campaign | tn_ok).astype(I32)),
+            jnp.sum(win.astype(I32)),
+            jnp.sum(commit - state.commit),
+            jnp.sum(applied - state.applied)])
+
     boxes = {}
     if cfg.mailboxes:
         boxes = dict(
@@ -1012,6 +1024,7 @@ def step(state: SimState, cfg: SimConfig,
         member=member, pending_conf=pending_conf,
         hup_conf=hup_conf, tail_conf=tail_conf,
         tick=state.tick + 1,
+        stats=stats,
         **boxes,
     )
 

@@ -104,6 +104,52 @@ def run_until_leader(state: SimState, cfg: SimConfig, max_ticks: int = 1000):
     return jax.lax.while_loop(cond, body, (state, jnp.asarray(0, I32)))
 
 
+class KernelObs:
+    """Host-side observability for the device kernel.
+
+    Two jobs (see metrics/catalog.py, swarm_kernel_* families):
+
+    - ``timed(call)``: wall-time histogram around a jitted driver call
+      (``swarm_kernel_tick_seconds{call=...}``), making PERF.md's cost
+      table live data instead of a one-off measurement.
+    - ``publish(state)``: fold the on-device cumulative event counters
+      (``SimState.stats``, cfg.collect_stats) into the kernel counter
+      families, incrementing by delta since the previous publish so
+      repeated calls are idempotent over the same state.
+    """
+
+    _STAT_NAMES = ("swarm_kernel_elections_started_total",
+                   "swarm_kernel_elections_won_total",
+                   "swarm_kernel_commit_advance_total",
+                   "swarm_kernel_apply_advance_total")
+
+    def __init__(self, obs=None) -> None:
+        from swarmkit_tpu.metrics import catalog as obs_catalog
+        from swarmkit_tpu.metrics import registry as obs_registry
+
+        self.obs = obs or obs_registry.DEFAULT
+        self._m_tick = obs_catalog.get(self.obs, "swarm_kernel_tick_seconds")
+        self._m_stats = [obs_catalog.get(self.obs, n)
+                         for n in self._STAT_NAMES]
+        self._last = [0, 0, 0, 0]
+
+    def timed(self, call: str):
+        return self._m_tick.labels(call=call).time()
+
+    def publish(self, state: SimState) -> dict:
+        """Returns the cumulative stats as a dict (empty when the state
+        carries none, i.e. cfg.collect_stats was off)."""
+        if state.stats is None:
+            return {}
+        cur = [int(v) for v in jax.device_get(state.stats)]
+        for fam, c, prev in zip(self._m_stats, cur, self._last):
+            if c > prev:
+                fam.inc(c - prev)
+        self._last = cur
+        return dict(zip(("elections_started", "elections_won",
+                         "commit_advance", "apply_advance"), cur))
+
+
 def committed_entries(state: SimState) -> jax.Array:
     """Total entries committed through consensus (max commit across rows)."""
     return jnp.max(state.commit)

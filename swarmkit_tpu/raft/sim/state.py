@@ -78,6 +78,12 @@ class SimConfig:
     # testing knob: run the mailbox wire even at latency 0 (same-tick
     # delivery) — must be decision-identical to the synchronous path
     force_mailboxes: bool = False
+    # Carry cumulative event counters in SimState.stats ([4] int32:
+    # campaigns started, elections won, commit advance, apply advance) so
+    # host-side metrics read live kernel activity without hauling the full
+    # state back per tick.  Off by default: the extra reduces are traced
+    # into the step program only when enabled.
+    collect_stats: bool = False
     # PreVote (vendor raft.go campaignPreElection): a timed-out node runs a
     # non-binding poll at term+1 WITHOUT bumping its term first, so a
     # flapping/partitioned node cannot inflate cluster terms.  Mirrors
@@ -197,6 +203,10 @@ class SimState:
                              # numOfPendingConf); computed end-of-tick
     # global tick counter (scalar) — also the PRNG stream position
     tick: jax.Array
+    # cumulative event counters [4] int32 (cfg.collect_stats; see SimConfig):
+    # [0] campaigns started  [1] elections won
+    # [2] sum of commit-index advance  [3] sum of applied-index advance
+    stats: Optional[jax.Array] = None
     # ---- in-flight mailboxes [N, N], only when cfg.mailboxes ------------
     # One slot per message class per directed edge; *_at holds deliver
     # tick + 1 (0 = empty).  Request classes index [sender, receiver];
@@ -311,6 +321,7 @@ def init_state(cfg: SimConfig,
         hup_conf=jnp.zeros((n,), jnp.bool_),
         tail_conf=jnp.zeros((n,), jnp.bool_),
         tick=jnp.zeros((), i32),
+        stats=jnp.zeros((4,), i32) if cfg.collect_stats else None,
     )
 
 

@@ -20,6 +20,8 @@ import asyncio
 import logging
 from typing import Any, Optional, Protocol
 
+from swarmkit_tpu.metrics import catalog as obs_catalog
+from swarmkit_tpu.metrics import registry as obs_registry
 from swarmkit_tpu.raft.faults import FaultSurface
 from swarmkit_tpu.raft.messages import Message, MsgType
 
@@ -56,6 +58,8 @@ class Network(FaultSurface):
     lives on the shared FaultSurface so the gRPC and device-mesh wires
     expose the identical surface; see swarmkit_tpu/raft/faults.py.
     """
+
+    wire_name = "inproc"  # transport metric label; subclasses override
 
     def __init__(self, seed: int = 0) -> None:
         super().__init__(seed=seed)
@@ -101,17 +105,18 @@ class _Peer:
 
     def send(self, m: Message) -> bool:
         try:
-            self.queue.put_nowait(m)
+            self.queue.put_nowait((self.tr.clock.now(), m))
             return True
         except asyncio.QueueFull:
             return False  # drop, reference peer.go:82-89
 
     async def _drain(self) -> None:
         while True:
-            m = await self.queue.get()
+            queued_at, m = await self.queue.get()
             if self.failures:
+                self.tr.m_redials.inc()
                 await self._redial_backoff()
-            await self._deliver(m)
+            await self._deliver(m, queued_at)
 
     async def _redial_backoff(self) -> None:
         """Bounded exponential backoff + jitter between redials of a failing
@@ -128,7 +133,7 @@ class _Peer:
         jitter = rng.random() if rng is not None else 0.5
         await self.tr.clock.sleep(delay * (0.5 + 0.5 * jitter))
 
-    async def _deliver(self, m: Message) -> None:
+    async def _deliver(self, m: Message, queued_at: float = 0.0) -> None:
         net, tr = self.tr.network, self.tr
         try:
             if net.lossy(tr.local_addr, self.addr):
@@ -141,6 +146,7 @@ class _Peer:
             server = net.server(tr.local_addr, self.addr)
             await server.process_raft_message(m)
             net.delivered += 1
+            tr.m_delivery.observe(max(0.0, tr.clock.now() - queued_at))
             if self.failures:
                 self.failures = 0
                 # recovery signal: clears the peer's failure count in status
@@ -160,6 +166,7 @@ class _Peer:
                             tr.local_addr, self.addr, e)
             self.active_since = 0.0
             self.failures += 1
+            tr.m_send_failures.inc()
             if m.type == MsgType.SNAP:
                 tr.handlers.report_snapshot(self.raft_id, False)
             tr.handlers.report_unreachable(self.raft_id, self.failures)
@@ -179,6 +186,16 @@ class Transport:
         self.clock = clock
         self._peers: dict[int, _Peer] = {}
         self.stopped = False
+        # share the node's typed registry when the handlers carry one
+        self.obs = getattr(handlers, "obs", None) or obs_registry.DEFAULT
+        wire = getattr(network, "wire_name", "inproc")
+        self.m_delivery = obs_catalog.get(
+            self.obs, "swarm_transport_delivery_latency_seconds"
+        ).labels(wire=wire)
+        self.m_redials = obs_catalog.get(
+            self.obs, "swarm_transport_redials_total").labels(wire=wire)
+        self.m_send_failures = obs_catalog.get(
+            self.obs, "swarm_transport_send_failures_total").labels(wire=wire)
 
     def add_peer(self, raft_id: int, addr: str) -> None:
         if raft_id in self._peers:

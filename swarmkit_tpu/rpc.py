@@ -91,6 +91,44 @@ async def check_health(channel: grpc.aio.Channel, service: str = "",
 
 
 # --------------------------------------------------------------------------
+# metrics on the wire: the /metrics scrape endpoint, served next to the
+# health service on the manager's raft listener (the text analog of the
+# reference's prometheus handler on the control socket)
+
+METRICS_SVC = "swarmkit.Metrics"
+
+
+def metrics_handlers(scrape: Callable[[], str]) -> list:
+    """Generic handlers serving ``Scrape`` from `scrape()`, a callable
+    returning the Prometheus text exposition (Manager.metrics_text)."""
+
+    async def scrape_rpc(request: bytes, context) -> bytes:
+        try:
+            return scrape().encode()
+        except Exception as e:
+            await context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+    return [grpc.method_handlers_generic_handler(METRICS_SVC, {
+        "Scrape": grpc.unary_unary_rpc_method_handler(
+            scrape_rpc, request_deserializer=_IDENT,
+            response_serializer=_IDENT)})]
+
+
+async def scrape_metrics(channel: grpc.aio.Channel,
+                         timeout: float = 2.0) -> str:
+    """Client side: fetch a manager's metrics text over its raft listener.
+    Raises RpcError when the endpoint is unreachable."""
+    call = channel.unary_unary(f"/{METRICS_SVC}/Scrape",
+                               request_serializer=_IDENT,
+                               response_deserializer=_IDENT)
+    try:
+        raw = await asyncio.wait_for(call(b""), timeout=timeout)
+    except (grpc.aio.AioRpcError, asyncio.TimeoutError) as e:
+        raise RpcError(f"metrics scrape failed: {e!r}")
+    return raw.decode()
+
+
+# --------------------------------------------------------------------------
 # server
 
 class ClusterService:

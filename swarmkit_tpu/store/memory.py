@@ -26,6 +26,8 @@ from swarmkit_tpu.store.errors import (
     ErrExist, ErrInvalidFindBy, ErrNameConflict, ErrNotExist,
     ErrSequenceConflict, ErrTxTooLarge,
 )
+from swarmkit_tpu.metrics import catalog as obs_catalog
+from swarmkit_tpu.metrics import registry as obs_registry
 from swarmkit_tpu.utils import metrics
 from swarmkit_tpu.watch.queue import Queue
 
@@ -369,7 +371,7 @@ class MemoryStore:
 
     def __init__(self, proposer: Optional[Proposer] = None,
                  clock: Optional[Callable[[], float]] = None,
-                 metrics_registry=None) -> None:
+                 metrics_registry=None, obs=None) -> None:
         self._tables: dict[str, _Table] = {k: _Table(k) for k in OBJECT_KINDS}
         self._proposer = proposer
         self._clock = clock or time.time
@@ -390,6 +392,9 @@ class MemoryStore:
         # undoing a just-committed node demotion).
         self._write_lock = asyncio.Lock()
         self.metrics = metrics_registry or metrics.REGISTRY
+        self.obs = obs or obs_registry.DEFAULT
+        self._m_commits = obs_catalog.get(self.obs,
+                                          "swarm_store_commits_total")
 
     def _timed(self, name: str):
         return metrics.timed(name, registry=self.metrics)
@@ -418,6 +423,7 @@ class MemoryStore:
 
     def view(self, cb: Callable[[ReadTx], Any]) -> Any:
         with self._timed(metrics.STORE_READ_TX_LATENCY):
+            self._m_commits.labels(kind="read").inc()
             return cb(ReadTx(self))
 
     def get(self, kind: str, id: str):
@@ -495,6 +501,7 @@ class MemoryStore:
                 else:
                     self._local_version += 1
                     self._commit(tx.changelist, self._local_version)
+            self._m_commits.labels(kind="write").inc()
             return result
 
     def wedged(self) -> bool:
@@ -678,4 +685,5 @@ class Batch:
                 await self._flush()
         finally:
             self._release_segment()
+        self._store._m_commits.labels(kind="batch").inc()
         return self.applied

@@ -27,10 +27,13 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Optional
 
 import numpy as np
 
+from swarmkit_tpu.metrics import catalog as obs_catalog
+from swarmkit_tpu.metrics import registry as obs_registry
 from swarmkit_tpu.parallel import MANAGER_AXIS, row_mesh
 from swarmkit_tpu.raft.messages import Message, MsgType
 from swarmkit_tpu.raft.transport import Network, PeerRemoved, RaftHandlers
@@ -57,7 +60,10 @@ class DeviceMeshNet(Network):
     messages go through the device exchange instead of per-peer queues.
     """
 
-    def __init__(self, seed: int = 0, rows: int = 8, mesh=None) -> None:
+    wire_name = "device"
+
+    def __init__(self, seed: int = 0, rows: int = 8, mesh=None,
+                 obs: Optional[obs_registry.MetricsRegistry] = None) -> None:
         super().__init__(seed=seed)
         self.rows = rows
         self._mesh = mesh  # built lazily so tests control jax init order
@@ -73,6 +79,16 @@ class DeviceMeshNet(Network):
         self._exchange_cache: dict = {}
         self.device_flushes = 0
         self.device_messages = 0
+        self.obs = obs or obs_registry.DEFAULT
+        obs_catalog.get(self.obs, "swarm_transport_mailbox_depth") \
+            .set_function(lambda: float(
+                sum(len(q) for q in self._staged.values())))
+        self._m_flushes = obs_catalog.get(
+            self.obs, "swarm_transport_device_flushes_total")
+        self._m_messages = obs_catalog.get(
+            self.obs, "swarm_transport_device_messages_total")
+        self._m_exchange = obs_catalog.get(
+            self.obs, "swarm_transport_exchange_seconds")
 
     # -- rows --------------------------------------------------------------
     @property
@@ -269,11 +285,15 @@ class DeviceMeshNet(Network):
             words[frm, to, k, :len(buf)] = buf
             lens[frm, to, k] = len(raw)
             keep[frm, to, k] = deliverable
+        t0 = time.perf_counter()
         d_words, d_lens = self._exchange_fn(kb, wb)(words, lens, keep)
         d_words = np.asarray(d_words)
         d_lens = np.asarray(d_lens)
+        self._m_exchange.observe(time.perf_counter() - t0)
         self.device_flushes += 1
         self.device_messages += len(entries)
+        self._m_flushes.inc()
+        self._m_messages.inc(len(entries))
 
         for frm, to, k, raw, m, tr, rid, to_addr, deliverable in entries:
             nbytes = int(d_lens[to, frm, k])

@@ -20,7 +20,7 @@ from swarmkit_tpu.api.types import NodeDescription, Platform
 from swarmkit_tpu.template import (
     TemplateError, expand, expand_container_spec, task_context,
 )
-from tests.conftest import async_test
+from tests.conftest import async_test, requires_cryptography
 
 
 def test_template_expansion():
@@ -559,6 +559,7 @@ async def test_swarmctl_service_update_and_rollback():
         await node.stop()
 
 
+@requires_cryptography  # worker admission flows through CA cert issuance
 @async_test
 async def test_swarmctl_node_update_availability_and_labels():
     """`swarmctl node-update --availability drain` evicts the node's tasks
@@ -910,12 +911,19 @@ async def test_swarmctl_cluster_update_settings_flow_to_components():
         assert stored.spec.orchestration.task_history_retention_limit == 9
         assert stored.spec.dispatcher.heartbeat_period == 2.5
 
-        # token rotation changes the worker join token
+        # token rotation changes the worker join token; tokens pin the
+        # root CA digest, so a no-CA degraded cluster refuses the rotate
+        from swarmkit_tpu.ca.certificates import HAVE_CRYPTOGRAPHY
         old = stored.root_ca.join_token_worker
         rc, out = await ctl("cluster-update", "--rotate-worker-token")
-        assert rc == 0, out
-        new = lead.store.find("cluster")[0].root_ca.join_token_worker
-        assert new and new != old
+        if HAVE_CRYPTOGRAPHY:
+            assert rc == 0, out
+            new = lead.store.find("cluster")[0].root_ca.join_token_worker
+            assert new and new != old
+        else:
+            assert rc == 1
+            assert lead.store.find(
+                "cluster")[0].root_ca.join_token_worker == old
     finally:
         await node._ctl_server.stop()
         await node.stop()

@@ -17,7 +17,7 @@ from swarmkit_tpu.manager.controlapi import (
     AlreadyExists, ControlApi, FailedPrecondition, InvalidArgument, NotFound,
 )
 from swarmkit_tpu.store.memory import MemoryStore
-from tests.conftest import async_test
+from tests.conftest import async_test, requires_cryptography
 
 
 def api():
@@ -226,6 +226,7 @@ async def test_secret_lifecycle_and_redaction():
     await c.remove_secret(sec.id)
 
 
+@requires_cryptography
 @async_test
 async def test_cluster_update_and_token_rotation():
     from swarmkit_tpu.ca import RootCA

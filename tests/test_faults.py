@@ -123,7 +123,9 @@ async def test_delay_defers_replication_until_clock_advances():
 @async_test
 async def test_unreachable_peer_failure_counts_surface_in_status():
     """Consecutive delivery failures reach raft Node.status() through
-    report_unreachable, and clear once the peer is reachable again."""
+    report_unreachable — as {count, last_failure} so probe-flip debugging
+    can correlate against wall time — and clear once the peer is reachable
+    again."""
     h = RaftHarness()
     try:
         n1 = await h.add_node()
@@ -135,7 +137,9 @@ async def test_unreachable_peer_failure_counts_surface_in_status():
 
         h.network.set_down(victim.addr)
         await h.wait_for(lambda: lead.status()["peer_failures"].get(
-            victim.raft_id, 0) >= 2)
+            victim.raft_id, {"count": 0})["count"] >= 2)
+        info = lead.status()["peer_failures"][victim.raft_id]
+        assert info["last_failure"] <= lead.clock.now()
 
         h.network.set_down(victim.addr, down=False)
         await h.wait_for(lambda: victim.raft_id

@@ -1,0 +1,19 @@
+"""Suite wrapper for tools/metrics_lint.py: the catalog stays the single
+ground truth for every metric name in the tree (slow-marked; tier-1 skips
+it, the full suite runs it)."""
+
+import os
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+
+
+@pytest.mark.slow
+def test_metrics_lint_is_clean():
+    from metrics_lint import run_lint
+
+    problems = run_lint(REPO_ROOT)
+    assert not problems, "\n".join(problems)

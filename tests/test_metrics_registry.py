@@ -1,0 +1,242 @@
+"""Unit tests for the typed metrics registry: registration semantics,
+label-cardinality bounding, histogram bucket edges, and the Prometheus
+text exposition (format 0.0.4) including a golden render.
+"""
+
+import pytest
+
+from swarmkit_tpu.metrics import catalog
+from swarmkit_tpu.metrics.exposition import render_all, snapshot_all
+from swarmkit_tpu.metrics.registry import (
+    LabelCardinalityError, MetricError, MetricsRegistry,
+    OVERFLOW_LABEL_VALUE,
+)
+
+
+# ---------------------------------------------------------------------------
+# registration semantics
+
+
+def test_get_or_create_returns_same_family():
+    r = MetricsRegistry()
+    a = r.counter("swarm_x_total", "help.")
+    b = r.counter("swarm_x_total", "help.")
+    assert a is b
+
+
+def test_conflicting_schema_raises():
+    r = MetricsRegistry()
+    r.counter("swarm_x_total", "help.", ("node",))
+    with pytest.raises(MetricError):
+        r.gauge("swarm_x_total", "help.")
+    with pytest.raises(MetricError):
+        r.counter("swarm_x_total", "help.", ("peer",))
+
+
+def test_help_text_mandatory():
+    r = MetricsRegistry()
+    with pytest.raises(MetricError):
+        r.counter("swarm_x_total", "")
+    with pytest.raises(MetricError):
+        r.counter("swarm_x_total", "   ")
+
+
+def test_invalid_names_rejected():
+    r = MetricsRegistry()
+    with pytest.raises(MetricError):
+        r.counter("0bad", "help.")
+    with pytest.raises(MetricError):
+        r.counter("swarm_x_total", "help.", ("bad-label",))
+    with pytest.raises(MetricError):
+        r.counter("swarm_x_total", "help.", ("__reserved",))
+
+
+def test_counter_only_goes_up():
+    r = MetricsRegistry()
+    c = r.counter("swarm_x_total", "help.")
+    c.inc(2)
+    with pytest.raises(MetricError):
+        c.inc(-1)
+    assert c.value == 2
+
+
+def test_labels_schema_enforced():
+    r = MetricsRegistry()
+    c = r.counter("swarm_x_total", "help.", ("node",))
+    with pytest.raises(MetricError):
+        c.labels(peer="a")
+    with pytest.raises(MetricError):
+        c.inc()  # labelled family has no default series
+    c.labels(node="a").inc()
+    assert c.labels(node="a").value == 1
+
+
+def test_gauge_set_function_lazily_evaluated_and_fault_tolerant():
+    r = MetricsRegistry()
+    g = r.gauge("swarm_x", "help.")
+    box = [3.0]
+    g.set_function(lambda: box[0])
+    assert g.value == 3.0
+    box[0] = 7.0
+    assert g.value == 7.0
+
+    def boom():
+        raise RuntimeError("scrape must survive this")
+
+    g2 = r.gauge("swarm_y", "help.")
+    g2.set(5.0)
+    g2.set_function(boom)
+    assert g2.value == 5.0  # last good value, no raise
+
+
+# ---------------------------------------------------------------------------
+# label cardinality
+
+
+def test_cardinality_overflow_collapses_by_default():
+    r = MetricsRegistry()
+    c = r.counter("swarm_x_total", "help.", ("id",))
+    c.max_label_sets = 4  # direct attr: MAX_LABEL_SETS is the prod default
+    for i in range(10):
+        c.labels(id=str(i)).inc()
+    snap = c.snapshot()
+    # 4 real series plus the single overflow series holding the excess
+    assert snap[f"id={OVERFLOW_LABEL_VALUE}"] == 6.0
+    assert len(snap) == 5
+
+
+def test_cardinality_overflow_raises_in_strict_mode():
+    r = MetricsRegistry(strict=True)
+    c = r.counter("swarm_x_total", "help.", ("id",))
+    c.max_label_sets = 2
+    c.labels(id="a").inc()
+    c.labels(id="b").inc()
+    with pytest.raises(LabelCardinalityError):
+        c.labels(id="c")
+
+
+# ---------------------------------------------------------------------------
+# histogram bucket edges
+
+
+def test_histogram_bucket_edges_are_upper_inclusive():
+    r = MetricsRegistry()
+    h = r.histogram("swarm_x_seconds", "help.", buckets=(0.1, 1.0, 10.0))
+    h.observe(0.1)    # lands in le=0.1 (upper bound is inclusive)
+    h.observe(0.1001)  # lands in le=1.0
+    h.observe(10.0)   # lands in le=10.0
+    h.observe(99.0)   # overflow -> +Inf only
+    child = h.labels()
+    assert child.counts == [1, 1, 1, 1]
+    assert child.cumulative() == [1, 2, 3, 4]
+    assert child.count == 4
+    assert child.sum == pytest.approx(0.1 + 0.1001 + 10.0 + 99.0)
+
+
+def test_histogram_bucket_validation():
+    r = MetricsRegistry()
+    with pytest.raises(MetricError):
+        r.histogram("swarm_x_seconds", "help.", buckets=())
+    with pytest.raises(MetricError):
+        r.histogram("swarm_y_seconds", "help.", buckets=(1.0, 1.0))
+
+
+def test_histogram_timer_records_one_observation():
+    r = MetricsRegistry()
+    h = r.histogram("swarm_x_seconds", "help.", ("call",),
+                    buckets=(1.0, 60.0))
+    with h.labels(call="a").time():
+        pass
+    with h.labels(call="a").time():
+        pass
+    assert h.labels(call="a").count == 2
+
+    plain = r.histogram("swarm_y_seconds", "help.", buckets=(1.0, 60.0))
+    with plain.time():
+        pass
+    assert plain.labels().count == 1
+
+
+# ---------------------------------------------------------------------------
+# exposition
+
+
+GOLDEN = """\
+# HELP swarm_demo_depth Queue depth.
+# TYPE swarm_demo_depth gauge
+swarm_demo_depth 3
+# HELP swarm_demo_seconds Latency.
+# TYPE swarm_demo_seconds histogram
+swarm_demo_seconds_bucket{le="0.1"} 1
+swarm_demo_seconds_bucket{le="1"} 2
+swarm_demo_seconds_bucket{le="+Inf"} 3
+swarm_demo_seconds_sum 7.6
+swarm_demo_seconds_count 3
+# HELP swarm_demo_total Things done, by result.
+# TYPE swarm_demo_total counter
+swarm_demo_total{result="err"} 1
+swarm_demo_total{result="ok"} 2
+"""
+
+
+def test_exposition_golden():
+    r = MetricsRegistry()
+    c = r.counter("swarm_demo_total", "Things done, by result.", ("result",))
+    c.labels(result="ok").inc(2)
+    c.labels(result="err").inc()
+    g = r.gauge("swarm_demo_depth", "Queue depth.")
+    g.set(3)
+    h = r.histogram("swarm_demo_seconds", "Latency.", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(7.05)
+    assert r.render() == GOLDEN
+
+
+def test_exposition_escapes_label_values():
+    r = MetricsRegistry()
+    c = r.counter("swarm_x_total", "help.", ("path",))
+    c.labels(path='a"b\\c\nd').inc()
+    line = r.render().splitlines()[-1]
+    assert line == 'swarm_x_total{path="a\\"b\\\\c\\nd"} 1'
+
+
+def test_render_all_merges_three_surfaces():
+    from swarmkit_tpu.manager.metrics import Collector
+    from swarmkit_tpu.store.memory import MemoryStore
+    from swarmkit_tpu.utils.metrics import Registry as LegacyRegistry
+
+    typed = MetricsRegistry()
+    typed.counter("swarm_x_total", "help.").inc()
+    legacy = LegacyRegistry()
+    legacy.timer("swarm_store_read_tx_latency_seconds").observe(0.01)
+    collector = Collector(MemoryStore())
+    text = render_all(registry=typed, legacy_registry=legacy,
+                      collector_gauges=collector.snapshot())
+    assert "swarm_x_total 1" in text
+    assert "# TYPE swarm_store_read_tx_latency_seconds summary" in text
+    assert 'swarm_store_read_tx_latency_seconds{quantile="0.5"}' in text
+    assert "swarm_manager_leader 0" in text
+
+    snap = snapshot_all(registry=typed, legacy_registry=legacy,
+                        collector_gauges=collector.snapshot())
+    assert snap["metrics"]["swarm_x_total"] == 1.0
+    assert snap["timers"]["swarm_store_read_tx_latency_seconds"]["count"] == 1
+    assert snap["objects"]["swarm_manager_leader"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# catalog
+
+
+def test_catalog_instantiates_every_spec_in_strict_registry():
+    r = MetricsRegistry(strict=True)
+    for name in catalog.CATALOG:
+        fam = catalog.get(r, name)
+        assert fam.name == name and fam.help
+
+
+def test_catalog_rejects_unknown_names():
+    r = MetricsRegistry()
+    with pytest.raises(KeyError):
+        catalog.get(r, "swarm_made_up_total")

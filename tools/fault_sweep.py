@@ -48,6 +48,7 @@ if "xla_force_host_platform_device_count" not in _flags:
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from swarmkit_tpu.api import Annotations, Node as ApiNode, NodeSpec  # noqa: E402
+from swarmkit_tpu.metrics.registry import MetricsRegistry  # noqa: E402
 from swarmkit_tpu.raft.faults import FaultPlan  # noqa: E402
 from swarmkit_tpu.raft.node import Node, NodeOpts  # noqa: E402
 from swarmkit_tpu.raft.transport import Network  # noqa: E402
@@ -81,11 +82,23 @@ class _Cluster:
     def __init__(self, seed: int) -> None:
         self.seed = seed
         self.clock = self._make_clock()
+        # one typed registry per cluster: scenario assertions read counters
+        # that only this cluster's nodes/transports could have moved
+        self.obs = MetricsRegistry()
         self.network = self._make_network(seed)
         self.nodes: dict[str, Node] = {}
         self.tmp = tempfile.TemporaryDirectory(
             prefix=f"fault-sweep-{self.wire}-")
         self._n = 0
+
+    def counter_sum(self, name: str) -> float:
+        """Total of a counter family across all of its label sets."""
+        fam = self.obs.get(name)
+        if fam is None:
+            return 0.0
+        snap = fam.snapshot()
+        return (sum(snap.values()) if isinstance(snap, dict)
+                else float(snap))
 
     # wire-specific bits --------------------------------------------------
     def _make_clock(self):
@@ -120,6 +133,7 @@ class _Cluster:
             election_tick=4,
             heartbeat_tick=1,
             seed=self.seed + self._n,
+            obs_registry=self.obs,
         ))
 
     async def add_node(self, join_from: Optional[Node] = None) -> Node:
@@ -197,7 +211,7 @@ class _DeviceMeshCluster(_Cluster):
     def _make_network(self, seed: int):
         from swarmkit_tpu.transport import DeviceMeshNet
 
-        return DeviceMeshNet(seed=seed, rows=8)
+        return DeviceMeshNet(seed=seed, rows=8, obs=self.obs)
 
     def _decorate_opts(self, opts: NodeOpts) -> NodeOpts:
         from swarmkit_tpu.transport import DeviceMeshTransport
@@ -220,7 +234,8 @@ class _GrpcCluster(_Cluster):
 
         return GrpcNetwork(seed=seed, probe_interval=0.1, probe_timeout=0.5,
                            failure_threshold=2, grace_period=0.2,
-                           redial_backoff=0.05, redial_backoff_max=0.4)
+                           redial_backoff=0.05, redial_backoff_max=0.4,
+                           obs=self.obs)
 
     def _addr(self, node_id: str) -> str:
         return f"127.0.0.1:{_free_port()}"
@@ -323,6 +338,11 @@ async def _run_scenario(wire: str, plan_name: str, seed: int) -> dict:
         victim = next(n for n in sorted(h.nodes.values(),
                                         key=lambda n: n.node_id)
                       if n.running and n.raft_id != lead.raft_id)
+        # counter baselines: the fault window must be VISIBLE in the typed
+        # registry, not just survivable (see metrics assertion post-heal)
+        campaigns_before = h.counter_sum("swarm_raft_elections_started_total")
+        flips_before = h.counter_sum(
+            "swarm_transport_probe_transitions_total")
         plan = _build_plan(plan_name, h, lead, victim)
         plan.inject(h.network)
         if plan_name == "crash":
@@ -332,6 +352,29 @@ async def _run_scenario(wire: str, plan_name: str, seed: int) -> dict:
         committed = await _commit_while_stepping(h, lead, f"mid-{tag}")
         notes.append(f"commit under fault: "
                      f"{'ok' if committed else 'timed out (tolerated)'}")
+
+        # -- metrics oracle: the fault must be VISIBLE, not just survived --
+        # Hold the partition open until the isolated victim's election
+        # timeout fires (the majority commits fast, so the mid-commit alone
+        # may not span a timeout) and, on the probing wire, until the
+        # health prober flips the victim's state down.
+        if plan_name == "partition":
+            await h.wait_for(
+                lambda: h.counter_sum("swarm_raft_elections_started_total")
+                > campaigns_before,
+                "partition to register in the campaign counter")
+            notes.append(
+                f"campaigns {campaigns_before:.0f} -> "
+                f"{h.counter_sum('swarm_raft_elections_started_total'):.0f}")
+            if wire == "grpc":
+                await h.wait_for(
+                    lambda: h.counter_sum(
+                        "swarm_transport_probe_transitions_total")
+                    > flips_before,
+                    "partition to flip a prober state")
+                notes.append(
+                    f"probe flips {flips_before:.0f} -> "
+                    f"{h.counter_sum('swarm_transport_probe_transitions_total'):.0f}")
 
         # -- heal + liveness ----------------------------------------------
         plan.heal(h.network)

@@ -1,0 +1,130 @@
+"""Metric-name lint: the catalog is the single ground truth.
+
+Checks, in order:
+
+1. Every catalog spec instantiates cleanly into a strict registry —
+   catches bad names, empty help text, invalid label names, and
+   non-increasing histogram bucket edges through the registry's own
+   validation.
+2. No two specs render to colliding exposition series (a histogram's
+   ``_bucket``/``_sum``/``_count`` suffixes must not shadow another
+   family, and vice versa).
+3. Every ``swarm_*`` metric-name literal in the source tree (package +
+   tools + bench.py, tests excluded) resolves to a catalog entry, so an
+   instrumentation site cannot invent a name the scrape page never
+   documents.
+4. Every catalog entry is referenced somewhere outside the catalog —
+   dead specs rot; delete or wire them.
+
+Importable (``run_lint`` returns the problem list) so the pytest wrapper
+in tests/test_metrics_lint.py runs it in-suite; the CLI exits nonzero on
+any finding.
+
+Usage: python tools/metrics_lint.py
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_NAME = re.compile(r"^swarm_[a-z0-9_]+$")
+_HISTO_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _source_files(repo_root: str):
+    roots = (os.path.join(repo_root, "swarmkit_tpu"),
+             os.path.join(repo_root, "tools"))
+    for root in roots:
+        for dirpath, _dirs, files in os.walk(root):
+            for f in files:
+                if f.endswith(".py"):
+                    yield os.path.join(dirpath, f)
+    yield os.path.join(repo_root, "bench.py")
+
+
+def _metric_literals(path: str) -> set[str]:
+    """All string constants in `path` shaped like a swarm_ metric name."""
+    with open(path, encoding="utf-8") as f:
+        try:
+            tree = ast.parse(f.read(), filename=path)
+        except SyntaxError:
+            return set()
+    return {node.value for node in ast.walk(tree)
+            if isinstance(node, ast.Constant)
+            and isinstance(node.value, str) and _NAME.match(node.value)}
+
+
+def run_lint(repo_root: str | None = None) -> list[str]:
+    """Returns a list of human-readable problems (empty = clean)."""
+    from swarmkit_tpu.metrics import catalog
+    from swarmkit_tpu.metrics.registry import MetricError, MetricsRegistry
+
+    repo_root = repo_root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    problems: list[str] = []
+
+    # 1. every spec instantiates under strict validation
+    reg = MetricsRegistry(strict=True)
+    for name in catalog.CATALOG:
+        try:
+            catalog.get(reg, name)
+        except (MetricError, ValueError, KeyError) as e:
+            problems.append(f"catalog entry {name!r} is invalid: {e}")
+
+    # 2. exposition-series collisions across families
+    series: dict[str, str] = {}
+    for name, spec in catalog.CATALOG.items():
+        rendered = ([name + s for s in _HISTO_SUFFIXES]
+                    if spec.kind == "histogram" else [name])
+        for r in rendered:
+            if r in series:
+                problems.append(
+                    f"exposition collision: {name!r} renders series {r!r} "
+                    f"already produced by {series[r]!r}")
+            series[r] = name
+
+    # 3+4. cross-reference source literals with the catalog
+    catalog_path = os.path.join(
+        repo_root, "swarmkit_tpu", "metrics", "catalog.py")
+    used: set[str] = set()
+    for path in _source_files(repo_root):
+        if os.path.abspath(path) == os.path.abspath(catalog_path):
+            continue
+        for name in _metric_literals(path):
+            used.add(name)
+            if name in catalog.LEGACY_SERIES \
+                    or name.startswith(catalog.LEGACY_PREFIXES):
+                continue
+            base = name
+            for suf in _HISTO_SUFFIXES:
+                if name.endswith(suf) and name[:-len(suf)] in catalog.CATALOG:
+                    base = name[:-len(suf)]
+                    break
+            if base not in catalog.CATALOG:
+                problems.append(
+                    f"{os.path.relpath(path, repo_root)}: metric name "
+                    f"{name!r} is not in the catalog")
+    for name in catalog.CATALOG:
+        if name not in used:
+            problems.append(f"catalog entry {name!r} is never referenced "
+                            "outside the catalog (dead spec?)")
+    return problems
+
+
+def main() -> int:
+    from swarmkit_tpu.metrics import catalog
+    problems = run_lint()
+    for p in problems:
+        print(f"LINT: {p}")
+    print(f"{len(problems)} problem(s) across {len(catalog.CATALOG)} "
+          "catalog entries")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

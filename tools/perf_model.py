@@ -30,14 +30,26 @@ import jax.numpy as jnp  # noqa: E402
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from swarmkit_tpu.metrics import catalog as obs_catalog  # noqa: E402
+from swarmkit_tpu.metrics import registry as obs_registry  # noqa: E402
 from swarmkit_tpu.raft.sim import (  # noqa: E402
     SimConfig, committed_entries, has_leader, init_state, run_ticks,
     run_until_leader,
 )
 from swarmkit_tpu.raft.sim.kernel import _idx_at_slots, _is_conf  # noqa: E402
+from swarmkit_tpu.raft.sim.run import KernelObs  # noqa: E402
 
 I32 = jnp.int32
 U32 = jnp.uint32
+
+OBS = KernelObs()  # feeds swarm_kernel_tick_seconds on the default registry
+
+
+def _phase_gauge(phase: str, ms: float) -> None:
+    """Publish one micro-kernel row as swarm_kernel_phase_ms{phase=...} so
+    PERF.md's attribution table is also a live gauge family."""
+    obs_catalog.get(OBS.obs, "swarm_kernel_phase_ms").labels(
+        phase=phase).set(ms)
 
 
 def steady_rate(n: int, ticks: int = 64, static: bool = False, **kw):
@@ -46,19 +58,25 @@ def steady_rate(n: int, ticks: int = 64, static: bool = False, **kw):
                     max_props=2048, keep=500, seed=42, election_tick=16,
                     static_members=static, **kw)
     st = init_state(cfg)
-    st, _ = run_until_leader(st, cfg, max_ticks=512)
-    jax.block_until_ready(st.term)
+    with OBS.timed("run_until_leader"):
+        st, _ = run_until_leader(st, cfg, max_ticks=512)
+        jax.block_until_ready(st.term)
     assert bool(has_leader(st)), f"no leader at n={n}"
     warm, _ = run_ticks(st, cfg, ticks, prop_count=cfg.max_props)
     jax.block_until_ready(warm.commit)
     best = float("inf")
     for _ in range(3):
-        t0 = time.perf_counter()
-        fin, _ = run_ticks(st, cfg, ticks, prop_count=cfg.max_props)
-        jax.block_until_ready(fin.commit)
+        with OBS.timed("run_ticks"):
+            t0 = time.perf_counter()
+            fin, _ = run_ticks(st, cfg, ticks, prop_count=cfg.max_props)
+            jax.block_until_ready(fin.commit)
         best = min(best, time.perf_counter() - t0)
     ents = int(committed_entries(fin)) - int(committed_entries(st))
-    return best / ticks * 1e3, ents / best
+    rate = ents / best
+    g = obs_catalog.get(OBS.obs, "swarm_bench_entries_per_second")
+    g.labels(config=f"perf-model-n{n}-"
+             f"{'static' if static else 'dynamic'}").set(rate)
+    return best / ticks * 1e3, rate
 
 
 def _time_jit(fn, *args, reps: int = 20):
@@ -123,7 +141,19 @@ def micro_phases(n: int, L: int = 8192):
 
     rows["(context) apply+checksum pass [N,L]"] = _time_jit(
         apply_chk, log_data, last, applied, commit)
+    for k, v in rows.items():
+        _phase_gauge(f"{_PHASE_SLUGS.get(k, k)}@n{n}", v)
     return rows
+
+
+_PHASE_SLUGS = {
+    "views: n_mem sum + quorum [N,N]->[N]": "views",
+    "mask: one granted&member reduction [N,N]": "vote-mask",
+    "unmasked equivalent [N,N]": "vote-unmasked",
+    "commit bisect mask: where(member,match,-1) [N,N]": "commit-bisect",
+    "Phase E conf decode + hup/tail scans [N,L]x3": "E-conf-scan",
+    "(context) apply+checksum pass [N,L]": "apply-chk",
+}
 
 
 def main():
@@ -155,6 +185,14 @@ def main():
         print("|---|---|")
         for k, v in micro_phases(n).items():
             print(f"| {k} | {v:.3f} |")
+
+    # everything above also landed in the typed registry (the same families
+    # a live manager scrape serves) — render it so the report doubles as an
+    # exposition-format example for README.md's Observability section
+    print("\n## Live metrics (registry render)\n")
+    print("```")
+    print(obs_registry.DEFAULT.render().rstrip())
+    print("```")
 
 
 if __name__ == "__main__":
