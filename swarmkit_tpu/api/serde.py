@@ -13,6 +13,7 @@ from __future__ import annotations
 import base64
 import dataclasses
 import enum
+import hashlib
 import json
 import sys
 import typing
@@ -134,10 +135,14 @@ class Message:
                           separators=(",", ":")).encode()
 
     def fingerprint(self) -> int:
-        """Stable per-process fingerprint of the canonical encoding —
-        plays the reference's SpecVersion role wherever spec-change
-        detection is needed (restart history, scheduler failure taints)."""
-        return hash(self.encode())
+        """Stable fingerprint of the canonical encoding — plays the
+        reference's SpecVersion role wherever spec-change detection is
+        needed (restart history, scheduler failure taints).  blake2b, not
+        hash(): str/bytes hashing is salted per process
+        (PYTHONHASHSEED), and these fingerprints outlive a process via
+        WAL/snapshot restore and cross-manager comparison."""
+        return int.from_bytes(
+            hashlib.blake2b(self.encode(), digest_size=8).digest(), "big")
 
     @classmethod
     def decode(cls, raw: bytes):

@@ -1,0 +1,48 @@
+"""Deterministic simulation testing (DST) for the batched raft kernel.
+
+FoundationDB-style schedule search at XLA speed: the tick kernel already
+advances N simulated managers as rows of device arrays, so exploring S
+adversarial fault schedules is ONE more leading vmap axis — S x N clusters
+advance per tick in a single jitted scan, with raft's safety properties
+checked on device every tick (see PAPERS.md: Raft in mCRL2 arXiv:2403.18916
+and LNT arXiv:2004.13284 do this by explicit-state model checking; "From
+Consensus to Chaos" arXiv:2601.00273 by searching fault schedules).
+
+Layout:
+
+- :mod:`schedule`  — `FaultSchedule` (stacked per-tick drop/partition
+  matrices, crash windows, adversary gates) + the seeded `jax.random`
+  generator and its named adversary profiles.
+- :mod:`invariants` — on-device checkers (ElectionSafety, LogMatching,
+  LeaderCompleteness, commit monotonicity, applied-checksum agreement)
+  reduced into a per-schedule violation bitmask.
+- :mod:`explore`   — `explore()`: the vmapped scan driver.
+- :mod:`repro`     — counterexample pipeline: host extraction, differential
+  oracle replay (field-level trace), greedy shrinking, seed-pinned JSON
+  artifacts replayable by ``tools/dst_sweep.py``.
+"""
+
+from swarmkit_tpu.dst.schedule import (
+    PROFILES, FaultSchedule, from_fault_plan, make_batch, make_schedule,
+)
+from swarmkit_tpu.dst.invariants import (
+    BIT_NAMES, CHECKSUM_AGREEMENT, COMMIT_MONOTONIC, ELECTION_SAFETY,
+    LEADER_COMPLETENESS, LOG_MATCHING, bits_to_names, check_state,
+    check_transition,
+)
+from swarmkit_tpu.dst.explore import ExploreResult, explore
+from swarmkit_tpu.dst.repro import (
+    fault_count, from_artifact, load_artifact, oracle_trace, replay,
+    replay_artifact, save_artifact, shrink, to_artifact,
+)
+
+__all__ = [
+    "PROFILES", "FaultSchedule", "from_fault_plan", "make_batch",
+    "make_schedule",
+    "BIT_NAMES", "CHECKSUM_AGREEMENT", "COMMIT_MONOTONIC", "ELECTION_SAFETY",
+    "LEADER_COMPLETENESS", "LOG_MATCHING", "bits_to_names", "check_state",
+    "check_transition",
+    "ExploreResult", "explore",
+    "fault_count", "from_artifact", "load_artifact", "oracle_trace",
+    "replay", "replay_artifact", "save_artifact", "shrink", "to_artifact",
+]

@@ -1,0 +1,170 @@
+"""Batched schedule exploration: one jitted scan over S x N clusters.
+
+`explore()` broadcasts one init state across a leading schedule axis S and
+vmaps the tick kernel over it, so every tick advances S independent
+clusters — each under its own `FaultSchedule` — in a single XLA program.
+The invariant checkers (:mod:`invariants`) run inside the same scan as
+vectorized reductions and OR into a per-schedule violation bitmask; the
+host sees only [S] masks and first-violation ticks.
+
+The S axis is data-parallel, so when the process has several devices (the
+CPU test mesh forces 8) the batch is sharded across them through the same
+`parallel` helpers the sim kernel uses for its row axis.
+
+The `mutation` knob compiles a DELIBERATELY broken kernel variant (e.g.
+``commit_no_quorum``) — the detection self-test: the checkers must catch
+it and the repro pipeline must shrink it (tools/dst_sweep.py --mutate).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from swarmkit_tpu import parallel
+from swarmkit_tpu.dst.invariants import (
+    ALL_BITS, BIT_NAMES, check_state, check_transition,
+)
+from swarmkit_tpu.dst.schedule import FaultSchedule, effective_faults
+from swarmkit_tpu.raft.sim.kernel import propose_dense, step
+from swarmkit_tpu.raft.sim.run import _payload_at
+from swarmkit_tpu.raft.sim.state import LEADER, SimConfig, SimState
+
+I32 = jnp.int32
+
+MUTATIONS = ("commit_no_quorum",)
+
+
+def apply_mutation(state: SimState, cfg: SimConfig,
+                   mutation: Optional[str]) -> SimState:
+    """Post-step state corruption implementing a named kernel bug."""
+    if mutation is None:
+        return state
+    if mutation == "commit_no_quorum":
+        # a leader commits its whole log without waiting for a quorum of
+        # match acks — invisible while messages flow (the synchronous wire
+        # acks within the tick) but fatal once a minority leader keeps
+        # accepting proposals behind a partition
+        leaders = state.role == LEADER
+        commit = jnp.where(leaders, jnp.maximum(state.commit, state.last),
+                           state.commit)
+        return dataclasses.replace(state, commit=commit)
+    raise KeyError(f"unknown mutation {mutation!r}; known: {MUTATIONS}")
+
+
+def broadcast_state(state: SimState, schedules: int) -> SimState:
+    """Stack one init state S times along a new leading axis."""
+    return jax.tree_util.tree_map(
+        lambda a: jnp.broadcast_to(a, (schedules,) + a.shape), state)
+
+
+def _tick_one(st: SimState, cfg: SimConfig, drop_t, alive_t, tl_t, cc_t,
+              prop_count: int, mutation: Optional[str]):
+    """Advance ONE cluster one tick under its schedule slice; returns the
+    new state and this tick's violation bits."""
+    alive, drop = effective_faults(st.role, drop_t, alive_t, tl_t, cc_t)
+    if prop_count:
+        st = propose_dense(st, cfg, _payload_at,
+                           jnp.asarray(prop_count, I32), alive=alive)
+    new = step(st, cfg, alive=alive, drop=drop)
+    new = apply_mutation(new, cfg, mutation)
+    bits = check_state(new, cfg) | check_transition(st, new)
+    return new, bits
+
+
+@partial(jax.jit, static_argnames=("cfg", "prop_count", "mutation"))
+def _explore_compiled(batched: SimState, cfg: SimConfig,
+                      schedule: FaultSchedule, prop_count: int,
+                      mutation: Optional[str]):
+    """scan over T of vmap over S. Returns (final, viol [S], first [S])."""
+    # scan consumes xs with a leading T axis; schedules batch as [S, T, ..]
+    xs = jax.tree_util.tree_map(lambda a: jnp.moveaxis(a, 1, 0), schedule)
+
+    def body(carry, sched_t):
+        st, acc = carry
+        new, bits = jax.vmap(
+            lambda s, d, a, tl, cc: _tick_one(s, cfg, d, a, tl, cc,
+                                              prop_count, mutation)
+        )(st, sched_t.drop, sched_t.alive, sched_t.target_leader,
+          sched_t.crash_campaign)
+        return (new, acc | bits), bits
+
+    schedules = schedule.target_leader.shape[0]
+    init = (batched, jnp.zeros((schedules,), jnp.uint32))
+    (final, viol), bits_by_tick = jax.lax.scan(body, init, xs)  # [T, S]
+    any_t = bits_by_tick > 0
+    first = jnp.where(jnp.any(any_t, axis=0),
+                      jnp.argmax(any_t, axis=0).astype(I32), -1)
+    return final, viol, first, bits_by_tick
+
+
+@dataclass
+class ExploreResult:
+    viol: np.ndarray          # [S] uint32 violation bitmasks
+    first_tick: np.ndarray    # [S] int32 first violating tick, -1 = clean
+    bits_by_tick: np.ndarray  # [T, S] per-tick bitmasks (diagnostics)
+    final_state: SimState
+    profiles: list            # profile name per schedule index (may be [])
+    elapsed: float
+    schedules_per_sec: float
+
+    @property
+    def violating(self) -> np.ndarray:
+        return np.nonzero(self.viol)[0]
+
+
+def explore(state: SimState, cfg: SimConfig, schedule: FaultSchedule,
+            profiles=(), prop_count: int = 2,
+            mutation: Optional[str] = None, shard: bool = True,
+            obs=None) -> ExploreResult:
+    """Run every schedule in the batch to completion and check invariants.
+
+    `state` is ONE cluster's init state (broadcast internally);
+    `schedule` is a [S, T, ...] batch from `schedule.make_batch`.
+    """
+    from swarmkit_tpu.metrics import catalog
+    from swarmkit_tpu.metrics import registry as obs_registry
+
+    schedules = schedule.target_leader.shape[0]
+    batched = broadcast_state(state, schedules)
+    if shard and len(jax.devices()) > 1:
+        mesh = parallel.schedule_mesh(schedules)
+        batched = parallel.shard_rows(batched, mesh,
+                                      axis=parallel.SCHEDULE_AXIS)
+        schedule = parallel.shard_rows(schedule, mesh,
+                                       axis=parallel.SCHEDULE_AXIS)
+
+    t0 = time.monotonic()
+    final, viol, first, bits = _explore_compiled(
+        batched, cfg, schedule, prop_count, mutation)
+    viol = np.asarray(jax.device_get(viol))
+    first = np.asarray(jax.device_get(first))
+    bits = np.asarray(jax.device_get(bits))
+    elapsed = time.monotonic() - t0
+    rate = schedules / elapsed if elapsed > 0 else float("inf")
+
+    obs = obs or obs_registry.DEFAULT
+    m_sched = catalog.get(obs, "swarm_dst_schedules_total")
+    m_viol = catalog.get(obs, "swarm_dst_violations_total")
+    m_rate = catalog.get(obs, "swarm_dst_schedules_per_second")
+    clean = int((viol == 0).sum())
+    if clean:
+        m_sched.labels(result="clean").inc(clean)
+    if schedules - clean:
+        m_sched.labels(result="violation").inc(schedules - clean)
+    for bit in ALL_BITS:
+        hits = int(((viol & bit) != 0).sum())
+        if hits:
+            m_viol.labels(invariant=BIT_NAMES[bit]).inc(hits)
+    m_rate.labels(config=f"n{cfg.n}x{schedule.ticks}t").set(rate)
+
+    return ExploreResult(viol=viol, first_tick=first, bits_by_tick=bits,
+                         final_state=final, profiles=list(profiles),
+                         elapsed=elapsed, schedules_per_sec=rate)

@@ -1,0 +1,114 @@
+"""On-device raft safety checkers, reduced to a violation bitmask.
+
+Each checker is a vectorized reduction over one cluster's SimState — no
+host round trip, so the explore scan evaluates all of them for S x N
+clusters every tick at array cost.  The formulations are the observable
+forms of the Raft paper's Figure 3 properties (the mCRL2/LNT encodings in
+PAPERS.md check the same five):
+
+ELECTION_SAFETY      at most one leader per term among current leaders.
+LOG_MATCHING         if two logs hold the same (index, term), the entries
+                     carry the same payload.
+LEADER_COMPLETENESS  a leader at the current globally-maximal term holds
+                     every committed entry (last >= max commit).  Sound:
+                     any commit reflected in some row's commit index was
+                     decided at a term <= the global max term; if decided
+                     AT the max term, the unique max-term leader decided
+                     it himself — either way the entry is in his log.
+                     Stale minority leaders (term < max) are exempt, as
+                     the property requires.
+COMMIT_MONOTONIC     per-row commit/applied never regress across one tick,
+                     and applied never passes commit (transition check).
+CHECKSUM_AGREEMENT   equal applied index => equal applied-state checksum
+                     (state-machine safety; sourced through
+                     ``run.quorum_applied_checksum``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from swarmkit_tpu.raft.sim.run import quorum_applied_checksum
+from swarmkit_tpu.raft.sim.state import LEADER, SimConfig, SimState
+
+U32 = jnp.uint32
+
+ELECTION_SAFETY = 1 << 0
+LOG_MATCHING = 1 << 1
+LEADER_COMPLETENESS = 1 << 2
+COMMIT_MONOTONIC = 1 << 3
+CHECKSUM_AGREEMENT = 1 << 4
+
+BIT_NAMES = {
+    ELECTION_SAFETY: "election_safety",
+    LOG_MATCHING: "log_matching",
+    LEADER_COMPLETENESS: "leader_completeness",
+    COMMIT_MONOTONIC: "commit_monotonic",
+    CHECKSUM_AGREEMENT: "checksum_agreement",
+}
+ALL_BITS = tuple(BIT_NAMES)
+
+
+def bits_to_names(bits: int) -> list[str]:
+    return [name for bit, name in BIT_NAMES.items() if bits & bit]
+
+
+def _bit(cond, bit: int):
+    return jnp.where(cond, jnp.uint32(bit), jnp.uint32(0))
+
+
+def _live_index(state: SimState, cfg: SimConfig):
+    """Per (row, slot): the live 1-based log index stored there, and its
+    validity.  Slot of index i is (i-1) % L and the ring holds
+    (snap_idx, last], so slot l of row r holds index
+    snap_idx[r] + 1 + ((l - snap_idx[r]) mod L) iff that is <= last[r]."""
+    L = cfg.log_len
+    slot = jnp.arange(L, dtype=jnp.int32)[None, :]
+    snap = state.snap_idx[:, None]
+    idx = snap + 1 + jnp.mod(slot - snap, L)
+    return idx, idx <= state.last[:, None]
+
+
+def check_state(state: SimState, cfg: SimConfig) -> jnp.ndarray:
+    """uint32 bitmask of the per-tick (non-transition) invariants."""
+    leaders = state.role == LEADER
+
+    # -- ELECTION_SAFETY: no two current leaders share a term
+    lterm = jnp.where(leaders, state.term, -1)
+    same = (lterm[:, None] == lterm[None, :]) \
+        & leaders[:, None] & leaders[None, :] \
+        & ~jnp.eye(cfg.n, dtype=bool)
+    elect = _bit(jnp.any(same), ELECTION_SAFETY)
+
+    # -- LOG_MATCHING: same (index, term) in two rings => same payload
+    idx, valid = _live_index(state, cfg)
+    both = valid[:, None, :] & valid[None, :, :]    # [N, N, L] (ring slots
+    # are index-determined, so idx equality per slot is snap-independent
+    # only when snaps differ mod L — compare explicitly to stay exact)
+    same_idx = idx[:, None, :] == idx[None, :, :]
+    same_term = state.log_term[:, None, :] == state.log_term[None, :, :]
+    diff_data = state.log_data[:, None, :] != state.log_data[None, :, :]
+    match = _bit(jnp.any(both & same_idx & same_term & diff_data),
+                 LOG_MATCHING)
+
+    # -- LEADER_COMPLETENESS: max-term leaders hold every committed entry
+    top = leaders & (state.term == jnp.max(state.term))
+    complete = _bit(jnp.any(top & (state.last < jnp.max(state.commit))),
+                    LEADER_COMPLETENESS)
+
+    # -- CHECKSUM_AGREEMENT: equal applied => equal checksum
+    applied, chk = quorum_applied_checksum(state)
+    agree = (applied[:, None] == applied[None, :]) \
+        & (chk[:, None] != chk[None, :])
+    chk_bit = _bit(jnp.any(agree), CHECKSUM_AGREEMENT)
+
+    return elect | match | complete | chk_bit
+
+
+def check_transition(prev: SimState, new: SimState) -> jnp.ndarray:
+    """uint32 bitmask of the across-one-tick invariants (the kernel models
+    durable state: even a crashed/restarted row never loses its commit)."""
+    regress = jnp.any(new.commit < prev.commit) \
+        | jnp.any(new.applied < prev.applied) \
+        | jnp.any(new.applied > new.commit)
+    return _bit(regress, COMMIT_MONOTONIC)

@@ -1,0 +1,332 @@
+"""Counterexample pipeline: replay, field-level oracle trace, shrinking,
+and seed-pinned JSON repro artifacts.
+
+A violating schedule index found by `explore()` flows through four steps:
+
+1. `replay()` — re-run the single schedule through the same compiled tick
+   path and confirm the violation bits + first tick reproduce (the whole
+   subsystem is counter-seeded, so this is exact, not statistical).
+2. `oracle_trace()` — drive the schedule tick-by-tick through BOTH the
+   kernel and the host differential oracle (`raft/sim/oracle.py`) and
+   record the first tick where any comparable field diverges — the
+   field-level trace that localizes a kernel bug.
+3. `shrink()` — greedy delta-debugging over the schedule arrays: clear
+   tick chunks, then whole edges, then whole-row outages, keeping each
+   clearing iff the violation persists.  Minimal repros replay in
+   milliseconds instead of re-searching.
+4. `to_artifact()`/`save_artifact()` — dump the shrunk schedule (sparse),
+   the SimConfig, and the pinned provenance (sweep seed, profile, index,
+   mutation) as JSON; ``tools/dst_sweep.py --replay`` re-runs it through
+   steps 1-2, turning every caught bug into a one-command regression.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from swarmkit_tpu.dst.explore import _tick_one
+from swarmkit_tpu.dst.invariants import bits_to_names
+from swarmkit_tpu.dst.schedule import FaultSchedule
+from swarmkit_tpu.raft.sim.state import CANDIDATE, LEADER, SimConfig, \
+    init_state
+
+ARTIFACT_VERSION = 1
+
+
+# ---------------------------------------------------------------------------
+# single-schedule replay (the shrinker's oracle — compiled once, ~ms/call)
+
+
+@partial(jax.jit, static_argnames=("cfg", "prop_count", "mutation"))
+def _replay_compiled(state, cfg: SimConfig, schedule: FaultSchedule,
+                     prop_count: int, mutation: Optional[str]):
+    def body(carry, sched_t):
+        st, acc = carry
+        new, bits = _tick_one(st, cfg, sched_t.drop, sched_t.alive,
+                              sched_t.target_leader, sched_t.crash_campaign,
+                              prop_count, mutation)
+        return (new, acc | bits), bits
+
+    init = (state, jnp.uint32(0))
+    (final, viol), bits = jax.lax.scan(body, init, schedule)
+    any_t = bits > 0
+    first = jnp.where(jnp.any(any_t), jnp.argmax(any_t), -1)
+    return viol, first.astype(jnp.int32)
+
+
+def replay(cfg: SimConfig, schedule: FaultSchedule, prop_count: int = 2,
+           mutation: Optional[str] = None) -> tuple[int, int]:
+    """(violation bits, first violating tick or -1) for ONE schedule."""
+    schedule = jax.tree_util.tree_map(jnp.asarray, schedule)
+    viol, first = _replay_compiled(init_state(cfg), cfg, schedule,
+                                   prop_count, mutation)
+    return int(viol), int(first)
+
+
+# ---------------------------------------------------------------------------
+# greedy shrinking
+
+
+def fault_count(schedule: FaultSchedule) -> int:
+    """Total injected fault-events: dropped edge-ticks + downed row-ticks
+    + active adversary-gate ticks (the shrinker's minimization metric)."""
+    return (int(np.asarray(schedule.drop).sum())
+            + int((~np.asarray(schedule.alive)).sum())
+            + int(np.asarray(schedule.target_leader).sum())
+            + int(np.asarray(schedule.crash_campaign).sum()))
+
+
+def _clear_ticks(arrs: dict, lo: int, hi: int) -> dict:
+    out = {k: v.copy() for k, v in arrs.items()}
+    out["drop"][lo:hi] = False
+    out["alive"][lo:hi] = True
+    out["target_leader"][lo:hi] = False
+    out["crash_campaign"][lo:hi] = False
+    return out
+
+
+def shrink(cfg: SimConfig, schedule: FaultSchedule, required_bits: int,
+           prop_count: int = 2, mutation: Optional[str] = None,
+           obs=None) -> tuple[FaultSchedule, int]:
+    """Greedily drop faults while any of `required_bits` still trips.
+
+    Returns (minimal schedule, replay evaluations spent).  Three passes:
+    tick chunks at halving granularity (ddmin-style), then whole directed
+    edges, then whole-row crash histories and the adversary gates.
+    """
+    from swarmkit_tpu.metrics import catalog
+    from swarmkit_tpu.metrics import registry as obs_registry
+
+    obs = obs or obs_registry.DEFAULT
+    m_rounds = catalog.get(obs, "swarm_dst_shrink_rounds_total")
+    evals = 0
+
+    arrs = {f.name: np.asarray(getattr(schedule, f.name)).copy()
+            for f in dataclasses.fields(schedule)}
+
+    def still_fails(cand: dict) -> bool:
+        nonlocal evals
+        evals += 1
+        viol, _ = replay(cfg, FaultSchedule(**cand), prop_count, mutation)
+        hit = bool(viol & required_bits)
+        m_rounds.labels(result="required" if not hit else "removed").inc()
+        return hit
+
+    ticks = arrs["target_leader"].shape[0]
+
+    # pass 1: clear tick windows, halving the chunk size
+    size = max(1, ticks // 2)
+    while size >= 1:
+        lo = 0
+        while lo < ticks:
+            hi = min(ticks, lo + size)
+            cand = _clear_ticks(arrs, lo, hi)
+            if any((cand[k] != arrs[k]).any() for k in arrs) \
+                    and still_fails(cand):
+                arrs = cand
+            lo = hi
+        if size == 1:
+            break
+        size //= 2
+
+    # pass 2: clear whole directed edges
+    for i in range(cfg.n):
+        for j in range(cfg.n):
+            if arrs["drop"][:, i, j].any():
+                cand = {k: v.copy() for k, v in arrs.items()}
+                cand["drop"][:, i, j] = False
+                if still_fails(cand):
+                    arrs = cand
+
+    # pass 3: clear whole-row outages, then each adversary gate
+    for r in range(cfg.n):
+        if (~arrs["alive"][:, r]).any():
+            cand = {k: v.copy() for k, v in arrs.items()}
+            cand["alive"][:, r] = True
+            if still_fails(cand):
+                arrs = cand
+    for gate in ("target_leader", "crash_campaign"):
+        if arrs[gate].any():
+            cand = {k: v.copy() for k, v in arrs.items()}
+            cand[gate][:] = False
+            if still_fails(cand):
+                arrs = cand
+
+    return FaultSchedule(**{k: jnp.asarray(v) for k, v in arrs.items()}), \
+        evals
+
+
+# ---------------------------------------------------------------------------
+# differential-oracle replay (field-level trace)
+
+_VIEW_FIELDS = ("term", "vote", "role", "lead", "last", "commit", "applied",
+                "apply_chk", "member")
+
+
+def _kernel_view(state) -> dict:
+    return {f: np.asarray(getattr(state, f)) for f in _VIEW_FIELDS}
+
+
+def oracle_trace(cfg: SimConfig, schedule: FaultSchedule,
+                 prop_count: int = 2, mutation: Optional[str] = None,
+                 stop_after_first: bool = True) -> dict:
+    """Replay one schedule through kernel AND host oracle, comparing every
+    comparable field per tick (the `run_differential` protocol).
+
+    The state-conditioned gates are resolved against the KERNEL's pre-step
+    roles on host, and the realized (alive, drop) arrays feed both sides —
+    so a mutated (or genuinely buggy) kernel diverges from the correct
+    oracle at a deterministic tick, and the returned trace names the first
+    differing fields with both sides' values.
+    """
+    from swarmkit_tpu.raft.sim.kernel import propose, step
+    from swarmkit_tpu.raft.sim.oracle import OracleCluster
+    from swarmkit_tpu.dst.explore import apply_mutation
+
+    _step = jax.jit(step, static_argnames=("cfg",))
+    _propose = jax.jit(propose, static_argnames=("cfg",))
+    _mutate = jax.jit(apply_mutation, static_argnames=("cfg", "mutation"))
+
+    state = init_state(cfg)
+    oracle = OracleCluster(cfg)
+    n = cfg.n
+    drop_s = np.asarray(schedule.drop)
+    alive_s = np.asarray(schedule.alive)
+    tl_s = np.asarray(schedule.target_leader)
+    cc_s = np.asarray(schedule.crash_campaign)
+
+    trace: list[dict] = []
+    diverged_at = -1
+    for t in range(schedule.ticks):
+        role = np.asarray(state.role)
+        leaders = role == LEADER
+        drop = drop_s[t] | (tl_s[t] & (leaders[:, None] | leaders[None, :]))
+        alive = alive_s[t] & ~(cc_s[t] & (role == CANDIDATE))
+
+        payloads = np.zeros(cfg.max_props, np.uint32)
+        if prop_count:
+            tick = int(np.asarray(state.tick))
+            k = np.arange(prop_count, dtype=np.uint32)
+            payloads[:prop_count] = \
+                (np.uint32(tick) * np.uint32(1 << 16) + k + np.uint32(1)) \
+                & np.uint32(0x7FFFFFFF)
+            state = _propose(state, cfg, jnp.asarray(payloads),
+                             jnp.asarray(prop_count, jnp.int32),
+                             alive=jnp.asarray(alive))
+        state = _step(state, cfg, alive=jnp.asarray(alive),
+                      drop=jnp.asarray(drop))
+        state = _mutate(state, cfg, mutation)
+        oracle.tick(alive, drop, payloads, prop_count)
+
+        kv = _kernel_view(state)
+        ov = oracle.view()
+        diffs = [f for f in _VIEW_FIELDS
+                 if not np.array_equal(kv[f], getattr(ov, f))]
+        if diffs:
+            if diverged_at < 0:
+                diverged_at = t
+            trace.append({
+                "tick": t,
+                "fields": diffs,
+                "kernel": {f: kv[f].tolist() for f in diffs},
+                "oracle": {f: np.asarray(getattr(ov, f)).tolist()
+                           for f in diffs},
+            })
+            if stop_after_first:
+                break
+    return {"diverged_at": diverged_at, "trace": trace}
+
+
+# ---------------------------------------------------------------------------
+# JSON artifacts (seed-pinned, sparse, replayable by tools/dst_sweep.py)
+
+
+def to_artifact(cfg: SimConfig, schedule: FaultSchedule, *, seed: int,
+                profile: str, index: int, prop_count: int,
+                mutation: Optional[str], viol: int,
+                first_tick: int) -> dict:
+    """Sparse JSON form of one (usually shrunk) repro schedule."""
+    drop = np.asarray(schedule.drop)
+    alive = np.asarray(schedule.alive)
+    t, i, j = np.nonzero(drop)
+    dt, dr = np.nonzero(~alive)
+    return {
+        "version": ARTIFACT_VERSION,
+        "seed": seed,
+        "profile": profile,
+        "index": index,
+        "cfg": dataclasses.asdict(cfg),
+        "ticks": int(schedule.ticks),
+        "prop_count": prop_count,
+        "mutation": mutation,
+        "violation_bits": viol,
+        "violations": bits_to_names(viol),
+        "first_tick": first_tick,
+        "fault_count": fault_count(schedule),
+        "faults": {
+            "drop": np.stack([t, i, j], axis=1).tolist(),
+            "down": np.stack([dt, dr], axis=1).tolist(),
+            "target_leader":
+                np.nonzero(np.asarray(schedule.target_leader))[0].tolist(),
+            "crash_campaign":
+                np.nonzero(np.asarray(schedule.crash_campaign))[0].tolist(),
+        },
+    }
+
+
+def from_artifact(art: dict):
+    """(cfg, schedule, prop_count, mutation) reconstructed from JSON."""
+    if art.get("version") != ARTIFACT_VERSION:
+        raise ValueError(f"unsupported artifact version {art.get('version')}")
+    cfg = SimConfig(**art["cfg"])
+    ticks, n = art["ticks"], cfg.n
+    drop = np.zeros((ticks, n, n), bool)
+    alive = np.ones((ticks, n), bool)
+    tl = np.zeros(ticks, bool)
+    cc = np.zeros(ticks, bool)
+    for t, i, j in art["faults"]["drop"]:
+        drop[t, i, j] = True
+    for t, r in art["faults"]["down"]:
+        alive[t, r] = False
+    tl[art["faults"]["target_leader"]] = True
+    cc[art["faults"]["crash_campaign"]] = True
+    schedule = FaultSchedule(drop=jnp.asarray(drop), alive=jnp.asarray(alive),
+                             target_leader=jnp.asarray(tl),
+                             crash_campaign=jnp.asarray(cc))
+    return cfg, schedule, art["prop_count"], art["mutation"]
+
+
+def save_artifact(path: str, art: dict) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(art, f, indent=1, sort_keys=True)
+
+
+def load_artifact(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def replay_artifact(art, with_trace: bool = True) -> dict:
+    """Re-run an artifact: the recorded violation must reproduce exactly
+    (bits AND first tick).  Returns the verdict + optional oracle trace."""
+    if isinstance(art, str):
+        art = load_artifact(art)
+    cfg, schedule, prop_count, mutation = from_artifact(art)
+    viol, first = replay(cfg, schedule, prop_count, mutation)
+    out = {
+        "violation_bits": viol,
+        "violations": bits_to_names(viol),
+        "first_tick": first,
+        "matches_recorded": (viol == art["violation_bits"]
+                             and first == art["first_tick"]),
+    }
+    if with_trace:
+        out["oracle"] = oracle_trace(cfg, schedule, prop_count, mutation)
+    return out

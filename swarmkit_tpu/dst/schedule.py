@@ -1,0 +1,241 @@
+"""Fault schedules: stacked device arrays + the seeded adversary generator.
+
+A `FaultSchedule` is the compiled, data-only form of an adversary: per-tick
+base drop matrices and liveness masks plus two STATE-CONDITIONED gates
+(`target_leader`, `crash_campaign`) that the explore/replay drivers resolve
+against the cluster's current roles each tick.  The gates make the two
+adversaries the fault layer cannot express statically — "isolate whoever
+leads right now" and "kill candidates mid-campaign" — pure functions of
+(schedule, state), so a replay is bit-identical to the original run.
+
+Generation is counter-based `jax.random`: one fold of the sweep seed per
+schedule index, so schedule s of seed k is the same arrays forever (the
+repro artifacts pin ``(seed, profile, index)`` for exactly this reason) and
+the whole batch generates on device with a single vmap.
+
+Tick-latency note: the synchronous wire retries every message each tick, so
+a directed edge that a schedule drops on d consecutive ticks delays that
+edge's traffic by d ticks — delay masks lower to drop runs (see
+``from_fault_plan`` and `raft/faults.py` ``plan_to_schedule``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from swarmkit_tpu.raft.sim.state import CANDIDATE, LEADER, SimConfig
+
+I32 = jnp.int32
+
+# Named adversary profiles (ISSUE 3 tentpole part 1).  `make_batch` deals
+# them round-robin across the schedule axis.
+PROFILES = ("random_drop", "partition_flapper", "leader_targeted",
+            "asymmetric_links", "crash_restart", "crash_during_campaign")
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class FaultSchedule:
+    """Stacked fault arrays for T ticks (optionally with a leading S axis).
+
+    drop           bool [.., T, N, N]  base per-tick drops, [i, j] = i -> j
+    alive          bool [.., T, N]     row liveness (False = crashed)
+    target_leader  bool [.., T]        gate: drop all edges touching any
+                                       row that is CURRENTLY leader
+    crash_campaign bool [.., T]        gate: rows CURRENTLY candidate are
+                                       treated as crashed this tick
+    """
+
+    drop: jax.Array
+    alive: jax.Array
+    target_leader: jax.Array
+    crash_campaign: jax.Array
+
+    @property
+    def ticks(self) -> int:
+        return self.target_leader.shape[-1]
+
+    def slice(self, s: int) -> "FaultSchedule":
+        """Extract one schedule from a batched [S, ...] stack."""
+        return jax.tree_util.tree_map(lambda a: a[s], self)
+
+
+def effective_faults(role: jax.Array, drop_t: jax.Array, alive_t: jax.Array,
+                     target_leader_t: jax.Array, crash_campaign_t: jax.Array):
+    """Resolve one tick's state-conditioned gates against current roles.
+
+    Returns (alive, drop) in the exact shapes `kernel.step` consumes; pure
+    in (role, schedule slice), so replays reproduce the original faults.
+    """
+    leaders = role == LEADER
+    isolate = target_leader_t & (leaders[:, None] | leaders[None, :])
+    drop = drop_t | isolate
+    alive = alive_t & ~(crash_campaign_t & (role == CANDIDATE))
+    return alive, drop
+
+
+# ---------------------------------------------------------------------------
+# profile generators: (key, cfg, ticks) -> FaultSchedule for ONE schedule.
+# All shapes are static in (cfg, ticks) so the batch generator can vmap.
+
+
+def _windows(key, ticks: int, period_lo: int, period_hi: int) -> jax.Array:
+    """[T] bool square-wave gate with a random period and phase — the
+    flapping primitive shared by several adversaries."""
+    kp, kf = jax.random.split(key)
+    period = jax.random.randint(kp, (), period_lo, period_hi + 1)
+    phase = jax.random.randint(kf, (), 0, period_hi)
+    t = jnp.arange(ticks, dtype=I32)
+    return ((t + phase) // period) % 2 == 1
+
+
+def _no_faults(cfg: SimConfig, ticks: int) -> FaultSchedule:
+    n = cfg.n
+    return FaultSchedule(
+        drop=jnp.zeros((ticks, n, n), bool),
+        alive=jnp.ones((ticks, n), bool),
+        target_leader=jnp.zeros((ticks,), bool),
+        crash_campaign=jnp.zeros((ticks,), bool))
+
+
+def _gen_random_drop(key, cfg: SimConfig, ticks: int) -> FaultSchedule:
+    """iid Bernoulli edge drops at a per-schedule rate in [0.05, 0.4)."""
+    kr, kd = jax.random.split(key)
+    rate = jax.random.uniform(kr, (), minval=0.05, maxval=0.4)
+    drop = jax.random.uniform(kd, (ticks, cfg.n, cfg.n)) < rate
+    return dataclasses.replace(_no_faults(cfg, ticks), drop=drop)
+
+
+def _gen_partition_flapper(key, cfg: SimConfig, ticks: int) -> FaultSchedule:
+    """A two-sided split that flaps open/closed: the cut point is random
+    and the flap period straddles the election timeout, so elections keep
+    starting on one side while commits race on the other."""
+    kc, kw = jax.random.split(key)
+    cut = jax.random.randint(kc, (), 1, cfg.n)
+    side = jnp.arange(cfg.n, dtype=I32) < cut
+    cross = side[:, None] != side[None, :]
+    gate = _windows(kw, ticks, cfg.election_tick // 2,
+                    2 * cfg.election_tick)
+    drop = gate[:, None, None] & cross[None, :, :]
+    return dataclasses.replace(_no_faults(cfg, ticks), drop=drop)
+
+
+def _gen_leader_targeted(key, cfg: SimConfig, ticks: int) -> FaultSchedule:
+    """Windows during which whoever currently leads is fully isolated —
+    the classic availability adversary (resolved per tick from live roles
+    by ``effective_faults``), over a light random-drop background."""
+    kw, kd = jax.random.split(key)
+    gate = _windows(kw, ticks, cfg.election_tick, 3 * cfg.election_tick)
+    drop = jax.random.uniform(kd, (ticks, cfg.n, cfg.n)) < 0.05
+    return dataclasses.replace(_no_faults(cfg, ticks), drop=drop,
+                               target_leader=gate)
+
+
+def _gen_asymmetric_links(key, cfg: SimConfig, ticks: int) -> FaultSchedule:
+    """Persistent one-directional loss: each directed edge gets its own
+    loss rate (a few edges near-dead), with NO symmetry — i hears j while
+    j never hears i, the regime that breaks naive failure detectors."""
+    kp, kd = jax.random.split(key)
+    edge_rate = jax.random.uniform(kp, (cfg.n, cfg.n)) ** 3  # skew to low
+    drop = jax.random.uniform(kd, (ticks, cfg.n, cfg.n)) < edge_rate[None]
+    return dataclasses.replace(_no_faults(cfg, ticks), drop=drop)
+
+
+def _gen_crash_restart(key, cfg: SimConfig, ticks: int) -> FaultSchedule:
+    """Random crash/restart windows: each row draws a crash tick and an
+    outage length; up to half the rows crash somewhere in the run."""
+    kv, ks, kd = jax.random.split(key, 3)
+    crash_at = jax.random.randint(ks, (cfg.n,), 0, max(1, ticks - 2))
+    down_for = jax.random.randint(kd, (cfg.n,),
+                                  2, max(3, 3 * cfg.election_tick))
+    victims = jax.random.uniform(kv, (cfg.n,)) < 0.5
+    t = jnp.arange(ticks, dtype=I32)[:, None]
+    downed = victims[None, :] & (t >= crash_at[None, :]) \
+        & (t < (crash_at + down_for)[None, :])
+    return dataclasses.replace(_no_faults(cfg, ticks), alive=~downed)
+
+
+def _gen_crash_during_campaign(key, cfg: SimConfig, ticks: int
+                               ) -> FaultSchedule:
+    """Windows during which any row that is mid-campaign (CANDIDATE) is
+    crashed — the adversary that maximizes term churn and interrupted
+    elections — over a light random-drop background."""
+    kw, kd = jax.random.split(key)
+    gate = _windows(kw, ticks, cfg.election_tick, 2 * cfg.election_tick)
+    drop = jax.random.uniform(kd, (ticks, cfg.n, cfg.n)) < 0.1
+    return dataclasses.replace(_no_faults(cfg, ticks), drop=drop,
+                               crash_campaign=gate)
+
+
+_GENERATORS = {
+    "random_drop": _gen_random_drop,
+    "partition_flapper": _gen_partition_flapper,
+    "leader_targeted": _gen_leader_targeted,
+    "asymmetric_links": _gen_asymmetric_links,
+    "crash_restart": _gen_crash_restart,
+    "crash_during_campaign": _gen_crash_during_campaign,
+}
+
+
+def make_schedule(cfg: SimConfig, ticks: int, profile: str,
+                  seed: int, index: int = 0) -> FaultSchedule:
+    """One schedule: profile generator keyed by fold_in(seed, index)."""
+    gen = _GENERATORS.get(profile)
+    if gen is None:
+        raise KeyError(f"unknown adversary profile {profile!r}; "
+                       f"known: {PROFILES}")
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), index)
+    return gen(key, cfg, ticks)
+
+
+def make_batch(cfg: SimConfig, ticks: int, schedules: int, seed: int,
+               profiles=PROFILES) -> tuple[FaultSchedule, list[str]]:
+    """[S, ...] stacked schedules + the profile name of each index.
+
+    Profiles are dealt round-robin over the schedule axis; each index's key
+    is fold_in(seed, index), independent of the batch size, so schedule
+    (seed, profile, index) is stable however wide the sweep runs.
+    """
+    profiles = tuple(profiles)
+    names = [profiles[s % len(profiles)] for s in range(schedules)]
+    base = jax.random.PRNGKey(seed)
+    parts = []
+    for s, name in enumerate(names):
+        parts.append((s, _GENERATORS[name], jax.random.fold_in(base, s)))
+    # group by generator so each profile's sub-batch is ONE vmapped call
+    stacks: dict[int, FaultSchedule] = {}
+    for gen in {g for _, g, _ in parts}:
+        idx = [s for s, g, _ in parts if g is gen]
+        keys = jnp.stack([k for s, g, k in parts if g is gen])
+        sub = jax.vmap(lambda k, g=gen: g(k, cfg, ticks))(keys)
+        for pos, s in enumerate(idx):
+            stacks[s] = jax.tree_util.tree_map(lambda a: a[pos], sub)
+    batch = jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves),
+        *[stacks[s] for s in range(schedules)])
+    return batch, names
+
+
+def from_fault_plan(cfg: SimConfig, plan, rows: dict[str, int], ticks: int,
+                    inject_at: int = 0, heal_at=None,
+                    seed: int = 0) -> FaultSchedule:
+    """Lower a declarative `raft.faults.FaultPlan` into a FaultSchedule.
+
+    `rows` maps the plan's wire addresses to kernel row indices.  The
+    actual lowering lives next to the plan vocabulary
+    (``raft.faults.plan_to_schedule``); this wraps its numpy output in the
+    device dataclass with the state-conditioned gates off.
+    """
+    from swarmkit_tpu.raft.faults import plan_to_schedule
+
+    arrs = plan_to_schedule(plan, rows, n=cfg.n, ticks=ticks,
+                            inject_at=inject_at, heal_at=heal_at, seed=seed)
+    return FaultSchedule(
+        drop=jnp.asarray(arrs["drop"]),
+        alive=jnp.asarray(arrs["alive"]),
+        target_leader=jnp.zeros((ticks,), bool),
+        crash_campaign=jnp.zeros((ticks,), bool))

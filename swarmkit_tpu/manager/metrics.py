@@ -43,15 +43,24 @@ class Collector:
         self._task: Optional[asyncio.Task] = None
         self._running = False
         self._restore_gen = -1
+        self._watcher = None
 
     def set_leader(self, leader: bool) -> None:
         self.gauges["swarm_manager_leader"] = 1.0 if leader else 0.0
 
     def snapshot(self) -> dict[str, float]:
+        # a bulk restore publishes no per-object events, so on a quiet
+        # store nothing would ever wake _run to notice the generation
+        # bump — a freshly promoted follower would serve pre-restore
+        # counts until the next unrelated commit.  Recount at scrape time
+        # instead of waiting for an event.
+        if self._watcher is not None \
+                and self.store.restore_generation != self._restore_gen:
+            self._resync(self._watcher)
         return dict(self.gauges)
 
     async def start(self) -> None:
-        watcher = self.store.watch(
+        watcher = self._watcher = self.store.watch(
             lambda e: isinstance(e, Event)
             and e.kind in ("node", "task") + _TOTAL_KINDS)
         self._recount()

@@ -120,7 +120,14 @@ class RestartSupervisor:
         tx.create(new)
 
         slot = common.slot_tuple(task)
-        key = _spec_key(task)
+        # record the strike under the REPLACEMENT's spec key: new_task
+        # builds from the service's current spec, which may differ from the
+        # failed task's.  Keying by the old spec would let the next failure
+        # (of the replacement) read the history as stale and wipe the
+        # slot's strike count, so max_attempts would never trip across a
+        # service update (reference keys by the restarted task's
+        # SpecVersion, restart.go:223).
+        key = _spec_key(new)
         h = self._history.get(slot)
         if h is None or h.spec_key != key:
             h = self._history[slot] = _History(spec_key=key)
@@ -217,6 +224,13 @@ class RestartSupervisor:
                         {ev, timeout}, return_when=asyncio.FIRST_COMPLETED)
                     if ev not in done:
                         ev.cancel()
+                    elif ev.exception() is not None:
+                        # watcher torn down under us (WatcherClosed on
+                        # store shutdown): no further events can arrive,
+                        # so treat it as terminal and start the
+                        # replacement instead of re-arming a get() that
+                        # fails instantly until the deadline
+                        return
                     if timeout in done:
                         return   # waited long enough; start anyway
             finally:

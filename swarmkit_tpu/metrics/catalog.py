@@ -137,6 +137,20 @@ CATALOG: dict[str, MetricSpec] = {
         "counter", "Store transactions committed, by kind "
         "(read / write / batch).", ("kind",)),
 
+    # ---- deterministic simulation testing (dst/) -------------------------
+    "swarm_dst_schedules_total": MetricSpec(
+        "counter", "Fault schedules fully explored, by result "
+        "(clean / violation).", ("result",)),
+    "swarm_dst_violations_total": MetricSpec(
+        "counter", "Schedules that tripped a raft safety invariant, by "
+        "invariant (dst/invariants.py bit names).", ("invariant",)),
+    "swarm_dst_schedules_per_second": MetricSpec(
+        "gauge", "Throughput of the last vmapped explore() call, by "
+        "config (n<rows>x<ticks>t).", ("config",)),
+    "swarm_dst_shrink_rounds_total": MetricSpec(
+        "counter", "Counterexample-shrinker replay evaluations, by verdict "
+        "on the candidate fault clearing (removed / required).", ("result",)),
+
     # ---- bench / tools (L6) ----------------------------------------------
     "swarm_bench_entries_per_second": MetricSpec(
         "gauge", "Steady-state committed entries/sec, by bench config.",

@@ -35,6 +35,21 @@ def row_mesh(rows: int, devices: Optional[Sequence] = None,
     return Mesh(devices[:d], axis_names=(axis,))
 
 
+SCHEDULE_AXIS = "schedules"
+
+
+def schedule_mesh(schedules: int, devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over the DST schedule axis (dst/explore.py).
+
+    Schedule exploration is embarrassingly data-parallel — each of the S
+    vmapped clusters is independent — so the leading S axis shards exactly
+    like the manager row axis, just under its own mesh-axis name so a
+    future two-level layout (schedules over hosts, rows over chips) can
+    compose with `host_row_mesh` without a rename.
+    """
+    return row_mesh(schedules, devices, axis=SCHEDULE_AXIS)
+
+
 DCN_AXIS = "hosts"    # outer: crosses the data-center network
 ICI_AXIS = "chips"    # inner: rides the on-pod interconnect
 

@@ -155,3 +155,66 @@ class FaultPlan:
     def crash(cls, addr: str) -> "FaultPlan":
         return cls(f"crash_restart({addr})",
                    inject=[("crash_restart", (addr,))])
+
+
+def plan_to_schedule(plan: FaultPlan, rows: dict[str, int], n: int,
+                     ticks: int, inject_at: int = 0, heal_at=None,
+                     seed: int = 0, tick_interval: float = 1.0) -> dict:
+    """Lower a declarative FaultPlan into dense per-tick schedule arrays.
+
+    The wire surfaces interpret faults at delivery time against live
+    connection state; the DST kernel instead consumes the whole run as
+    data — drop [T, N, N] and alive [T, N] — so each primitive lowers to a
+    deterministic array pattern over the window [inject_at, heal_at):
+
+    - ``set_down(addr)``      every edge INTO the row is dropped (the
+                              surface blocks delivery TO down nodes)
+    - ``set_drop(f, t, p)``   seeded Bernoulli per tick on the edge
+    - ``partition(groups)``   cross-group edges dropped
+    - ``set_delay(f, t, s)``  the synchronous wire retries every tick, so
+                              a d-tick delay is the edge gated open only
+                              every (d+1)-th tick (d = ceil(s / tick
+                              interval)) — traffic lands d ticks late
+    - ``crash_restart(addr)`` the row is not alive inside the window
+
+    `rows` maps plan addresses to kernel row indices.  Returns numpy
+    arrays (``dst.schedule.from_fault_plan`` wraps them on device).
+    """
+    import math
+
+    import numpy as np
+
+    heal_at = ticks if heal_at is None else heal_at
+    if not 0 <= inject_at <= heal_at <= ticks:
+        raise ValueError(f"bad fault window [{inject_at}, {heal_at}) "
+                         f"for {ticks} ticks")
+    drop = np.zeros((ticks, n, n), bool)
+    alive = np.ones((ticks, n), bool)
+    rng = np.random.default_rng(seed)
+    win = slice(inject_at, heal_at)
+    wlen = heal_at - inject_at
+
+    for method, args in plan._inject:
+        if method == "set_down":
+            addr, down = (args + (True,))[:2]
+            if down:
+                drop[win, :, rows[addr]] = True
+        elif method == "set_drop":
+            frm, to, p = args
+            drop[win, rows[frm], rows[to]] |= rng.random(wlen) < p
+        elif method == "partition":
+            groups = [set(rows[a] for a in g) for g in args]
+            for i in range(n):
+                for j in range(n):
+                    if any((i in g) != (j in g) for g in groups):
+                        drop[win, i, j] = True
+        elif method == "set_delay":
+            frm, to, seconds = args
+            d = max(1, math.ceil(seconds / tick_interval))
+            t = np.arange(inject_at, heal_at)
+            drop[win, rows[frm], rows[to]] |= ((t - inject_at) % (d + 1)) != d
+        elif method == "crash_restart":
+            alive[win, rows[args[0]]] = False
+        else:
+            raise ValueError(f"cannot lower fault action {method!r}")
+    return {"drop": drop, "alive": alive}

@@ -4,7 +4,8 @@ from swarmkit_tpu.raft.sim.kernel import (
     propose, propose_conf, step, transfer_leadership,
 )
 from swarmkit_tpu.raft.sim.run import (
-    committed_entries, has_leader, leader_mask, run_ticks, run_until_leader,
+    committed_entries, has_leader, leader_mask, run_schedule, run_ticks,
+    run_until_leader,
 )
 from swarmkit_tpu.raft.sim.state import (
     CANDIDATE, FOLLOWER, LEADER, NONE, SimConfig, SimState, drop_matrix,
@@ -14,7 +15,8 @@ from swarmkit_tpu.raft.sim.state import (
 __all__ = [
     "propose", "propose_conf", "step", "transfer_leadership",
     "committed_entries", "has_leader", "leader_mask",
-    "run_ticks", "run_until_leader", "CANDIDATE", "FOLLOWER", "LEADER",
+    "run_schedule", "run_ticks", "run_until_leader",
+    "CANDIDATE", "FOLLOWER", "LEADER",
     "NONE", "SimConfig", "SimState", "drop_matrix", "init_state",
     "rand_timeout",
 ]

@@ -88,6 +88,32 @@ def run_ticks(state: SimState, cfg: SimConfig, n_ticks: int,
     return final, trace
 
 
+@partial(jax.jit, static_argnames=("cfg", "prop_count"))
+def run_schedule(state: SimState, cfg: SimConfig, drop: jax.Array,
+                 alive: jax.Array, prop_count: int = 0):
+    """Advance len(drop) ticks under a PRECOMPILED fault schedule: drop is
+    [T, N, N] per-tick edge drops, alive is [T, N] row liveness (the
+    schedule-shaped form the DST layer generates — see dst/schedule.py and
+    raft/faults.py plan_to_schedule; run_ticks, by contrast, derives its
+    faults from scalar knobs inside the scan).
+
+    Returns (final_state, trace) with the run_ticks trace rows
+    [n_leaders, max_commit, max_term].
+    """
+
+    def body(st, xs):
+        drop_t, alive_t = xs
+        if prop_count:
+            st = propose_dense(st, cfg, _payload_at,
+                               jnp.asarray(prop_count, I32), alive=alive_t)
+        st = step(st, cfg, alive=alive_t, drop=drop_t)
+        row = jnp.stack([jnp.sum(leader_mask(st).astype(I32)),
+                         jnp.max(st.commit), jnp.max(st.term)])
+        return st, row
+
+    return jax.lax.scan(body, state, (drop, alive))
+
+
 @partial(jax.jit, static_argnames=("cfg", "max_ticks"))
 def run_until_leader(state: SimState, cfg: SimConfig, max_ticks: int = 1000):
     """Tick until some node is leader (leader-election latency measurement).

@@ -87,3 +87,30 @@ def test_cluster_spec_defaults():
     assert cs.raft.snapshot_interval == 10000
     assert cs.raft.election_tick == 10
     assert cs.dispatcher.heartbeat_period == 5.0
+
+
+def test_fingerprint_stable_across_hash_seeds():
+    """fingerprint() feeds restart history and scheduler taints that
+    survive WAL/snapshot restore into a NEW process, so it must not ride
+    on salted hash() — identical specs must fingerprint identically under
+    any PYTHONHASHSEED."""
+    import os
+    import subprocess
+    import sys
+
+    s = _service()
+    fp = s.spec.fingerprint()
+    assert fp == s.spec.copy().fingerprint()
+
+    prog = (
+        "from tests.test_api import _service; "
+        "print(_service().spec.fingerprint())"
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    seen = set()
+    for seed in ("0", "1", "424242"):
+        env = dict(os.environ, PYTHONHASHSEED=seed, PYTHONPATH=repo)
+        out = subprocess.run([sys.executable, "-c", prog], env=env, cwd=repo,
+                             capture_output=True, text=True, check=True)
+        seen.add(int(out.stdout.strip()))
+    assert seen == {fp}, seen

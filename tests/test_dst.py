@@ -1,0 +1,306 @@
+"""Deterministic simulation testing subsystem (swarmkit_tpu/dst/).
+
+Fast tier: invariant checkers against hand-built states (each must trip
+exactly its own bit), schedule-generator determinism, FaultPlan lowering,
+a small stock explore() (zero violations), and the full mutation pipeline
+(detect -> shrink -> artifact -> exact replay) on a pinned seed.
+
+Slow tier: the >=256-schedule x >=100-tick sweep and the field-level
+oracle trace live in tests/test_dst_sweep.py.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from swarmkit_tpu import dst
+from swarmkit_tpu.raft.faults import FaultPlan, plan_to_schedule
+from swarmkit_tpu.raft.sim import run_schedule
+from swarmkit_tpu.raft.sim.state import (
+    CANDIDATE, FOLLOWER, LEADER, SimConfig, init_state,
+)
+
+CFG3 = SimConfig(n=3, log_len=64, window=8, apply_batch=16, max_props=8,
+                 keep=4, election_tick=10, seed=7)
+CFG5 = SimConfig(n=5, log_len=64, window=8, apply_batch=16, max_props=8,
+                 keep=4, election_tick=10, seed=0)
+
+
+def _bits(state, cfg=CFG5) -> int:
+    return int(dst.check_state(state, cfg))
+
+
+def _arr(base, **updates):
+    """dataclasses.replace with each update applied via .at[idx].set."""
+    fields = {}
+    for name, pairs in updates.items():
+        a = getattr(base, name)
+        for idx, val in pairs:
+            a = a.at[idx].set(val)
+        fields[name] = a
+    return dataclasses.replace(base, **fields)
+
+
+# ---------------------------------------------------------------------------
+# invariant checkers: each hand-built state trips exactly the right bit
+
+
+def test_clean_init_state_has_no_violations():
+    st = init_state(CFG5)
+    assert _bits(st) == 0
+    assert int(dst.check_transition(st, st)) == 0
+
+
+def test_election_safety_two_leaders_same_term():
+    st = _arr(init_state(CFG5),
+              role=[(0, LEADER), (1, LEADER)],
+              term=[(0, 5), (1, 5)])
+    assert _bits(st) == dst.ELECTION_SAFETY
+
+
+def test_election_safety_allows_stale_minority_leader():
+    # two leaders at DIFFERENT terms is the legal partition aftermath
+    st = _arr(init_state(CFG5),
+              role=[(0, LEADER), (1, LEADER)],
+              term=[(0, 5), (1, 4)])
+    assert _bits(st) == 0
+
+
+def test_log_matching_same_index_term_different_payload():
+    # index 1 lives in slot 0; rows 0 and 1 agree on its term but not data
+    st = _arr(init_state(CFG5),
+              last=[(0, 1), (1, 1)],
+              log_term=[((0, 0), 1), ((1, 0), 1)],
+              log_data=[((0, 0), 10), ((1, 0), 11)])
+    assert _bits(st) == dst.LOG_MATCHING
+    same = _arr(st, log_data=[((1, 0), 10)])
+    assert _bits(same) == 0
+
+
+def test_log_matching_ignores_same_index_different_term():
+    # conflicting-term entries are exactly what raft overwrites — legal
+    st = _arr(init_state(CFG5),
+              last=[(0, 1), (1, 1)],
+              log_term=[((0, 0), 1), ((1, 0), 2)],
+              log_data=[((0, 0), 10), ((1, 0), 11)])
+    assert _bits(st) == 0
+
+
+def test_leader_completeness_top_term_leader_missing_commits():
+    st = _arr(init_state(CFG5),
+              role=[(0, LEADER)],
+              term=[(0, 5)],
+              last=[(1, 3)],
+              commit=[(1, 3)],
+              log_term=[((1, 0), 1), ((1, 1), 1), ((1, 2), 1)])
+    assert _bits(st) == dst.LEADER_COMPLETENESS
+
+
+def test_leader_completeness_exempts_stale_leader():
+    # same shape, but the lagging leader is NOT at the global max term
+    st = _arr(init_state(CFG5),
+              role=[(0, LEADER)],
+              term=[(0, 3), (1, 5)],
+              last=[(1, 3)],
+              commit=[(1, 3)],
+              log_term=[((1, 0), 1), ((1, 1), 1), ((1, 2), 1)])
+    assert _bits(st) == 0
+
+
+def test_commit_monotonic_regression_and_apply_overrun():
+    prev = _arr(init_state(CFG5), commit=[(0, 3)], last=[(0, 3)])
+    lost = _arr(init_state(CFG5), commit=[(0, 2)], last=[(0, 3)])
+    assert int(dst.check_transition(prev, lost)) == dst.COMMIT_MONOTONIC
+    ahead = _arr(init_state(CFG5), applied=[(0, 1)])
+    assert int(dst.check_transition(init_state(CFG5), ahead)) \
+        == dst.COMMIT_MONOTONIC
+
+
+def test_checksum_agreement_same_applied_different_checksum():
+    st = _arr(init_state(CFG5),
+              last=[(0, 2), (1, 2)],
+              commit=[(0, 2), (1, 2)],
+              applied=[(0, 2), (1, 2)],
+              apply_chk=[(0, 7), (1, 9)])
+    assert _bits(st) == dst.CHECKSUM_AGREEMENT
+    agree = _arr(st, apply_chk=[(1, 7)])
+    assert _bits(agree) == 0
+
+
+def test_bits_to_names():
+    assert dst.bits_to_names(0) == []
+    assert dst.bits_to_names(dst.ELECTION_SAFETY | dst.CHECKSUM_AGREEMENT) \
+        == ["election_safety", "checksum_agreement"]
+
+
+# ---------------------------------------------------------------------------
+# schedule generation: counter-seeded determinism + the adversary gates
+
+
+def _leaves(sched):
+    return [np.asarray(a) for a in
+            (sched.drop, sched.alive, sched.target_leader,
+             sched.crash_campaign)]
+
+
+@pytest.mark.parametrize("profile", dst.PROFILES)
+def test_make_schedule_deterministic_per_seed(profile):
+    a = dst.make_schedule(CFG3, ticks=24, profile=profile, seed=5, index=3)
+    b = dst.make_schedule(CFG3, ticks=24, profile=profile, seed=5, index=3)
+    for la, lb in zip(_leaves(a), _leaves(b)):
+        assert np.array_equal(la, lb)
+    c = dst.make_schedule(CFG3, ticks=24, profile=profile, seed=6, index=3)
+    assert any(not np.array_equal(la, lc)
+               for la, lc in zip(_leaves(a), _leaves(c)))
+
+
+def test_make_schedule_rejects_unknown_profile():
+    with pytest.raises(KeyError):
+        dst.make_schedule(CFG3, ticks=8, profile="nope", seed=0)
+
+
+def test_make_batch_index_stable_across_widths():
+    # schedule (seed, index) must not depend on how wide the sweep runs
+    wide, wide_names = dst.make_batch(CFG3, ticks=16, schedules=12, seed=9)
+    narrow, narrow_names = dst.make_batch(CFG3, ticks=16, schedules=6, seed=9)
+    assert wide_names[:6] == narrow_names
+    assert wide_names == [dst.PROFILES[s % len(dst.PROFILES)]
+                          for s in range(12)]
+    for s in range(6):
+        for lw, ln in zip(_leaves(wide.slice(s)), _leaves(narrow.slice(s))):
+            assert np.array_equal(lw, ln)
+
+
+def test_effective_faults_resolves_gates_against_roles():
+    role = jnp.asarray([FOLLOWER, CANDIDATE, LEADER])
+    alive, drop = dst.schedule.effective_faults(
+        role, jnp.zeros((3, 3), bool), jnp.ones((3,), bool),
+        jnp.asarray(True), jnp.asarray(True))
+    alive, drop = np.asarray(alive), np.asarray(drop)
+    assert alive.tolist() == [True, False, True]   # candidate crashed
+    assert drop[2, :].all() and drop[:, 2].all()   # leader isolated
+    assert not drop[0, 1] and not drop[1, 0]       # others untouched
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan -> schedule lowering (raft/faults.py plan_to_schedule)
+
+ROWS3 = {"a": 0, "b": 1, "c": 2}
+
+
+def test_plan_lowering_down_blocks_edges_into_row():
+    arrs = plan_to_schedule(FaultPlan.down("b"), ROWS3, n=3, ticks=10,
+                            inject_at=2, heal_at=7)
+    assert arrs["drop"][2:7, :, 1].all()
+    assert not arrs["drop"][:2].any() and not arrs["drop"][7:].any()
+    assert not arrs["drop"][2:7, :, [0, 2]].any()
+    assert arrs["alive"].all()
+
+
+def test_plan_lowering_split_drops_cross_group_edges():
+    arrs = plan_to_schedule(FaultPlan.split(("a", "b"), ("c",)), ROWS3,
+                            n=3, ticks=4)
+    assert arrs["drop"][:, 0, 2].all() and arrs["drop"][:, 2, 0].all()
+    assert arrs["drop"][:, 1, 2].all() and arrs["drop"][:, 2, 1].all()
+    assert not arrs["drop"][:, 0, 1].any() and not arrs["drop"][:, 1, 0].any()
+
+
+def test_plan_lowering_delay_gates_edge_open_every_dplus1_ticks():
+    # 3-second delay at 1s/tick: edge open only every 4th tick, so traffic
+    # lands 3 ticks late on the retry-every-tick synchronous wire
+    arrs = plan_to_schedule(FaultPlan.delay("a", "b", 3.0, symmetric=False),
+                            ROWS3, n=3, ticks=8)
+    assert arrs["drop"][:, 0, 1].tolist() == [True, True, True, False,
+                                              True, True, True, False]
+    assert not arrs["drop"][:, 1, 0].any()
+
+
+def test_plan_lowering_crash_and_drop():
+    arrs = plan_to_schedule(FaultPlan.crash("c"), ROWS3, n=3, ticks=6,
+                            inject_at=1, heal_at=4)
+    assert (~arrs["alive"][1:4, 2]).all()
+    assert arrs["alive"][:1].all() and arrs["alive"][4:].all()
+    arrs = plan_to_schedule(FaultPlan.drop("a", "c", p=1.0), ROWS3,
+                            n=3, ticks=5)
+    assert arrs["drop"][:, 0, 2].all()
+
+
+def test_from_fault_plan_wraps_device_schedule():
+    sched = dst.from_fault_plan(CFG3, FaultPlan.down("a"), ROWS3, ticks=12,
+                                inject_at=3, heal_at=9)
+    assert isinstance(sched, dst.FaultSchedule)
+    assert sched.ticks == 12
+    assert np.asarray(sched.drop)[3:9, :, 0].all()
+    assert not np.asarray(sched.target_leader).any()
+    assert not np.asarray(sched.crash_campaign).any()
+
+
+def test_run_schedule_driver_advances_under_clean_schedule():
+    drop = jnp.zeros((40, 3, 3), bool)
+    alive = jnp.ones((40, 3), bool)
+    final, trace = run_schedule(init_state(CFG3), CFG3, drop, alive,
+                                prop_count=2)
+    assert trace.shape == (40, 3)
+    assert int(jnp.max(final.commit)) > 0
+
+
+# ---------------------------------------------------------------------------
+# explore(): stock kernel is invariant-clean; the mutated kernel is caught,
+# shrunk, and the repro artifact replays exactly
+
+
+def test_explore_stock_kernel_clean():
+    batch, names = dst.make_batch(CFG3, ticks=30, schedules=6, seed=1)
+    res = dst.explore(init_state(CFG3), CFG3, batch, profiles=names)
+    assert res.viol.shape == (6,)
+    assert res.violating.size == 0, \
+        [dst.bits_to_names(int(res.viol[s])) for s in res.violating]
+    assert (res.first_tick == -1).all()
+    assert res.bits_by_tick.shape == (30, 6)
+
+
+def test_mutation_caught_shrunk_and_replayable(tmp_path):
+    mutation = "commit_no_quorum"
+    batch, names = dst.make_batch(CFG5, ticks=100, schedules=24, seed=0)
+    res = dst.explore(init_state(CFG5), CFG5, batch, profiles=names,
+                      mutation=mutation)
+    assert res.violating.size > 0, "mutation escaped the checkers"
+
+    s = int(res.violating[0])
+    viol = int(res.viol[s])
+    assert viol & dst.LEADER_COMPLETENESS
+
+    # replay of the un-shrunk schedule reproduces explore() exactly
+    v0, f0 = dst.replay(CFG5, batch.slice(s), mutation=mutation)
+    assert (v0, f0) == (viol, int(res.first_tick[s]))
+
+    small, evals = dst.shrink(CFG5, batch.slice(s), viol, mutation=mutation)
+    assert evals > 0
+    assert dst.fault_count(small) < dst.fault_count(batch.slice(s))
+    v1, f1 = dst.replay(CFG5, small, mutation=mutation)
+    assert v1 & viol
+
+    # the same minimal schedule is CLEAN on the stock kernel: the bug is
+    # in the mutation, not the adversary
+    v2, _ = dst.replay(CFG5, small)
+    assert v2 == 0
+
+    # artifact roundtrip: JSON -> schedule -> identical replay
+    art = dst.to_artifact(CFG5, small, seed=0, profile=names[s], index=s,
+                          prop_count=2, mutation=mutation, viol=v1,
+                          first_tick=f1)
+    path = tmp_path / "repro.json"
+    dst.save_artifact(str(path), art)
+    verdict = dst.replay_artifact(dst.load_artifact(str(path)),
+                                  with_trace=False)
+    assert verdict["matches_recorded"], verdict
+    assert verdict["violations"] == dst.bits_to_names(v1)
+
+
+def test_apply_mutation_rejects_unknown_knob():
+    from swarmkit_tpu.dst.explore import apply_mutation
+
+    with pytest.raises(KeyError):
+        apply_mutation(init_state(CFG3), CFG3, "made_up")

@@ -1,0 +1,33 @@
+"""Slow wrapper around the DST sweep (tools/dst_sweep.py).
+
+Runs the acceptance-sized sweep — 256 schedules x 100 ticks, seed 0 —
+and the mutation self-test end to end (detect, shrink, artifact, exact
+replay, oracle localization).  Excluded from tier-1 by the ``slow``
+marker; run with::
+
+    pytest tests/test_dst_sweep.py -m slow -q
+"""
+
+import pytest
+
+from tools.dst_sweep import run_mutation_demo, run_sweep
+
+
+@pytest.mark.slow
+def test_dst_sweep_stock_kernel_clean():
+    sweep = run_sweep(schedules=256, ticks=100, seed=0, verbose=False)
+    assert sweep["violations"] == 0, sweep["violating_profiles"]
+    assert sweep["schedules_per_sec"] > 0
+
+
+@pytest.mark.slow
+def test_dst_sweep_mutation_demo_end_to_end(tmp_path):
+    demo = run_mutation_demo(schedules=24, ticks=100, seed=0,
+                             out_path=str(tmp_path / "repro.json"),
+                             verbose=False)
+    assert demo["caught"], demo
+    assert "leader_completeness" in demo["bits"]
+    assert demo["fault_count_after"] < demo["fault_count_before"]
+    assert demo["replay_matches"], demo
+    # the field-level differential trace localizes the mutated commit path
+    assert demo["oracle_diverged_at"] >= 0

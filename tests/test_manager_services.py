@@ -286,6 +286,40 @@ async def test_metrics_collector_incremental_matches_recount():
 
 
 @async_test
+async def test_metrics_collector_restore_on_quiet_store():
+    """A bulk restore publishes no per-object events, so on a QUIET
+    cluster nothing ever wakes the event loop to notice the generation
+    bump — snapshot() must resync at scrape time, or a freshly promoted
+    follower serves pre-restore counts until some unrelated commit."""
+    store = MemoryStore()
+    coll = Collector(store)
+    await coll.start()
+
+    def mk_task(i, state):
+        return Task(id=f"t{i}", spec=TaskSpec(),
+                    status=TaskStatus(state=state))
+
+    await store.update(lambda tx: [
+        tx.create(mk_task(i, TaskState.RUNNING)) for i in range(3)])
+    await pump()
+    assert coll.snapshot()["swarm_task_running"] == 3
+
+    saved = store.save()
+    await store.update(lambda tx: tx.delete("task", "t0"))
+    await pump()
+    assert coll.snapshot()["swarm_task_running"] == 2
+
+    # roll back to the snapshot; NO commit follows, so no event arrives
+    store.restore(saved)
+    assert coll.snapshot()["swarm_task_running"] == 3
+    # incremental accounting still exact after the scrape-time resync
+    await store.update(lambda tx: tx.delete("task", "t1"))
+    await pump()
+    assert coll.snapshot()["swarm_task_running"] == 2
+    await coll.stop()
+
+
+@async_test
 async def test_resourceapi_attach_detach():
     store = MemoryStore()
     api = ResourceApi(store)

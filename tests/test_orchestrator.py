@@ -260,6 +260,64 @@ async def test_restart_waits_for_old_task_to_stop():
 
 
 @async_test
+async def test_restart_history_keyed_by_replacement_spec():
+    """The strike is recorded under the REPLACEMENT's spec key: when a
+    task running an old spec fails after a service update, its replacement
+    is built from the new spec, and the new spec's failures must
+    accumulate — keying by the failed task's spec would make every
+    replacement look history-free and max_attempts would never trip."""
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    sup = RestartSupervisor(store, clock=clock)
+    svc = make_service(replicas=1, restart=RestartPolicy(
+        condition=RestartCondition.ANY, delay=0.0, max_attempts=1))
+    t1 = common.new_task(None, svc, slot=1)        # runs spec v1
+    t1.status.state = TaskState.FAILED
+    await store.update(lambda tx: tx.create(t1))
+
+    svc.spec.task.container.image = "nginx:2"      # update lands before
+    await store.update(lambda tx: sup.restart(tx, None, svc, t1))  # failure
+    await pump(clock)
+
+    t2 = [t for t in store.find("task") if t.id != t1.id][0]  # runs v2
+    t2.status.state = TaskState.FAILED
+    # the v2 slot already burned its one attempt (recorded at t1's restart)
+    assert not sup.should_restart(t2, svc)
+    await sup.stop()
+
+
+@async_test
+async def test_restart_wait_survives_watcher_close():
+    """If the store's event bus shuts down while the replacement waits for
+    the old task, the wait treats it as terminal and promotes — instead of
+    re-arming a get() that fails instantly (busy loop with an unretrieved
+    exception) until the old-task timeout."""
+    clock = FakeClock()
+    store = MemoryStore(clock=clock.now)
+    sup = RestartSupervisor(store, clock=clock)
+    svc = make_service(replicas=1, restart=RestartPolicy(
+        condition=RestartCondition.ANY, delay=0.0))
+    node = make_node(1)
+    t1 = common.new_task(None, svc, slot=1)
+    t1.node_id = node.id
+    t1.status.state = TaskState.RUNNING
+
+    def setup(tx):
+        tx.create(node)
+        tx.create(t1)
+        sup.restart(tx, None, svc, t1)
+    await store.update(setup)
+    await pump(clock, seconds=0.2)
+    repl = [t for t in store.find("task") if t.id != t1.id][0]
+    assert store.get("task", repl.id).desired_state == TaskState.READY
+
+    store.queue.close()   # teardown: every watcher's get() -> WatcherClosed
+    await pump(clock)     # no clock advance: must not need the timeout
+    assert store.get("task", repl.id).desired_state == TaskState.RUNNING
+    await sup.stop()
+
+
+@async_test
 async def test_restart_no_wait_when_node_down():
     """A dead node can't report its task stopped: the replacement starts
     immediately (reference restart.go:173 waitStop=false)."""

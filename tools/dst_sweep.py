@@ -1,0 +1,215 @@
+"""Deterministic-simulation sweep: vmapped fault-schedule search with
+on-device raft invariant checking (swarmkit_tpu/dst/).
+
+Two jobs, both seed-pinned and CPU-runnable under tier-1:
+
+1. **Sweep** (default): generate S adversarial fault schedules across the
+   named profiles, advance S x N simulated clusters in one jitted scan,
+   and check ElectionSafety / LogMatching / LeaderCompleteness / commit
+   monotonicity / applied-checksum agreement every tick.  The stock kernel
+   must report ZERO violations.
+
+2. **Mutation self-test** (runs after the sweep unless suppressed): repeat
+   a smaller sweep against a deliberately broken kernel knob
+   (``commit_no_quorum``: leaders commit without a match quorum), assert
+   the checkers CATCH it, greedily shrink the first counterexample to a
+   minimal repro, dump it as a JSON artifact, and replay the artifact —
+   bits and first-violation tick must reproduce exactly, and the
+   differential oracle trace must localize the divergence.
+
+Usage:
+    python tools/dst_sweep.py --schedules 256 --ticks 100 --seed 0
+    python tools/dst_sweep.py --mutate commit_no_quorum --out repro.json
+    python tools/dst_sweep.py --replay repro.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from swarmkit_tpu import dst  # noqa: E402
+from swarmkit_tpu.raft.sim.state import SimConfig, init_state  # noqa: E402
+
+DEFAULT_MUTATION = "commit_no_quorum"
+
+
+def _cfg(n: int, seed: int) -> SimConfig:
+    """The DST cluster shape: small rows, small ring — schedule diversity,
+    not cluster size, is the search dimension (mirrors the differential
+    suite's CFG5)."""
+    return SimConfig(n=n, log_len=64, window=8, apply_batch=16, max_props=8,
+                     keep=4, election_tick=10, seed=seed)
+
+
+def run_sweep(schedules: int = 256, ticks: int = 100, seed: int = 0,
+              n: int = 5, prop_count: int = 2, profiles=dst.PROFILES,
+              mutation=None, verbose: bool = True) -> dict:
+    """One explore() call; returns a result summary dict (importable)."""
+    cfg = _cfg(n, seed)
+    batch, names = dst.make_batch(cfg, ticks=ticks, schedules=schedules,
+                                  seed=seed, profiles=profiles)
+    res = dst.explore(init_state(cfg), cfg, batch, profiles=names,
+                      prop_count=prop_count, mutation=mutation)
+    by_profile: dict[str, int] = {}
+    for s in res.violating:
+        by_profile[names[s]] = by_profile.get(names[s], 0) + 1
+    out = {
+        "schedules": schedules, "ticks": ticks, "seed": seed, "n": n,
+        "mutation": mutation,
+        "violations": int((res.viol != 0).sum()),
+        "violating_profiles": by_profile,
+        "elapsed": round(res.elapsed, 3),
+        "schedules_per_sec": round(res.schedules_per_sec, 1),
+    }
+    if verbose:
+        tag = f" [mutation={mutation}]" if mutation else ""
+        print(f"explored {schedules} schedules x {ticks} ticks x {n} rows"
+              f"{tag}: {out['violations']} violation(s), "
+              f"{out['elapsed']}s ({out['schedules_per_sec']} schedules/s)",
+              flush=True)
+    out["_result"] = res
+    out["_batch"] = batch
+    out["_names"] = names
+    out["_cfg"] = cfg
+    return out
+
+
+def run_mutation_demo(schedules: int = 24, ticks: int = 100, seed: int = 0,
+                      n: int = 5, prop_count: int = 2,
+                      mutation: str = DEFAULT_MUTATION,
+                      out_path=None, verbose: bool = True) -> dict:
+    """Detect -> shrink -> dump -> replay one seeded mutation repro."""
+    sweep = run_sweep(schedules, ticks, seed, n, prop_count,
+                      mutation=mutation, verbose=verbose)
+    res, batch, names, cfg = (sweep["_result"], sweep["_batch"],
+                              sweep["_names"], sweep["_cfg"])
+    demo = {"mutation": mutation, "caught": bool(len(res.violating)),
+            "violations": sweep["violations"]}
+    if not demo["caught"]:
+        if verbose:
+            print(f"mutation {mutation!r} NOT caught "
+                  f"({schedules}x{ticks}, seed {seed})", flush=True)
+        return demo
+
+    s = int(res.violating[0])
+    sched = batch.slice(s)
+    viol = int(res.viol[s])
+    before = dst.fault_count(sched)
+    small, evals = dst.shrink(cfg, sched, viol, prop_count, mutation)
+    v2, f2 = dst.replay(cfg, small, prop_count, mutation)
+    art = dst.to_artifact(cfg, small, seed=seed, profile=names[s], index=s,
+                          prop_count=prop_count, mutation=mutation,
+                          viol=v2, first_tick=f2)
+    out_path = out_path or os.path.join(tempfile.gettempdir(),
+                                        "dst_repro.json")
+    dst.save_artifact(out_path, art)
+    verdict = dst.replay_artifact(out_path)
+    demo.update({
+        "profile": names[s], "index": s,
+        "bits": dst.bits_to_names(viol),
+        "fault_count_before": before,
+        "fault_count_after": dst.fault_count(small),
+        "shrink_evals": evals,
+        "artifact": out_path,
+        "replay_matches": verdict["matches_recorded"],
+        "oracle_diverged_at": verdict["oracle"]["diverged_at"],
+    })
+    if verbose:
+        print(f"mutation {mutation!r} caught ({demo['bits']}, profile "
+              f"{demo['profile']}): shrunk {before} -> "
+              f"{demo['fault_count_after']} fault-events in {evals} replays",
+              flush=True)
+        print(f"repro artifact: {out_path} — replay "
+              f"{'reproduces exactly' if demo['replay_matches'] else 'DIVERGED'},"
+              f" oracle trace localizes divergence at tick "
+              f"{demo['oracle_diverged_at']}", flush=True)
+    return demo
+
+
+def replay_artifact_file(path: str, verbose: bool = True) -> dict:
+    verdict = dst.replay_artifact(path)
+    if verbose:
+        print(f"replayed {path}: {verdict['violations']} at tick "
+              f"{verdict['first_tick']} — "
+              f"{'matches recorded run' if verdict['matches_recorded'] else 'MISMATCH'}",
+              flush=True)
+        tr = verdict["oracle"]
+        if tr["trace"]:
+            first = tr["trace"][0]
+            print(f"oracle divergence at tick {tr['diverged_at']}: "
+                  f"fields {first['fields']}", flush=True)
+        else:
+            print("differential oracle agrees with the kernel on every "
+                  "tick (stock-kernel artifact)", flush=True)
+    return verdict
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--schedules", type=int, default=256)
+    ap.add_argument("--ticks", type=int, default=100)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--n", type=int, default=5, help="cluster rows")
+    ap.add_argument("--prop-count", type=int, default=2,
+                    help="proposals injected per tick")
+    ap.add_argument("--profiles", default=",".join(dst.PROFILES),
+                    help=f"comma list from {dst.PROFILES}")
+    ap.add_argument("--mutate", default=None,
+                    help="run ONLY a mutation sweep with this broken-kernel "
+                    "knob (e.g. commit_no_quorum) instead of stock+demo")
+    ap.add_argument("--no-mutation-demo", action="store_true",
+                    help="skip the detection self-test after the sweep")
+    ap.add_argument("--out", default=None,
+                    help="where to write the shrunk repro artifact")
+    ap.add_argument("--replay", default=None, metavar="ARTIFACT",
+                    help="replay a JSON repro artifact and exit")
+    args = ap.parse_args(argv)
+
+    if args.replay:
+        return 0 if replay_artifact_file(args.replay)["matches_recorded"] \
+            else 1
+
+    profiles = tuple(p for p in args.profiles.split(",") if p)
+    for p in profiles:
+        if p not in dst.PROFILES:
+            ap.error(f"unknown profile {p!r}")
+
+    if args.mutate:
+        demo = run_mutation_demo(args.schedules, args.ticks, args.seed,
+                                 args.n, args.prop_count, args.mutate,
+                                 out_path=args.out)
+        return 0 if demo["caught"] and demo.get("replay_matches") else 1
+
+    sweep = run_sweep(args.schedules, args.ticks, args.seed, args.n,
+                      args.prop_count, profiles)
+    ok = sweep["violations"] == 0
+    if not ok:
+        res, names = sweep["_result"], sweep["_names"]
+        for s in res.violating[:8]:
+            print(f"  VIOLATION schedule {s} ({names[s]}): "
+                  f"{dst.bits_to_names(int(res.viol[s]))} "
+                  f"at tick {int(res.first_tick[s])}", flush=True)
+
+    if not args.no_mutation_demo:
+        demo = run_mutation_demo(
+            min(args.schedules, 24), args.ticks, args.seed, args.n,
+            args.prop_count, out_path=args.out)
+        ok = ok and demo["caught"] and demo.get("replay_matches", False)
+
+    print("PASS" if ok else "FAIL", flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
