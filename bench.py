@@ -200,7 +200,7 @@ class MeasureError(Exception):
 
 def measure(jax, n: int, entries: int, seed: int, election_tick: int,
             latency: int = 0, latency_jitter: int = 0, inflight: int = 1,
-            **run_kw):
+            log_len: int = 8192, **run_kw):
     """Elect a leader, then time one compiled steady-state replication run of
     ~`entries` committed entries. Returns a dict of measurements; raises
     MeasureError if no leader emerges.
@@ -228,7 +228,7 @@ def measure(jax, n: int, entries: int, seed: int, election_tick: int,
     # by the differential suite and test_static_members_equivalence.
     # collect_stats: four O(N) reduces per tick against O(N^2) phases —
     # negligible, but BENCH_COLLECT_STATS=0 restores the bare program.
-    cfg = SimConfig(n=n, log_len=8192, window=2048, apply_batch=2048,
+    cfg = SimConfig(n=n, log_len=log_len, window=2048, apply_batch=2048,
                     max_props=2048, keep=500, seed=seed,
                     election_tick=election_tick,
                     latency=latency, latency_jitter=latency_jitter,
@@ -452,6 +452,10 @@ def main() -> None:
             # 4-deep pipelined append window (vendor MaxInflightMsgs)
             ("1024-mailbox-lat2-jitter1-inflight4", 1024,
              {"latency": 2, "latency_jitter": 1, "inflight": 4}),
+            # log-capacity tripwire for the chunked log axis: with tiling,
+            # an 8x larger ring must land within ~2x of the L=8192
+            # headline rate (the un-tiled kernel degrades ~8x here)
+            ("4096-longlog-L65536", 4096, {"log_len": 65536}),
         ):
             if only and only not in name:
                 extra.setdefault(f"filtered-by-only:{only}",
@@ -464,6 +468,12 @@ def main() -> None:
                     # run it reduced rather than skip it
                     name = f"{name}-reduced-n64"
                     cn = 64
+                elif "longlog" in name:
+                    # same rule for the log-capacity tripwire: the
+                    # tiled-vs-capacity scaling it guards is visible at
+                    # any n, so shrink rather than lose the number
+                    name = f"{name}-reduced-n256"
+                    cn = 256
                 else:
                     extra[name] = "skipped (cpu)"
                     continue

@@ -71,9 +71,13 @@ def _tick_one(st: SimState, cfg: SimConfig, drop_t, alive_t, tl_t, cc_t,
     new state and this tick's violation bits."""
     alive, drop = effective_faults(st.role, drop_t, alive_t, tl_t, cc_t)
     if prop_count:
-        st = propose_dense(st, cfg, _payload_at,
-                           jnp.asarray(prop_count, I32), alive=alive)
-    new = step(st, cfg, alive=alive, drop=drop)
+        # fused propose (kernel.step docstring): one [N, L] write cond per
+        # scan iteration keeps the vmapped log buffers in place
+        new = step(st, cfg, alive=alive, drop=drop,
+                   prop_count=jnp.asarray(prop_count, I32),
+                   payload_fn=_payload_at)
+    else:
+        new = step(st, cfg, alive=alive, drop=drop)
     new = apply_mutation(new, cfg, mutation)
     bits = check_state(new, cfg) | check_transition(st, new)
     return new, bits

@@ -101,6 +101,10 @@ CATALOG: dict[str, MetricSpec] = {
         "gauge", "Isolated per-phase A-F cost in ms from the micro-kernel "
         "model (tools/perf_model.py), keyed by PERF.md's phase table.",
         ("phase",)),
+    "swarm_kernel_bytes_touched": MetricSpec(
+        "gauge", "Analytic per-tick log-buffer bytes read+written by the "
+        "C/E/F hot phases (tools/perf_model.py --tiled), by phase and "
+        "kernel variant (tiled / full).", ("phase", "variant")),
     "swarm_kernel_elections_started_total": MetricSpec(
         "counter", "On-device cumulative campaigns across all rows "
         "(SimState.stats[0]).", ()),

@@ -166,6 +166,51 @@ def sumsq(x: jax.Array, *, tile_m: int = 256,
     return out[0, 0]
 
 
+def _band_copy_kernel(d_ref, s_ref, m_ref, o_ref):
+    """One row-tile of the banded append copy: a pure VPU select."""
+    o_ref[:] = jnp.where(m_ref[:], s_ref[:], d_ref[:])
+
+
+@functools.partial(jax.jit, static_argnames=("tile_m", "interpret"))
+def append_band_copy(dst: jax.Array, src: jax.Array, write: jax.Array, *,
+                     tile_m: int = 8, interpret: bool | None = None
+                     ) -> jax.Array:
+    """Fused masked copy for one [N, C] log band chunk:
+    ``out[i, s] = src[i, s] if write[i, s] else dst[i, s]``.
+
+    The raft tick kernel's banded append pass (raft/sim/kernel.py, behind
+    SWARMKIT_PALLAS_BAND=1) routes its per-chunk write-back through this
+    kernel so the whole chunk streams once through VMEM on TPU; off-TPU it
+    runs in interpret mode and is value-identical to the jnp.where it
+    replaces (C is a cfg.log_chunk, i.e. a 128-multiple, so the compiled
+    path is always lane-aligned)."""
+    if dst.shape != src.shape or dst.shape != write.shape:
+        raise ValueError(
+            f"shape mismatch: dst {dst.shape}, src {src.shape}, "
+            f"write {write.shape}")
+    m, c = dst.shape
+    tile_m = min(tile_m, m)
+    while m % tile_m:
+        tile_m -= 1
+    if interpret is None:
+        interpret = not _on_tpu()
+    if not interpret and c % _LANE:
+        raise ValueError(
+            f"compiled TPU path needs a lane-aligned chunk width (multiple "
+            f"of {_LANE}): got {c}")
+    spec = pl.BlockSpec((tile_m, c), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _band_copy_kernel,
+        out_shape=jax.ShapeDtypeStruct((m, c), dst.dtype),
+        grid=(m // tile_m,),
+        in_specs=[spec, spec, spec],
+        out_specs=spec,
+        compiler_params=_compiler_params(("parallel",)),
+        interpret=interpret,
+    )(dst, src, write)
+
+
 def matmul_chain(x: jax.Array, a: jax.Array, steps: int, *,
                  tile: int = 256, interpret: bool | None = None) -> jax.Array:
     """`steps` rounds of x <- normalize(x @ a), all through the Pallas

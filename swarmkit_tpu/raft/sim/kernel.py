@@ -131,13 +131,94 @@ def _entry_chk(idx, data):
     return hash32(idx.astype(U32) * U32(0x01000193) ^ data.astype(U32))
 
 
+# ---- log-axis tiling (cfg.tiled) ----------------------------------------
+# Every [N, L] hot pass below (append copy, apply checksum, compaction
+# subtraction, conf-gate scans, dense propose) only has live work inside
+# the band of log indexes its cursors moved through this tick — at most
+# window / apply_batch / max_props / keep entries, a compile-time bound.
+# When cfg.tiled, the pass computes its band from [N] cursor extrema,
+# visits cfg.band_chunks aligned ring chunks of cfg.log_chunk slots via
+# lax.dynamic_slice, evaluates the SAME masked element-wise logic on each
+# [N, log_chunk] chunk, and (for writes) dynamic_update_slice's it back —
+# in place on the scan carry, so per-tick bytes scale with the work, not
+# with log capacity.  A lax.cond falls back to the full pass when the
+# cross-row straggler spread exceeds the band cap.  Bit-identity with the
+# full pass holds because the masks are functions of absolute log indexes
+# (false outside the live band), chunk visits are distinct
+# (band_chunks < num_chunks, enforced by SimConfig validation), and the
+# reductions are order-independent (bool any / int min / uint32 wrap-safe
+# sums) — asserted by tests/test_raft_sim.py::TestTiledLog and the DST
+# cross-check sweep.
+
+
+def _band_origin(cfg: SimConfig, lo, hi):
+    """Unwrapped chunk coordinates of the live band (lo, hi] of 1-based log
+    indexes: (c0u, nchunks) where c0u is the chunk holding index lo+1 and
+    nchunks counts chunks through index hi.  nchunks <= 0 on an empty band;
+    callers compare nchunks against cfg.band_chunks for the fallback."""
+    c0u = lo // cfg.log_chunk
+    return c0u, (hi - 1) // cfg.log_chunk - c0u + 1
+
+
+def _band_offsets(cfg: SimConfig, c0u):
+    """Slot offsets of the cfg.band_chunks ring chunks a banded pass
+    visits, starting at unwrapped chunk c0u.  Offsets are pairwise
+    distinct (band_chunks < num_chunks), so per-chunk reductions never
+    double count and per-chunk writes never overlap."""
+    return [((c0u + k) % cfg.num_chunks) * cfg.log_chunk
+            for k in range(cfg.band_chunks)]
+
+
+def _idx_at_band(cfg: SimConfig, anchor, off):
+    """[N, log_chunk] analog of _idx_at_slots for the single chunk at slot
+    offset `off` (traced), anchored at `anchor` [N]."""
+    s = (off + jnp.arange(cfg.log_chunk, dtype=I32))[None, :]
+    a = anchor[:, None]
+    return a - ((a - (s + 1)) % cfg.log_len)
+
+
+_PALLAS_BAND = None
+
+
+def _pallas_band_copy():
+    """Opt-in fused Pallas kernel for the banded append copy (set
+    SWARMKIT_PALLAS_BAND=1; parallel.pallas_ops.append_band_copy tiles the
+    chunk through VMEM on TPU, interpret mode elsewhere).  Off by default
+    so the portable hot path stays pure jnp — the op is value-identical to
+    jnp.where either way (asserted by tests)."""
+    global _PALLAS_BAND
+    if _PALLAS_BAND is None:
+        import os
+        if os.environ.get("SWARMKIT_PALLAS_BAND", "0") not in ("", "0"):
+            from swarmkit_tpu.parallel.pallas_ops import append_band_copy
+            _PALLAS_BAND = append_band_copy
+        else:
+            _PALLAS_BAND = False
+    return _PALLAS_BAND
+
+
 def step(state: SimState, cfg: SimConfig,
          alive: Optional[jax.Array] = None,
-         drop: Optional[jax.Array] = None) -> SimState:
+         drop: Optional[jax.Array] = None,
+         prop_count=None,
+         payload_fn: Optional[Callable] = None) -> SimState:
     """Advance every simulated manager by one tick.
 
     alive: [N] bool — False rows are crashed (frozen, no send/receive).
     drop:  [N, N] bool — drop[i, j] drops all i->j traffic this tick.
+
+    prop_count/payload_fn: optional FUSED dense propose — bit-identical to
+    ``step(propose_dense(state, cfg, payload_fn, prop_count, alive), ...)``
+    but with the proposal ring stores folded into Phase C's single
+    banded-write cond.  XLA keeps scan-carried [N, L] buffers in place only
+    while the scan body holds at most ONE cond whose branches rewrite them;
+    a separate propose_dense cond per tick costs full-capacity log copies
+    (the exact decoupling this tiling exists to remove), so the scan
+    drivers in run.py always propose through here.  The [N] cursor effects
+    land at the top of the tick; the three pre-cond ring reads that could
+    see a freshly proposed entry (last_term, local_p_term, have_term) are
+    patched analytically: a proposing row's new entries all carry its own
+    pre-tick term.
     """
     n = cfg.n
     node = jnp.arange(n, dtype=I32)
@@ -159,6 +240,18 @@ def step(state: SimState, cfg: SimConfig,
     pre = state.pre
     member = state.member
     pending_conf = state.pending_conf
+
+    # Fused dense propose (see docstring): cursor effects now, ring stores
+    # deferred to Phase C's write cond.  Rows are judged on the PRE-tick
+    # state exactly as a standalone propose_dense call would.
+    fused_prop = payload_fn is not None
+    if fused_prop:
+        prop_ok = _leader_ok(state, cfg, alive)
+        prop_cnt = jnp.asarray(prop_count, I32)
+        prop_last0 = last
+        prop_anchor = prop_last0 + prop_cnt
+        last = last + jnp.where(prop_ok, prop_cnt, 0).astype(I32)
+        match = jnp.where(prop_ok[:, None] & eye, last[:, None], match)
 
     # Per-row membership views: every quorum decision counts over the
     # deciding row's APPLIED configuration (reference: each node's prs map
@@ -322,6 +415,11 @@ def step(state: SimState, cfg: SimConfig,
     # BEFORE real votes each tick (defined delivery order), against the
     # receiver's pre-catch-up state; grants change NO receiver state.
     last_term = _term_own(cfg, log_term, snap_idx, snap_term, last, last)
+    if fused_prop:
+        # ring stores are still pending in Phase C; a proposing row's new
+        # last entry carries its own pre-tick term
+        last_term = jnp.where(prop_ok & (prop_cnt > 0), state.term,
+                              last_term)
     lt_i, lt_j = last_term[:, None], last_term[None, :]
     log_ok = (lt_i > lt_j) | ((lt_i == lt_j) & (last[:, None] >= last[None, :]))
     if cfg.pre_vote:
@@ -476,11 +574,17 @@ def step(state: SimState, cfg: SimConfig,
         probing = jnp.where(win[:, None], True, state.probing)
     else:
         probing = None
-    noop_slot = _slot(cfg, last + 1)
-    log_term = log_term.at[node, noop_slot].set(
-        jnp.where(win, term, log_term[node, noop_slot]))
-    log_data = log_data.at[node, noop_slot].set(
-        jnp.where(win, U32(0), log_data[node, noop_slot]))
+    noop_term = term   # the winner's candidacy term, captured HERE: later
+    #                    catch-ups must not leak into the noop entry
+    if not cfg.tiled:
+        noop_slot = _slot(cfg, last + 1)
+        log_term = log_term.at[node, noop_slot].set(
+            jnp.where(win, term, log_term[node, noop_slot]))
+        log_data = log_data.at[node, noop_slot].set(
+            jnp.where(win, U32(0), log_data[node, noop_slot]))
+    # else: the noop store rides Phase C's single write cond — a per-row
+    # scatter on the scan-carried [N, L] arrays defeats XLA's in-place
+    # aliasing and costs full-capacity log copies per tick
     last = last + win.astype(I32)
     is_leader = (role == LEADER) & alive
     match = jnp.where(win[:, None] & eye, last[:, None], match)
@@ -648,16 +752,13 @@ def step(state: SimState, cfg: SimConfig,
     # Slot alignment: slot(idx) = (idx-1) % L on every row, so entry idx
     # lives at the SAME slot on sender and receiver. The window copy is a
     # contiguous row-gather of the chosen sender's ring (log_*[src]) plus
-    # elementwise masks over [N, L] — no per-element gathers.
-    lead_term_row = log_term[src]                                # [N, L]
-    lead_data_row = log_data[src]                                # [N, L]
+    # elementwise masks over [N, L] — no per-element gathers.  Under
+    # cfg.tiled the copy visits only the live chunk band (see the log-axis
+    # tiling block above) with a full-pass fallback on straggler spread.
     last_src, snap_src = last[src], snap_idx[src]
-    lead_idx = _idx_at_slots(cfg, last_src)                      # [N, L]
 
     p = prev_mat[src, node]                                      # [j]
-    p_slot = _slot(cfg, p)
-    p_ring_term = jnp.take_along_axis(lead_term_row, p_slot[:, None],
-                                      axis=1)[:, 0]
+    p_ring_term = log_term[src, _slot(cfg, p)]   # one element per row
     p_term_sent = jnp.where(
         p == snap_src, snap_term[src],
         jnp.where((p > snap_src) & (p <= last_src), p_ring_term, 0))
@@ -671,24 +772,218 @@ def step(state: SimState, cfg: SimConfig,
     hi = p + n_avail                                             # lastnewi
 
     commit0 = commit  # pre-append commit (handleAppendEntries fast path)
-    local_p_term = _term_own(cfg, log_term, snap_idx, snap_term, last,
-                             jnp.minimum(p, last))
+    q_p = jnp.minimum(p, last)
+    local_p_term = _term_own(cfg, log_term, snap_idx, snap_term, last, q_p)
+    if fused_prop:
+        # a stale co-leader's prev can reach into the receiver's OWN
+        # freshly proposed range (still pending in the write cond)
+        local_p_term = jnp.where(prop_ok & (q_p > prop_last0), state.term,
+                                 local_p_term)
+    if cfg.tiled:
+        # likewise for a fresh winner's pending noop entry (idx == last)
+        local_p_term = jnp.where(win & (q_p == last), noop_term,
+                                 local_p_term)
     prev_ok = (p <= last) & (p >= snap_idx) & (local_p_term == p_term_sent)
     stale = p < commit0
     accept = got_app & prev_ok & ~stale
-
-    # find_conflict: first incoming entry missing locally or with a
-    # mismatched term, located by index (min over the masked index map).
-    in_win = got_app[:, None] & (lead_idx > p[:, None]) \
-        & (lead_idx <= hi[:, None])
-    exists = (lead_idx <= last[:, None]) & (lead_idx > snap_idx[:, None])
-    mism = in_win & (~exists | (log_term != lead_term_row))
-    any_mism = jnp.any(mism, axis=1)
     big = jnp.iinfo(jnp.int32).max
-    ci_idx = jnp.min(jnp.where(mism, lead_idx, big), axis=1)     # [j]
-    write = in_win & accept[:, None] & (lead_idx >= ci_idx[:, None])
-    log_term = jnp.where(write, lead_term_row, log_term)
-    log_data = jnp.where(write, lead_data_row, log_data)
+
+    # -- snapshot-receive decision, hoisted ABOVE the ring write cond so the
+    # banded branch can exclude restores from its predicate (the wipe is
+    # full-width by nature and rides the full branch).  Safe to hoist: for
+    # got_snap rows nothing the append pass updates (last/commit/ring row)
+    # changes — append and snapshot receipt are edge-disjoint.  Semantics
+    # at the original site, see "snapshot receive" below.
+    snap_pt = jnp.minimum(snap_idx[src], last)
+    have_term = _term_own(cfg, log_term, snap_idx, snap_term, last, snap_pt)
+    if fused_prop:
+        # deposed leader receiving a snapshot over its own pending proposals
+        have_term = jnp.where(prop_ok & (snap_pt > prop_last0), state.term,
+                              have_term)
+    if cfg.tiled:
+        have_term = jnp.where(win & (snap_pt == last), noop_term, have_term)
+    already = (snap_idx[src] <= last) & (have_term == snap_term[src])
+    advance = got_snap & (snap_idx[src] > commit)
+    do_restore = advance & ~already
+
+    if cfg.tiled:
+        # Window extraction: every entry VALUE the append pass can copy this
+        # tick lives in the sender's (p, p + window] range — gather it into
+        # [N, window] side buffers BEFORE the write cond, then let both
+        # branches read entry values ONLY from these.  This is what keeps
+        # the scan-carried logs copy-free on CPU: if a branch's log_data
+        # writes read log_term chunks (or row-gather lt[src]), XLA's fusion
+        # duplicates those reads into the data-side update with the whole
+        # term buffer as an operand, the live range of the pre-write value
+        # then spans the in-place writes, and copy insertion materializes
+        # full-capacity copies of the carry each tick.  With the values
+        # pre-gathered, each branch chain touches only its own buffer plus
+        # [N, window] operands, and the fallback becomes a pure elementwise
+        # select — in-place eligible — so the cond output can alias the
+        # carry.  The gathers see the PRE-cond ring; entries still pending
+        # in the write cond (fused proposals, a fresh winner's noop) are
+        # patched analytically, same trick as local_p_term above.
+        wspan = jnp.arange(cfg.window, dtype=I32)[None, :]
+        widx = p[:, None] + 1 + wspan                            # [N, W]
+        wslot = _slot(cfg, widx)
+        wsrc_t = log_term[src[:, None], wslot]   # sender window values
+        wsrc_d = log_data[src[:, None], wslot]
+        wown_t = jnp.take_along_axis(log_term, wslot, axis=1)
+        if fused_prop:
+            k_src = widx - prop_last0[src][:, None] - 1
+            pend_s = prop_ok[src][:, None] & (k_src >= 0) \
+                & (k_src < prop_cnt)
+            wsrc_t = jnp.where(pend_s, state.term[src][:, None], wsrc_t)
+            wsrc_d = jnp.where(
+                pend_s,
+                payload_fn(now, jnp.maximum(k_src, 0).astype(U32))
+                & U32(0x7FFFFFFF), wsrc_d)
+            k_own = widx - prop_last0[:, None] - 1
+            pend_o = prop_ok[:, None] & (k_own >= 0) & (k_own < prop_cnt)
+            wown_t = jnp.where(pend_o, state.term[:, None], wown_t)
+        noop_s = win[src][:, None] & (widx == last[src][:, None])
+        wsrc_t = jnp.where(noop_s, noop_term[src][:, None], wsrc_t)
+        wsrc_d = jnp.where(noop_s, U32(0), wsrc_d)
+        wown_t = jnp.where(win[:, None] & (widx == last[:, None]),
+                           noop_term[:, None], wown_t)
+        # find_conflict on the window axis (replaces the full-row scan):
+        # first incoming entry missing locally or with a mismatched term.
+        # widx > p by construction, so in_win needs only the upper bound.
+        w_in = got_app[:, None] & (widx <= hi[:, None])
+        w_exists = (widx <= last[:, None]) & (widx > snap_idx[:, None])
+        w_mism = w_in & (~w_exists | (wown_t != wsrc_t))
+        any_mism = jnp.any(w_mism, axis=1)
+        ci_idx = jnp.min(jnp.where(w_mism, widx, big), axis=1)   # [j]
+
+    def _prop_write_full(lt, ld):
+        # propose_dense._write_full inlined: slot -> new index map anchored
+        # one batch ahead of the pre-tick last
+        new_idx = _idx_at_slots(cfg, prop_anchor)                # [N, L]
+        k_of = new_idx - prop_last0[:, None] - 1
+        valid = prop_ok[:, None] & (k_of >= 0) & (k_of < prop_cnt)
+        pl = payload_fn(now, jnp.maximum(k_of, 0).astype(U32)) \
+            & U32(0x7FFFFFFF)
+        return (jnp.where(valid, state.term[:, None], lt),
+                jnp.where(valid, pl, ld))
+
+    def _append_full(lt, ld):
+        # find_conflict: first incoming entry missing locally or with a
+        # mismatched term, located by index (min over the masked index map).
+        lead_term_row = lt[src]                                  # [N, L]
+        lead_data_row = ld[src]
+        lead_idx = _idx_at_slots(cfg, last_src)                  # [N, L]
+        in_win = got_app[:, None] & (lead_idx > p[:, None]) \
+            & (lead_idx <= hi[:, None])
+        exists = (lead_idx <= last[:, None]) & (lead_idx > snap_idx[:, None])
+        mism = in_win & (~exists | (lt != lead_term_row))
+        am = jnp.any(mism, axis=1)
+        ci_idx = jnp.min(jnp.where(mism, lead_idx, big), axis=1)  # [j]
+        write = in_win & accept[:, None] & (lead_idx >= ci_idx[:, None])
+        return (jnp.where(write, lead_term_row, lt),
+                jnp.where(write, lead_data_row, ld), am)
+
+    def _ring_full(lt, ld):
+        # the tick's whole [N, L] mutation in original order: dense
+        # propose, append receive, snapshot-restore wipe (the untiled
+        # noop store is Phase B's scatter)
+        if fused_prop:
+            lt, ld = _prop_write_full(lt, ld)
+        lt, ld, am = _append_full(lt, ld)
+        lt = jnp.where(do_restore[:, None], 0, lt)
+        ld = jnp.where(do_restore[:, None], U32(0), ld)
+        return lt, ld, am
+
+    if cfg.tiled:
+        # Append band (min prev, max lastnewi] over receiving rows; the
+        # fused propose stores get their own band over proposing rows.
+        # ONE cond owns every [N, L] write of the tick (propose + noop +
+        # append + restore wipe): more than one write cond per scan
+        # iteration — or a scatter outside it — makes
+        # XLA materialize full-capacity log copies, re-coupling tick cost
+        # to L.  Entry values come from the pre-cond window buffers (no
+        # sender-row reads of the carry inside either branch); the banded
+        # predicate excludes restore ticks (full-width wipe), election
+        # ticks, and either band overflowing cfg.band_chunks.
+        lo_b = jnp.min(jnp.where(got_app, p, big))
+        hi_b = jnp.max(jnp.where(got_app, hi, 0))
+        c0u, nch = _band_origin(cfg, lo_b, hi_b)
+        # election ticks (any win: pending noop store) and restore ticks
+        # (full-width wipe) take the full branch — both are rare
+        fits = (nch <= cfg.band_chunks) & ~jnp.any(do_restore) \
+            & ~jnp.any(win)
+        if fused_prop:
+            lo_p = jnp.min(jnp.where(prop_ok, prop_last0, big))
+            hi_p = jnp.max(jnp.where(prop_ok, prop_anchor, 0))
+            c0p, nch_p = _band_origin(cfg, lo_p, hi_p)
+            fits = fits & (nch_p <= cfg.band_chunks)
+
+        def _write_at(lead_idx, lt_c, ld_c):
+            """Masked append write for one chunk/full view: entry values
+            come from the pre-gathered sender window, never from the other
+            carried buffer (the decoupling the header comment explains)."""
+            in_win = got_app[:, None] & (lead_idx > p[:, None]) \
+                & (lead_idx <= hi[:, None])
+            write = in_win & accept[:, None] & (lead_idx >= ci_idx[:, None])
+            wk = jnp.clip(lead_idx - p[:, None] - 1, 0, cfg.window - 1)
+            src_t = jnp.take_along_axis(wsrc_t, wk, axis=1)
+            src_d = jnp.take_along_axis(wsrc_d, wk, axis=1)
+            fused = _pallas_band_copy()
+            if fused and lt_c.shape[1] == cfg.log_chunk:
+                return fused(lt_c, src_t, write), fused(ld_c, src_d, write)
+            return (jnp.where(write, src_t, lt_c),
+                    jnp.where(write, src_d, ld_c))
+
+        def _ring_banded(lt, ld):
+            if fused_prop:
+                for off in _band_offsets(cfg, c0p):
+                    lt_c = jax.lax.dynamic_slice(lt, (0, off),
+                                                 (n, cfg.log_chunk))
+                    ld_c = jax.lax.dynamic_slice(ld, (0, off),
+                                                 (n, cfg.log_chunk))
+                    new_idx = _idx_at_band(cfg, prop_anchor, off)
+                    k_of = new_idx - prop_last0[:, None] - 1
+                    valid = prop_ok[:, None] & (k_of >= 0) \
+                        & (k_of < prop_cnt)
+                    pl = payload_fn(now, jnp.maximum(k_of, 0).astype(U32)) \
+                        & U32(0x7FFFFFFF)
+                    lt = jax.lax.dynamic_update_slice(
+                        lt, jnp.where(valid, state.term[:, None], lt_c),
+                        (0, off))
+                    ld = jax.lax.dynamic_update_slice(
+                        ld, jnp.where(valid, pl, ld_c), (0, off))
+            # append write-back, one visit per chunk (the conflict scan ran
+            # on the window buffers above, outside the cond)
+            for off in _band_offsets(cfg, c0u):
+                lt_c = jax.lax.dynamic_slice(lt, (0, off),
+                                             (n, cfg.log_chunk))
+                ld_c = jax.lax.dynamic_slice(ld, (0, off),
+                                             (n, cfg.log_chunk))
+                lt_w, ld_w = _write_at(_idx_at_band(cfg, last_src, off),
+                                       lt_c, ld_c)
+                lt = jax.lax.dynamic_update_slice(lt, lt_w, (0, off))
+                ld = jax.lax.dynamic_update_slice(ld, ld_w, (0, off))
+            return lt, ld
+
+        def _ring_full_t(lt, ld):
+            # tiled fallback: same mutations as _ring_full but elementwise
+            # in the carry (values via the window buffers), so XLA can run
+            # this branch in place too and the cond output aliases the
+            # carry; includes the fallback-only noop store and restore wipe
+            if fused_prop:
+                lt, ld = _prop_write_full(lt, ld)
+            own_idx = _idx_at_slots(cfg, last)
+            noop_m = win[:, None] & (own_idx == last[:, None])
+            lt = jnp.where(noop_m, noop_term[:, None], lt)
+            ld = jnp.where(noop_m, U32(0), ld)
+            lt, ld = _write_at(_idx_at_slots(cfg, last_src), lt, ld)
+            lt = jnp.where(do_restore[:, None], 0, lt)
+            ld = jnp.where(do_restore[:, None], U32(0), ld)
+            return lt, ld
+
+        log_term, log_data = jax.lax.cond(
+            fits, _ring_banded, _ring_full_t, log_term, log_data)
+    else:
+        log_term, log_data, any_mism = _ring_full(log_term, log_data)
     lastnewi = hi
     last = jnp.where(accept,
                      jnp.where(any_mism, lastnewi, jnp.maximum(last, lastnewi)),
@@ -701,12 +996,9 @@ def step(state: SimState, cfg: SimConfig,
     # -- snapshot receive: jump to the sender's compaction watermark.
     # If our log already contains the snapshot point (same term), only
     # fast-forward commit — never wipe acked-but-uncommitted suffix entries
-    # (core.py _restore / etcd raft.go restore semantics).
-    snap_pt = jnp.minimum(snap_idx[src], last)
-    have_term = _term_own(cfg, log_term, snap_idx, snap_term, last, snap_pt)
-    already = (snap_idx[src] <= last) & (have_term == snap_term[src])
-    advance = got_snap & (snap_idx[src] > commit)
-    do_restore = advance & ~already
+    # (core.py _restore / etcd raft.go restore semantics).  The decision
+    # (do_restore) was hoisted above the write cond; the ring wipe already
+    # happened inside it.  Only the cursor/meta effects land here.
     commit = jnp.where(advance & already, snap_idx[src], commit)
     r_src = src
     last = jnp.where(do_restore, snap_idx[r_src], last)
@@ -717,8 +1009,6 @@ def step(state: SimState, cfg: SimConfig,
     new_snap_chk = jnp.where(do_restore, snap_chk[r_src], snap_chk)
     new_snap_idx = jnp.where(do_restore, snap_idx[r_src], snap_idx)
     snap_term, snap_chk, snap_idx = new_snap_term, new_snap_chk, new_snap_idx
-    log_term = jnp.where(do_restore[:, None], 0, log_term)
-    log_data = jnp.where(do_restore[:, None], U32(0), log_data)
     # The snapshot carries the sender's configuration (SnapshotMeta.voters;
     # core._restore rebuilds prs from it): adopt the sender's view.  Conf
     # entries in (snap_idx, sender.applied] are re-applied later via the
@@ -896,27 +1186,68 @@ def step(state: SimState, cfg: SimConfig,
     # is clamped AT the first conf entry so at most one membership flip
     # lands per row per tick (order within a batch is thereby trivial; the
     # propose-side one-in-flight gate makes >1 conf per window rare anyway).
-    own_idx = _idx_at_slots(cfg, last)                           # [N, L]
     base_applied = jnp.minimum(commit, applied + cfg.apply_batch)
     base_applied = jnp.where(alive, base_applied, applied)  # crashed: frozen
-    win_mask = (own_idx > applied[:, None]) \
-        & (own_idx <= base_applied[:, None])
+    if cfg.tiled:
+        # Per-row gather window instead of a shared chunk band: each row's
+        # apply window (applied, base_applied] is at most apply_batch wide
+        # BY CONSTRUCTION, so a [N, apply_batch] take_along_axis covers it
+        # exactly — no straggler fallback cond needed, and keeping the
+        # buffer out of extra conditionals lets the scan keep it in place
+        # (every lax.cond consuming the log carry risks a defensive
+        # full-capacity copy on the CPU backend).  The U32 checksum sum is
+        # order-independent (modular add), so summing in index order
+        # matches the full pass bit-for-bit.
+        aspan = jnp.arange(cfg.apply_batch, dtype=I32)[None, :]
+        aidx = applied[:, None] + 1 + aspan                     # [N, V]
+        am_e = aidx <= base_applied[:, None]
     if static_m:
         # No conf entries can exist (propose masks the tag bit and
         # propose_conf is a trace-time error): apply the whole batch.
         new_applied = base_applied
-        app_mask = win_mask
+
+        def _apply_full(ld):
+            own_idx = _idx_at_slots(cfg, last)                   # [N, L]
+            app_mask = (own_idx > applied[:, None]) \
+                & (own_idx <= base_applied[:, None])
+            return jnp.sum(jnp.where(app_mask, _entry_chk(own_idx, ld),
+                                     U32(0)), axis=1, dtype=U32)
+
+        if cfg.tiled:
+            avals = jnp.take_along_axis(log_data, _slot(cfg, aidx), axis=1)
+            chk_inc = jnp.sum(
+                jnp.where(am_e, _entry_chk(aidx, avals), U32(0)),
+                axis=1, dtype=U32)
+        else:
+            chk_inc = _apply_full(log_data)
     else:
-        is_conf_ring = _is_conf(log_data)                        # [N, L]
-        conf_in_win = win_mask & is_conf_ring
-        big = jnp.iinfo(jnp.int32).max
-        first_conf = jnp.min(jnp.where(conf_in_win, own_idx, big), axis=1)
+        def _apply_full(ld):
+            own_idx = _idx_at_slots(cfg, last)                   # [N, L]
+            win_mask = (own_idx > applied[:, None]) \
+                & (own_idx <= base_applied[:, None])
+            conf_in_win = win_mask & _is_conf(ld)
+            fc = jnp.min(jnp.where(conf_in_win, own_idx, big), axis=1)
+            na = jnp.minimum(base_applied, jnp.where(fc < big, fc, big))
+            app_mask = win_mask & (own_idx <= na[:, None])
+            return (jnp.sum(jnp.where(app_mask, _entry_chk(own_idx, ld),
+                                      U32(0)), axis=1, dtype=U32), fc)
+
+        if cfg.tiled:
+            avals = jnp.take_along_axis(log_data, _slot(cfg, aidx), axis=1)
+            fc = jnp.min(jnp.where(am_e & _is_conf(avals), aidx, big),
+                         axis=1)
+            na = jnp.minimum(base_applied, jnp.where(fc < big, fc, big))
+            chk_inc = jnp.sum(
+                jnp.where(am_e & (aidx <= na[:, None]),
+                          _entry_chk(aidx, avals), U32(0)),
+                axis=1, dtype=U32)
+            first_conf = fc
+        else:
+            chk_inc, first_conf = _apply_full(log_data)
         has_conf = first_conf < big
         new_applied = jnp.minimum(base_applied,
                                   jnp.where(has_conf, first_conf, big))
-        app_mask = win_mask & (own_idx <= new_applied[:, None])
-    contrib = jnp.where(app_mask, _entry_chk(own_idx, log_data), U32(0))
-    apply_chk = apply_chk + jnp.sum(contrib, axis=1, dtype=U32)
+    apply_chk = apply_chk + chk_inc
     applied = new_applied
 
     if not static_m:
@@ -956,9 +1287,26 @@ def step(state: SimState, cfg: SimConfig,
     new_snap = jnp.maximum(snap_idx, applied - cfg.keep)
     do_compact = pressure & (new_snap > snap_idx) & alive
     nst = _term_own(cfg, log_term, snap_idx, snap_term, last, new_snap)
-    ahead = (own_idx > new_snap[:, None]) & (own_idx <= applied[:, None])
-    ahead_sum = jnp.sum(jnp.where(ahead, _entry_chk(own_idx, log_data),
-                                  U32(0)), axis=1, dtype=U32)
+
+    def _ahead_full(ld):
+        own_idx = _idx_at_slots(cfg, last)                       # [N, L]
+        ahead = (own_idx > new_snap[:, None]) & (own_idx <= applied[:, None])
+        return jnp.sum(jnp.where(ahead, _entry_chk(own_idx, ld), U32(0)),
+                       axis=1, dtype=U32)
+
+    if cfg.tiled:
+        # Per-row gather window, same trade as the apply pass: the span
+        # (new_snap, applied] is at most `keep` wide by construction
+        # (new_snap >= applied - keep on every row), so [N, keep] indices
+        # cover it exactly with no fallback cond.
+        fspan = jnp.arange(max(cfg.keep, 1), dtype=I32)[None, :]
+        fidx = new_snap[:, None] + 1 + fspan                     # [N, keep]
+        fvals = jnp.take_along_axis(log_data, _slot(cfg, fidx), axis=1)
+        ahead_sum = jnp.sum(
+            jnp.where(fidx <= applied[:, None], _entry_chk(fidx, fvals),
+                      U32(0)), axis=1, dtype=U32)
+    else:
+        ahead_sum = _ahead_full(log_data)
     nsc = apply_chk - ahead_sum
     snap_term = jnp.where(do_compact, nst, snap_term)
     snap_chk = jnp.where(do_compact, nsc, snap_chk)
@@ -978,12 +1326,45 @@ def step(state: SimState, cfg: SimConfig,
     if static_m:
         hup_conf, tail_conf = state.hup_conf, state.tail_conf  # all-False
     else:
-        hup_conf = jnp.any((own_idx > applied[:, None])
-                           & (own_idx <= commit[:, None]) & is_conf_ring,
-                           axis=1)
-        tail_conf = jnp.any((own_idx > commit[:, None])
-                            & (own_idx <= last[:, None]) & is_conf_ring,
-                            axis=1)
+        def _gates_full(ld):
+            own_idx = _idx_at_slots(cfg, last)                   # [N, L]
+            icr = _is_conf(ld)
+            hup = jnp.any((own_idx > applied[:, None])
+                          & (own_idx <= commit[:, None]) & icr, axis=1)
+            tail = jnp.any((own_idx > commit[:, None])
+                           & (own_idx <= last[:, None]) & icr, axis=1)
+            return hup, tail
+
+        if cfg.tiled:
+            # applied <= commit <= last, so (applied, last] covers both
+            # scans; a straggler's whole backlog can exceed the band cap,
+            # falling back to the full scan.
+            work_g = last > applied
+            lo_g = jnp.min(jnp.where(work_g, applied, big))
+            hi_g = jnp.max(jnp.where(work_g, last, 0))
+            c0g, nch_g = _band_origin(cfg, lo_g, hi_g)
+
+            def _gates_banded(ld):
+                hup = jnp.zeros((n,), bool)
+                tail = jnp.zeros((n,), bool)
+                for off in _band_offsets(cfg, c0g):
+                    ld_c = jax.lax.dynamic_slice(ld, (0, off),
+                                                 (n, cfg.log_chunk))
+                    oi = _idx_at_band(cfg, last, off)
+                    icr = _is_conf(ld_c)
+                    hup = hup | jnp.any(
+                        (oi > applied[:, None]) & (oi <= commit[:, None])
+                        & icr, axis=1)
+                    tail = tail | jnp.any(
+                        (oi > commit[:, None]) & (oi <= last[:, None])
+                        & icr, axis=1)
+                return hup, tail
+
+            hup_conf, tail_conf = jax.lax.cond(
+                nch_g <= cfg.band_chunks, _gates_banded, _gates_full,
+                log_data)
+        else:
+            hup_conf, tail_conf = _gates_full(log_data)
     # Cumulative event counters (cfg.collect_stats): cheap reduces appended
     # to the program so host metrics can read kernel activity from a [4]
     # vector instead of diffing full states (see metrics/catalog.py
@@ -1082,14 +1463,51 @@ def propose_dense(state: SimState, cfg: SimConfig,
     n = cfg.n
     ok = _leader_ok(state, cfg, alive)
     count = jnp.asarray(count, I32)
-    # slot -> new index map anchored one batch ahead of last
-    new_idx = _idx_at_slots(cfg, state.last + count)             # [N, L]
-    k_of = new_idx - state.last[:, None] - 1                     # [N, L]
-    valid = ok[:, None] & (k_of >= 0) & (k_of < count)
-    pl = payload_fn(state.tick, jnp.maximum(k_of, 0).astype(U32)) \
-        & U32(0x7FFFFFFF)
-    log_term = jnp.where(valid, state.term[:, None], state.log_term)
-    log_data = jnp.where(valid, pl, state.log_data)
+    anchor = state.last + count
+
+    def _write_full(lt, ld):
+        # slot -> new index map anchored one batch ahead of last
+        new_idx = _idx_at_slots(cfg, anchor)                     # [N, L]
+        k_of = new_idx - state.last[:, None] - 1                 # [N, L]
+        valid = ok[:, None] & (k_of >= 0) & (k_of < count)
+        pl = payload_fn(state.tick, jnp.maximum(k_of, 0).astype(U32)) \
+            & U32(0x7FFFFFFF)
+        return (jnp.where(valid, state.term[:, None], lt),
+                jnp.where(valid, pl, ld))
+
+    if cfg.tiled:
+        # Banded store over (min last, max last+count] of proposing rows —
+        # same geometry as the kernel's append band (leaders at different
+        # terms can sit far apart: the cond falls back to the full pass).
+        big = jnp.iinfo(jnp.int32).max
+        lo_p = jnp.min(jnp.where(ok, state.last, big))
+        hi_p = jnp.max(jnp.where(ok, anchor, 0))
+        c0p, nch_p = _band_origin(cfg, lo_p, hi_p)
+
+        def _write_banded(lt, ld):
+            for off in _band_offsets(cfg, c0p):
+                lt_c = jax.lax.dynamic_slice(lt, (0, off),
+                                             (n, cfg.log_chunk))
+                ld_c = jax.lax.dynamic_slice(ld, (0, off),
+                                             (n, cfg.log_chunk))
+                new_idx = _idx_at_band(cfg, anchor, off)
+                k_of = new_idx - state.last[:, None] - 1
+                valid = ok[:, None] & (k_of >= 0) & (k_of < count)
+                pl = payload_fn(state.tick,
+                                jnp.maximum(k_of, 0).astype(U32)) \
+                    & U32(0x7FFFFFFF)
+                lt = jax.lax.dynamic_update_slice(
+                    lt, jnp.where(valid, state.term[:, None], lt_c),
+                    (0, off))
+                ld = jax.lax.dynamic_update_slice(
+                    ld, jnp.where(valid, pl, ld_c), (0, off))
+            return lt, ld
+
+        log_term, log_data = jax.lax.cond(
+            nch_p <= cfg.band_chunks, _write_banded, _write_full,
+            state.log_term, state.log_data)
+    else:
+        log_term, log_data = _write_full(state.log_term, state.log_data)
     new_last = state.last + jnp.where(ok, count, 0).astype(I32)
     eye = jnp.eye(n, dtype=bool)
     match = jnp.where(ok[:, None] & eye, new_last[:, None], state.match)

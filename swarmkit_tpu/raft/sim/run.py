@@ -74,11 +74,17 @@ def run_ticks(state: SimState, cfg: SimConfig, n_ticks: int,
             downed, down_left = new_downed, new_left
             alive = alive & ~((jnp.arange(n, dtype=I32) == downed)
                               & (down_left > 0))
-        if prop_count:
-            st = propose_dense(st, cfg, _payload_at,
-                               jnp.asarray(prop_count, I32), alive=alive)
         drop = drop_matrix(cfg, tick, drop_rate) if drop_rate else None
-        st = step(st, cfg, alive=alive, drop=drop)
+        if prop_count:
+            # fused propose: bit-identical to a propose_dense call before
+            # step, but all [N, L] stores share ONE cond inside the scan
+            # body so XLA keeps the log buffers in place (kernel.step
+            # docstring; a separate propose cond costs full-log copies)
+            st = step(st, cfg, alive=alive, drop=drop,
+                      prop_count=jnp.asarray(prop_count, I32),
+                      payload_fn=_payload_at)
+        else:
+            st = step(st, cfg, alive=alive, drop=drop)
         row = jnp.stack([jnp.sum(leader_mask(st).astype(I32)),
                          jnp.max(st.commit), jnp.max(st.term)])
         return (st, downed, down_left), row
@@ -104,9 +110,12 @@ def run_schedule(state: SimState, cfg: SimConfig, drop: jax.Array,
     def body(st, xs):
         drop_t, alive_t = xs
         if prop_count:
-            st = propose_dense(st, cfg, _payload_at,
-                               jnp.asarray(prop_count, I32), alive=alive_t)
-        st = step(st, cfg, alive=alive_t, drop=drop_t)
+            # fused propose, same rationale as run_ticks
+            st = step(st, cfg, alive=alive_t, drop=drop_t,
+                      prop_count=jnp.asarray(prop_count, I32),
+                      payload_fn=_payload_at)
+        else:
+            st = step(st, cfg, alive=alive_t, drop=drop_t)
         row = jnp.stack([jnp.sum(leader_mask(st).astype(I32)),
                          jnp.max(st.commit), jnp.max(st.term)])
         return st, row

@@ -102,6 +102,36 @@ class SimConfig:
     # same way).  propose_conf() on a static-members config is a trace-time
     # error.
     static_members: bool = False
+    # Log-axis tiling (kernel.py banded passes): chunk width in ring slots.
+    # When 0 < log_chunk < log_len the [N, L] hot phases (append receive,
+    # apply+checksum, conf scans, compaction, dense propose) slice only the
+    # lane-aligned chunks covering the tick's active cursor band out of the
+    # ring, so per-tick cost scales with window/apply_batch instead of L —
+    # with a full-pass fallback branch when straggler spread exceeds the
+    # band cap (bit-identical either way; see TestTiledLog).  log_chunk=0
+    # disables tiling explicitly; a chunk >= log_len disables it trivially
+    # (the default leaves every small-ring test config untiled).
+    log_chunk: int = 1024
+
+    @property
+    def tiled(self) -> bool:
+        """True when the kernel compiles the banded (chunked) log passes."""
+        return 0 < self.log_chunk < self.log_len
+
+    @property
+    def num_chunks(self) -> int:
+        """Chunks per ring (only meaningful when tiled)."""
+        return self.log_len // self.log_chunk
+
+    @property
+    def band_chunks(self) -> int:
+        """Compile-time cap on chunks one banded pass visits: the widest
+        per-tick cursor advance (window / apply_batch / max_props / keep)
+        plus two boundary chunks for band misalignment and cross-row
+        spread.  A band wider than this falls back to the full pass."""
+        widest = max(self.window, self.apply_batch, self.max_props,
+                     self.keep)
+        return widest // self.log_chunk + 2
 
     @property
     def ack_depth(self) -> int:
@@ -127,6 +157,31 @@ class SimConfig:
             # a full round trip must fit well inside the election timeout or
             # healthy leaders get deposed by their own followers
             assert 2 * (self.latency + self.latency_jitter) < self.election_tick
+        # Tiling validation: clear trace-time errors instead of silent
+        # mis-tiling (the banded passes assume aligned, ring-dividing
+        # chunks and a band cap strictly under the chunk count).
+        if self.log_chunk < 0:
+            raise ValueError(f"log_chunk must be >= 0, got {self.log_chunk}")
+        if self.tiled:
+            if self.log_chunk % 128 != 0:
+                raise ValueError(
+                    f"log_chunk={self.log_chunk} must be a multiple of 128 "
+                    f"(TPU lane alignment for the banded dynamic slices); "
+                    f"set log_chunk=0 to disable tiling")
+            if self.log_len % self.log_chunk != 0:
+                raise ValueError(
+                    f"log_chunk={self.log_chunk} must divide "
+                    f"log_len={self.log_len} (the ring is sliced in whole "
+                    f"chunks); set log_chunk=0 to disable tiling")
+            if self.band_chunks >= self.num_chunks:
+                raise ValueError(
+                    f"window/apply_batch/max_props/keep "
+                    f"({self.window}/{self.apply_batch}/{self.max_props}/"
+                    f"{self.keep}) are inconsistent with the band cap: "
+                    f"band_chunks={self.band_chunks} must stay below "
+                    f"num_chunks={self.num_chunks} or the banded pass "
+                    f"covers the whole ring — raise log_len, raise "
+                    f"log_chunk, or set log_chunk=0 to disable tiling")
 
 
 @jax.tree_util.register_dataclass

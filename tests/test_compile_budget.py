@@ -1,0 +1,58 @@
+"""Compile-time memory budget for the headline bench shape.
+
+The tiled log axis exists so tick cost — and XLA's temp allocation —
+scales with the active window, not log capacity.  This pins that property
+at compile time: lowering the headline `run_ticks` program (n=4096,
+L=8192, tiled, static members) must stay under a temp-memory budget that
+the full-pass kernel CANNOT meet (it materializes whole [N, L] buffer
+copies per tick: ~709 MB temp vs ~378 MB tiled when this was pinned).  A
+regression that re-introduces full-width materialization — a fusion
+regression, a new cross-buffer coupling, a dropped in-place DUS chain —
+trips this without running a single tick.
+
+CPU-backend numbers; the budget is about the program structure XLA emits,
+which the differential and DST suites pin for value-identity.
+"""
+
+from swarmkit_tpu.raft.sim import SimConfig, init_state
+from swarmkit_tpu.raft.sim.run import run_ticks
+
+# Between the measured tiled high-water mark (~378 MB) and the full-pass
+# kernel's (~709 MB): headroom for compiler drift, hard fail on any
+# full-width materialization creeping back in.
+TEMP_BUDGET_BYTES = 512 * 1024 * 1024
+
+
+def test_headline_tiled_compile_fits_temp_budget():
+    cfg = SimConfig(n=4096, log_len=8192, window=2048, apply_batch=2048,
+                    max_props=2048, keep=500, static_members=True,
+                    log_chunk=1024)
+    assert cfg.tiled
+    st = init_state(cfg)
+    compiled = run_ticks.lower(st, cfg, 8, prop_count=64).compile()
+    stats = compiled.memory_analysis()
+    assert stats is not None, "backend exposes no memory analysis"
+    temp = stats.temp_size_in_bytes
+    assert temp > 0, "suspicious zero temp size — analysis not populated"
+    assert temp <= TEMP_BUDGET_BYTES, (
+        f"tiled headline compile uses {temp / 2**20:.0f} MiB temp, over "
+        f"the {TEMP_BUDGET_BYTES / 2**20:.0f} MiB budget — a full-width "
+        f"[N, L] materialization likely crept back into the tick kernel")
+
+
+def test_small_tiled_compile_fits_scaled_budget():
+    """Tier-1-sized version of the same pin (n=256): catches the same
+    full-materialization regressions in seconds.  Budget scaling: tiled
+    temp is dominated by per-row O(window)/O(band) scratch, so 1/16 the
+    rows gets 1/16 the budget (plus a small constant floor)."""
+    cfg = SimConfig(n=256, log_len=8192, window=2048, apply_batch=2048,
+                    max_props=2048, keep=500, static_members=True,
+                    log_chunk=1024)
+    st = init_state(cfg)
+    compiled = run_ticks.lower(st, cfg, 8, prop_count=64).compile()
+    stats = compiled.memory_analysis()
+    assert stats is not None, "backend exposes no memory analysis"
+    temp = stats.temp_size_in_bytes
+    assert 0 < temp <= TEMP_BUDGET_BYTES // 16 + 8 * 2**20, (
+        f"tiled n=256 compile uses {temp / 2**20:.0f} MiB temp — a "
+        f"full-width [N, L] materialization likely crept back in")

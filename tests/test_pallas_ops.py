@@ -87,3 +87,42 @@ def test_matmul_chain_matches_builtin_chain():
                                np.asarray(want, np.float32),
                                rtol=1e-1, atol=1e-1)
     assert np.isfinite(np.asarray(got, np.float32)).all()
+
+
+@pytest.mark.parametrize("dtype", [jnp.int32, jnp.uint32])
+def test_append_band_copy_matches_where(dtype):
+    """The raft kernel's fused banded-append copy (SWARMKIT_PALLAS_BAND=1)
+    must be value-identical to the jnp.where it replaces, for both log
+    buffer dtypes, including uneven row-tile splits."""
+    rng = np.random.default_rng(11)
+    for m, c, tile_m in ((8, 128, 8), (5, 256, 8), (12, 128, 5)):
+        dst = jnp.asarray(rng.integers(0, 2**31, (m, c)), dtype)
+        src = jnp.asarray(rng.integers(0, 2**31, (m, c)), dtype)
+        write = jnp.asarray(rng.random((m, c)) < 0.3)
+        got = pallas_ops.append_band_copy(dst, src, write, tile_m=tile_m,
+                                          interpret=True)
+        want = jnp.where(write, src, dst)
+        assert got.dtype == dtype
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_append_band_copy_rejects_shape_mismatch():
+    dst = jnp.zeros((4, 128), jnp.int32)
+    src = jnp.zeros((4, 256), jnp.int32)
+    with pytest.raises(ValueError, match="shape mismatch"):
+        pallas_ops.append_band_copy(dst, src, jnp.zeros((4, 128), bool))
+
+
+def test_pallas_band_env_gate_selects_kernel(monkeypatch):
+    """kernel._pallas_band_copy() resolves the env gate once: default off
+    (pure jnp path), SWARMKIT_PALLAS_BAND=1 routes chunk write-backs
+    through append_band_copy."""
+    from swarmkit_tpu.raft.sim import kernel
+
+    monkeypatch.setattr(kernel, "_PALLAS_BAND", None)
+    monkeypatch.setenv("SWARMKIT_PALLAS_BAND", "0")
+    assert kernel._pallas_band_copy() is False
+    monkeypatch.setattr(kernel, "_PALLAS_BAND", None)
+    monkeypatch.setenv("SWARMKIT_PALLAS_BAND", "1")
+    assert kernel._pallas_band_copy() is pallas_ops.append_band_copy
+    monkeypatch.setattr(kernel, "_PALLAS_BAND", None)

@@ -827,3 +827,162 @@ class TestStaticMembers:
                         max_props=16, keep=8, static_members=True)
         with pytest.raises(ValueError, match="static_members"):
             init_state(cfg, voters=[0, 1, 2])
+
+
+class TestTiledLog:
+    """The chunked log axis (cfg.log_chunk > 0) rewrites the [N, L] hot
+    phases — append fan-out, apply+checksum, compaction — as active-window
+    banded passes.  It is an OPTIMIZATION, not a semantic: every SimState
+    field (including the raw ring buffers) must be bit-identical to the
+    full-pass kernel on every schedule, on both wires, through elections,
+    crashes, drops, transfers, and the masked full-pass fallback branch."""
+
+    @staticmethod
+    def _field_names():
+        import dataclasses
+
+        from swarmkit_tpu.raft.sim.state import SimState
+        return [f.name for f in dataclasses.fields(SimState)]
+
+    @staticmethod
+    def _fused_step():
+        from swarmkit_tpu.raft.sim.run import _payload_at
+        return jax.jit(
+            lambda st, cfg, alive, drop, cnt: step(
+                st, cfg, alive=alive, drop=drop, prop_count=cnt,
+                payload_fn=_payload_at),
+            static_argnames=("cfg",))
+
+    def _assert_identical(self, tag, t, golden, other, fields):
+        for f in fields:
+            g = np.asarray(getattr(golden, f))
+            v = np.asarray(getattr(other, f))
+            if not np.array_equal(g, v):
+                bad = np.argwhere(g != v)[:5]
+                raise AssertionError(
+                    f"{tag} tick {t}: field {f} diverged at {bad.tolist()}")
+
+    @pytest.mark.parametrize(
+        "combo", ["dynamic-sync", "static-sync", "dynamic-mailbox"])
+    def test_bit_identity_under_faults(self, combo):
+        """300 faulted ticks (crashes, drops, leader transfers, bursty
+        fused proposals): tiled-fused and untiled-fused vs the untiled
+        separate-propose ground truth, all fields compared every tick."""
+        from swarmkit_tpu.raft.sim.kernel import propose_dense
+        from swarmkit_tpu.raft.sim.run import _payload_at
+
+        static = combo.startswith("static")
+        base = dict(n=7, log_len=1024, window=64, apply_batch=64,
+                    max_props=64, keep=32, election_tick=14, seed=3,
+                    static_members=static)
+        if combo.endswith("mailbox"):
+            base.update(latency=2, latency_jitter=1, inflight=2)
+        cfg_t = SimConfig(**base, log_chunk=128)
+        cfg_u = SimConfig(**base, log_chunk=0)
+        assert cfg_t.tiled and not cfg_u.tiled
+        step_fused = self._fused_step()
+        prop_dense = jax.jit(
+            lambda st, cfg, cnt, alive: propose_dense(
+                st, cfg, _payload_at, cnt, alive=alive),
+            static_argnames=("cfg",))
+        fields = self._field_names()
+        rng = np.random.default_rng(42)
+        st_t, st_uf, st_us = (init_state(cfg_t), init_state(cfg_u),
+                              init_state(cfg_u))
+        for t in range(300):
+            alive = jnp.asarray(rng.random(7) > 0.08)
+            drop = jnp.asarray(rng.random((7, 7)) < 0.05)
+            cnt = jnp.asarray(int(rng.integers(0, 49)), jnp.int32)
+            if t % 37 == 36:
+                leaders = np.flatnonzero(np.asarray(st_us.role) == LEADER)
+                if len(leaders):
+                    lid, tgt = int(leaders[0]), int(rng.integers(7))
+                    st_t = transfer_leadership(st_t, cfg_t, lid, tgt)
+                    st_uf = transfer_leadership(st_uf, cfg_u, lid, tgt)
+                    st_us = transfer_leadership(st_us, cfg_u, lid, tgt)
+            st_t = step_fused(st_t, cfg_t, alive, drop, cnt)
+            st_uf = step_fused(st_uf, cfg_u, alive, drop, cnt)
+            st_us = prop_dense(st_us, cfg_u, cnt, alive)
+            st_us = step_j(st_us, cfg_u, alive=alive, drop=drop)
+            self._assert_identical(f"{combo}/tiled-fused", t, st_us, st_t,
+                                   fields)
+            self._assert_identical(f"{combo}/untiled-fused", t, st_us,
+                                   st_uf, fields)
+        assert int(np.asarray(st_us.commit).max()) > 100
+
+    def test_forced_fallback_win_and_restore_identical(self):
+        """Deterministically drives the tiled kernel through its masked
+        full-pass fallback branch and asserts bit-identity on every tick.
+
+        The band cap covers the widest LEGAL append spread by construction
+        (keep bounds how far a straggler can lag before the snapshot path
+        takes over), so the fallback's triggers are the other `fits`
+        terms: election-win ticks (any(win) — the winner stamps a noop at
+        its own head) and snapshot-restore ticks (any(do_restore) — a
+        revived straggler's ring is wiped).  This schedule forces both:
+        the initial election, then a crash long enough that ring-pressure
+        compaction (fires when last - snap_idx nears log_len) overtakes
+        the victim so its revival is a restore, then a re-election after
+        the leader itself crashes."""
+        base = dict(n=3, log_len=1024, window=64, apply_batch=64,
+                    max_props=32, keep=32, election_tick=10, seed=5)
+        cfg_t = SimConfig(**base, log_chunk=128)
+        cfg_u = SimConfig(**base, log_chunk=0)
+        step_fused = self._fused_step()
+        fields = self._field_names()
+        st_t, st_u = init_state(cfg_t), init_state(cfg_u)
+        no_drop = jnp.zeros((3, 3), bool)
+        all_up = jnp.ones(3, bool)
+        cnt8 = jnp.asarray(32, jnp.int32)
+
+        def tick(alive, cnt, t, tag):
+            nonlocal st_t, st_u
+            st_t = step_fused(st_t, cfg_t, alive, no_drop, cnt)
+            st_u = step_fused(st_u, cfg_u, alive, no_drop, cnt)
+            self._assert_identical(tag, t, st_u, st_t, fields)
+
+        for t in range(40):  # election win tick -> first forced fallback
+            tick(all_up, cnt8, t, "warmup")
+            if len(leaders_of(st_u)) and t > 5:
+                break
+        leaders = leaders_of(st_u)
+        assert len(leaders) == 1
+        victim = (int(leaders[0]) + 1) % 3
+        down = all_up.at[victim].set(False)
+        for t in range(45):  # leader fills the ring: pressure compaction
+            tick(down, cnt8, t, "down")  # overtakes the crashed victim
+        assert int(np.asarray(st_u.snap_idx).max()) \
+            > int(np.asarray(st_u.last)[victim]), \
+            "scenario broke: victim still reachable by plain appends"
+        snap_before = int(np.asarray(st_u.snap_idx)[victim])
+        for t in range(30):  # revival -> snapshot restore forced fallback
+            tick(all_up, cnt8, t, "restore")
+        assert int(np.asarray(st_u.snap_idx)[victim]) > snap_before, \
+            "victim was never restored from snapshot"
+        assert int(np.asarray(st_u.last)[victim]) \
+            == int(np.asarray(st_u.last).max()), "victim never caught up"
+        lead_down = all_up.at[int(leaders[0])].set(False)
+        for t in range(30):  # depose the leader -> re-election fallback
+            tick(lead_down, cnt8, t, "re-elect")
+        assert len(leaders_of(st_u)), "no re-election happened"
+
+    def test_dst_cross_check_equal_bitmasks(self):
+        """64 fault schedules x 100 ticks through the DST explorer, once
+        per kernel variant: zero violations on stock profiles and the SAME
+        per-schedule violation bitmask (and per-tick bit trace) from both
+        kernels."""
+        from swarmkit_tpu import dst
+
+        base = dict(n=5, log_len=512, window=8, apply_batch=16, max_props=8,
+                    keep=4, election_tick=10, seed=77)
+        cfg_t = SimConfig(**base, log_chunk=128)
+        cfg_u = SimConfig(**base, log_chunk=0)
+        assert cfg_t.tiled and not cfg_u.tiled
+        batch, names = dst.make_batch(cfg_u, ticks=100, schedules=64, seed=9)
+        res_t = dst.explore(init_state(cfg_t), cfg_t, batch, profiles=names)
+        res_u = dst.explore(init_state(cfg_u), cfg_u, batch, profiles=names)
+        assert res_t.violating.size == 0, \
+            [dst.bits_to_names(int(res_t.viol[s])) for s in res_t.violating]
+        assert np.array_equal(res_t.viol, res_u.viol)
+        assert np.array_equal(res_t.first_tick, res_u.first_tick)
+        assert np.array_equal(res_t.bits_by_tick, res_u.bits_by_tick)

@@ -257,6 +257,31 @@ def test_differential_compaction_snapshot(seed):
     assert stats["max_commit"] > 20  # compaction pressure was reached
 
 
+# Tiled log axis: the banded (log_chunk) kernel against the host golden
+# core — the chunked C/E/F passes and their fallback branch must track the
+# oracle exactly like the full-pass kernel does (it is also pinned against
+# the full-pass kernel field-for-field in TestTiledLog).
+CFG5_TILED = SimConfig(n=5, log_len=512, window=8, apply_batch=16,
+                       max_props=8, keep=4, election_tick=10, seed=77,
+                       log_chunk=128)
+
+
+@pytest.mark.parametrize("seed", range(600, 612))
+def test_differential_tiled_kernel(seed):
+    drop = [0.0, 0.1, 0.25][seed % 3]
+    crash = [0.0, 0.05, 0.1][(seed // 3) % 3]
+    assert CFG5_TILED.tiled
+    run_differential(CFG5_TILED, n_ticks=90, seed=seed, drop_rate=drop,
+                     crash_prob=crash)
+
+
+@pytest.mark.parametrize("seed", range(620, 624))
+def test_differential_tiled_leader_crash_cycles(seed):
+    stats = run_differential(CFG5_TILED, n_ticks=120, seed=seed,
+                             crash_leader_every=30, prop_prob=0.7)
+    assert stats["max_commit"] > 0
+
+
 # ---------------------------------------------------------------------------
 # Mailbox-wire differential: the SAME schedules, but messages ride the
 # [N, N] in-flight mailboxes (kernel.py "Device-mailbox wire") with per-edge

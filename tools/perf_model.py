@@ -10,8 +10,10 @@ registers the axon TPU platform and ignores JAX_PLATFORMS):
 2. Standalone micro-kernels for each membership-related phase component,
    timed in isolation over realistic array shapes, attributing the delta.
 
-Usage: python tools/perf_model.py [--quick]
-Prints a markdown report to stdout (paste into PERF.md).
+Usage: python tools/perf_model.py [--quick] [--tiled {on,off,both}]
+Prints a markdown report to stdout (paste into PERF.md).  --tiled runs the
+chunked-log-axis A/B instead (ms/tick per variant plus the analytic
+swarm_kernel_bytes_touched{phase=...,variant=...} gauges).
 """
 
 from __future__ import annotations
@@ -54,7 +56,8 @@ def _phase_gauge(phase: str, ms: float) -> None:
 
 def steady_rate(n: int, ticks: int = 64, static: bool = False, **kw):
     """Per-tick ms + entries/s for the bench steady-state flow."""
-    cfg = SimConfig(n=n, log_len=8192, window=2048, apply_batch=2048,
+    kw.setdefault("log_len", 8192)
+    cfg = SimConfig(n=n, window=2048, apply_batch=2048,
                     max_props=2048, keep=500, seed=42, election_tick=16,
                     static_members=static, **kw)
     st = init_state(cfg)
@@ -146,6 +149,60 @@ def micro_phases(n: int, L: int = 8192):
     return rows
 
 
+def _bytes_touched(n: int, L: int, chunk: int, variant: str) -> None:
+    """Publish the analytic per-tick log-buffer traffic of the C/E/F hot
+    phases as swarm_kernel_bytes_touched{phase=...,variant=...}.
+
+    full: every phase streams the whole [N, L] s32+u32 pair (append also
+    writes it back).  tiled: append touches the band_chunks*log_chunk DUS
+    band plus the [N, window] gather side-buffers, apply reads the
+    [N, apply_batch] gather window, compaction the [N, keep] ahead span."""
+    cfg = SimConfig(n=n, log_len=L, window=2048, apply_batch=2048,
+                    max_props=2048, keep=500, log_chunk=chunk)
+    g = obs_catalog.get(OBS.obs, "swarm_kernel_bytes_touched")
+    if cfg.tiled:
+        band = cfg.band_chunks * cfg.log_chunk
+        phases = {"C-append": n * (band * 8 * 2 + cfg.window * 12),
+                  "E-apply": n * cfg.apply_batch * 8,
+                  "F-compact": n * cfg.keep * 8}
+    else:
+        phases = {"C-append": n * L * 8 * 2,
+                  "E-apply": n * L * 8,
+                  "F-compact": n * L * 8}
+    for ph, b in phases.items():
+        g.labels(phase=ph, variant=variant).set(b)
+
+
+def tiled_report(mode: str, quick: bool) -> None:
+    """--tiled {on,off,both}: A/B the chunked log-axis kernel against the
+    full-pass kernel on the synchronous wire, static_members."""
+    variants = {"on": ("tiled",), "off": ("full",),
+                "both": ("full", "tiled")}[mode]
+    points = [(256, 8192), (256, 65536)]
+    if not quick:
+        points.append((1024, 8192))
+    print("\n## Tiled log axis A/B (static_members, synchronous wire, "
+          "log_chunk=1024)\n")
+    print("Best-of-3 wall times; absolute numbers move with machine load, "
+          "the tiled/full ratio is the stable signal.\n")
+    print("| n | log_len | " + " | ".join(
+        f"{v} ms/tick" for v in variants)
+        + (" | speedup |" if len(variants) == 2 else " |"))
+    print("|---|---|" + "---|" * (len(variants) + (len(variants) == 2)))
+    for n, L in points:
+        ms = {}
+        for v in variants:
+            chunk = 1024 if v == "tiled" else 0
+            ms[v], _ = steady_rate(n, static=True, log_len=L,
+                                   log_chunk=chunk)
+            _bytes_touched(n, L, chunk, v)
+        row = f"| {n} | {L} | " + " | ".join(
+            f"{ms[v]:.2f}" for v in variants)
+        if len(variants) == 2:
+            row += f" | {ms['full'] / ms['tiled']:.2f}x"
+        print(row + " |")
+
+
 _PHASE_SLUGS = {
     "views: n_mem sum + quorum [N,N]->[N]": "views",
     "mask: one granted&member reduction [N,N]": "vote-mask",
@@ -158,6 +215,16 @@ _PHASE_SLUGS = {
 
 def main():
     quick = "--quick" in sys.argv
+    if "--tiled" in sys.argv:
+        mode = sys.argv[sys.argv.index("--tiled") + 1]
+        if mode not in ("on", "off", "both"):
+            raise SystemExit(f"--tiled {mode}: expected on, off, or both")
+        tiled_report(mode, quick)
+        print("\n## Live metrics (registry render)\n")
+        print("```")
+        print(obs_registry.DEFAULT.render().rstrip())
+        print("```")
+        return
     sizes = (256,) if quick else (64, 256, 1024)
     print("## Steady-state per-tick cost (CPU, synchronous wire, "
           "2048 props/tick)\n")
