@@ -228,13 +228,17 @@ def measure(jax, n: int, entries: int, seed: int, election_tick: int,
     # by the differential suite and test_static_members_equivalence.
     # collect_stats: four O(N) reduces per tick against O(N^2) phases —
     # negligible, but BENCH_COLLECT_STATS=0 restores the bare program.
+    # BENCH_RECORD_EVENTS=1 turns the flight recorder on, measuring the
+    # masked-scatter overhead of event capture (PERF.md A/B).
     cfg = SimConfig(n=n, log_len=log_len, window=2048, apply_batch=2048,
                     max_props=2048, keep=500, seed=seed,
                     election_tick=election_tick,
                     latency=latency, latency_jitter=latency_jitter,
                     inflight=inflight, static_members=True,
                     collect_stats=os.environ.get(
-                        "BENCH_COLLECT_STATS", "1") != "0")
+                        "BENCH_COLLECT_STATS", "1") != "0",
+                    record_events=os.environ.get(
+                        "BENCH_RECORD_EVENTS", "0") == "1")
     ticks_needed = max(1, (entries + cfg.max_props - 1) // cfg.max_props)
     chunk = int(os.environ.get("BENCH_CHUNK_TICKS", "64"))
     n_chunks = (ticks_needed + chunk - 1) // chunk

@@ -30,10 +30,10 @@ from swarmkit_tpu.dst.invariants import (
     LEADER_COMPLETENESS, LOG_MATCHING, bits_to_names, check_state,
     check_transition,
 )
-from swarmkit_tpu.dst.explore import ExploreResult, explore
+from swarmkit_tpu.dst.explore import ExploreResult, explore, postmortem
 from swarmkit_tpu.dst.repro import (
-    fault_count, from_artifact, load_artifact, oracle_trace, replay,
-    replay_artifact, save_artifact, shrink, to_artifact,
+    capture_flight, fault_count, from_artifact, load_artifact, oracle_trace,
+    replay, replay_artifact, save_artifact, shrink, to_artifact,
 )
 
 __all__ = [
@@ -42,7 +42,8 @@ __all__ = [
     "BIT_NAMES", "CHECKSUM_AGREEMENT", "COMMIT_MONOTONIC", "ELECTION_SAFETY",
     "LEADER_COMPLETENESS", "LOG_MATCHING", "bits_to_names", "check_state",
     "check_transition",
-    "ExploreResult", "explore",
-    "fault_count", "from_artifact", "load_artifact", "oracle_trace",
-    "replay", "replay_artifact", "save_artifact", "shrink", "to_artifact",
+    "ExploreResult", "explore", "postmortem",
+    "capture_flight", "fault_count", "from_artifact", "load_artifact",
+    "oracle_trace", "replay", "replay_artifact", "save_artifact", "shrink",
+    "to_artifact",
 ]

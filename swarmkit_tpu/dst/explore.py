@@ -124,6 +124,31 @@ class ExploreResult:
         return np.nonzero(self.viol)[0]
 
 
+def postmortem(result: ExploreResult, cfg: SimConfig,
+               schedule: FaultSchedule, prop_count: int = 2,
+               mutation: Optional[str] = None, window: int = 40,
+               limit: int = 4, obs=None) -> dict:
+    """Flight-record the violating schedules of an explore batch.
+
+    Each violating index is re-run solo with `record_events=True`
+    (stopping right after its first violating tick) and decoded; returns
+    {index: capture dict} — see :func:`swarmkit_tpu.dst.repro.capture_flight`.
+    `limit` caps the re-runs: post-mortems are for reading, and one sweep
+    can violate hundreds of schedules with the same root cause.
+    """
+    from swarmkit_tpu.dst import repro  # late: repro imports this module
+
+    out: dict[int, dict] = {}
+    for idx in result.violating[:limit]:
+        idx = int(idx)
+        one = jax.tree_util.tree_map(lambda a: jnp.asarray(a)[idx], schedule)
+        out[idx] = repro.capture_flight(
+            cfg, one, prop_count, mutation,
+            first_tick=int(result.first_tick[idx]), window=window,
+            trigger="dst_violation", obs=obs)
+    return out
+
+
 def explore(state: SimState, cfg: SimConfig, schedule: FaultSchedule,
             profiles=(), prop_count: int = 2,
             mutation: Optional[str] = None, shard: bool = True,

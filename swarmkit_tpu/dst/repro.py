@@ -71,6 +71,66 @@ def replay(cfg: SimConfig, schedule: FaultSchedule, prop_count: int = 2,
 
 
 # ---------------------------------------------------------------------------
+# flight-recorder post-mortem (re-run one schedule with recording on)
+
+
+@partial(jax.jit, static_argnames=("cfg", "prop_count", "mutation"))
+def _replay_final(state, cfg: SimConfig, schedule: FaultSchedule,
+                  prop_count: int, mutation: Optional[str]):
+    def body(carry, sched_t):
+        st, acc = carry
+        new, bits = _tick_one(st, cfg, sched_t.drop, sched_t.alive,
+                              sched_t.target_leader, sched_t.crash_campaign,
+                              prop_count, mutation)
+        return (new, acc | bits), bits
+
+    (final, viol), bits = jax.lax.scan(body, (state, jnp.uint32(0)),
+                                       schedule)
+    any_t = bits > 0
+    first = jnp.where(jnp.any(any_t), jnp.argmax(any_t), -1)
+    return final, viol, first.astype(jnp.int32)
+
+
+def capture_flight(cfg: SimConfig, schedule: FaultSchedule,
+                   prop_count: int = 2, mutation: Optional[str] = None, *,
+                   first_tick: int = -1, window: int = 40,
+                   trigger: str = "dst_violation", obs=None) -> dict:
+    """Re-run ONE schedule with the flight recorder on and return the
+    decoded post-mortem: the event window leading up to the violation
+    plus the re-run's own verdict.
+
+    The re-run STOPS right after `first_tick` (when known), so the ring's
+    tail holds the ticks that produced the violation instead of whatever
+    happened afterwards.  Determinism makes this exact: same schedule,
+    same seed, same trajectory — recording only adds the ring writes.
+    """
+    from swarmkit_tpu.flightrec import record as flight_record
+
+    rcfg = dataclasses.replace(cfg, record_events=True,
+                               event_ring=max(cfg.event_ring, 128))
+    schedule = jax.tree_util.tree_map(jnp.asarray, schedule)
+    if first_tick >= 0:
+        stop = min(int(schedule.ticks), first_tick + 1)
+        schedule = jax.tree_util.tree_map(lambda a: a[:stop], schedule)
+    final, viol, first = _replay_final(init_state(rcfg), rcfg, schedule,
+                                       prop_count, mutation)
+    rec = flight_record.capture(
+        final, trigger=trigger, obs=obs,
+        meta={"mutation": mutation, "prop_count": prop_count,
+              "violation_bits": int(viol),
+              "violations": bits_to_names(int(viol)),
+              "first_tick": int(first)})
+    return {
+        "violation_bits": int(viol),
+        "violations": bits_to_names(int(viol)),
+        "first_tick": int(first),
+        "dropped": rec.dropped,
+        "window": [e.to_dict() for e in rec.window(window)],
+        "record": rec,
+    }
+
+
+# ---------------------------------------------------------------------------
 # greedy shrinking
 
 
@@ -251,13 +311,18 @@ def oracle_trace(cfg: SimConfig, schedule: FaultSchedule,
 def to_artifact(cfg: SimConfig, schedule: FaultSchedule, *, seed: int,
                 profile: str, index: int, prop_count: int,
                 mutation: Optional[str], viol: int,
-                first_tick: int) -> dict:
-    """Sparse JSON form of one (usually shrunk) repro schedule."""
+                first_tick: int, flight: Optional[dict] = None) -> dict:
+    """Sparse JSON form of one (usually shrunk) repro schedule.
+
+    When `flight` is given (see :func:`capture_flight`), its decoded
+    event window rides along so the artifact explains itself: the last
+    device events before the violation, without re-running anything.
+    """
     drop = np.asarray(schedule.drop)
     alive = np.asarray(schedule.alive)
     t, i, j = np.nonzero(drop)
     dt, dr = np.nonzero(~alive)
-    return {
+    art = {
         "version": ARTIFACT_VERSION,
         "seed": seed,
         "profile": profile,
@@ -279,6 +344,14 @@ def to_artifact(cfg: SimConfig, schedule: FaultSchedule, *, seed: int,
                 np.nonzero(np.asarray(schedule.crash_campaign))[0].tolist(),
         },
     }
+    if flight is not None:
+        art["flight"] = {
+            "window": flight.get("window", []),
+            "dropped": flight.get("dropped", []),
+            "first_tick": flight.get("first_tick", -1),
+            "violations": flight.get("violations", []),
+        }
+    return art
 
 
 def from_artifact(art: dict):

@@ -1,0 +1,35 @@
+"""On-device flight recorder for the batched raft simulation.
+
+`SimConfig.record_events` threads a fixed-width event ring through the
+jitted tick (`codes.py` holds the coded event vocabulary and the masked
+ring-append the kernel calls); the host side decodes rings into typed
+events (`decoder.py`), wraps them with provenance into savable records
+(`record.py`), and exports merged device + tracer-span timelines as
+Chrome-trace / Perfetto JSON (`export.py`).
+"""
+
+from swarmkit_tpu.flightrec.codes import (
+    APPEND_REJECT, CODE_NAMES, COMMIT_ADVANCE, EDGE_DOWN, EDGE_DROP,
+    EDGE_UP, ELECTION_WON, EVENT_WIDTH, FALLBACK_TICK, FAULT_EDGE,
+    SNAPSHOT_RESTORE, TERM_BUMP, ring_append,
+)
+from swarmkit_tpu.flightrec.decoder import (
+    FlightEvent, decode_rings, decode_state,
+)
+from swarmkit_tpu.flightrec.export import (
+    export_record, to_chrome_trace, validate_chrome_trace,
+)
+from swarmkit_tpu.flightrec.record import (
+    FlightRecord, capture, diff_records, load_record, save_record,
+    summarize,
+)
+
+__all__ = [
+    "APPEND_REJECT", "CODE_NAMES", "COMMIT_ADVANCE", "EDGE_DOWN",
+    "EDGE_DROP", "EDGE_UP", "ELECTION_WON", "EVENT_WIDTH",
+    "FALLBACK_TICK", "FAULT_EDGE", "SNAPSHOT_RESTORE", "TERM_BUMP",
+    "FlightEvent", "FlightRecord", "capture", "decode_rings",
+    "decode_state", "diff_records", "export_record", "load_record",
+    "ring_append", "save_record", "summarize", "to_chrome_trace",
+    "validate_chrome_trace",
+]

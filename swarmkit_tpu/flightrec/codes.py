@@ -1,0 +1,77 @@
+"""Event vocabulary + the on-device ring append.
+
+Events are fixed-width i32 rows ``(tick, code, arg0, arg1)`` written into
+``SimState.ev_buf`` ([N, event_ring, 4]) with a per-row cumulative cursor
+``ev_pos`` — slot of event k is ``k % event_ring``, so old events
+overwrite silently and the host derives the dropped count from the
+cursor.  This module owns the code <-> meaning contract; the kernel
+imports :func:`ring_append` (flightrec never imports the kernel, keeping
+the layering acyclic) and the decoder mirrors the arg semantics below.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+EVENT_WIDTH = 4  # (tick, code, arg0, arg1)
+
+# Codes (ISSUE 5 vocabulary).  args per code:
+#   ELECTION_WON     arg0=new term            arg1=last log index
+#   TERM_BUMP        arg0=new term            arg1=old term
+#   COMMIT_ADVANCE   arg0=new commit index    arg1=advance delta
+#   SNAPSHOT_RESTORE arg0=sending leader row  arg1=new snap_idx
+#   FALLBACK_TICK    arg0=chunks needed       arg1=band cap (row 0 only:
+#                    the tiled full-pass fallback is a cluster-wide event)
+#   FAULT_EDGE       arg0=EDGE_* transition   arg1=drop degree (EDGE_DROP)
+#   APPEND_REJECT    arg0=rejected leader row arg1=rejector's last index
+ELECTION_WON = 1
+TERM_BUMP = 2
+COMMIT_ADVANCE = 3
+SNAPSHOT_RESTORE = 4
+FALLBACK_TICK = 5
+FAULT_EDGE = 6
+APPEND_REJECT = 7
+
+CODE_NAMES = {
+    ELECTION_WON: "ELECTION_WON",
+    TERM_BUMP: "TERM_BUMP",
+    COMMIT_ADVANCE: "COMMIT_ADVANCE",
+    SNAPSHOT_RESTORE: "SNAPSHOT_RESTORE",
+    FALLBACK_TICK: "FALLBACK_TICK",
+    FAULT_EDGE: "FAULT_EDGE",
+    APPEND_REJECT: "APPEND_REJECT",
+}
+
+# FAULT_EDGE arg0 values: row went down / came back / its drop degree
+# (in+out partitioned edges) changed.
+EDGE_DOWN = 0
+EDGE_UP = 1
+EDGE_DROP = 2
+
+I32 = jnp.int32
+
+
+def ring_append(ev_buf: jax.Array, ev_pos: jax.Array, mask: jax.Array,
+                tick: jax.Array, code: int, arg0: jax.Array,
+                arg1: jax.Array):
+    """Append one event per row where `mask` is True.
+
+    ev_buf [N, cap, 4], ev_pos [N] cumulative cursor, mask [N] bool,
+    tick scalar i32, arg0/arg1 [N] i32.  Rows where mask is False keep
+    their slot contents and cursor.  The write is a plain per-row scatter
+    — the ring is tiny and only traced when cfg.record_events is on, so
+    the kernel's one-write-cond discipline (which protects the [N, L]
+    log carries) does not apply here.  Shapes are row-local, so the same
+    code composes with vmap over a leading schedule axis.
+    """
+    n, cap, _ = ev_buf.shape
+    node = jnp.arange(n, dtype=I32)
+    slot = (ev_pos % cap).astype(I32)
+    row = jnp.stack([jnp.broadcast_to(tick.astype(I32), (n,)),
+                     jnp.full((n,), code, I32),
+                     arg0.astype(I32), arg1.astype(I32)], axis=-1)
+    cur = ev_buf[node, slot]
+    ev_buf = ev_buf.at[node, slot].set(
+        jnp.where(mask[:, None], row, cur))
+    return ev_buf, ev_pos + mask.astype(I32)

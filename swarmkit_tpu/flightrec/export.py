@@ -1,0 +1,122 @@
+"""Chrome-trace / Perfetto JSON export.
+
+One timeline merges two clocks: device events are instants on a
+tick-as-microsecond axis (pid "sim", one tid track per simulated
+manager), host tracer spans are complete ("X") events on a wall-clock
+axis normalized to start at 0 (pid "host", one tid track per subsystem —
+the first dotted segment of the span name).  Both load in
+chrome://tracing and ui.perfetto.dev; :func:`validate_chrome_trace` is
+the dependency-free schema check the tests (and `flight_view.py
+export --check`) run on the output.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable, Optional
+
+SIM_PID = 1
+HOST_PID = 2
+
+# Chrome trace "ph" phases used here: i = instant, X = complete span,
+# M = metadata (process/thread names).
+_REQUIRED_EVENT_KEYS = {"ph", "pid", "tid", "name"}
+
+
+def _meta(pid: int, name: str, tid: Optional[int] = None,
+          tname: Optional[str] = None) -> list[dict]:
+    out = [{"ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+            "args": {"name": name}}]
+    if tid is not None:
+        out = [{"ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
+                "args": {"name": tname or str(tid)}}]
+    return out
+
+
+def to_chrome_trace(events: Iterable = (), spans: Iterable[dict] = (),
+                    tick_us: float = 1.0) -> dict:
+    """Build the trace dict.  `events` are FlightEvents (or dicts from a
+    saved record); `spans` are Span.to_dict() rows.  `tick_us` maps one
+    sim tick onto the µs timeline (ticks are unitless; 1 µs/tick keeps
+    the two clock domains visually comparable, not aligned)."""
+    trace_events: list[dict] = _meta(SIM_PID, "sim (device flight ring)")
+    sim_tids = set()
+    for e in events:
+        d = e if isinstance(e, dict) else e.to_dict()
+        node = int(d["node"])
+        sim_tids.add(node)
+        trace_events.append({
+            "ph": "i", "s": "t",  # thread-scoped instant
+            "pid": SIM_PID, "tid": node,
+            "ts": float(d["tick"]) * tick_us,
+            "name": d.get("name", f"CODE_{d['code']}"),
+            "args": {"arg0": int(d["arg0"]), "arg1": int(d["arg1"]),
+                     "seq": int(d.get("seq", 0))},
+        })
+    for node in sorted(sim_tids):
+        trace_events += _meta(SIM_PID, "", tid=node, tname=f"manager {node}")
+
+    span_rows = [s for s in spans if s.get("duration") is not None]
+    t0 = min((s["start"] for s in span_rows), default=0.0)
+    host_tids: dict[str, int] = {}
+    for s in span_rows:
+        subsystem = s["name"].split(".", 1)[0]
+        tid = host_tids.setdefault(subsystem, len(host_tids))
+        args = {k: v for k, v in (s.get("attrs") or {}).items()}
+        args["span_id"] = s.get("span_id")
+        if s.get("parent_id"):
+            args["parent_id"] = s["parent_id"]
+        trace_events.append({
+            "ph": "X", "pid": HOST_PID, "tid": tid,
+            "ts": (s["start"] - t0) * 1e6,
+            "dur": max(s["duration"] * 1e6, 0.001),
+            "name": s["name"], "args": args,
+        })
+    if span_rows:
+        trace_events = _meta(HOST_PID, "host (tracer spans)") + trace_events
+        for subsystem, tid in sorted(host_tids.items(), key=lambda kv: kv[1]):
+            trace_events += _meta(HOST_PID, "", tid=tid, tname=subsystem)
+
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+
+def validate_chrome_trace(trace: dict) -> list[str]:
+    """Schema problems (empty = valid).  Checks the JSON-object format:
+    a traceEvents array whose members carry ph/pid/tid/name, numeric
+    ts (+dur for X phases), and JSON-serializable args."""
+    problems: list[str] = []
+    if not isinstance(trace, dict):
+        return ["trace must be a JSON object"]
+    evs = trace.get("traceEvents")
+    if not isinstance(evs, list):
+        return ["traceEvents must be an array"]
+    for i, e in enumerate(evs):
+        if not isinstance(e, dict):
+            problems.append(f"event #{i} is not an object")
+            continue
+        missing = _REQUIRED_EVENT_KEYS - e.keys()
+        if missing:
+            problems.append(f"event #{i} missing keys {sorted(missing)}")
+            continue
+        if e["ph"] not in ("i", "X", "M", "B", "E", "C"):
+            problems.append(f"event #{i} has unknown phase {e['ph']!r}")
+        if e["ph"] in ("i", "X") and not isinstance(
+                e.get("ts"), (int, float)):
+            problems.append(f"event #{i} ({e['ph']}) lacks numeric ts")
+        if e["ph"] == "X" and not isinstance(e.get("dur"), (int, float)):
+            problems.append(f"event #{i} (X) lacks numeric dur")
+        if "args" in e and not isinstance(e["args"], dict):
+            problems.append(f"event #{i} args is not an object")
+    try:
+        json.dumps(trace)
+    except (TypeError, ValueError) as exc:
+        problems.append(f"trace is not JSON-serializable: {exc}")
+    return problems
+
+
+def export_record(rec, path: str, tick_us: float = 1.0) -> dict:
+    """FlightRecord -> chrome trace JSON file; returns the trace dict."""
+    trace = to_chrome_trace(rec.events, rec.spans, tick_us=tick_us)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(trace, f, indent=1)
+    return trace

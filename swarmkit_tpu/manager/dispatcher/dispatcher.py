@@ -376,13 +376,20 @@ class Dispatcher:
                               network_bootstrap_keys=keys, root_ca=root_ca)
 
     async def session(self, node_id: str, description=None,
-                      session_id: str = "", addr: str = ""
+                      session_id: str = "", addr: str = "",
+                      parent_span: str = ""
                       ) -> AsyncIterator[SessionMessage]:
         """Reference: Session dispatcher.go:1219.  Registers (unless resuming
         an existing session) and streams SessionMessages until the session is
-        superseded or expires."""
+        superseded or expires.
+
+        `parent_span` carries the caller's span id across the gRPC wire
+        (rpc.py packs it) so the trace reparents instead of rooting a
+        fresh tree in the serving process.
+        """
         self._check_running()
         with obs_trace.DEFAULT.span("dispatcher.session", node=node_id,
+                                    parent_id=parent_span or None,
                                     resumed=bool(session_id)) as sp:
             if not session_id:
                 session_id = await self.register(node_id, description, addr)

@@ -135,11 +135,12 @@ class Manager:
     # (reference: manager.go registers the prometheus handler next to the
     # health service).
     def metrics_text(self) -> str:
-        from swarmkit_tpu.metrics import exposition
+        from swarmkit_tpu.metrics import exposition, trace as obs_trace
         return exposition.render_all(
             registry=self.obs,
             legacy_registry=self.metrics_registry,
-            collector_gauges=self.metrics.snapshot())
+            collector_gauges=self.metrics.snapshot(),
+            tracer=obs_trace.DEFAULT)
 
     def metrics_snapshot(self) -> dict:
         from swarmkit_tpu.metrics import exposition, trace as obs_trace

@@ -118,6 +118,17 @@ CATALOG: dict[str, MetricSpec] = {
         "counter", "On-device cumulative applied-index advance summed over "
         "rows (SimState.stats[3]).", ()),
 
+    # ---- flight recorder (flightrec/) ------------------------------------
+    "swarm_flightrec_events_total": MetricSpec(
+        "counter", "Device flight-ring events decoded by capture(), by "
+        "event code name (flightrec/codes.py).", ("code",)),
+    "swarm_flightrec_dropped_total": MetricSpec(
+        "counter", "Events overwritten in a row's ring before decoding "
+        "(cursor ran past SimConfig.event_ring).", ()),
+    "swarm_flightrec_captures_total": MetricSpec(
+        "counter", "Flight-record captures, by trigger (manual / "
+        "dst_violation / scenario_failure).", ("trigger",)),
+
     # ---- scheduler / dispatcher / store (L5) -----------------------------
     "swarm_scheduler_latency_seconds": MetricSpec(
         "histogram", "One scheduler tick: snapshot, score, and commit of "

@@ -12,16 +12,22 @@ A manager scrape must show one coherent page built from:
 
 :func:`render_all` is what ``Manager.metrics_text()`` and the gRPC
 ``swarmkit.Metrics/Scrape`` service serve; :func:`snapshot_all` is the
-JSON-able equivalent consumed by tools/ and tests.
+JSON-able equivalent consumed by tools/ and tests.  When a tracer is
+passed, the page ends with a recent-events comment section (finished
+spans + any flight-recorder captures) — comments are format-legal, so
+Prometheus scrapers ignore the section while humans hitting Scrape get
+the last few interesting things that happened.
 """
 
 from __future__ import annotations
 
 from typing import Optional
 
-from .registry import MetricsRegistry, format_value
+from .registry import MetricsRegistry, escape_help, format_value
 
 _QUANTILES = ((50, "0.5"), (90, "0.9"), (99, "0.99"))
+
+RECENT_EVENT_LIMIT = 16
 
 
 def render_timers(legacy_registry) -> str:
@@ -46,15 +52,54 @@ def render_plain_gauges(gauges: dict, help_prefix: str = "Cluster object "
                         ) -> str:
     lines: list[str] = []
     for name in sorted(gauges):
-        lines.append(f"# HELP {name} {help_prefix}")
+        lines.append(f"# HELP {name} {escape_help(help_prefix)}")
         lines.append(f"# TYPE {name} gauge")
         lines.append(f"{name} {format_value(gauges[name])}")
     return "\n".join(lines) + ("\n" if lines else "")
 
 
+def recent_events(tracer=None, limit: int = RECENT_EVENT_LIMIT
+                  ) -> list[dict]:
+    """The scrape page's recent-events feed: the newest finished tracer
+    spans merged with the newest flight-recorder capture summaries (both
+    JSON-able dicts, newest last)."""
+    out: list[dict] = []
+    if tracer is not None:
+        for s in tracer.finished()[-limit:]:
+            d = s.to_dict()
+            d["source"] = "span"
+            out.append(d)
+    try:
+        from swarmkit_tpu.flightrec import record as flight_record
+        out += flight_record.recent_capture_events(limit)
+    except Exception:
+        pass  # a flightrec problem must never break the scrape page
+    return out[-limit:] if limit else out
+
+
+def render_recent_events(tracer=None, limit: int = RECENT_EVENT_LIMIT
+                         ) -> str:
+    """Comment-only section ('# recent-event ...' lines): legal in the
+    0.0.4 text format, invisible to scrapers, useful to humans."""
+    events = recent_events(tracer, limit)
+    if not events:
+        return ""
+    lines = ["# recent-events (newest last; spans + flightrec captures)"]
+    for e in events:
+        if e.get("source") == "span":
+            dur = e.get("duration")
+            dur_s = f"{dur * 1000:.3f}ms" if dur is not None else "open"
+            desc = f"span {e['name']} {dur_s} attrs={e.get('attrs', {})}"
+        else:
+            desc = e.get("describe", str(e))
+        lines.append("# recent-event " + escape_help(str(desc)))
+    return "\n".join(lines) + "\n"
+
+
 def render_all(registry: Optional[MetricsRegistry] = None,
                legacy_registry=None,
-               collector_gauges: Optional[dict] = None) -> str:
+               collector_gauges: Optional[dict] = None,
+               tracer=None) -> str:
     parts = []
     if registry is not None:
         parts.append(registry.render())
@@ -62,6 +107,8 @@ def render_all(registry: Optional[MetricsRegistry] = None,
         parts.append(render_timers(legacy_registry))
     if collector_gauges:
         parts.append(render_plain_gauges(collector_gauges))
+    if tracer is not None:
+        parts.append(render_recent_events(tracer))
     return "".join(p for p in parts if p)
 
 
@@ -78,4 +125,5 @@ def snapshot_all(registry: Optional[MetricsRegistry] = None,
         out["objects"] = dict(collector_gauges)
     if tracer is not None:
         out["spans"] = tracer.snapshot()
+        out["recent_events"] = recent_events(tracer)
     return out

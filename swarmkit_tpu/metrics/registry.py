@@ -56,6 +56,12 @@ def escape_label_value(v: str) -> str:
     return v.replace("\\", "\\\\").replace("\n", "\\n").replace('"', '\\"')
 
 
+def escape_help(v: str) -> str:
+    """HELP-line escaping per the 0.0.4 text format: backslash and
+    newline only (quotes stay literal in HELP, unlike label values)."""
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def format_value(v: float) -> str:
     if v != v:                      # NaN
         return "NaN"
@@ -229,7 +235,7 @@ class MetricFamily:
 
     # -- exposition --------------------------------------------------------
     def header(self) -> list[str]:
-        return [f"# HELP {self.name} {self.help}",
+        return [f"# HELP {self.name} {escape_help(self.help)}",
                 f"# TYPE {self.name} {self.kind}"]
 
     def sample_lines(self) -> list[str]:

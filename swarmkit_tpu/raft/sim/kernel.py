@@ -1377,6 +1377,54 @@ def step(state: SimState, cfg: SimConfig,
             jnp.sum(commit - state.commit),
             jnp.sum(applied - state.applied)])
 
+    # Flight recorder (cfg.record_events; flightrec/codes.py owns the event
+    # vocabulary): append coded (tick, code, arg0, arg1) rows into the
+    # per-row event ring from the masks this tick already computed.  Like
+    # collect_stats, the whole block is Python-gated, so a recorder-off
+    # program is structurally identical to a recorder-less build (the
+    # bit-identity acceptance test).  The ring writes are plain scatters —
+    # the one-write-cond discipline protects the [N, L] log carries, not
+    # this [N, ring, 4] side buffer — and every operand is row-local, so
+    # recording composes with dst/explore.py's vmap over schedules.
+    ev_fields = {}
+    if cfg.record_events and state.ev_buf is not None:
+        from swarmkit_tpu.flightrec import codes as _fc
+        ev_buf, ev_pos = state.ev_buf, state.ev_pos
+        zero = jnp.zeros((n,), I32)
+
+        def _emit(mask, code, a0, a1):
+            nonlocal ev_buf, ev_pos
+            ev_buf, ev_pos = _fc.ring_append(ev_buf, ev_pos, mask, now,
+                                             code, a0, a1)
+
+        # fault edges: crash/heal transitions + partition-degree changes,
+        # detected against the PREVIOUS tick's inputs carried in ev_*
+        drop_deg = (jnp.sum(drop.astype(I32), axis=1)
+                    + jnp.sum(drop.astype(I32), axis=0))
+        _emit(state.ev_alive & ~alive, _fc.FAULT_EDGE,
+              jnp.full((n,), _fc.EDGE_DOWN, I32), zero)
+        _emit(~state.ev_alive & alive, _fc.FAULT_EDGE,
+              jnp.full((n,), _fc.EDGE_UP, I32), zero)
+        _emit(drop_deg != state.ev_drop, _fc.FAULT_EDGE,
+              jnp.full((n,), _fc.EDGE_DROP, I32), drop_deg)
+        # protocol events, from the end-of-tick values vs the pre-tick
+        # state (TERM_BUMP covers every bump source — campaign, transfer,
+        # pre-vote promotion, catch-up from any message class — uniformly)
+        _emit(term != state.term, _fc.TERM_BUMP, term, state.term)
+        _emit(win, _fc.ELECTION_WON, term, last)
+        _emit(resp_reject, _fc.APPEND_REJECT, src, reject_hint)
+        _emit(do_restore, _fc.SNAPSHOT_RESTORE, src, snap_idx)
+        _emit(commit > state.commit, _fc.COMMIT_ADVANCE, commit,
+              commit - state.commit)
+        if cfg.tiled:
+            # cluster-wide event: one row (0) records the fallback so the
+            # ring doesn't burn N slots on every full-pass tick
+            _emit(~fits & (node == 0), _fc.FALLBACK_TICK,
+                  jnp.broadcast_to(nch, (n,)),
+                  jnp.full((n,), cfg.band_chunks, I32))
+        ev_fields = dict(ev_buf=ev_buf, ev_pos=ev_pos, ev_alive=alive,
+                         ev_drop=drop_deg)
+
     boxes = {}
     if cfg.mailboxes:
         boxes = dict(
@@ -1406,6 +1454,7 @@ def step(state: SimState, cfg: SimConfig,
         hup_conf=hup_conf, tail_conf=tail_conf,
         tick=state.tick + 1,
         stats=stats,
+        **ev_fields,
         **boxes,
     )
 

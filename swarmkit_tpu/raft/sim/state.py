@@ -102,6 +102,16 @@ class SimConfig:
     # same way).  propose_conf() on a static-members config is a trace-time
     # error.
     static_members: bool = False
+    # Flight recorder (flightrec/): carry a per-row event ring
+    # [N, event_ring, 4] in SimState plus a monotonic write cursor, and
+    # have the kernel append coded events (election won, term bump, commit
+    # advance, snapshot restore, fault edges, append rejects, tiling
+    # fallbacks) at the end of every tick.  Off by default: like
+    # collect_stats, the recording scatters are traced into the step
+    # program only when enabled, so the off path stays bit-identical to a
+    # recorder-less build.  Decode host-side with flightrec.decode_rings.
+    record_events: bool = False
+    event_ring: int = 128       # slots per row (oldest events overwrite)
     # Log-axis tiling (kernel.py banded passes): chunk width in ring slots.
     # When 0 < log_chunk < log_len the [N, L] hot phases (append receive,
     # apply+checksum, conf scans, compaction, dense propose) slice only the
@@ -157,6 +167,10 @@ class SimConfig:
             # a full round trip must fit well inside the election timeout or
             # healthy leaders get deposed by their own followers
             assert 2 * (self.latency + self.latency_jitter) < self.election_tick
+        if self.record_events and self.event_ring < 8:
+            raise ValueError(
+                f"event_ring={self.event_ring} is too small to hold one "
+                f"tick's worth of events; use >= 8 slots per row")
         # Tiling validation: clear trace-time errors instead of silent
         # mis-tiling (the banded passes assume aligned, ring-dividing
         # chunks and a band cap strictly under the chunk count).
@@ -262,6 +276,17 @@ class SimState:
     # [0] campaigns started  [1] elections won
     # [2] sum of commit-index advance  [3] sum of applied-index advance
     stats: Optional[jax.Array] = None
+    # ---- flight recorder (cfg.record_events; flightrec/) ----------------
+    # ev_buf [N, event_ring, 4] i32 rows of (tick, code, arg0, arg1);
+    # ev_pos [N] is the CUMULATIVE events-written cursor per row (slot of
+    # event k = k % event_ring, so dropped-event count = max(0, pos - cap)
+    # and the decoder can order survivors without a separate epoch field).
+    # ev_alive / ev_drop carry the previous tick's fault inputs so the
+    # kernel can emit FAULT_EDGE events on transitions only.
+    ev_buf: Optional[jax.Array] = None
+    ev_pos: Optional[jax.Array] = None
+    ev_alive: Optional[jax.Array] = None   # bool [N]: last tick's alive
+    ev_drop: Optional[jax.Array] = None    # i32 [N]: last tick's drop degree
     # ---- in-flight mailboxes [N, N], only when cfg.mailboxes ------------
     # One slot per message class per directed edge; *_at holds deliver
     # tick + 1 (0 = empty).  Request classes index [sender, receiver];
@@ -377,6 +402,9 @@ def init_state(cfg: SimConfig,
         tail_conf=jnp.zeros((n,), jnp.bool_),
         tick=jnp.zeros((), i32),
         stats=jnp.zeros((4,), i32) if cfg.collect_stats else None,
+        **(dict(ev_buf=z(n, cfg.event_ring, 4), ev_pos=z(n),
+                ev_alive=jnp.ones((n,), jnp.bool_), ev_drop=z(n))
+           if cfg.record_events else {}),
     )
 
 

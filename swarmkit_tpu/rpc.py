@@ -31,6 +31,7 @@ from swarmkit_tpu.api.dispatcher_msgs import (
 )
 from swarmkit_tpu.api.types import NodeDescription
 from swarmkit_tpu.ca.certificates import MANAGER_ROLE_OU, WORKER_ROLE_OU
+from swarmkit_tpu.metrics import trace as obs_trace
 
 log = logging.getLogger("swarmkit_tpu.rpc")
 
@@ -208,14 +209,19 @@ class ClusterService:
     async def session(self, request: bytes, context):
         info = await self._authorize(context, WORKER_ROLE_OU,
                                      MANAGER_ROLE_OU)
-        node_id, desc_json, session_id, addr = msgpack.unpackb(request)
+        vals = msgpack.unpackb(request)
+        # 5th tuple element (optional, newer clients): the caller's span
+        # id, so the dispatcher.session span reparents across the wire
+        node_id, desc_json, session_id, addr = vals[:4]
+        parent_span = vals[4] if len(vals) > 4 else ""
         await self._bind_identity(context, info, node_id)
         description = (NodeDescription.decode(desc_json)
                        if desc_json else None)
         try:
             d = self._leader_manager().dispatcher
             async for msg in d.session(node_id, description,
-                                       session_id=session_id, addr=addr):
+                                       session_id=session_id, addr=addr,
+                                       parent_span=parent_span):
                 yield msg.encode()
         except RpcError as e:
             await self._abort(context, e)
@@ -368,10 +374,17 @@ class ClusterService:
         # local unix socket
         await self._authorize(context, MANAGER_ROLE_OU)
         req = json.loads(request)
+        # optional span_id from control_call: dispatch under a span
+        # parented to the remote caller so inner spans (raft.propose)
+        # nest in one cross-process trace
+        parent_span = req.get("span_id", "")
         try:
             c = self._leader_manager().control_api
-            result = await dispatch_control(c, req.get("method", ""),
-                                            req.get("params", {}))
+            with obs_trace.DEFAULT.span("control.dispatch",
+                                        parent_id=parent_span or None,
+                                        method=req.get("method", "")):
+                result = await dispatch_control(c, req.get("method", ""),
+                                                req.get("params", {}))
             return json.dumps({"result": result}).encode()
         except RpcError as e:
             await self._abort(context, e)
@@ -511,6 +524,19 @@ class ClusterService:
 # --------------------------------------------------------------------------
 # client
 
+def pack_session_request(node_id, description=None, session_id="",
+                         addr="") -> bytes:
+    """Wire form of a dispatcher session request.  The 5th element is the
+    caller's current span id (or ""), carried so the server-side
+    dispatcher.session span reparents under the caller's trace instead of
+    rooting a fresh tree across the process boundary; pre-span servers
+    that unpack only 4 values still work."""
+    return msgpack.packb((node_id,
+                          description.encode() if description else b"",
+                          session_id, addr,
+                          obs_trace.current_span_id() or ""))
+
+
 def _redirectable(e: grpc.aio.AioRpcError) -> Exception:
     details = e.details() or ""
     if details.startswith("not-leader:"):
@@ -544,9 +570,7 @@ class RemoteDispatcher:
 
     async def session(self, node_id, description=None, session_id="",
                       addr=""):
-        req = msgpack.packb((node_id,
-                             description.encode() if description else b"",
-                             session_id, addr))
+        req = pack_session_request(node_id, description, session_id, addr)
         try:
             async for raw in self._session(req):
                 yield SessionMessage.decode(raw)
@@ -966,7 +990,8 @@ class RemoteManager:
         await self._connect()
         try:
             raw = await self._ctl(json.dumps(
-                {"method": method, "params": params}).encode())
+                {"method": method, "params": params,
+                 "span_id": obs_trace.current_span_id() or ""}).encode())
         except grpc.aio.AioRpcError as e:
             raise _redirectable(e)
         resp = json.loads(raw)

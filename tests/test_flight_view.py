@@ -1,0 +1,90 @@
+"""Slow wrapper around the flight-record viewer (tools/flight_view.py).
+
+Generates real records — one from a recorded kernel run, one from the
+seed-pinned DST mutation post-mortem — then drives the CLI end to end:
+summarize, export (schema-checked Chrome trace), and diff.  Excluded
+from tier-1 by the ``slow`` marker; run with::
+
+    pytest tests/test_flight_view.py -m slow -q
+"""
+
+import dataclasses
+import json
+
+import pytest
+
+from swarmkit_tpu.flightrec import record as flight_record
+from swarmkit_tpu.raft.sim.run import run_ticks
+from swarmkit_tpu.raft.sim.state import SimConfig, init_state
+from tools.flight_view import main as flight_view_main
+
+
+def _cfg(seed):
+    return SimConfig(n=5, log_len=64, window=8, apply_batch=16, max_props=8,
+                     keep=4, election_tick=10, seed=seed,
+                     record_events=True, event_ring=128)
+
+
+def _make_record(path, seed, ticks=60):
+    cfg = _cfg(seed)
+    final, _ = run_ticks(init_state(cfg), cfg, ticks, prop_count=1)
+    rec = flight_record.capture(final, trigger="manual",
+                                meta={"seed": seed, "ticks": ticks})
+    flight_record.save_record(rec, str(path))
+    return rec
+
+
+@pytest.mark.slow
+def test_flight_view_end_to_end(tmp_path, capsys):
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    rec = _make_record(a, seed=3)
+    _make_record(b, seed=4)
+
+    # summarize
+    assert flight_view_main(["summarize", str(a), "--last", "5"]) == 0
+    out = capsys.readouterr().out
+    assert f"{len(rec.events)} events" in out
+    assert "COMMIT_ADVANCE" in out
+
+    # export --check: schema-valid Chrome trace lands on disk
+    trace_path = tmp_path / "a.trace.json"
+    assert flight_view_main(["export", str(a), "-o", str(trace_path),
+                             "--check"]) == 0
+    trace = json.loads(trace_path.read_text())
+    assert isinstance(trace["traceEvents"], list) and trace["traceEvents"]
+    phases = {t["ph"] for t in trace["traceEvents"]}
+    assert "i" in phases and "M" in phases
+
+    # diff: different seeds diverge (exit 1), self-diff is clean (exit 0)
+    assert flight_view_main(["diff", str(a), str(b)]) == 1
+    out = capsys.readouterr().out
+    assert "first divergence" in out
+    assert flight_view_main(["diff", str(a), str(a)]) == 0
+
+
+@pytest.mark.slow
+def test_flight_view_on_dst_postmortem_record(tmp_path, capsys):
+    """The DST violation post-mortem record flows through the same CLI:
+    capture_flight -> save -> summarize/export."""
+    from swarmkit_tpu import dst
+
+    cfg = dataclasses.replace(_cfg(0), record_events=False)
+    sched, names = dst.make_batch(cfg, schedules=24, ticks=100, seed=0)
+    res = dst.explore(init_state(cfg), cfg, sched, names, prop_count=2,
+                      mutation="commit_no_quorum", shard=False)
+    assert len(res.violating) > 0
+    s = int(res.violating[0])
+    cap = dst.capture_flight(cfg, sched.slice(s), 2, "commit_no_quorum",
+                             first_tick=int(res.first_tick[s]))
+    path = tmp_path / "postmortem.json"
+    flight_record.save_record(cap["record"], str(path))
+
+    assert flight_view_main(["summarize", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "trigger=dst_violation" in out
+    assert "leader_completeness" in out   # meta carries the violation
+
+    assert flight_view_main(["export", str(path), "-o",
+                             str(tmp_path / "pm.trace.json"),
+                             "--check"]) == 0

@@ -1,0 +1,286 @@
+"""Flight-recorder tests: ring semantics, decode, capture, export, the
+recorder-off bit-identity guarantee, and the DST post-mortem flow.
+
+The load-bearing guarantees:
+
+- ``record_events=False`` (the default) must leave the kernel program
+  untouched — every non-recorder SimState field bit-identical to a run
+  that never knew the recorder existed (the recording block is gated in
+  Python, so it is simply not traced).
+- A seed-pinned DST violation re-run with recording on must end with
+  events that explain the violated invariant.
+- Exported traces must be valid Chrome/Perfetto JSON.
+"""
+
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from swarmkit_tpu.flightrec import (
+    APPEND_REJECT, COMMIT_ADVANCE, ELECTION_WON, EVENT_WIDTH, TERM_BUMP,
+    FlightEvent, FlightRecord, capture, decode_rings, decode_state,
+    diff_records, load_record, ring_append, save_record, summarize,
+    to_chrome_trace, validate_chrome_trace,
+)
+from swarmkit_tpu.raft.sim.run import run_ticks
+from swarmkit_tpu.raft.sim.state import SimConfig, SimState, init_state
+
+I32 = jnp.int32
+
+
+def small_cfg(**kw):
+    base = dict(n=5, log_len=64, window=8, apply_batch=16, max_props=8,
+                keep=4, election_tick=10, seed=3)
+    base.update(kw)
+    return SimConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# ring primitives
+
+
+def test_ring_append_masked_rows_only():
+    buf = jnp.zeros((3, 4, EVENT_WIDTH), I32)
+    pos = jnp.zeros((3,), I32)
+    mask = jnp.asarray([True, False, True])
+    buf, pos = ring_append(buf, pos, mask, jnp.asarray(7, I32), ELECTION_WON,
+                           jnp.asarray([1, 2, 3], I32),
+                           jnp.asarray([4, 5, 6], I32))
+    assert pos.tolist() == [1, 0, 1]
+    assert buf[0, 0].tolist() == [7, ELECTION_WON, 1, 4]
+    assert buf[1, 0].tolist() == [0, 0, 0, 0]   # masked-out row untouched
+    assert buf[2, 0].tolist() == [7, ELECTION_WON, 3, 6]
+
+
+def test_ring_wraps_and_reports_dropped():
+    cap_slots = 4
+    buf = jnp.zeros((2, cap_slots, EVENT_WIDTH), I32)
+    pos = jnp.zeros((2,), I32)
+    mask = jnp.asarray([True, True])
+    for t in range(6):   # 6 appends into a 4-slot ring: 2 dropped
+        buf, pos = ring_append(buf, pos, mask, jnp.asarray(t, I32),
+                               COMMIT_ADVANCE,
+                               jnp.full((2,), t, I32), jnp.zeros((2,), I32))
+    events, dropped = decode_rings(buf, pos)
+    assert dropped.tolist() == [2, 2]
+    # oldest surviving event is t=2 — 0 and 1 were overwritten
+    ticks = sorted({e.tick for e in events})
+    assert ticks == [2, 3, 4, 5]
+
+
+def test_decode_orders_by_tick_node_seq():
+    buf = jnp.zeros((2, 8, EVENT_WIDTH), I32)
+    pos = jnp.zeros((2,), I32)
+    both = jnp.asarray([True, True])
+    only1 = jnp.asarray([False, True])
+    buf, pos = ring_append(buf, pos, both, jnp.asarray(5, I32), TERM_BUMP,
+                           jnp.zeros((2,), I32), jnp.zeros((2,), I32))
+    buf, pos = ring_append(buf, pos, only1, jnp.asarray(5, I32), ELECTION_WON,
+                           jnp.zeros((2,), I32), jnp.zeros((2,), I32))
+    buf, pos = ring_append(buf, pos, both, jnp.asarray(9, I32),
+                           COMMIT_ADVANCE,
+                           jnp.zeros((2,), I32), jnp.zeros((2,), I32))
+    events, _ = decode_rings(buf, pos)
+    keys = [(e.tick, e.node, e.seq) for e in events]
+    assert keys == sorted(keys)
+    # within node 1 at tick 5, TERM_BUMP precedes ELECTION_WON (append order)
+    n1t5 = [e.name for e in events if e.node == 1 and e.tick == 5]
+    assert n1t5 == ["TERM_BUMP", "ELECTION_WON"]
+
+
+def test_decode_state_requires_recording():
+    cfg = small_cfg()
+    with pytest.raises(ValueError, match="record_events"):
+        decode_state(init_state(cfg))
+
+
+def test_event_ring_validated():
+    with pytest.raises(ValueError, match="event_ring"):
+        small_cfg(record_events=True, event_ring=4)
+
+
+# ---------------------------------------------------------------------------
+# recorded runs
+
+
+def recorded_run(ticks=40, **kw):
+    cfg = small_cfg(record_events=True, event_ring=128, **kw)
+    final, _ = run_ticks(init_state(cfg), cfg, ticks, prop_count=1)
+    return cfg, final
+
+
+def test_recorded_run_produces_election_and_commit_events():
+    _, final = recorded_run()
+    events, dropped = decode_state(final)
+    names = {e.name for e in events}
+    assert "ELECTION_WON" in names
+    assert "TERM_BUMP" in names
+    assert "COMMIT_ADVANCE" in names
+    assert all(d == 0 for d in dropped)   # 128-slot ring, 40 ticks: no wrap
+    # commit deltas are positive and commit values non-decreasing per node
+    for node in range(final.commit.shape[0]):
+        commits = [e.arg0 for e in events
+                   if e.node == node and e.code == COMMIT_ADVANCE]
+        assert commits == sorted(commits)
+
+
+def test_recorder_off_is_bit_identical():
+    """The acceptance regression: with record_events=False every kernel
+    output matches a run of the identical config with recording on —
+    recording only ADDS the ev_* fields, it never perturbs the sim."""
+    cfg_off = small_cfg()
+    cfg_on = small_cfg(record_events=True, event_ring=64)
+    off, _ = run_ticks(init_state(cfg_off), cfg_off, 50, prop_count=1)
+    on, _ = run_ticks(init_state(cfg_on), cfg_on, 50, prop_count=1)
+    assert off.ev_buf is None and on.ev_buf is not None
+    for f in dataclasses.fields(SimState):
+        if f.name.startswith("ev_"):
+            continue
+        a, b = getattr(off, f.name), getattr(on, f.name)
+        if a is None:
+            assert b is None, f.name
+            continue
+        assert np.array_equal(np.asarray(a), np.asarray(b)), \
+            f"field {f.name} diverged with recording on"
+
+
+def test_recording_composes_with_vmap():
+    cfg = small_cfg(record_events=True, event_ring=32)
+    from swarmkit_tpu.raft.sim.kernel import step
+
+    batched = jax.tree_util.tree_map(
+        lambda a: jnp.broadcast_to(a, (3,) + a.shape), init_state(cfg))
+    stepped = jax.vmap(lambda s: step(s, cfg))(batched)
+    assert stepped.ev_buf.shape == (3, cfg.n, 32, EVENT_WIDTH)
+    assert stepped.ev_pos.shape == (3, cfg.n)
+
+
+# ---------------------------------------------------------------------------
+# capture / save / load / summarize / diff
+
+
+def test_capture_record_roundtrip(tmp_path):
+    from swarmkit_tpu.metrics.registry import MetricsRegistry
+
+    _, final = recorded_run()
+    obs = MetricsRegistry()
+    rec = capture(final, trigger="manual", meta={"k": "v"}, obs=obs)
+    assert rec.n == 5 and rec.events and rec.meta == {"k": "v"}
+    snap = obs.snapshot()
+    assert snap["swarm_flightrec_captures_total"]["trigger=manual"] == 1.0
+    assert sum(snap["swarm_flightrec_events_total"].values()) == \
+        len(rec.events)
+
+    path = tmp_path / "rec.json"
+    save_record(rec, str(path))
+    back = load_record(str(path))
+    assert [e.to_dict() for e in back.events] == \
+        [e.to_dict() for e in rec.events]
+    assert back.trigger == "manual" and back.meta == {"k": "v"}
+
+    text = summarize(back, last=5)
+    assert "trigger=manual" in text and "COMMIT_ADVANCE" in text
+
+
+def test_load_record_rejects_unknown_version(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps({"version": 99, "events": []}))
+    with pytest.raises(ValueError, match="version"):
+        load_record(str(p))
+
+
+def test_diff_records_localizes_first_divergence():
+    e = lambda tick, code, a0: FlightEvent(tick=tick, node=0, code=code,
+                                           arg0=a0, arg1=0, seq=0)
+    a = FlightRecord(events=[e(1, TERM_BUMP, 1), e(2, COMMIT_ADVANCE, 3)],
+                     dropped=[0], n=1)
+    b = FlightRecord(events=[e(1, TERM_BUMP, 1), e(4, COMMIT_ADVANCE, 3)],
+                     dropped=[0], n=1)
+    out = diff_records(a, b)
+    assert "first divergence at event #1" in out
+    assert diff_records(a, a).endswith("streams are identical")
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace export
+
+
+def test_chrome_trace_schema_valid():
+    _, final = recorded_run()
+    events, _ = decode_state(final)
+    spans = [{"name": "raft.propose", "span_id": "s1", "parent_id": None,
+              "start": 10.0, "duration": 0.25, "attrs": {"node": "m1"}},
+             {"name": "dispatcher.session", "span_id": "s2",
+              "parent_id": "s1", "start": 10.1, "duration": 0.05,
+              "attrs": {}}]
+    trace = to_chrome_trace(events, spans)
+    assert validate_chrome_trace(trace) == []
+    json.loads(json.dumps(trace))   # round-trips as plain JSON
+
+    te = trace["traceEvents"]
+    instants = [t for t in te if t["ph"] == "i"]
+    completes = [t for t in te if t["ph"] == "X"]
+    assert len(instants) == len(events)
+    assert len(completes) == len(spans)
+    # one sim track per node, one host track per subsystem
+    assert {t["pid"] for t in instants} == {1}
+    assert {t["pid"] for t in completes} == {2}
+    host_threads = {t["args"]["name"] for t in te
+                    if t["ph"] == "M" and t["pid"] == 2
+                    and t["name"] == "thread_name"}
+    assert host_threads == {"raft", "dispatcher"}
+    sim_threads = {t["args"]["name"] for t in te
+                   if t["ph"] == "M" and t["pid"] == 1
+                   and t["name"] == "thread_name"}
+    assert sim_threads == {f"manager {i}" for i in range(5)}
+
+
+def test_validate_chrome_trace_flags_problems():
+    assert validate_chrome_trace({"traceEvents": "nope"})
+    assert validate_chrome_trace(
+        {"traceEvents": [{"ph": "i", "pid": 1}]})        # missing keys
+    assert validate_chrome_trace(
+        {"traceEvents": [{"ph": "?", "pid": 1, "tid": 0, "name": "x"}]})
+
+
+# ---------------------------------------------------------------------------
+# DST post-mortem (the acceptance scenario, seed-pinned)
+
+
+def test_dst_postmortem_explains_commit_no_quorum():
+    """A seed-pinned commit_no_quorum violation, re-run with recording on,
+    must end with the events that explain leader_completeness: a fault
+    edge / term bump / new election exposing the un-quorumed commit."""
+    from swarmkit_tpu import dst
+
+    cfg = small_cfg(seed=0)
+    sched, names = dst.make_batch(cfg, schedules=24, ticks=100, seed=0)
+    res = dst.explore(init_state(cfg), cfg, sched, names, prop_count=2,
+                      mutation="commit_no_quorum", shard=False)
+    assert len(res.violating) > 0, "seed-pinned mutation not caught"
+
+    pm = dst.postmortem(res, cfg, sched, prop_count=2,
+                        mutation="commit_no_quorum", window=20, limit=1)
+    (idx, cap), = pm.items()
+    assert cap["violations"], cap
+    assert cap["window"], "post-mortem produced no events"
+    # the re-run stopped at the violation: window ends at/near first_tick
+    last_tick = cap["window"][-1]["tick"]
+    assert abs(last_tick - cap["first_tick"]) <= 2
+    tail_names = {e["name"] for e in cap["window"]}
+    assert tail_names & {"ELECTION_WON", "TERM_BUMP", "FAULT_EDGE"}, \
+        f"window does not explain the violation: {tail_names}"
+
+    # the window rides along in the repro artifact
+    art = dst.to_artifact(cfg, sched.slice(int(idx)), seed=0,
+                          profile=names[int(idx)], index=int(idx),
+                          prop_count=2, mutation="commit_no_quorum",
+                          viol=int(res.viol[int(idx)]),
+                          first_tick=int(res.first_tick[int(idx)]),
+                          flight=cap)
+    art = json.loads(json.dumps(art))   # artifact stays plain JSON
+    assert art["flight"]["window"] == cap["window"]

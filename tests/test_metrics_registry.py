@@ -201,6 +201,65 @@ def test_exposition_escapes_label_values():
     assert line == 'swarm_x_total{path="a\\"b\\\\c\\nd"} 1'
 
 
+def test_exposition_escaping_adversarial_label_values():
+    """0.0.4 escaping is order-sensitive: backslash FIRST, else the
+    backslashes introduced for newline/quote get double-escaped.  These
+    values are the classic corruptions (literal \\n in data, trailing
+    backslash, quote+newline adjacency)."""
+    cases = {
+        "\\n": "\\\\n",          # literal backslash-n, NOT a newline
+        "a\n": "a\\n",           # real newline becomes the two-char escape
+        "q\"\nz": "q\\\"\\nz",   # quote adjacent to newline
+        "end\\": "end\\\\",      # trailing backslash cannot eat the quote
+        "\\\"": "\\\\\\\"",      # backslash-quote: four + two chars out
+    }
+    for raw, escaped in cases.items():
+        r = MetricsRegistry()
+        r.counter("swarm_adv_total", "help.", ("v",)).labels(v=raw).inc()
+        line = r.render().splitlines()[-1]
+        assert line == f'swarm_adv_total{{v="{escaped}"}} 1', (raw, line)
+        # every sample line must stay exactly one exposition line
+        assert "\n" not in line
+
+
+def test_exposition_escapes_help_text():
+    """HELP lines escape backslash and newline but keep quotes literal
+    (the format treats HELP as raw text to end-of-line)."""
+    r = MetricsRegistry()
+    r.counter("swarm_h_total", 'multi\nline "quoted" \\path help.')
+    rendered = r.render()
+    help_line = [ln for ln in rendered.splitlines()
+                 if ln.startswith("# HELP")][0]
+    assert help_line == ('# HELP swarm_h_total multi\\nline '
+                         '"quoted" \\\\path help.')
+
+
+def test_plain_gauges_escape_help_prefix():
+    from swarmkit_tpu.metrics.exposition import render_plain_gauges
+
+    text = render_plain_gauges({"swarm_g": 1.0},
+                               help_prefix="evil\nhelp \\x")
+    help_line = text.splitlines()[0]
+    assert help_line == "# HELP swarm_g evil\\nhelp \\\\x"
+    assert text.count("\n") == 3   # HELP + TYPE + sample, newline-terminated
+
+
+def test_recent_events_section_is_comment_only():
+    """Span attrs can contain newlines; the recent-events section must
+    stay comment lines so scrapers never parse attr garbage as samples."""
+    from swarmkit_tpu.metrics.exposition import render_recent_events
+    from swarmkit_tpu.metrics.trace import Tracer
+
+    t = Tracer()
+    with t.span("raft.propose", note="line1\nline2 \\ \"q\""):
+        pass
+    text = render_recent_events(t)
+    assert text
+    for ln in text.strip().splitlines():
+        assert ln.startswith("#"), ln
+    assert "\nline2" not in text   # newline arrived escaped, not literal
+
+
 def test_render_all_merges_three_surfaces():
     from swarmkit_tpu.manager.metrics import Collector
     from swarmkit_tpu.store.memory import MemoryStore

@@ -108,9 +108,13 @@ def run_mutation_demo(schedules: int = 24, ticks: int = 100, seed: int = 0,
     before = dst.fault_count(sched)
     small, evals = dst.shrink(cfg, sched, viol, prop_count, mutation)
     v2, f2 = dst.replay(cfg, small, prop_count, mutation)
+    # post-mortem: re-run the shrunk schedule with the flight recorder on
+    # so the artifact carries the event window explaining the violation
+    flight = dst.capture_flight(cfg, small, prop_count, mutation,
+                                first_tick=f2)
     art = dst.to_artifact(cfg, small, seed=seed, profile=names[s], index=s,
                           prop_count=prop_count, mutation=mutation,
-                          viol=v2, first_tick=f2)
+                          viol=v2, first_tick=f2, flight=flight)
     out_path = out_path or os.path.join(tempfile.gettempdir(),
                                         "dst_repro.json")
     dst.save_artifact(out_path, art)
@@ -124,6 +128,7 @@ def run_mutation_demo(schedules: int = 24, ticks: int = 100, seed: int = 0,
         "artifact": out_path,
         "replay_matches": verdict["matches_recorded"],
         "oracle_diverged_at": verdict["oracle"]["diverged_at"],
+        "flight_events": len(flight["window"]),
     })
     if verbose:
         print(f"mutation {mutation!r} caught ({demo['bits']}, profile "
@@ -134,6 +139,12 @@ def run_mutation_demo(schedules: int = 24, ticks: int = 100, seed: int = 0,
               f"{'reproduces exactly' if demo['replay_matches'] else 'DIVERGED'},"
               f" oracle trace localizes divergence at tick "
               f"{demo['oracle_diverged_at']}", flush=True)
+        tail = flight["record"].window(6)
+        if tail:
+            print(f"flight window (last {len(tail)} device events before "
+                  f"the violation):", flush=True)
+            for e in tail:
+                print("  " + e.describe(), flush=True)
     return demo
 
 

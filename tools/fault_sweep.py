@@ -447,10 +447,38 @@ async def _run_scenario(wire: str, plan_name: str, seed: int) -> dict:
 # sweep driver
 
 
+def _dump_flight(res: dict, flight_dir: str) -> Optional[str]:
+    """Write a host-side flight record for one failed scenario: the
+    tracer's finished spans (raft.propose, dispatcher.session, probe
+    spans...) captured at the moment of failure, trigger-tagged so the
+    Manager scrape's recent-events section picks it up too."""
+    try:
+        from swarmkit_tpu.flightrec import record as flight_record
+        from swarmkit_tpu.metrics import trace as obs_trace
+
+        rec = flight_record.FlightRecord(
+            events=[], dropped=[], n=0, trigger="scenario_failure",
+            meta={k: res.get(k) for k in
+                  ("wire", "plan", "seed", "error", "notes")},
+            spans=[s.to_dict() for s in obs_trace.DEFAULT.finished()])
+        flight_record._RECENT.append(rec)
+        os.makedirs(flight_dir, exist_ok=True)
+        path = os.path.join(
+            flight_dir,
+            f"fault_{res['wire']}_{res['plan']}_{res['seed']}.json")
+        flight_record.save_record(rec, path)
+        return path
+    except Exception as e:  # a dump failure must not mask the scenario's
+        print(f"  (flight dump failed: {type(e).__name__}: {e})", flush=True)
+        return None
+
+
 def run_sweep(wires=WIRES, plans=PLANS, seeds=DEFAULT_SEEDS,
-              verbose: bool = True) -> list[dict]:
+              verbose: bool = True,
+              flight_dir: Optional[str] = None) -> list[dict]:
     """Run each (wire, plan, seed) scenario on a fresh event loop and
-    return one result dict per scenario (importable from tests)."""
+    return one result dict per scenario (importable from tests).  With
+    `flight_dir`, every failing scenario dumps a flight record there."""
     results = []
     for wire in wires:
         for plan in plans:
@@ -458,6 +486,8 @@ def run_sweep(wires=WIRES, plans=PLANS, seeds=DEFAULT_SEEDS,
                 t0 = time.monotonic()
                 res = asyncio.run(_run_scenario(wire, plan, seed))
                 res["secs"] = round(time.monotonic() - t0, 2)
+                if not res["ok"] and flight_dir:
+                    res["flight"] = _dump_flight(res, flight_dir)
                 results.append(res)
                 if verbose:
                     state = "ok  " if res["ok"] else "FAIL"
@@ -465,6 +495,8 @@ def run_sweep(wires=WIRES, plans=PLANS, seeds=DEFAULT_SEEDS,
                             f"({res['secs']}s)")
                     if not res["ok"]:
                         line += f"  {res.get('error', '')}"
+                        if res.get("flight"):
+                            line += f"  [flight: {res['flight']}]"
                     print(line, flush=True)
     return results
 
@@ -477,6 +509,10 @@ def main(argv=None) -> int:
                     help=f"comma list from {PLANS}")
     ap.add_argument("--seeds", default=",".join(map(str, DEFAULT_SEEDS)),
                     help="comma list of seeds")
+    ap.add_argument("--flight-dir", default=None, metavar="DIR",
+                    help="dump a flight record (host spans + failure "
+                         "provenance) here for every failing scenario; "
+                         "inspect with tools/flight_view.py")
     args = ap.parse_args(argv)
 
     wires = [w for w in args.wires.split(",") if w]
@@ -489,7 +525,7 @@ def main(argv=None) -> int:
         if p not in PLANS:
             ap.error(f"unknown plan {p!r}")
 
-    results = run_sweep(wires, plans, seeds)
+    results = run_sweep(wires, plans, seeds, flight_dir=args.flight_dir)
     failed = [r for r in results if not r["ok"]]
     print(f"\n{len(results) - len(failed)}/{len(results)} scenarios passed")
     return 1 if failed else 0

@@ -1,0 +1,99 @@
+"""Flight-record viewer: summarize / export / diff saved records.
+
+Records come from three producers (all JSON via
+``swarmkit_tpu.flightrec.record.save_record``):
+
+- DST post-mortems — ``tools/dst_sweep.py --mutate`` re-runs a shrunk
+  violating schedule with the recorder on and attaches the window to the
+  repro artifact; ``swarmkit_tpu.dst.capture_flight`` gives the full
+  record programmatically.
+- ``tools/fault_sweep.py --flight-dir DIR`` — host-span records dumped
+  for every failing scenario.
+- Any recorded run — ``flightrec.record.capture(state)`` on a SimState
+  built with ``SimConfig(record_events=True)``.
+
+Usage:
+    python tools/flight_view.py summarize rec.json [--last 20]
+    python tools/flight_view.py export rec.json -o trace.json [--check]
+    python tools/flight_view.py diff a.json b.json
+
+``export`` writes Chrome-trace JSON: open it at https://ui.perfetto.dev
+or chrome://tracing.  Device events appear as instants on one track per
+simulated manager; host tracer spans as complete events on one track per
+subsystem.  ``--check`` schema-validates the result before writing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from swarmkit_tpu.flightrec import export as flight_export          # noqa: E402
+from swarmkit_tpu.flightrec import record as flight_record          # noqa: E402
+
+
+def cmd_summarize(args) -> int:
+    rec = flight_record.load_record(args.record)
+    print(flight_record.summarize(rec, last=args.last), flush=True)
+    return 0
+
+
+def cmd_export(args) -> int:
+    rec = flight_record.load_record(args.record)
+    trace = flight_export.to_chrome_trace(rec.events, rec.spans,
+                                          tick_us=args.tick_us)
+    if args.check:
+        problems = flight_export.validate_chrome_trace(trace)
+        if problems:
+            for p in problems:
+                print(f"INVALID: {p}", file=sys.stderr, flush=True)
+            return 1
+    out = args.out or os.path.splitext(args.record)[0] + ".trace.json"
+    flight_export.export_record(rec, out, tick_us=args.tick_us)
+    print(f"wrote {len(trace['traceEvents'])} trace events to {out} "
+          f"(open at https://ui.perfetto.dev)", flush=True)
+    return 0
+
+
+def cmd_diff(args) -> int:
+    a = flight_record.load_record(args.a)
+    b = flight_record.load_record(args.b)
+    report = flight_record.diff_records(a, b)
+    print(report, flush=True)
+    return 0 if "streams are identical" in report else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("summarize", help="per-code counts + tail window")
+    p.add_argument("record")
+    p.add_argument("--last", type=int, default=20,
+                   help="tail-window length (default 20)")
+    p.set_defaults(fn=cmd_summarize)
+
+    p = sub.add_parser("export", help="write Chrome/Perfetto trace JSON")
+    p.add_argument("record")
+    p.add_argument("-o", "--out", default=None,
+                   help="output path (default: <record>.trace.json)")
+    p.add_argument("--tick-us", type=float, default=1.0,
+                   help="microseconds per simulated tick on the timeline")
+    p.add_argument("--check", action="store_true",
+                   help="schema-validate the trace; nonzero exit if invalid")
+    p.set_defaults(fn=cmd_export)
+
+    p = sub.add_parser("diff", help="first divergence between two records")
+    p.add_argument("a")
+    p.add_argument("b")
+    p.set_defaults(fn=cmd_diff)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

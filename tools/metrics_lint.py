@@ -15,6 +15,10 @@ Checks, in order:
    documents.
 4. Every catalog entry is referenced somewhere outside the catalog —
    dead specs rot; delete or wire them.
+5. The flight-recorder event vocabulary (``flightrec/codes.py``) stays
+   publishable: every code name must fit the
+   ``swarm_flightrec_events_total{code=...}`` schema, and the capture
+   counter must keep its ``trigger`` label.
 
 Importable (``run_lint`` returns the problem list) so the pytest wrapper
 in tests/test_metrics_lint.py runs it in-suite; the CLI exits nonzero on
@@ -113,6 +117,34 @@ def run_lint(repo_root: str | None = None) -> list[str]:
         if name not in used:
             problems.append(f"catalog entry {name!r} is never referenced "
                             "outside the catalog (dead spec?)")
+
+    # 5. flight-recorder wiring: every event code in the device vocabulary
+    #    must publish under swarm_flightrec_events_total{code=...} — a code
+    #    added to flightrec/codes.py without scrape-side room (or a label
+    #    schema drift on the counter) breaks post-mortem accounting silently
+    from swarmkit_tpu.flightrec import codes as flight_codes
+
+    ev_spec = catalog.CATALOG.get("swarm_flightrec_events_total")
+    if ev_spec is None:
+        problems.append("flightrec: 'swarm_flightrec_events_total' missing "
+                        "from the catalog")
+    elif tuple(ev_spec.labels) != ("code",):
+        problems.append("flightrec: 'swarm_flightrec_events_total' must be "
+                        f"labeled by ('code',), got {tuple(ev_spec.labels)}")
+    else:
+        fam = catalog.get(MetricsRegistry(strict=True),
+                          "swarm_flightrec_events_total")
+        for code in sorted(flight_codes.CODE_NAMES):
+            try:
+                fam.labels(code=flight_codes.CODE_NAMES[code]).inc(0)
+            except MetricError as e:
+                problems.append(f"flightrec: event code "
+                                f"{flight_codes.CODE_NAMES[code]!r} cannot "
+                                f"publish: {e}")
+    cap_spec = catalog.CATALOG.get("swarm_flightrec_captures_total")
+    if cap_spec is None or "trigger" not in tuple(cap_spec.labels):
+        problems.append("flightrec: 'swarm_flightrec_captures_total' must "
+                        "exist with a 'trigger' label")
     return problems
 
 
