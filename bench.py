@@ -200,7 +200,8 @@ class MeasureError(Exception):
 
 def measure(jax, n: int, entries: int, seed: int, election_tick: int,
             latency: int = 0, latency_jitter: int = 0, inflight: int = 1,
-            log_len: int = 8192, **run_kw):
+            log_len: int = 8192, read_batch: int = 0,
+            read_leases: bool = True, **run_kw):
     """Elect a leader, then time one compiled steady-state replication run of
     ~`entries` committed entries. Returns a dict of measurements; raises
     MeasureError if no leader emerges.
@@ -216,8 +217,8 @@ def measure(jax, n: int, entries: int, seed: int, election_tick: int,
     configs so both measure the same flow.
     """
     from swarmkit_tpu.raft.sim import (
-        SimConfig, committed_entries, has_leader, init_state, run_ticks,
-        run_until_leader,
+        SimConfig, committed_entries, has_leader, init_state, reads_blocked,
+        reads_served, run_ticks, run_until_leader,
     )
     from swarmkit_tpu.raft.sim.run import KernelObs
 
@@ -235,6 +236,7 @@ def measure(jax, n: int, entries: int, seed: int, election_tick: int,
                     election_tick=election_tick,
                     latency=latency, latency_jitter=latency_jitter,
                     inflight=inflight, static_members=True,
+                    read_batch=read_batch, read_leases=read_leases,
                     collect_stats=os.environ.get(
                         "BENCH_COLLECT_STATS", "1") != "0",
                     record_events=os.environ.get(
@@ -291,17 +293,24 @@ def measure(jax, n: int, entries: int, seed: int, election_tick: int,
     _, _, t_elect_post = measure_election()
 
     base = int(committed_entries(state))
+    base_reads = int(reads_served(state)) if read_batch else 0
     t0 = time.perf_counter()
     final = run_chunks(state)
     dt = time.perf_counter() - t0
     committed = int(committed_entries(final)) - base
 
-    return {
+    out = {
         "cfg": cfg, "final": final, "committed": committed, "dt": dt,
         "rate": committed / dt, "election_ticks": ticks,
         "t_elect": t_elect, "t_elect_post": t_elect_post,
         "t_compile": t_compile, "kernel_stats": obs.publish(final),
     }
+    if read_batch:
+        reads = int(reads_served(final)) - base_reads
+        out["reads"] = reads
+        out["read_rate"] = reads / dt
+        out["reads_blocked"] = int(reads_blocked(final))
+    return out
 
 
 def _bench_gauges(config: str, m: dict) -> None:
@@ -317,6 +326,9 @@ def _bench_gauges(config: str, m: dict) -> None:
             config=config).set(m["t_compile"])
         obs_catalog.get(r, "swarm_bench_election_seconds").labels(
             config=config).set(m["t_elect_post"])
+        if "read_rate" in m:
+            obs_catalog.get(r, "swarm_bench_reads_per_second").labels(
+                config=config).set(m["read_rate"])
     except Exception as e:
         log(f"bench gauges failed: {e}")
 
@@ -460,6 +472,14 @@ def main() -> None:
             # an 8x larger ring must land within ~2x of the L=8192
             # headline rate (the un-tiled kernel degrades ~8x here)
             ("4096-longlog-L65536", 4096, {"log_len": 65536}),
+            # read-heavy mix, 99:1 offered reads:writes — 99 reads per
+            # committed entry spread over the rows (99 * 2048 / 256 per
+            # row per refill).  reads/s is the SECOND HEADLINE metric:
+            # lease-valid leaders serve with zero extra collectives,
+            # followers serve at applied index one stamp round behind, so
+            # served reads/s must stay >= 10x committed entries/s.
+            ("256-readmix-99to1", 256,
+             {"read_batch": 99 * 2048 // 256}),
         ):
             if only and only not in name:
                 extra.setdefault(f"filtered-by-only:{only}",
@@ -492,6 +512,23 @@ def main() -> None:
                 extra[name] = round(cm["rate"], 1)
                 log(f"config {name}: {cm['rate']:,.0f} entries/s "
                     f"(election {cm['election_ticks']} ticks)")
+                if "read_rate" in cm:
+                    # second headline: linearizable reads served/sec
+                    RESULT["read_metric"] = (
+                        f"linearizable-reads/sec @ {cn} simulated managers "
+                        f"(99:1 offered read:write mix)")
+                    RESULT["reads_per_second"] = round(cm["read_rate"], 1)
+                    RESULT["read_write_ratio"] = round(
+                        cm["read_rate"] / cm["rate"], 1)
+                    RESULT["reads_blocked"] = cm["reads_blocked"]
+                    if cm["read_rate"] < 10 * cm["rate"]:
+                        RESULT.setdefault(
+                            "note", f"read-mix underperformed: "
+                            f"{cm['read_rate']:,.0f} reads/s < 10x "
+                            f"{cm['rate']:,.0f} entries/s")
+                    log(f"config {name}: {cm['read_rate']:,.0f} reads/s "
+                        f"({RESULT['read_write_ratio']}x entries/s, "
+                        f"{cm['reads_blocked']} blocked)")
             except Exception as e:  # secondary configs must not kill the run
                 log(f"config {name} failed: {e}")
                 extra[name] = f"failed: {e}"

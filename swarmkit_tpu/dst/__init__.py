@@ -14,8 +14,8 @@ Layout:
   matrices, crash windows, adversary gates) + the seeded `jax.random`
   generator and its named adversary profiles.
 - :mod:`invariants` — on-device checkers (ElectionSafety, LogMatching,
-  LeaderCompleteness, commit monotonicity, applied-checksum agreement)
-  reduced into a per-schedule violation bitmask.
+  LeaderCompleteness, commit monotonicity, applied-checksum agreement,
+  read linearizability) reduced into a per-schedule violation bitmask.
 - :mod:`explore`   — `explore()`: the vmapped scan driver.
 - :mod:`repro`     — counterexample pipeline: host extraction, differential
   oracle replay (field-level trace), greedy shrinking, seed-pinned JSON
@@ -23,12 +23,13 @@ Layout:
 """
 
 from swarmkit_tpu.dst.schedule import (
-    PROFILES, FaultSchedule, from_fault_plan, make_batch, make_schedule,
+    EXTRA_PROFILES, PROFILES, FaultSchedule, from_fault_plan, make_batch,
+    make_schedule,
 )
 from swarmkit_tpu.dst.invariants import (
     BIT_NAMES, CHECKSUM_AGREEMENT, COMMIT_MONOTONIC, ELECTION_SAFETY,
-    LEADER_COMPLETENESS, LOG_MATCHING, bits_to_names, check_state,
-    check_transition,
+    LEADER_COMPLETENESS, LINEARIZABLE_READ, LOG_MATCHING, bits_to_names,
+    check_state, check_transition,
 )
 from swarmkit_tpu.dst.explore import ExploreResult, explore, postmortem
 from swarmkit_tpu.dst.repro import (
@@ -37,11 +38,11 @@ from swarmkit_tpu.dst.repro import (
 )
 
 __all__ = [
-    "PROFILES", "FaultSchedule", "from_fault_plan", "make_batch",
-    "make_schedule",
+    "EXTRA_PROFILES", "PROFILES", "FaultSchedule", "from_fault_plan",
+    "make_batch", "make_schedule",
     "BIT_NAMES", "CHECKSUM_AGREEMENT", "COMMIT_MONOTONIC", "ELECTION_SAFETY",
-    "LEADER_COMPLETENESS", "LOG_MATCHING", "bits_to_names", "check_state",
-    "check_transition",
+    "LEADER_COMPLETENESS", "LINEARIZABLE_READ", "LOG_MATCHING",
+    "bits_to_names", "check_state", "check_transition",
     "ExploreResult", "explore", "postmortem",
     "capture_flight", "fault_count", "from_artifact", "load_artifact",
     "oracle_trace", "replay", "replay_artifact", "save_artifact", "shrink",

@@ -39,7 +39,7 @@ from swarmkit_tpu.raft.sim.state import LEADER, SimConfig, SimState
 
 I32 = jnp.int32
 
-MUTATIONS = ("commit_no_quorum",)
+MUTATIONS = ("commit_no_quorum", "stale_lease_read")
 
 
 def apply_mutation(state: SimState, cfg: SimConfig,
@@ -56,6 +56,28 @@ def apply_mutation(state: SimState, cfg: SimConfig,
         commit = jnp.where(leaders, jnp.maximum(state.commit, state.last),
                            state.commit)
         return dataclasses.replace(state, commit=commit)
+    if mutation == "stale_lease_read":
+        # leases force-disabled: any row still CLAIMING leadership serves
+        # its pending read batch immediately at its own applied index,
+        # skipping every gate (lease validity, quorum-ack confirmation,
+        # own-term commit, applied >= read_index) — the arXiv:2601.00273
+        # stale-read attack.  Healthy leaders get away with it most ticks;
+        # a partitioned stale leader serves reads missing the writes the
+        # NEW leader has been committing, and LINEARIZABLE_READ fires
+        # (srv_idx = stale applied < srv_goal = submit-time max(commit)).
+        if state.read_pend is None:
+            raise ValueError("stale_lease_read requires cfg.read_batch > 0")
+        leaders = state.role == LEADER
+        serve = leaders & (state.read_pend > 0)
+        return dataclasses.replace(
+            state,
+            read_srv=state.read_srv + jnp.where(serve, state.read_pend, 0),
+            read_srv_idx=jnp.where(serve, state.applied, state.read_srv_idx),
+            read_srv_goal=jnp.where(serve, state.read_goal,
+                                    state.read_srv_goal),
+            read_pend=jnp.where(serve, 0, state.read_pend),
+            read_idx=jnp.where(serve, jnp.full_like(state.read_idx, -1),
+                               state.read_idx))
     raise KeyError(f"unknown mutation {mutation!r}; known: {MUTATIONS}")
 
 

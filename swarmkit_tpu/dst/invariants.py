@@ -22,6 +22,14 @@ COMMIT_MONOTONIC     per-row commit/applied never regress across one tick,
 CHECKSUM_AGREEMENT   equal applied index => equal applied-state checksum
                      (state-machine safety; sourced through
                      ``run.quorum_applied_checksum``).
+LINEARIZABLE_READ    no served read batch observed a state missing a
+                     write acknowledged before the batch was submitted:
+                     read_srv_idx (applied at serve) >= read_srv_goal
+                     (max(commit) anywhere at submit).  Only checked
+                     when the read path is compiled in
+                     (cfg.read_batch > 0); the goal register is pure
+                     oracle bookkeeping the serving decisions never
+                     read, exactly like apply_chk for checksums.
 """
 
 from __future__ import annotations
@@ -38,6 +46,7 @@ LOG_MATCHING = 1 << 1
 LEADER_COMPLETENESS = 1 << 2
 COMMIT_MONOTONIC = 1 << 3
 CHECKSUM_AGREEMENT = 1 << 4
+LINEARIZABLE_READ = 1 << 5
 
 BIT_NAMES = {
     ELECTION_SAFETY: "election_safety",
@@ -45,6 +54,7 @@ BIT_NAMES = {
     LEADER_COMPLETENESS: "leader_completeness",
     COMMIT_MONOTONIC: "commit_monotonic",
     CHECKSUM_AGREEMENT: "checksum_agreement",
+    LINEARIZABLE_READ: "linearizable_read",
 }
 ALL_BITS = tuple(BIT_NAMES)
 
@@ -102,7 +112,15 @@ def check_state(state: SimState, cfg: SimConfig) -> jnp.ndarray:
         & (chk[:, None] != chk[None, :])
     chk_bit = _bit(jnp.any(agree), CHECKSUM_AGREEMENT)
 
-    return elect | match | complete | chk_bit
+    # -- LINEARIZABLE_READ: every served batch saw the writes acked
+    # before it was submitted (Python-gated on the read path's registers,
+    # so reads-off sweeps trace the same five-checker program as before)
+    read_bit = jnp.uint32(0)
+    if state.read_srv_idx is not None:
+        read_bit = _bit(jnp.any(state.read_srv_idx < state.read_srv_goal),
+                        LINEARIZABLE_READ)
+
+    return elect | match | complete | chk_bit | read_bit
 
 
 def check_transition(prev: SimState, new: SimState) -> jnp.ndarray:

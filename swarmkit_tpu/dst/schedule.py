@@ -32,9 +32,12 @@ from swarmkit_tpu.raft.sim.state import CANDIDATE, LEADER, SimConfig
 I32 = jnp.int32
 
 # Named adversary profiles (ISSUE 3 tentpole part 1).  `make_batch` deals
-# them round-robin across the schedule axis.
+# them round-robin across the schedule axis.  PROFILES is the default
+# rotation and is pinned by seed-stability tests — new special-purpose
+# adversaries go in EXTRA_PROFILES and are requested explicitly.
 PROFILES = ("random_drop", "partition_flapper", "leader_targeted",
             "asymmetric_links", "crash_restart", "crash_during_campaign")
+EXTRA_PROFILES = ("stale_leader_reads",)
 
 
 @jax.tree_util.register_dataclass
@@ -171,6 +174,37 @@ def _gen_crash_during_campaign(key, cfg: SimConfig, ticks: int
                                crash_campaign=gate)
 
 
+def _gen_stale_leader_reads(key, cfg: SimConfig, ticks: int
+                            ) -> FaultSchedule:
+    """The arXiv:2601.00273 stale-read attack shape: ONE random victim row
+    is fully edge-isolated for ~3 election timeouts, window start after
+    the first election settles.  When the victim happens to be the leader
+    (the rotation makes that a constant fraction of the sub-batch), the
+    majority elects a successor that commits fresh writes while
+    CheckQuorum's recent-activity lag leaves the victim CLAIMING
+    leadership with read batches pending — the stale-leader overlap.  A
+    correct lease expires inside the window (lease_ticks < election_tick
+    <= time to a rival quorum) and refuses those reads; a lease-disabled
+    serve (the ``stale_lease_read`` mutation) returns state missing the
+    successor's acked writes and must trip LINEARIZABLE_READ.
+
+    Deliberately NOT the target_leader gate: that gate isolates every
+    CURRENT leader each tick, so it would muzzle the successor too and
+    stall exactly the commit progress the stale read must miss."""
+    kv, ks, kd = jax.random.split(key, 3)
+    T = cfg.election_tick
+    width = 3 * T
+    victim = jax.random.randint(kv, (), 0, cfg.n)
+    start = jax.random.randint(ks, (), 2 * T, max(2 * T + 1, ticks - width))
+    t = jnp.arange(ticks, dtype=I32)
+    gate = (t >= start) & (t < start + width)                    # [T]
+    row = jnp.arange(cfg.n, dtype=I32)
+    touches = (row[:, None] == victim) | (row[None, :] == victim)  # [N, N]
+    isolate = gate[:, None, None] & touches[None, :, :]
+    drop = (jax.random.uniform(kd, (ticks, cfg.n, cfg.n)) < 0.02) | isolate
+    return dataclasses.replace(_no_faults(cfg, ticks), drop=drop)
+
+
 _GENERATORS = {
     "random_drop": _gen_random_drop,
     "partition_flapper": _gen_partition_flapper,
@@ -178,6 +212,7 @@ _GENERATORS = {
     "asymmetric_links": _gen_asymmetric_links,
     "crash_restart": _gen_crash_restart,
     "crash_during_campaign": _gen_crash_during_campaign,
+    "stale_leader_reads": _gen_stale_leader_reads,
 }
 
 
@@ -187,7 +222,7 @@ def make_schedule(cfg: SimConfig, ticks: int, profile: str,
     gen = _GENERATORS.get(profile)
     if gen is None:
         raise KeyError(f"unknown adversary profile {profile!r}; "
-                       f"known: {PROFILES}")
+                       f"known: {PROFILES + EXTRA_PROFILES}")
     key = jax.random.fold_in(jax.random.PRNGKey(seed), index)
     return gen(key, cfg, ticks)
 

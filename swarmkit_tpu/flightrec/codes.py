@@ -25,6 +25,9 @@ EVENT_WIDTH = 4  # (tick, code, arg0, arg1)
 #                    the tiled full-pass fallback is a cluster-wide event)
 #   FAULT_EDGE       arg0=EDGE_* transition   arg1=drop degree (EDGE_DROP)
 #   APPEND_REJECT    arg0=rejected leader row arg1=rejector's last index
+#   READ_SERVED      arg0=applied idx served  arg1=batch size (reads)
+#   READ_BLOCKED     arg0=reads refused       arg1=BLOCK_* reason
+#   LEASE_EXPIRED    arg0=lease expiry tick   arg1=reads bounced with it
 ELECTION_WON = 1
 TERM_BUMP = 2
 COMMIT_ADVANCE = 3
@@ -32,6 +35,9 @@ SNAPSHOT_RESTORE = 4
 FALLBACK_TICK = 5
 FAULT_EDGE = 6
 APPEND_REJECT = 7
+READ_SERVED = 8
+READ_BLOCKED = 9
+LEASE_EXPIRED = 10
 
 CODE_NAMES = {
     ELECTION_WON: "ELECTION_WON",
@@ -41,6 +47,9 @@ CODE_NAMES = {
     FALLBACK_TICK: "FALLBACK_TICK",
     FAULT_EDGE: "FAULT_EDGE",
     APPEND_REJECT: "APPEND_REJECT",
+    READ_SERVED: "READ_SERVED",
+    READ_BLOCKED: "READ_BLOCKED",
+    LEASE_EXPIRED: "LEASE_EXPIRED",
 }
 
 # FAULT_EDGE arg0 values: row went down / came back / its drop degree
@@ -48,6 +57,11 @@ CODE_NAMES = {
 EDGE_DOWN = 0
 EDGE_UP = 1
 EDGE_DROP = 2
+
+# READ_BLOCKED arg1 values: the row lost leadership with unstamped reads
+# pending, or its lease expired without renewal.
+BLOCK_DEPOSED = 0
+BLOCK_LEASE = 1
 
 I32 = jnp.int32
 

@@ -7,10 +7,12 @@ from dataclasses import dataclass
 import numpy as np
 
 from swarmkit_tpu.flightrec.codes import (
-    CODE_NAMES, EDGE_DOWN, EDGE_DROP, EDGE_UP, FAULT_EDGE,
+    BLOCK_DEPOSED, BLOCK_LEASE, CODE_NAMES, EDGE_DOWN, EDGE_DROP, EDGE_UP,
+    FAULT_EDGE,
 )
 
 _EDGE_NAMES = {EDGE_DOWN: "down", EDGE_UP: "up", EDGE_DROP: "drop"}
+_BLOCK_NAMES = {BLOCK_DEPOSED: "deposed", BLOCK_LEASE: "lease_expired"}
 
 
 @dataclass(frozen=True)
@@ -36,6 +38,10 @@ class FlightEvent:
             "SNAPSHOT_RESTORE": f"from=n{a0} snap_idx={a1}",
             "FALLBACK_TICK": f"chunks={a0} band_cap={a1}",
             "APPEND_REJECT": f"leader=n{a0} last={a1}",
+            "READ_SERVED": f"applied={a0} batch={a1}",
+            "READ_BLOCKED": f"reads={a0} "
+                            f"reason={_BLOCK_NAMES.get(a1, a1)}",
+            "LEASE_EXPIRED": f"expired_at={a0} bounced={a1}",
         }.get(self.name)
         if self.code == FAULT_EDGE:
             edge = _EDGE_NAMES.get(a0, f"edge_{a0}")

@@ -117,6 +117,13 @@ CATALOG: dict[str, MetricSpec] = {
     "swarm_kernel_apply_advance_total": MetricSpec(
         "counter", "On-device cumulative applied-index advance summed over "
         "rows (SimState.stats[3]).", ()),
+    "swarm_kernel_reads_served_total": MetricSpec(
+        "counter", "On-device cumulative linearizable read ops served "
+        "summed over rows (SimState.read_srv, cfg.read_batch > 0).", ()),
+    "swarm_kernel_reads_blocked_total": MetricSpec(
+        "counter", "On-device cumulative read ops refused (leadership lost "
+        "or lease expired with the batch unstamped) summed over rows "
+        "(SimState.read_block).", ()),
 
     # ---- flight recorder (flightrec/) ------------------------------------
     "swarm_flightrec_events_total": MetricSpec(
@@ -170,6 +177,9 @@ CATALOG: dict[str, MetricSpec] = {
     "swarm_bench_entries_per_second": MetricSpec(
         "gauge", "Steady-state committed entries/sec, by bench config.",
         ("config",)),
+    "swarm_bench_reads_per_second": MetricSpec(
+        "gauge", "Steady-state linearizable reads served/sec, by bench "
+        "config (read-mix configs only).", ("config",)),
     "swarm_bench_compile_seconds": MetricSpec(
         "gauge", "XLA compile+first-call wall time, by bench config.",
         ("config",)),

@@ -1,0 +1,55 @@
+"""Linearizable read path: batched ReadIndex, tick-clock leader leases,
+and follower reads served at the applied index.
+
+Every operation the simulation modeled before this package was a log
+write; the north-star workload is read-dominated.  The read
+optimizations here are the ones the Paxos<->Raft parallels paper
+(arXiv:1905.10786) catalogs as transferable across consensus variants:
+
+* **Batched ReadIndex** — a pending read batch is *stamped* with the
+  leader's commit index once the leader has confirmed it still leads.
+  Confirmation reuses the [N, N] append/heartbeat ack collective the
+  kernel already runs every tick (``q_ok`` = a quorum of member acks
+  arrived this tick), so a ReadIndex round costs no extra messages.
+* **Tick-clock leader leases** — each quorum-ack tick extends the
+  leader's lease to ``now + lease_ticks`` where ``lease_ticks =
+  election_tick - lease_margin - (latency + latency_jitter)``.  A
+  lease-valid leader stamps read batches with zero additional
+  collectives.  The margin term is the clock-skew guard: every ack in
+  the quorum proves its sender refused votes until strictly after the
+  lease expires (see ``lease.py``), so no rival can be elected — and
+  commit new writes — while the lease is live.
+* **Follower reads** — a follower forwards its batch to its known
+  leader for stamping (resolved against the leader row's own gates)
+  and serves locally once ``applied >= read_index``.
+
+Serving itself never needs a quorum: the stamp is the linearization
+point.  A batch stamped with read index R and submit-time goal G
+(``max(commit)`` across rows at submit — the frontier of writes already
+acknowledged to clients) satisfies R >= G by construction, so serving
+at ``applied >= R`` can never miss an acknowledged write.  The DST
+invariant ``LINEARIZABLE_READ`` (dst/invariants.py) checks exactly
+that: ``read_srv_idx >= read_srv_goal`` on every row, every tick.
+
+Layering mirrors ``flightrec/``: the kernel imports this package; this
+package never imports the kernel.  All functions are pure array ops —
+vmap/jit/scan-safe — and everything is Python-gated on
+``cfg.read_batch > 0`` so a reads-off build stays bit-identical.
+"""
+
+from swarmkit_tpu.raft.read.lease import lease_span, renew, valid
+from swarmkit_tpu.raft.read.serve import (ReadRegs, read_fields,
+                                          regs_from_state, settle, stamp,
+                                          submit)
+
+__all__ = [
+    "ReadRegs",
+    "lease_span",
+    "read_fields",
+    "regs_from_state",
+    "renew",
+    "settle",
+    "stamp",
+    "submit",
+    "valid",
+]

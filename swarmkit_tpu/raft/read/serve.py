@@ -1,0 +1,156 @@
+"""Read-batch lifecycle: submit -> stamp -> serve (or refuse).
+
+The per-row read registers are plain [N] i32 vectors — they ride the
+scan carry like every other SimState scalar but never touch the [N, L]
+log rings, so the read path stays outside the kernel's one-write-cond
+budget and adds no per-read collective.
+
+Lifecycle of one batch on row i:
+
+1. ``submit`` (kernel phase R0) — an idle row takes a fresh batch of
+   ``cfg.read_batch`` client reads.  The *goal* register captures
+   ``max(commit)`` across rows at submit time: the frontier of writes
+   already acknowledged to clients, i.e. the linearizability witness
+   this batch must not miss.  The goal is oracle bookkeeping (like
+   ``apply_chk``) — serving decisions never read it.
+2. ``stamp`` (R1, after the commit phase) — the batch gets its read
+   index.  A leader stamps with its own commit index once it has
+   confirmed leadership (valid lease, or a quorum of acks this tick)
+   *and* has committed an entry of its own term (the classic ReadIndex
+   guard: a new leader's commit index may lag the true frontier until
+   its own no-op commits).  A follower forwards to its known leader
+   and stamps with the leader row's commit under the same gates,
+   provided the round trip is clean this tick.
+3. ``settle`` (R2, after the apply phase) — a stamped batch is served
+   once ``applied >= read_index``; unstamped batches are refused when
+   their row was deposed or its lease expired unrenewed (the client
+   retries: the row's pend clears and R0 refills it with a fresh goal).
+
+Safety: stamps only ever use a commit index proven >= the submit-time
+goal (lease/quorum + own-term-commit gates), and commit/applied are
+monotone — so every served batch has ``srv_idx >= srv_goal``.  The DST
+invariant LINEARIZABLE_READ is exactly that reduction.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from swarmkit_tpu.raft.read import lease
+from swarmkit_tpu.raft.sim.state import LEADER, NONE, SimConfig
+
+I32 = jnp.int32
+
+
+class ReadRegs(NamedTuple):
+    """The read subsystem's slice of SimState (all [N] i32)."""
+    pend: jax.Array        # reads queued on this row (0 = idle)
+    goal: jax.Array        # max(commit) anywhere at submit (oracle witness)
+    idx: jax.Array         # ReadIndex stamp (NONE = not yet stamped)
+    lease_until: jax.Array  # absolute expiry tick of the row's lease
+    srv: jax.Array         # cumulative reads served
+    block: jax.Array       # cumulative reads refused
+    srv_idx: jax.Array     # applied index of the last served batch
+    srv_goal: jax.Array    # submit goal of the last served batch
+
+
+def regs_from_state(state) -> ReadRegs:
+    return ReadRegs(pend=state.read_pend, goal=state.read_goal,
+                    idx=state.read_idx, lease_until=state.lease_until,
+                    srv=state.read_srv, block=state.read_block,
+                    srv_idx=state.read_srv_idx,
+                    srv_goal=state.read_srv_goal)
+
+
+def read_fields(regs: ReadRegs) -> dict:
+    """SimState field dict for dataclasses.replace at end of tick."""
+    return dict(read_pend=regs.pend, read_goal=regs.goal,
+                read_idx=regs.idx, lease_until=regs.lease_until,
+                read_srv=regs.srv, read_block=regs.block,
+                read_srv_idx=regs.srv_idx, read_srv_goal=regs.srv_goal)
+
+
+def submit(cfg: SimConfig, regs: ReadRegs, alive: jax.Array,
+           commit: jax.Array) -> ReadRegs:
+    """R0: refill idle live rows with a fresh client batch, capturing
+    the acked-write frontier as the batch's linearizability goal."""
+    refill = alive & (regs.pend == 0)
+    goal = jnp.max(commit)
+    return regs._replace(
+        pend=jnp.where(refill, cfg.read_batch, regs.pend),
+        goal=jnp.where(refill, goal, regs.goal),
+        idx=jnp.where(refill, NONE, regs.idx))
+
+
+def stamp(cfg: SimConfig, regs: ReadRegs, *, alive: jax.Array,
+          role: jax.Array, lead: jax.Array, term: jax.Array,
+          commit: jax.Array, commit_term_ok: jax.Array, q_ok: jax.Array,
+          transferee: jax.Array, now: jax.Array,
+          drop: jax.Array) -> tuple[ReadRegs, jax.Array]:
+    """R1: renew leases, then stamp pending batches with a read index.
+    Returns (regs, confirm) where confirm[i] = row i could vouch for
+    its leadership this tick (lease or quorum + own-term commit)."""
+    n = regs.pend.shape[-1]
+    is_leader = (role == LEADER) & alive
+    lease_until = lease.renew(cfg, regs.lease_until, role, q_ok,
+                              transferee, now)
+    lease_ok = lease.valid(cfg, lease_until, is_leader, transferee, now)
+    confirm = is_leader & commit_term_ok & (lease_ok | q_ok)
+    unstamped = (regs.pend > 0) & (regs.idx == NONE)
+
+    idx = jnp.where(unstamped & confirm, commit, regs.idx)
+
+    # follower read: forward to the row's known leader, stamp with THAT
+    # row's commit under the leader's own gates.  The round trip resolves
+    # same-tick when both edge directions are clean (the mailbox wire's
+    # latency budget is already inside lease_span, so same-tick resolution
+    # never outruns the skew margin).
+    node = jnp.arange(n, dtype=I32)
+    li = jnp.clip(lead, 0, n - 1)
+    has_lead = (lead != NONE) & (lead != node)
+    rt_clean = ~drop[node, li] & ~drop[li, node]
+    stamp_f = unstamped & alive & ~is_leader & has_lead \
+        & (term == term[li]) & confirm[li] & rt_clean
+    idx = jnp.where(stamp_f, commit[li], idx)
+    return regs._replace(idx=idx, lease_until=lease_until), confirm
+
+
+def settle(cfg: SimConfig, regs: ReadRegs, *, alive: jax.Array,
+           applied: jax.Array, role: jax.Array, was_leader: jax.Array,
+           now: jax.Array, prev_lease_until: jax.Array):
+    """R2: serve stamped batches whose applied index caught the stamp;
+    refuse unstamped batches whose serving basis is gone.
+
+    Returns (regs, served, srv_cnt, blocked, blk_cnt, expired) — the
+    masks feed the flight recorder (READ_SERVED / READ_BLOCKED /
+    LEASE_EXPIRED).
+    """
+    is_leader = (role == LEADER) & alive
+    served = alive & (regs.pend > 0) & (regs.idx != NONE) \
+        & (applied >= regs.idx)
+    srv_cnt = jnp.where(served, regs.pend, 0)
+    regs = regs._replace(
+        srv=regs.srv + srv_cnt,
+        srv_idx=jnp.where(served, applied, regs.srv_idx),
+        srv_goal=jnp.where(served, regs.goal, regs.srv_goal),
+        pend=jnp.where(served, 0, regs.pend),
+        idx=jnp.where(served, NONE, regs.idx))
+
+    # a stamped batch is already linearizable and just waits for apply;
+    # only UNSTAMPED batches get refused back to the client.
+    unstamped = (regs.pend > 0) & (regs.idx == NONE)
+    deposed = was_leader & (role != LEADER)
+    if cfg.read_leases:
+        # expiry edge: valid through tick now-1, invalid now, not renewed
+        expired = is_leader & (prev_lease_until == now) \
+            & (now >= regs.lease_until)
+    else:
+        expired = jnp.zeros_like(deposed)
+    blocked = unstamped & (deposed | expired)
+    blk_cnt = jnp.where(blocked, regs.pend, 0)
+    regs = regs._replace(block=regs.block + blk_cnt,
+                         pend=jnp.where(blocked, 0, regs.pend))
+    return regs, served, srv_cnt, blocked, blk_cnt, expired

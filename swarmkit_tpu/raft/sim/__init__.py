@@ -4,8 +4,8 @@ from swarmkit_tpu.raft.sim.kernel import (
     propose, propose_conf, step, transfer_leadership,
 )
 from swarmkit_tpu.raft.sim.run import (
-    committed_entries, has_leader, leader_mask, run_schedule, run_ticks,
-    run_until_leader,
+    committed_entries, has_leader, leader_mask, reads_blocked, reads_served,
+    run_schedule, run_ticks, run_until_leader, submit_reads,
 )
 from swarmkit_tpu.raft.sim.state import (
     CANDIDATE, FOLLOWER, LEADER, NONE, SimConfig, SimState, drop_matrix,
@@ -15,7 +15,8 @@ from swarmkit_tpu.raft.sim.state import (
 __all__ = [
     "propose", "propose_conf", "step", "transfer_leadership",
     "committed_entries", "has_leader", "leader_mask",
-    "run_schedule", "run_ticks", "run_until_leader",
+    "reads_blocked", "reads_served",
+    "run_schedule", "run_ticks", "run_until_leader", "submit_reads",
     "CANDIDATE", "FOLLOWER", "LEADER",
     "NONE", "SimConfig", "SimState", "drop_matrix", "init_state",
     "rand_timeout",

@@ -273,6 +273,16 @@ def step(state: SimState, cfg: SimConfig,
 
     now = state.tick   # pre-increment tick: all wire timestamps key off it
 
+    # ---- Phase R0: read-batch submit (cfg.read_batch; raft/read/) --------
+    # Idle rows take a fresh client batch and capture the PRE-tick acked
+    # frontier max(commit) as the batch's linearizability goal.  Python-
+    # gated like the flight recorder: read_batch=0 traces none of this.
+    reads_on = cfg.read_batch > 0
+    if reads_on:
+        from swarmkit_tpu.raft import read as _rd
+        read_regs = _rd.submit(cfg, _rd.regs_from_state(state), alive,
+                               commit)
+
     # ---- Phase A: timers + CheckQuorum + campaign start ------------------
     # Liveness splits from membership: crashed rows freeze entirely;
     # non-member rows still receive and respond (a joiner must be able to
@@ -1178,6 +1188,30 @@ def step(state: SimState, cfg: SimConfig,
     can_commit = is_leader & (mci > commit) & (mci_term == term)
     commit = jnp.where(can_commit, mci, commit)
 
+    # ---- Phase R1: lease renewal + ReadIndex stamping (raft/read/) -------
+    # Leadership confirmation reuses THIS tick's ack collective — the same
+    # [N, N] ok/reject mats (and heartbeat responses on the mailbox wire)
+    # that just fed recent_active/progress — so a ReadIndex round costs no
+    # extra messages.  A quorum of member acks in one tick both renews the
+    # tick-clock lease and, with the own-term-commit guard (the classic
+    # ReadIndex subtlety: a fresh leader's commit may lag the true
+    # frontier until its no-op commits), authorizes stamping the pending
+    # batch with the just-updated commit index.
+    if reads_on:
+        rd_ack = ok_mat | rej_mat
+        if cfg.mailboxes:
+            rd_ack = rd_ack | _mview(jnp.any(val_hbr, axis=2))
+        rd_nack = jnp.sum(_mview(rd_ack | eye).astype(I32), axis=1)
+        rd_is_leader = (role == LEADER) & alive
+        rd_q_ok = rd_is_leader & (rd_nack >= quorum_row)
+        rd_cterm_ok = (commit > 0) \
+            & (_term_own(cfg, log_term, snap_idx, snap_term, last,
+                         commit) == term)
+        read_regs, rd_confirm = _rd.stamp(
+            cfg, read_regs, alive=alive, role=role, lead=lead, term=term,
+            commit=commit, commit_term_ok=rd_cterm_ok, q_ok=rd_q_ok,
+            transferee=transferee, now=now, drop=drop)
+
     # ---- Phase E: apply + checksum accumulation + conf activation --------
     # Entries (applied, new_applied] are summed in place via the slot->index
     # map of the OWN ring; _entry_chk is order-independent so no cumsum ring
@@ -1277,6 +1311,19 @@ def step(state: SimState, cfg: SimConfig,
                                NONE, transferee)
         # ... and clears the leader's propose gate (add/remove_node both do).
         pending_conf = pending_conf & ~has_conf
+
+    # ---- Phase R2: serve / refuse read batches (raft/read/) --------------
+    # Stamped batches serve once the fresh applied index covers the stamp
+    # (leader same-tick in steady state, followers one apply round later);
+    # unstamped batches on a deposed row or behind an unrenewed lease
+    # expiry are refused back to the client (READ_BLOCKED accounting —
+    # the stale-leader path the DST adversary exercises).
+    if reads_on:
+        read_regs, rd_served, rd_srv_cnt, rd_blocked, rd_blk_cnt, \
+            rd_expired = _rd.settle(
+                cfg, read_regs, alive=alive, applied=applied, role=role,
+                was_leader=(state.role == LEADER), now=now,
+                prev_lease_until=state.lease_until)
 
     # ---- Phase F: compaction (ring-pressure driven) ----------------------
     # Compact to applied-keep (mirroring LogEntriesForSlowFollowers=500)
@@ -1422,9 +1469,22 @@ def step(state: SimState, cfg: SimConfig,
             _emit(~fits & (node == 0), _fc.FALLBACK_TICK,
                   jnp.broadcast_to(nch, (n,)),
                   jnp.full((n,), cfg.band_chunks, I32))
+        if reads_on:
+            # read lifecycle (masks from phases R1/R2): serves carry the
+            # index actually observed, refusals their reason, expiries the
+            # count of client reads they bounced
+            _emit(rd_served, _fc.READ_SERVED, applied, rd_srv_cnt)
+            _emit(rd_blocked, _fc.READ_BLOCKED, rd_blk_cnt,
+                  jnp.where(rd_expired, _fc.BLOCK_LEASE,
+                            _fc.BLOCK_DEPOSED).astype(I32))
+            _emit(rd_expired, _fc.LEASE_EXPIRED, read_regs.lease_until,
+                  rd_blk_cnt)
         ev_fields = dict(ev_buf=ev_buf, ev_pos=ev_pos, ev_alive=alive,
                          ev_drop=drop_deg)
 
+    rd_fields = {}
+    if reads_on:
+        rd_fields = _rd.read_fields(read_regs)
     boxes = {}
     if cfg.mailboxes:
         boxes = dict(
@@ -1455,6 +1515,7 @@ def step(state: SimState, cfg: SimConfig,
         tick=state.tick + 1,
         stats=stats,
         **ev_fields,
+        **rd_fields,
         **boxes,
     )
 

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 
 from swarmkit_tpu.raft.sim.kernel import propose, propose_dense, step
 from swarmkit_tpu.raft.sim.state import (
-    LEADER, SimConfig, SimState, drop_matrix, hash32, init_state,
+    LEADER, NONE, SimConfig, SimState, drop_matrix, hash32, init_state,
 )
 
 I32 = jnp.int32
@@ -157,6 +157,8 @@ class KernelObs:
                    "swarm_kernel_elections_won_total",
                    "swarm_kernel_commit_advance_total",
                    "swarm_kernel_apply_advance_total")
+    _READ_NAMES = ("swarm_kernel_reads_served_total",
+                   "swarm_kernel_reads_blocked_total")
 
     def __init__(self, obs=None) -> None:
         from swarmkit_tpu.metrics import catalog as obs_catalog
@@ -166,23 +168,77 @@ class KernelObs:
         self._m_tick = obs_catalog.get(self.obs, "swarm_kernel_tick_seconds")
         self._m_stats = [obs_catalog.get(self.obs, n)
                          for n in self._STAT_NAMES]
+        self._m_reads = [obs_catalog.get(self.obs, n)
+                         for n in self._READ_NAMES]
         self._last = [0, 0, 0, 0]
+        self._last_reads = [0, 0]
 
     def timed(self, call: str):
         return self._m_tick.labels(call=call).time()
 
     def publish(self, state: SimState) -> dict:
         """Returns the cumulative stats as a dict (empty when the state
-        carries none, i.e. cfg.collect_stats was off)."""
-        if state.stats is None:
-            return {}
-        cur = [int(v) for v in jax.device_get(state.stats)]
-        for fam, c, prev in zip(self._m_stats, cur, self._last):
-            if c > prev:
-                fam.inc(c - prev)
-        self._last = cur
-        return dict(zip(("elections_started", "elections_won",
-                         "commit_advance", "apply_advance"), cur))
+        carries none, i.e. cfg.collect_stats was off and the read path
+        is not compiled in)."""
+        out: dict[str, int] = {}
+        if state.stats is not None:
+            cur = [int(v) for v in jax.device_get(state.stats)]
+            for fam, c, prev in zip(self._m_stats, cur, self._last):
+                if c > prev:
+                    fam.inc(c - prev)
+            self._last = cur
+            out.update(zip(("elections_started", "elections_won",
+                            "commit_advance", "apply_advance"), cur))
+        if state.read_srv is not None:
+            cur_r = [int(jax.device_get(reads_served(state))),
+                     int(jax.device_get(reads_blocked(state)))]
+            for fam, c, prev in zip(self._m_reads, cur_r, self._last_reads):
+                if c > prev:
+                    fam.inc(c - prev)
+            self._last_reads = cur_r
+            out.update(zip(("reads_served", "reads_blocked"), cur_r))
+        return out
+
+
+def submit_reads(state: SimState, cfg: SimConfig, count: int,
+                 rows=None) -> SimState:
+    """Enqueue a linearizable read batch of `count` ops on the selected
+    rows (all rows when `rows` is None), step-compatible: the next
+    `step()` stamps the batch with a ReadIndex (or serves it under a
+    valid lease) and serves it once applied catches up.
+
+    Mirrors the kernel's own closed-loop refill (read/serve.py `submit`):
+    only rows whose previous batch fully drained accept a new one, and
+    the submit-time linearizability goal — max(commit) anywhere — is
+    recorded for the LINEARIZABLE_READ oracle.  Requires
+    cfg.read_batch > 0 so the read registers are compiled in.
+    """
+    if state.read_pend is None:
+        raise ValueError("read path is off (SimConfig.read_batch == 0); "
+                         "no read registers to submit into")
+    sel = jnp.ones((cfg.n,), bool) if rows is None \
+        else jnp.zeros((cfg.n,), bool).at[jnp.asarray(rows)].set(True)
+    open_ = sel & (state.read_pend == 0)
+    goal = jnp.max(state.commit)
+    return dataclasses.replace(
+        state,
+        read_pend=jnp.where(open_, jnp.asarray(count, I32), state.read_pend),
+        read_goal=jnp.where(open_, goal, state.read_goal),
+        read_idx=jnp.where(open_, jnp.asarray(NONE, I32), state.read_idx))
+
+
+def reads_served(state: SimState) -> jax.Array:
+    """Total read ops served across rows (0 when the read path is off)."""
+    if state.read_srv is None:
+        return jnp.asarray(0, I32)
+    return jnp.sum(state.read_srv)
+
+
+def reads_blocked(state: SimState) -> jax.Array:
+    """Total read ops refused (deposal / lease expiry) across rows."""
+    if state.read_block is None:
+        return jnp.asarray(0, I32)
+    return jnp.sum(state.read_block)
 
 
 def committed_entries(state: SimState) -> jax.Array:

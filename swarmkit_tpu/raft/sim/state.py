@@ -122,6 +122,35 @@ class SimConfig:
     # disables tiling explicitly; a chunk >= log_len disables it trivially
     # (the default leaves every small-ring test config untiled).
     log_chunk: int = 1024
+    # Linearizable read path (raft/read/): read_batch > 0 threads the
+    # read-serving phases (R0 submit / R1 stamp / R2 settle) through the
+    # tick and allocates the [N] read registers.  Each idle row auto-
+    # submits a batch of `read_batch` client reads per refill; batches
+    # are stamped with a ReadIndex (leader lease or quorum-ack
+    # confirmation) and served once applied >= read_index.  Off by
+    # default: like the flight recorder, the phases are traced into the
+    # step program only when enabled, so read_batch=0 stays bit-identical
+    # to a build without the subsystem.
+    read_batch: int = 0
+    # False = ReadIndex-classic: every batch waits for a quorum-ack tick
+    # before stamping.  True = tick-clock leader leases: a leader inside
+    # its lease stamps immediately, with zero extra collectives (see
+    # raft/read/lease.py for the clock-skew safety argument).
+    read_leases: bool = True
+    # Safety margin subtracted from the lease span, in ticks.  Must stay
+    # >= 1: the voter no-vote window and the lease are measured by the
+    # same tick clock, and the margin is what keeps lease expiry strictly
+    # before the earliest rival election.
+    lease_margin: int = 1
+
+    @property
+    def lease_ticks(self) -> int:
+        """Lease span: election_tick - lease_margin - (latency + jitter).
+        The latency term discounts ack staleness on the mailbox wire —
+        an ack delivered now proves follower contact only as of up to
+        latency + jitter ticks ago."""
+        return self.election_tick - self.lease_margin \
+            - (self.latency + self.latency_jitter)
 
     @property
     def tiled(self) -> bool:
@@ -167,6 +196,23 @@ class SimConfig:
             # a full round trip must fit well inside the election timeout or
             # healthy leaders get deposed by their own followers
             assert 2 * (self.latency + self.latency_jitter) < self.election_tick
+        if self.read_batch < 0:
+            raise ValueError(f"read_batch must be >= 0, got {self.read_batch}")
+        if self.read_batch and self.read_leases:
+            if self.lease_margin < 1:
+                raise ValueError(
+                    f"lease_margin={self.lease_margin} must be >= 1: the "
+                    f"margin is the clock-skew guard keeping lease expiry "
+                    f"strictly before the earliest rival election")
+            if self.lease_ticks <= 0:
+                raise ValueError(
+                    f"lease_ticks={self.lease_ticks} (election_tick="
+                    f"{self.election_tick} - lease_margin="
+                    f"{self.lease_margin} - latency+jitter="
+                    f"{self.latency + self.latency_jitter}) must be > 0 — "
+                    f"the wire is too slow for this election timeout to "
+                    f"support leases; raise election_tick or set "
+                    f"read_leases=False for ReadIndex-only serving")
         if self.record_events and self.event_ring < 8:
             raise ValueError(
                 f"event_ring={self.event_ring} is too small to hold one "
@@ -287,6 +333,22 @@ class SimState:
     ev_pos: Optional[jax.Array] = None
     ev_alive: Optional[jax.Array] = None   # bool [N]: last tick's alive
     ev_drop: Optional[jax.Array] = None    # i32 [N]: last tick's drop degree
+    # ---- linearizable read path (cfg.read_batch > 0; raft/read/) --------
+    # All [N] i32.  pend/goal/idx are the in-flight batch (goal = the
+    # acked-write frontier max(commit) captured at submit — the oracle
+    # witness the DST invariant checks against, never read by serving
+    # decisions); lease_until is the leader-lease register; srv/block are
+    # cumulative served/refused read counters; srv_idx/srv_goal snapshot
+    # (applied, goal) of the last served batch — the LINEARIZABLE_READ
+    # invariant is jnp.any(srv_idx < srv_goal).
+    read_pend: Optional[jax.Array] = None
+    read_goal: Optional[jax.Array] = None
+    read_idx: Optional[jax.Array] = None      # NONE = not yet stamped
+    lease_until: Optional[jax.Array] = None
+    read_srv: Optional[jax.Array] = None
+    read_block: Optional[jax.Array] = None
+    read_srv_idx: Optional[jax.Array] = None
+    read_srv_goal: Optional[jax.Array] = None
     # ---- in-flight mailboxes [N, N], only when cfg.mailboxes ------------
     # One slot per message class per directed edge; *_at holds deliver
     # tick + 1 (0 = empty).  Request classes index [sender, receiver];
@@ -405,6 +467,11 @@ def init_state(cfg: SimConfig,
         **(dict(ev_buf=z(n, cfg.event_ring, 4), ev_pos=z(n),
                 ev_alive=jnp.ones((n,), jnp.bool_), ev_drop=z(n))
            if cfg.record_events else {}),
+        **(dict(read_pend=z(n), read_goal=z(n),
+                read_idx=jnp.full((n,), NONE, i32),
+                lease_until=z(n), read_srv=z(n), read_block=z(n),
+                read_srv_idx=z(n), read_srv_goal=z(n))
+           if cfg.read_batch > 0 else {}),
     )
 
 

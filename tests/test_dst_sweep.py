@@ -31,3 +31,21 @@ def test_dst_sweep_mutation_demo_end_to_end(tmp_path):
     assert demo["replay_matches"], demo
     # the field-level differential trace localizes the mutated commit path
     assert demo["oracle_diverged_at"] >= 0
+
+
+@pytest.mark.slow
+def test_dst_sweep_stale_read_mutation_demo(tmp_path):
+    demo = run_mutation_demo(schedules=24, ticks=100, seed=0,
+                             mutation="stale_lease_read",
+                             out_path=str(tmp_path / "repro.json"),
+                             verbose=False)
+    assert demo["caught"], demo
+    assert demo["bits"] == ["linearizable_read"]
+    assert demo["profile"] == "stale_leader_reads"
+    assert demo["fault_count_after"] <= demo["fault_count_before"]
+    assert demo["replay_matches"], demo
+    # the read registers sit OUTSIDE the differential oracle's field view
+    # (dst/repro._VIEW_FIELDS), so no oracle divergence is expected here —
+    # localization comes from the LINEARIZABLE_READ bit + flight window
+    assert demo["oracle_diverged_at"] == -1
+    assert demo["flight_events"] > 0

@@ -1,0 +1,291 @@
+"""Linearizable read path tests (swarmkit_tpu/raft/read/).
+
+The load-bearing guarantees:
+
+- ``read_batch=0`` (the default) must leave the kernel program untouched —
+  every non-read SimState field bit-identical to a run that never knew the
+  read path existed, on all three wires (the read phases are gated in
+  Python, so they are simply not traced).
+- Lease safety: the tick-clock lease expires strictly before any rival can
+  assemble an election quorum, so a partitioned stale leader refuses reads
+  instead of serving state missing the successor's committed writes —
+  including across a leader crash mid-lease.
+- The LINEARIZABLE_READ DST invariant catches a lease-disabled stale serve
+  (the ``stale_lease_read`` mutation) under the pinned-victim
+  ``stale_leader_reads`` adversary.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from swarmkit_tpu.dst.invariants import LINEARIZABLE_READ, check_state
+from swarmkit_tpu.raft import read as rd
+from swarmkit_tpu.raft.read import lease
+from swarmkit_tpu.raft.sim import (
+    LEADER, NONE, SimConfig, SimState, init_state, leader_mask,
+    reads_blocked, reads_served, run_schedule, run_ticks, run_until_leader,
+    submit_reads,
+)
+
+I32 = jnp.int32
+
+
+def small_cfg(**kw):
+    base = dict(n=5, log_len=64, window=8, apply_batch=16, max_props=8,
+                keep=4, election_tick=10, seed=3)
+    base.update(kw)
+    return SimConfig(**base)
+
+
+WIRES = {
+    "sync": {},
+    "force_mailboxes": {"force_mailboxes": True},
+    "mailbox_lat2": {"latency": 2, "latency_jitter": 1, "inflight": 4},
+}
+
+
+# ---------------------------------------------------------------------------
+# config validation + lease arithmetic
+
+
+def test_read_batch_rejects_negative():
+    with pytest.raises(ValueError, match="read_batch"):
+        small_cfg(read_batch=-1)
+
+
+def test_lease_margin_must_cover_clock_skew():
+    with pytest.raises(ValueError, match="lease_margin"):
+        small_cfg(read_batch=2, lease_margin=0)
+
+
+def test_lease_ticks_must_be_positive():
+    # election_tick 10 - margin 7 - (latency 2 + jitter 1) = 0: the margin
+    # plus wire staleness consume the whole timeout, no lease span left
+    with pytest.raises(ValueError, match="lease_ticks"):
+        small_cfg(read_batch=2, latency=2, latency_jitter=1, lease_margin=7)
+    # ReadIndex-only serving with the same knobs is fine
+    small_cfg(read_batch=2, latency=2, latency_jitter=1, lease_margin=7,
+              read_leases=False)
+
+
+def test_lease_ticks_arithmetic():
+    assert small_cfg(read_batch=2).lease_ticks == 9
+    assert small_cfg(read_batch=2, latency=2,
+                     latency_jitter=1).lease_ticks == 6
+    cfg = small_cfg(read_batch=2, lease_margin=3)
+    assert cfg.lease_ticks == 7
+    assert lease.lease_span(cfg) == cfg.lease_ticks
+
+
+def test_lease_renew_and_valid_semantics():
+    cfg = small_cfg(read_batch=2)
+    n = cfg.n
+    role = jnp.asarray([LEADER, 0, 0, LEADER, 0], I32)
+    q_ok = jnp.asarray([True, False, False, False, False])
+    transferee = jnp.full((n,), NONE, I32).at[3].set(1)
+    now = jnp.asarray(20, I32)
+    prev = jnp.full((n,), 15, I32)
+    until = lease.renew(cfg, prev, role, q_ok, transferee, now)
+    # quorum ack grants now + span; non-leaders are cleared to 0 so a new
+    # leader starts lease-less; an in-flight transfer blocks the grant
+    assert int(until[0]) == 20 + cfg.lease_ticks
+    assert int(until[1]) == 0 and int(until[2]) == 0
+    assert int(until[3]) == 15    # leader, but transferring: no renewal
+
+    is_leader = role == LEADER
+    ok = lease.valid(cfg, until, is_leader, transferee, now)
+    assert bool(ok[0])
+    assert not bool(ok[3])        # transfer voids the lease
+    assert not bool(ok[1])
+    # expiry is strict: now == lease_until is already invalid
+    at_edge = jnp.full((n,), 20, I32)
+    assert not bool(lease.valid(cfg, at_edge, is_leader, transferee, now)[0])
+    # leases disabled: never valid, regardless of state
+    cfg_off = small_cfg(read_batch=2, read_leases=False)
+    assert not bool(lease.valid(cfg_off, until, is_leader, transferee,
+                                now)[0])
+
+
+# ---------------------------------------------------------------------------
+# read_batch=0 bit-identity (the acceptance regression)
+
+
+@pytest.mark.parametrize("wire", sorted(WIRES))
+def test_reads_off_is_bit_identical(wire):
+    """With read_batch=0 every kernel output matches a run of the identical
+    config with reads on — the read path only ADDS the read_*/lease_*
+    registers, it never perturbs the sim."""
+    cfg_off = small_cfg(**WIRES[wire])
+    cfg_on = small_cfg(read_batch=2, **WIRES[wire])
+    off, _ = run_ticks(init_state(cfg_off), cfg_off, 50, prop_count=1)
+    on, _ = run_ticks(init_state(cfg_on), cfg_on, 50, prop_count=1)
+    assert off.read_pend is None and on.read_pend is not None
+    for f in dataclasses.fields(SimState):
+        if f.name.startswith(("read_", "lease_")):
+            continue
+        a, b = getattr(off, f.name), getattr(on, f.name)
+        if a is None:
+            assert b is None, f.name
+            continue
+        assert np.array_equal(np.asarray(a), np.asarray(b)), \
+            f"field {f.name} diverged with reads on ({wire} wire)"
+
+
+def test_reads_off_registers_are_none():
+    st = init_state(small_cfg())
+    assert st.read_pend is None and st.read_srv is None
+    assert st.lease_until is None
+    assert int(reads_served(st)) == 0 and int(reads_blocked(st)) == 0
+
+
+# ---------------------------------------------------------------------------
+# serving behavior
+
+
+def _settled(cfg, warm_ticks=30):
+    st = init_state(cfg)
+    st, _ = run_until_leader(st, cfg, max_ticks=200)
+    st, _ = run_ticks(st, cfg, warm_ticks, prop_count=2)
+    return st
+
+
+@pytest.mark.parametrize("leases", [True, False])
+def test_steady_state_serves_reads(leases):
+    cfg = small_cfg(read_batch=4, read_leases=leases)
+    st = _settled(cfg)
+    before = int(reads_served(st))
+    fin, _ = run_ticks(st, cfg, 20, prop_count=2)
+    served = int(reads_served(fin)) - before
+    # the leader serves every tick; followers settle one stamp round later
+    assert served >= 20 * cfg.read_batch
+    assert int(check_state(fin, cfg)) == 0
+    assert bool(jnp.all(fin.read_srv_idx >= fin.read_srv_goal))
+
+
+def test_submit_reads_host_api():
+    cfg = small_cfg(read_batch=2)
+    st = init_state(cfg)
+    st = submit_reads(st, cfg, 7, rows=[0, 2])
+    assert st.read_pend.tolist() == [7, 0, 7, 0, 0]
+    assert int(st.read_idx[0]) == NONE
+    # occupied rows keep their batch: a second submit is a no-op there
+    again = submit_reads(st, cfg, 3, rows=[0, 1])
+    assert again.read_pend.tolist() == [7, 3, 7, 0, 0]
+    # the batches drain through the normal step flow
+    fin, _ = run_ticks(again, cfg, 40, prop_count=1)
+    assert int(reads_served(fin)) + int(reads_blocked(fin)) >= 17
+    with pytest.raises(ValueError, match="read path is off"):
+        submit_reads(init_state(small_cfg()), small_cfg(), 1)
+
+
+def test_stale_leader_partition_refuses_reads():
+    """Isolate the sitting leader: its lease expires inside the window and
+    it must stop serving (bounded by the lease span) and refuse the rest,
+    while the majority elects a successor and read linearizability holds."""
+    cfg = small_cfg(read_batch=2)
+    st = _settled(cfg)
+    lm = np.asarray(leader_mask(st))
+    assert lm.any()
+    ldr = int(np.argmax(lm))
+    srv_before = int(st.read_srv[ldr])
+    ticks = 60
+    drop = np.zeros((ticks, cfg.n, cfg.n), bool)
+    drop[:, ldr, :] = True
+    drop[:, :, ldr] = True
+    fin, _ = run_schedule(st, cfg, jnp.asarray(drop),
+                          jnp.ones((ticks, cfg.n), bool), prop_count=2)
+    assert int(check_state(fin, cfg)) == 0
+    assert bool(jnp.all(fin.read_srv_idx >= fin.read_srv_goal))
+    # served only while the lease was still valid, then refused
+    served = int(fin.read_srv[ldr]) - srv_before
+    assert served <= (cfg.lease_ticks + 1) * cfg.read_batch
+    assert int(fin.read_block[ldr]) > 0
+    # the majority moved on: a successor leads and commits
+    lm_fin = np.asarray(leader_mask(fin))
+    others = np.arange(cfg.n) != ldr
+    assert lm_fin[others].any()
+    assert int(jnp.max(fin.commit)) > int(jnp.max(st.commit))
+
+
+def test_leader_crash_mid_lease_stays_linearizable():
+    """Crash the leader while its lease is valid; revive it after the
+    majority re-elected.  The revived row's lease has expired on the
+    absolute tick clock and its term is stale, so it cannot serve reads
+    from before the crash."""
+    cfg = small_cfg(read_batch=2)
+    st = _settled(cfg)
+    ldr = int(np.argmax(np.asarray(leader_mask(st))))
+    ticks = 60
+    alive = np.ones((ticks, cfg.n), bool)
+    alive[:25, ldr] = False
+    fin, _ = run_schedule(st, cfg, jnp.zeros((ticks, cfg.n, cfg.n), bool),
+                          jnp.asarray(alive), prop_count=2)
+    assert int(check_state(fin, cfg)) == 0
+    assert bool(jnp.all(fin.read_srv_idx >= fin.read_srv_goal))
+    assert int(jnp.max(fin.commit)) > int(jnp.max(st.commit))
+
+
+def test_invariant_flags_corrupted_serve():
+    cfg = small_cfg(read_batch=2)
+    st = _settled(cfg)
+    fin, _ = run_ticks(st, cfg, 10, prop_count=2)
+    assert int(check_state(fin, cfg)) == 0
+    bad = dataclasses.replace(
+        fin, read_srv_idx=fin.read_srv_goal - 1,
+        read_srv_goal=jnp.maximum(fin.read_srv_goal, 1))
+    assert int(check_state(bad, cfg)) & LINEARIZABLE_READ
+
+
+def test_read_flight_events_recorded():
+    cfg = small_cfg(read_batch=2, record_events=True, event_ring=128)
+    st = _settled(cfg)
+    fin, _ = run_ticks(st, cfg, 15, prop_count=2)
+    from swarmkit_tpu.flightrec import decode_state
+    events, _ = decode_state(fin)
+    assert any(e.name == "READ_SERVED" for e in events)
+
+
+def test_dst_catches_stale_lease_read_mutation():
+    """The detection self-test at unit size: the lease-disabled serve must
+    trip LINEARIZABLE_READ (and only it) under the pinned-victim
+    stale-leader adversary, while the stock kernel run of the same
+    schedules stays clean (the 256-schedule version is the slow sweep)."""
+    from swarmkit_tpu import dst
+
+    cfg = small_cfg(read_batch=2, seed=0)
+    batch, names = dst.make_batch(cfg, ticks=100, schedules=12, seed=0,
+                                  profiles=dst.EXTRA_PROFILES)
+    res = dst.explore(init_state(cfg), cfg, batch, profiles=names,
+                      prop_count=2, mutation="stale_lease_read")
+    assert len(res.violating) > 0
+    for s in res.violating:
+        assert dst.bits_to_names(int(res.viol[s])) == ["linearizable_read"]
+
+
+def test_stale_mutation_requires_read_path():
+    from swarmkit_tpu.dst.explore import apply_mutation
+
+    cfg = small_cfg()
+    with pytest.raises(ValueError, match="read_batch"):
+        apply_mutation(init_state(cfg), cfg, "stale_lease_read")
+
+
+# ---------------------------------------------------------------------------
+# bench wrapper (slow): the 99:1 read-mix config
+
+
+@pytest.mark.slow
+def test_bench_readmix_reads_dominate():
+    """The acceptance bar for the read-heavy bench config: served reads/s
+    at the 99:1 offered mix must be >= 10x committed entries/s."""
+    import jax
+
+    from bench import measure
+
+    m = measure(jax, 256, 50_000, seed=7, election_tick=16,
+                read_batch=99 * 2048 // 256)
+    assert m["rate"] > 0
+    assert m["read_rate"] >= 10 * m["rate"], m

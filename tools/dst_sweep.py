@@ -6,20 +6,27 @@ Two jobs, both seed-pinned and CPU-runnable under tier-1:
 1. **Sweep** (default): generate S adversarial fault schedules across the
    named profiles, advance S x N simulated clusters in one jitted scan,
    and check ElectionSafety / LogMatching / LeaderCompleteness / commit
-   monotonicity / applied-checksum agreement every tick.  The stock kernel
-   must report ZERO violations.
+   monotonicity / applied-checksum agreement / read linearizability every
+   tick (the sweep config enables the linearizable read path,
+   ``--reads``, so all six invariants are armed).  The stock kernel must
+   report ZERO violations.
 
 2. **Mutation self-test** (runs after the sweep unless suppressed): repeat
-   a smaller sweep against a deliberately broken kernel knob
-   (``commit_no_quorum``: leaders commit without a match quorum), assert
-   the checkers CATCH it, greedily shrink the first counterexample to a
+   a smaller sweep against a deliberately broken kernel knob, assert the
+   checkers CATCH it, greedily shrink the first counterexample to a
    minimal repro, dump it as a JSON artifact, and replay the artifact —
-   bits and first-violation tick must reproduce exactly, and the
-   differential oracle trace must localize the divergence.
+   bits and first-violation tick must reproduce exactly.  Two knobs run
+   by default: ``commit_no_quorum`` (leaders commit without a match
+   quorum; the differential oracle additionally localizes the divergence)
+   and ``stale_lease_read`` (leases force-disabled, stale leaders serve
+   reads; swept under the explicit ``stale_leader_reads`` adversary,
+   caught by LINEARIZABLE_READ — the oracle view excludes read registers,
+   so no oracle divergence is expected there).
 
 Usage:
     python tools/dst_sweep.py --schedules 256 --ticks 100 --seed 0
     python tools/dst_sweep.py --mutate commit_no_quorum --out repro.json
+    python tools/dst_sweep.py --mutate stale_lease_read
     python tools/dst_sweep.py --replay repro.json
 """
 
@@ -43,20 +50,28 @@ from swarmkit_tpu.raft.sim.state import SimConfig, init_state  # noqa: E402
 
 DEFAULT_MUTATION = "commit_no_quorum"
 
+# each mutation is swept under the adversary rotation that realizes the
+# scenario it breaks: the stale-read knob needs the pinned-victim
+# stale-leader overlap, which lives in EXTRA_PROFILES
+MUTATION_PROFILES = {
+    "stale_lease_read": dst.EXTRA_PROFILES,
+}
 
-def _cfg(n: int, seed: int) -> SimConfig:
+
+def _cfg(n: int, seed: int, reads: int = 2) -> SimConfig:
     """The DST cluster shape: small rows, small ring — schedule diversity,
     not cluster size, is the search dimension (mirrors the differential
-    suite's CFG5)."""
+    suite's CFG5).  `reads` enables the linearizable read path so the
+    LINEARIZABLE_READ checker is armed (0 sweeps the read-free kernel)."""
     return SimConfig(n=n, log_len=64, window=8, apply_batch=16, max_props=8,
-                     keep=4, election_tick=10, seed=seed)
+                     keep=4, election_tick=10, seed=seed, read_batch=reads)
 
 
 def run_sweep(schedules: int = 256, ticks: int = 100, seed: int = 0,
               n: int = 5, prop_count: int = 2, profiles=dst.PROFILES,
-              mutation=None, verbose: bool = True) -> dict:
+              mutation=None, reads: int = 2, verbose: bool = True) -> dict:
     """One explore() call; returns a result summary dict (importable)."""
-    cfg = _cfg(n, seed)
+    cfg = _cfg(n, seed, reads)
     batch, names = dst.make_batch(cfg, ticks=ticks, schedules=schedules,
                                   seed=seed, profiles=profiles)
     res = dst.explore(init_state(cfg), cfg, batch, profiles=names,
@@ -88,9 +103,12 @@ def run_sweep(schedules: int = 256, ticks: int = 100, seed: int = 0,
 def run_mutation_demo(schedules: int = 24, ticks: int = 100, seed: int = 0,
                       n: int = 5, prop_count: int = 2,
                       mutation: str = DEFAULT_MUTATION,
-                      out_path=None, verbose: bool = True) -> dict:
+                      out_path=None, profiles=None,
+                      verbose: bool = True) -> dict:
     """Detect -> shrink -> dump -> replay one seeded mutation repro."""
-    sweep = run_sweep(schedules, ticks, seed, n, prop_count,
+    if profiles is None:
+        profiles = MUTATION_PROFILES.get(mutation, dst.PROFILES)
+    sweep = run_sweep(schedules, ticks, seed, n, prop_count, profiles,
                       mutation=mutation, verbose=verbose)
     res, batch, names, cfg = (sweep["_result"], sweep["_batch"],
                               sweep["_names"], sweep["_cfg"])
@@ -116,7 +134,7 @@ def run_mutation_demo(schedules: int = 24, ticks: int = 100, seed: int = 0,
                           prop_count=prop_count, mutation=mutation,
                           viol=v2, first_tick=f2, flight=flight)
     out_path = out_path or os.path.join(tempfile.gettempdir(),
-                                        "dst_repro.json")
+                                        f"dst_repro_{mutation}.json")
     dst.save_artifact(out_path, art)
     verdict = dst.replay_artifact(out_path)
     demo.update({
@@ -135,10 +153,15 @@ def run_mutation_demo(schedules: int = 24, ticks: int = 100, seed: int = 0,
               f"{demo['profile']}): shrunk {before} -> "
               f"{demo['fault_count_after']} fault-events in {evals} replays",
               flush=True)
+        oracle_note = (
+            f"oracle trace localizes divergence at tick "
+            f"{demo['oracle_diverged_at']}"
+            if demo["oracle_diverged_at"] >= 0 else
+            "oracle view agrees (mutation corrupts only read registers, "
+            "outside the oracle's field view)")
         print(f"repro artifact: {out_path} — replay "
               f"{'reproduces exactly' if demo['replay_matches'] else 'DIVERGED'},"
-              f" oracle trace localizes divergence at tick "
-              f"{demo['oracle_diverged_at']}", flush=True)
+              f" {oracle_note}", flush=True)
         tail = flight["record"].window(6)
         if tail:
             print(f"flight window (last {len(tail)} device events before "
@@ -175,7 +198,11 @@ def main(argv=None) -> int:
     ap.add_argument("--prop-count", type=int, default=2,
                     help="proposals injected per tick")
     ap.add_argument("--profiles", default=",".join(dst.PROFILES),
-                    help=f"comma list from {dst.PROFILES}")
+                    help=f"comma list from "
+                    f"{dst.PROFILES + dst.EXTRA_PROFILES}")
+    ap.add_argument("--reads", type=int, default=2,
+                    help="per-row linearizable read batch size; arms the "
+                    "LINEARIZABLE_READ checker (0 = read-free kernel)")
     ap.add_argument("--mutate", default=None,
                     help="run ONLY a mutation sweep with this broken-kernel "
                     "knob (e.g. commit_no_quorum) instead of stock+demo")
@@ -193,7 +220,7 @@ def main(argv=None) -> int:
 
     profiles = tuple(p for p in args.profiles.split(",") if p)
     for p in profiles:
-        if p not in dst.PROFILES:
+        if p not in dst.PROFILES + dst.EXTRA_PROFILES:
             ap.error(f"unknown profile {p!r}")
 
     if args.mutate:
@@ -203,7 +230,7 @@ def main(argv=None) -> int:
         return 0 if demo["caught"] and demo.get("replay_matches") else 1
 
     sweep = run_sweep(args.schedules, args.ticks, args.seed, args.n,
-                      args.prop_count, profiles)
+                      args.prop_count, profiles, reads=args.reads)
     ok = sweep["violations"] == 0
     if not ok:
         res, names = sweep["_result"], sweep["_names"]
@@ -213,10 +240,12 @@ def main(argv=None) -> int:
                   f"at tick {int(res.first_tick[s])}", flush=True)
 
     if not args.no_mutation_demo:
-        demo = run_mutation_demo(
-            min(args.schedules, 24), args.ticks, args.seed, args.n,
-            args.prop_count, out_path=args.out)
-        ok = ok and demo["caught"] and demo.get("replay_matches", False)
+        for mutation in (DEFAULT_MUTATION, "stale_lease_read"):
+            demo = run_mutation_demo(
+                min(args.schedules, 24), args.ticks, args.seed, args.n,
+                args.prop_count, mutation,
+                out_path=args.out if mutation == DEFAULT_MUTATION else None)
+            ok = ok and demo["caught"] and demo.get("replay_matches", False)
 
     print("PASS" if ok else "FAIL", flush=True)
     return 0 if ok else 1

@@ -16,9 +16,14 @@ Checks, in order:
 4. Every catalog entry is referenced somewhere outside the catalog —
    dead specs rot; delete or wire them.
 5. The flight-recorder event vocabulary (``flightrec/codes.py``) stays
-   publishable: every code name must fit the
-   ``swarm_flightrec_events_total{code=...}`` schema, and the capture
-   counter must keep its ``trigger`` label.
+   publishable AND internally consistent: every code name must fit the
+   ``swarm_flightrec_events_total{code=...}`` schema, the capture
+   counter must keep its ``trigger`` label, every ``CODE_NAMES`` entry
+   must name a module constant carrying that exact value (names unique),
+   and every uppercase int event constant — arg-value enums like
+   ``EDGE_*``/``BLOCK_*`` and ``EVENT_WIDTH`` excepted — must appear in
+   ``CODE_NAMES``, so the device vocabulary and the scrape-side schema
+   cannot drift apart.
 
 Importable (``run_lint`` returns the problem list) so the pytest wrapper
 in tests/test_metrics_lint.py runs it in-suite; the CLI exits nonzero on
@@ -145,6 +150,28 @@ def run_lint(repo_root: str | None = None) -> list[str]:
     if cap_spec is None or "trigger" not in tuple(cap_spec.labels):
         problems.append("flightrec: 'swarm_flightrec_captures_total' must "
                         "exist with a 'trigger' label")
+
+    #    ... and the vocabulary itself cannot drift: CODE_NAMES entries
+    #    must mirror the module constants exactly, and no event constant
+    #    may be missing from CODE_NAMES (the decoder and the events
+    #    counter both key on the names)
+    code_names = list(flight_codes.CODE_NAMES.values())
+    if len(set(code_names)) != len(code_names):
+        problems.append("flightrec: duplicate event names in CODE_NAMES")
+    for code, cname in flight_codes.CODE_NAMES.items():
+        if getattr(flight_codes, cname, None) != code:
+            problems.append(
+                f"flightrec: CODE_NAMES[{code}] = {cname!r} but the module "
+                f"constant {cname} = {getattr(flight_codes, cname, None)!r}")
+    non_codes = {"EVENT_WIDTH"}
+    arg_prefixes = ("EDGE_", "BLOCK_")
+    for attr, val in vars(flight_codes).items():
+        if (attr.isupper() and isinstance(val, int)
+                and attr not in non_codes
+                and not attr.startswith(arg_prefixes)
+                and attr not in code_names):
+            problems.append(f"flightrec: event constant {attr} = {val} is "
+                            "missing from CODE_NAMES")
     return problems
 
 

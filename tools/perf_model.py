@@ -11,9 +11,14 @@ registers the axon TPU platform and ignores JAX_PLATFORMS):
    timed in isolation over realistic array shapes, attributing the delta.
 
 Usage: python tools/perf_model.py [--quick] [--tiled {on,off,both}]
+                                  [--reads]
 Prints a markdown report to stdout (paste into PERF.md).  --tiled runs the
 chunked-log-axis A/B instead (ms/tick per variant plus the analytic
-swarm_kernel_bytes_touched{phase=...,variant=...} gauges).
+swarm_kernel_bytes_touched{phase=...,variant=...} gauges).  --reads runs
+the linearizable-read A/B instead: tick-clock leases on (lease-valid
+leaders serve with zero extra collectives) vs off (every batch waits for
+a ReadIndex quorum confirmation), reads/s + ms/tick per wire, plus the
+analytic swarm_kernel_bytes_touched{phase="read",...} rows.
 """
 
 from __future__ import annotations
@@ -35,8 +40,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from swarmkit_tpu.metrics import catalog as obs_catalog  # noqa: E402
 from swarmkit_tpu.metrics import registry as obs_registry  # noqa: E402
 from swarmkit_tpu.raft.sim import (  # noqa: E402
-    SimConfig, committed_entries, has_leader, init_state, run_ticks,
-    run_until_leader,
+    SimConfig, committed_entries, has_leader, init_state, reads_served,
+    run_ticks, run_until_leader,
 )
 from swarmkit_tpu.raft.sim.kernel import _idx_at_slots, _is_conf  # noqa: E402
 from swarmkit_tpu.raft.sim.run import KernelObs  # noqa: E402
@@ -203,6 +208,73 @@ def tiled_report(mode: str, quick: bool) -> None:
         print(row + " |")
 
 
+def read_steady(n: int, ticks: int = 64, leases: bool = True, **kw):
+    """Per-tick ms + reads/s + entries/s with the read path compiled in
+    (32 reads per row per refill, leases on or off)."""
+    kw.setdefault("log_len", 8192)
+    cfg = SimConfig(n=n, window=2048, apply_batch=2048, max_props=2048,
+                    keep=500, seed=42, election_tick=16, static_members=True,
+                    read_batch=32, read_leases=leases, **kw)
+    st = init_state(cfg)
+    with OBS.timed("run_until_leader"):
+        st, _ = run_until_leader(st, cfg, max_ticks=512)
+        jax.block_until_ready(st.term)
+    assert bool(has_leader(st)), f"no leader at n={n}"
+    warm, _ = run_ticks(st, cfg, ticks, prop_count=cfg.max_props)
+    jax.block_until_ready(warm.commit)
+    best = float("inf")
+    for _ in range(3):
+        with OBS.timed("run_ticks"):
+            t0 = time.perf_counter()
+            fin, _ = run_ticks(st, cfg, ticks, prop_count=cfg.max_props)
+            jax.block_until_ready(fin.commit)
+        best = min(best, time.perf_counter() - t0)
+    reads = int(reads_served(fin)) - int(reads_served(st))
+    ents = int(committed_entries(fin)) - int(committed_entries(st))
+    return best / ticks * 1e3, reads / best, ents / best
+
+
+def _read_bytes_touched(n: int) -> None:
+    """Analytic per-tick read-path traffic as
+    swarm_kernel_bytes_touched{phase="read",variant=...}.
+
+    The read registers are eight [N] i32 vectors (read + write every
+    tick).  A lease-valid leader serves against the tick clock — one [N]
+    compare, zero extra collectives.  The ReadIndex-every-batch variant
+    additionally reduces the [N, N] heartbeat-ack matrix per confirmation
+    (on a real transport that is the extra quorum round-trip the lease
+    elides; on device it is the ack-matrix read)."""
+    g = obs_catalog.get(OBS.obs, "swarm_kernel_bytes_touched")
+    regs = n * 8 * 4 * 2
+    g.labels(phase="read", variant="lease").set(regs + n * 4)
+    g.labels(phase="read", variant="readindex").set(regs + n * n + n * 4)
+
+
+def reads_report(quick: bool) -> None:
+    """--reads: lease-serving vs ReadIndex-every-batch A/B at n=256."""
+    n = 256
+    print(f"\n## Linearizable reads A/B (static_members, n={n}, "
+          "read_batch=32/row, 2048 props/tick)\n")
+    print("Leases serve from the tick clock once a quorum ack renews them; "
+          "`readindex` (read_leases=False) confirms every batch against "
+          "the heartbeat ack quorum instead.\n")
+    print("| wire | variant | ms/tick | reads/s | entries/s |")
+    print("|---|---|---|---|---|")
+    wires = [("sync", {})]
+    if not quick:
+        wires.append(("mailbox lat=2 jit=1",
+                      dict(latency=2, latency_jitter=1, inflight=4)))
+    g = obs_catalog.get(OBS.obs, "swarm_bench_reads_per_second")
+    for wire, kw in wires:
+        for leases in (True, False):
+            variant = "lease" if leases else "readindex"
+            ms, rps, eps = read_steady(n, leases=leases, **kw)
+            g.labels(config=f"perf-model-n{n}-{variant}").set(rps)
+            print(f"| {wire} | {variant} | {ms:.2f} | {rps:,.0f} | "
+                  f"{eps:,.0f} |")
+    _read_bytes_touched(n)
+
+
 _PHASE_SLUGS = {
     "views: n_mem sum + quorum [N,N]->[N]": "views",
     "mask: one granted&member reduction [N,N]": "vote-mask",
@@ -215,6 +287,13 @@ _PHASE_SLUGS = {
 
 def main():
     quick = "--quick" in sys.argv
+    if "--reads" in sys.argv:
+        reads_report(quick)
+        print("\n## Live metrics (registry render)\n")
+        print("```")
+        print(obs_registry.DEFAULT.render().rstrip())
+        print("```")
+        return
     if "--tiled" in sys.argv:
         mode = sys.argv[sys.argv.index("--tiled") + 1]
         if mode not in ("on", "off", "both"):
