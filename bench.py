@@ -201,7 +201,8 @@ class MeasureError(Exception):
 def measure(jax, n: int, entries: int, seed: int, election_tick: int,
             latency: int = 0, latency_jitter: int = 0, inflight: int = 1,
             log_len: int = 8192, read_batch: int = 0,
-            read_leases: bool = True, **run_kw):
+            read_leases: bool = True, peer_chunk: int | None = None,
+            shard: bool = False, **run_kw):
     """Elect a leader, then time one compiled steady-state replication run of
     ~`entries` committed entries. Returns a dict of measurements; raises
     MeasureError if no leader emerges.
@@ -240,7 +241,23 @@ def measure(jax, n: int, entries: int, seed: int, election_tick: int,
                     collect_stats=os.environ.get(
                         "BENCH_COLLECT_STATS", "1") != "0",
                     record_events=os.environ.get(
-                        "BENCH_RECORD_EVENTS", "0") == "1")
+                        "BENCH_RECORD_EVENTS", "0") == "1",
+                    # peer_chunk picks the peer-axis lowering: None keeps
+                    # the SimConfig default (banded hierarchical quorum
+                    # reductions once n > peer_chunk), 0 pins the dense
+                    # [N, N] tallies (the densepeer tripwire's reference)
+                    **({} if peer_chunk is None
+                       else {"peer_chunk": peer_chunk}))
+    # shard=True runs the whole flow row-sharded over the device mesh
+    # (32768-sharded config): with the banded peer reductions the kernel
+    # never materializes a full [N, N] intermediate, so each device only
+    # holds its row slab plus one [rows/D, peer_chunk] band at a time.
+    if shard:
+        from swarmkit_tpu.parallel import row_mesh, shard_rows
+        _mesh = row_mesh(n)
+        _shard = lambda st: shard_rows(st, _mesh)  # noqa: E731
+    else:
+        _shard = lambda st: st  # noqa: E731
     ticks_needed = max(1, (entries + cfg.max_props - 1) // cfg.max_props)
     chunk = int(os.environ.get("BENCH_CHUNK_TICKS", "64"))
     n_chunks = (ticks_needed + chunk - 1) // chunk
@@ -261,7 +278,7 @@ def measure(jax, n: int, entries: int, seed: int, election_tick: int,
     def measure_election():
         """Run one election from fresh state; returns (state, ticks,
         seconds).  Raises if no leader emerges within the tick budget."""
-        st = init_state(cfg)
+        st = _shard(init_state(cfg))
         t0 = time.perf_counter()
         ticks = 0
         while ticks < max_elect_ticks:
@@ -480,6 +497,19 @@ def main() -> None:
             # served reads/s must stay >= 10x committed entries/s.
             ("256-readmix-99to1", 256,
              {"read_batch": 99 * 2048 // 256}),
+            # peer-lowering regression tripwire (handled specially below):
+            # the SAME shape measured dense (peer_chunk=0, full [N, N]
+            # tallies) and banded (hierarchical quorum reductions); the
+            # pinned signal is the banded/dense rate ratio — n=1024 is the
+            # wash point, so banded collapsing below ~0.7x dense means the
+            # banded lowering regressed, and dense collapsing means the
+            # fallback did
+            ("1024-densepeer", 1024, {"_peer_ab": True}),
+            # sharded headline rung: rows sharded over the device mesh
+            # with banded peer reductions — no device ever materializes a
+            # full [N, N] intermediate, only its row slab plus one
+            # [rows/D, peer_chunk] band (the n=32768 scaling story)
+            ("32768-sharded", 32768, {"shard": True, "peer_chunk": 1024}),
         ):
             if only and only not in name:
                 extra.setdefault(f"filtered-by-only:{only}",
@@ -498,6 +528,18 @@ def main() -> None:
                     # any n, so shrink rather than lose the number
                     name = f"{name}-reduced-n256"
                     cn = 256
+                elif "densepeer" in name:
+                    # the dense-vs-banded ratio is measurable wherever
+                    # banding is legal (peer_chunk scales with n below)
+                    name = f"{name}-reduced-n256"
+                    cn = 256
+                elif "sharded" in name:
+                    # ISSUE 7: the 32k sharded rung runs CPU-reduced on
+                    # the 8-virtual-device mesh; the no-[N,N]-buffer
+                    # property it exercises is pinned at full scale by
+                    # test_compile_budget's sharded 32k lowering
+                    name = f"{name}-reduced-n4096"
+                    cn = 4096
                 else:
                     extra[name] = "skipped (cpu)"
                     continue
@@ -506,6 +548,31 @@ def main() -> None:
                 extra[name] = "skipped (budget)"
                 continue
             try:
+                if kw.pop("_peer_ab", False):
+                    # densepeer tripwire: one shape, both peer lowerings;
+                    # the pinned signal is the banded/dense rate ratio
+                    pc = max(64, cn // 4)
+                    dm = measure(jax, cn, target_entries, seed=7,
+                                 election_tick=election_tick_for(cn),
+                                 peer_chunk=0, **kw)
+                    bm = measure(jax, cn, target_entries, seed=7,
+                                 election_tick=election_tick_for(cn),
+                                 peer_chunk=pc, **kw)
+                    ratio = bm["rate"] / dm["rate"]
+                    _bench_gauges(f"{name}-dense", dm)
+                    _bench_gauges(f"{name}-banded-pc{pc}", bm)
+                    extra[name] = {
+                        "dense": round(dm["rate"], 1),
+                        f"banded_pc{pc}": round(bm["rate"], 1),
+                        "banded_over_dense": round(ratio, 3)}
+                    log(f"config {name}: dense {dm['rate']:,.0f} vs banded "
+                        f"{bm['rate']:,.0f} entries/s ({ratio:.2f}x)")
+                    if ratio < 0.7:
+                        RESULT.setdefault(
+                            "note", f"peer-tiling tripwire: banded rate "
+                            f"{bm['rate']:,.0f} < 0.7x dense "
+                            f"{dm['rate']:,.0f} at {name}")
+                    continue
                 cm = measure(jax, cn, target_entries, seed=7,
                              election_tick=election_tick_for(cn), **kw)
                 _bench_gauges(name, cm)

@@ -102,9 +102,12 @@ CATALOG: dict[str, MetricSpec] = {
         "model (tools/perf_model.py), keyed by PERF.md's phase table.",
         ("phase",)),
     "swarm_kernel_bytes_touched": MetricSpec(
-        "gauge", "Analytic per-tick log-buffer bytes read+written by the "
-        "C/E/F hot phases (tools/perf_model.py --tiled), by phase and "
-        "kernel variant (tiled / full).", ("phase", "variant")),
+        "gauge", "Analytic per-tick kernel bytes read+written by phase and "
+        "kernel variant: the C/E/F log-buffer hot phases (tools/"
+        "perf_model.py --tiled; variant tiled / full), the read path "
+        "(--reads; variant lease / readindex), and the peer-axis quorum "
+        "reductions phase=votes|commit (--peer-tiled; variant banded / "
+        "dense).", ("phase", "variant")),
     "swarm_kernel_elections_started_total": MetricSpec(
         "counter", "On-device cumulative campaigns across all rows "
         "(SimState.stats[0]).", ()),

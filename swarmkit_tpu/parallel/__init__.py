@@ -136,9 +136,23 @@ def row_spec(ndim: int, axis=MANAGER_AXIS) -> P:
 
 
 def state_shardings(mesh: Mesh, tree, axis=MANAGER_AXIS):
-    """Per-leaf NamedSharding tree: leading axis on the mesh axis (or axes)."""
+    """Per-leaf NamedSharding tree: leading axis on the mesh axis (or axes).
+
+    Leaves whose leading dimension the mesh does not divide are
+    replicated instead of sharded: row-axis state always divides (the
+    mesh is built from a divisor of n), so a non-divisible leaf is
+    per-cluster bookkeeping like the [4] stats vector, not row state."""
+    names = axis if isinstance(axis, tuple) else (axis,)
+    size = 1
+    for a in names:
+        size *= mesh.shape[a]
+
+    def _spec(leaf):
+        if leaf.ndim and leaf.shape[0] % size == 0:
+            return row_spec(leaf.ndim, axis)
+        return P()
     return jax.tree.map(
-        lambda leaf: NamedSharding(mesh, row_spec(leaf.ndim, axis)), tree)
+        lambda leaf: NamedSharding(mesh, _spec(leaf)), tree)
 
 
 def shard_rows(tree, mesh: Mesh, axis=MANAGER_AXIS):

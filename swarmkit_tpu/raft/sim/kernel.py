@@ -259,12 +259,60 @@ def step(state: SimState, cfg: SimConfig,
     # Under cfg.static_members the config is the full row set forever:
     # views collapse to constants and every mask below traces away.
     static_m = cfg.static_members
+
+    # ---- peer-axis tiling (cfg.peer_tiled): hierarchical quorum counts --
+    # Every [N, N] tally in the tick (CheckQuorum heard-count, the vote /
+    # pre-vote / rejection tallies, the commit bisection's per-round
+    # compares, the heartbeat-ack quorum Phase R1 reuses) is a per-row
+    # COUNT of peers satisfying a predicate.  When cfg.peer_tiled, _pcount
+    # evaluates the predicate one [N, peer_chunk] column band at a time
+    # (lax.dynamic_slice — device-local under parallel.shard_rows, which
+    # shards rows and replicates columns), folds the deciding row's
+    # membership view into the band (once per band — the dense bisect
+    # instead materializes a full [N, N] match_eff once and re-compares it
+    # every round), writes the group-local count into column g of an
+    # [N, num_peer_chunks] partial buffer, and combines across groups with
+    # one final sum — the two-level hierarchical reduction.  No full [N, N]
+    # boolean/compare intermediate is ever materialized, so reduction
+    # temporaries scale with n*peer_chunk instead of n².  Integer sums are
+    # order-independent, hence bit-identical to the dense lowering
+    # (TestTiledPeer + the DST cross-check assert this field-by-field);
+    # composes with dst/explore.py's vmap (batched dynamic_slice) and the
+    # row-sharded mesh (tests/test_sharded_sim.py).
+    if cfg.peer_tiled:
+        PC, PG = cfg.peer_chunk, cfg.num_peer_chunks
+
+        def _pband(x, j0):
+            """[N, peer_chunk] column band of an [N, N] matrix at j0."""
+            return jax.lax.dynamic_slice(x, (0, j0), (n, PC))
+
+        def _peye(j0):
+            """Analytic eye band: no [N, N] identity materialized."""
+            return node[:, None] == (j0 + jnp.arange(PC, dtype=I32))[None, :]
+
+        def _pcount(pred, masked=True):
+            """Per-row count of peers j with pred band true, hierarchical.
+            `masked` folds the deciding row's membership view into each
+            band (the _mview analog; no-op under static_members)."""
+            def _grp(g, acc):
+                j0 = g * PC
+                p = pred(j0)
+                if masked and not static_m:
+                    p = p & _pband(member, j0)
+                c = jnp.sum(p.astype(I32), axis=1)
+                return jax.lax.dynamic_update_slice(acc, c[:, None], (0, g))
+            parts = jax.lax.fori_loop(0, PG, _grp, jnp.zeros((n, PG), I32))
+            return jnp.sum(parts, axis=1)
+
     if static_m:
         self_mem = jnp.ones((n,), bool)
         quorum_row = n // 2 + 1                                  # scalar
     else:
         self_mem = jnp.diagonal(member)                          # [N]
-        n_mem = jnp.sum(member.astype(I32), axis=1)              # [N]
+        if cfg.peer_tiled:
+            n_mem = _pcount(lambda j0: jnp.ones((n, PC), bool))  # [N]
+        else:
+            n_mem = jnp.sum(member.astype(I32), axis=1)          # [N]
         quorum_row = n_mem // 2 + 1                              # [N]
 
     def _mview(x):
@@ -298,8 +346,10 @@ def step(state: SimState, cfg: SimConfig,
     # members since the last round; a partitioned stale leader steps down
     # instead of lingering until a higher term reaches it.
     check_due = is_leader & (elapsed >= cfg.election_tick)
-    heard = recent_active | eye
-    n_heard = jnp.sum(_mview(heard).astype(I32), axis=1)
+    if cfg.peer_tiled:
+        n_heard = _pcount(lambda j0: _pband(recent_active, j0) | _peye(j0))
+    else:
+        n_heard = jnp.sum(_mview(recent_active | eye).astype(I32), axis=1)
     cq_fail = check_due & (n_heard < quorum_row)
     role = jnp.where(cq_fail, FOLLOWER, role)
     lead = jnp.where(cq_fail, NONE, lead)
@@ -468,7 +518,10 @@ def step(state: SimState, cfg: SimConfig,
         # Evaluated only on POLL EVENTS (fresh candidacy or a response
         # arrival, core._poll call sites): a conf change shrinking the
         # quorum must not retro-promote a stale tally between arrivals.
-        votes_pv = jnp.sum(_mview(granted).astype(I32), axis=1)
+        if cfg.peer_tiled:
+            votes_pv = _pcount(lambda j0: _pband(granted, j0))
+        else:
+            votes_pv = jnp.sum(_mview(granted).astype(I32), axis=1)
         pre_win = pre_cand & (votes_pv >= quorum_row) \
             & (campaign | pv_polled)
         term = term + pre_win.astype(I32)
@@ -550,7 +603,10 @@ def step(state: SimState, cfg: SimConfig,
     # pre-candidacies poll on PreVote response arrivals (pv_polled is
     # nonzero only on pre rows; the win line excludes them via ~pre)
     polled = v_polled | pv_polled if cfg.pre_vote else v_polled
-    votes = jnp.sum(_mview(granted).astype(I32), axis=1)
+    if cfg.peer_tiled:
+        votes = _pcount(lambda j0: _pband(granted, j0))
+    else:
+        votes = jnp.sum(_mview(granted).astype(I32), axis=1)
     win = is_cand & ~pre & (votes >= quorum_row) & (fresh_real | polled)
     # Rejection quorum: the candidate stands down (a REAL candidacy keeps
     # term and vote; a pre-candidacy keeps both untouched by design) and
@@ -559,7 +615,11 @@ def step(state: SimState, cfg: SimConfig,
     # per voter (core._poll), and within one candidacy a grant can only
     # precede a rejection (log/vote checks are monotone), so masking with
     # ~granted reproduces first-response-wins exactly.
-    n_rej = jnp.sum(_mview(rejected & ~granted).astype(I32), axis=1)
+    if cfg.peer_tiled:
+        n_rej = _pcount(
+            lambda j0: _pband(rejected, j0) & ~_pband(granted, j0))
+    else:
+        n_rej = jnp.sum(_mview(rejected & ~granted).astype(I32), axis=1)
     lose = is_cand & ~win & (n_rej >= quorum_row) & (fresh_real | polled)
     role = jnp.where(lose, FOLLOWER, role)
     lead = jnp.where(lose, NONE, lead)  # become_follower(term, NONE)
@@ -1171,16 +1231,32 @@ def step(state: SimState, cfg: SimConfig,
     # ceil(log2(L))+1 rounds of [N, N] compares) instead of sorting [N, N]
     # every tick.
     match = jnp.where(is_leader[:, None] & eye, last[:, None], match)
-    match_eff = match if static_m else jnp.where(member, match, -1)
+    if cfg.peer_tiled:
+        # Banded bisect: the membership mask folds into each band compare
+        # (once per band) instead of materializing a full [N, N] match_eff
+        # that every round re-compares.  Identity with the dense form:
+        # (where(member, match, -1) >= mid) == member & (match >= mid) for
+        # every reachable mid (mid = (lo+hi+1)>>1 with lo, hi, match >= 0,
+        # so mid >= 0 > -1), and the integer band sums commute.
+        def _bisect(_, lo_hi):
+            lo, hi_b = lo_hi
+            mid = (lo + hi_b + 1) >> 1
+            cnt = _pcount(lambda j0: _pband(match, j0) >= mid[:, None])
+            ok = (cnt >= quorum_row) & (hi_b >= mid) & (mid > lo)
+            lo = jnp.where(ok, mid, lo)
+            hi_b = jnp.where(ok, hi_b, mid - 1)
+            return lo, hi_b
+    else:
+        match_eff = match if static_m else jnp.where(member, match, -1)
 
-    def _bisect(_, lo_hi):
-        lo, hi_b = lo_hi
-        mid = (lo + hi_b + 1) >> 1
-        cnt = jnp.sum((match_eff >= mid[:, None]).astype(I32), axis=1)
-        ok = (cnt >= quorum_row) & (hi_b >= mid) & (mid > lo)
-        lo = jnp.where(ok, mid, lo)
-        hi_b = jnp.where(ok, hi_b, mid - 1)
-        return lo, hi_b
+        def _bisect(_, lo_hi):
+            lo, hi_b = lo_hi
+            mid = (lo + hi_b + 1) >> 1
+            cnt = jnp.sum((match_eff >= mid[:, None]).astype(I32), axis=1)
+            ok = (cnt >= quorum_row) & (hi_b >= mid) & (mid > lo)
+            lo = jnp.where(ok, mid, lo)
+            hi_b = jnp.where(ok, hi_b, mid - 1)
+            return lo, hi_b
 
     iters = max(1, (cfg.log_len).bit_length() + 1)
     mci, _ = jax.lax.fori_loop(0, iters, _bisect, (commit, last))
@@ -1201,7 +1277,10 @@ def step(state: SimState, cfg: SimConfig,
         rd_ack = ok_mat | rej_mat
         if cfg.mailboxes:
             rd_ack = rd_ack | _mview(jnp.any(val_hbr, axis=2))
-        rd_nack = jnp.sum(_mview(rd_ack | eye).astype(I32), axis=1)
+        if cfg.peer_tiled:
+            rd_nack = _pcount(lambda j0: _pband(rd_ack, j0) | _peye(j0))
+        else:
+            rd_nack = jnp.sum(_mview(rd_ack | eye).astype(I32), axis=1)
         rd_is_leader = (role == LEADER) & alive
         rd_q_ok = rd_is_leader & (rd_nack >= quorum_row)
         rd_cterm_ok = (commit > 0) \
@@ -1446,8 +1525,21 @@ def step(state: SimState, cfg: SimConfig,
 
         # fault edges: crash/heal transitions + partition-degree changes,
         # detected against the PREVIOUS tick's inputs carried in ev_*
-        drop_deg = (jnp.sum(drop.astype(I32), axis=1)
-                    + jnp.sum(drop.astype(I32), axis=0))
+        if cfg.peer_tiled:
+            # fault-layer banding: the drop/partition mask's degree
+            # reduction runs band-at-a-time too — out-degree via the
+            # column-band count (unmasked: fault edges ignore membership),
+            # in-degree by accumulating row-band column sums, so neither
+            # direction widens a temporary past n*peer_chunk.
+            def _colsum(g, acc):
+                i0 = g * PC
+                return acc + jnp.sum(jax.lax.dynamic_slice(
+                    drop, (i0, 0), (PC, n)).astype(I32), axis=0)
+            drop_deg = _pcount(lambda j0: _pband(drop, j0), masked=False) \
+                + jax.lax.fori_loop(0, PG, _colsum, jnp.zeros((n,), I32))
+        else:
+            drop_deg = (jnp.sum(drop.astype(I32), axis=1)
+                        + jnp.sum(drop.astype(I32), axis=0))
         _emit(state.ev_alive & ~alive, _fc.FAULT_EDGE,
               jnp.full((n,), _fc.EDGE_DOWN, I32), zero)
         _emit(~state.ev_alive & alive, _fc.FAULT_EDGE,

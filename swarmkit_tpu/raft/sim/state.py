@@ -122,6 +122,23 @@ class SimConfig:
     # disables tiling explicitly; a chunk >= log_len disables it trivially
     # (the default leaves every small-ring test config untiled).
     log_chunk: int = 1024
+    # Peer-axis tiling (kernel.py hierarchical quorum reductions): column
+    # band width in rows.  When 0 < peer_chunk < n every [N, N]
+    # tally/reduction in the tick (CheckQuorum heard-count, vote/pre-vote/
+    # rejection tallies, the commit bisection's per-round compares, the
+    # heartbeat-ack quorum the read path reuses) runs as a two-level
+    # hierarchical pass: a scan over [N, peer_chunk] column bands computes
+    # group-local counts into an [N, n/peer_chunk] partial buffer, and a
+    # cross-group combine produces the per-row total — so no full [N, N]
+    # boolean/compare intermediate is ever materialized and per-band
+    # membership masking happens once per band, not once per bisection
+    # round.  Integer sums are order-independent, so the banded lowering
+    # is bit-identical to the dense one (see TestTiledPeer).
+    # peer_chunk=0 disables peer tiling explicitly; a chunk >= n disables
+    # it trivially (the default leaves every small-cluster test config
+    # dense).  Chunks must divide n and be sublane-aligned (multiple of
+    # 8); 128-multiples are recommended on real TPUs for lane alignment.
+    peer_chunk: int = 1024
     # Linearizable read path (raft/read/): read_batch > 0 threads the
     # read-serving phases (R0 submit / R1 stamp / R2 settle) through the
     # tick and allocates the [N] read registers.  Each idle row auto-
@@ -171,6 +188,17 @@ class SimConfig:
         widest = max(self.window, self.apply_batch, self.max_props,
                      self.keep)
         return widest // self.log_chunk + 2
+
+    @property
+    def peer_tiled(self) -> bool:
+        """True when the kernel compiles the banded (hierarchical) peer
+        reductions instead of dense [N, N] tallies."""
+        return 0 < self.peer_chunk < self.n
+
+    @property
+    def num_peer_chunks(self) -> int:
+        """Column bands per peer row (only meaningful when peer_tiled)."""
+        return self.n // self.peer_chunk
 
     @property
     def ack_depth(self) -> int:
@@ -242,6 +270,20 @@ class SimConfig:
                     f"num_chunks={self.num_chunks} or the banded pass "
                     f"covers the whole ring — raise log_len, raise "
                     f"log_chunk, or set log_chunk=0 to disable tiling")
+        if self.peer_chunk < 0:
+            raise ValueError(f"peer_chunk must be >= 0, got {self.peer_chunk}")
+        if self.peer_tiled:
+            if self.peer_chunk % 8 != 0:
+                raise ValueError(
+                    f"peer_chunk={self.peer_chunk} must be a multiple of 8 "
+                    f"(sublane alignment for the banded column slices; use "
+                    f"128-multiples on real TPUs for lane alignment); set "
+                    f"peer_chunk=0 to disable peer tiling")
+            if self.n % self.peer_chunk != 0:
+                raise ValueError(
+                    f"peer_chunk={self.peer_chunk} must divide n={self.n} "
+                    f"(the peer axis is sliced in whole column bands); set "
+                    f"peer_chunk=0 to disable peer tiling")
 
 
 @jax.tree_util.register_dataclass

@@ -14,6 +14,8 @@ CPU-backend numbers; the budget is about the program structure XLA emits,
 which the differential and DST suites pin for value-identity.
 """
 
+import pytest
+
 from swarmkit_tpu.raft.sim import SimConfig, init_state
 from swarmkit_tpu.raft.sim.run import run_ticks
 
@@ -56,3 +58,75 @@ def test_small_tiled_compile_fits_scaled_budget():
     assert 0 < temp <= TEMP_BUDGET_BYTES // 16 + 8 * 2**20, (
         f"tiled n=256 compile uses {temp / 2**20:.0f} MiB temp — a "
         f"full-width [N, L] materialization likely crept back in")
+
+
+# ---- peer-axis pins ---------------------------------------------------------
+# The banded hierarchical quorum reductions (cfg.peer_chunk) exist so the
+# tick's tally/bisect phases never materialize full [N, N] intermediates.
+# On the dynamic-membership path the dense kernel MUST write at least the
+# [N, N] i32 match_eff buffer (where(member, match, -1): 64 MiB at
+# n=4096) before bisecting; the banded kernel folds the member band into
+# each [N, peer_chunk] pass instead.  Measured when pinned: banded
+# 195 MiB vs dense 259 MiB temp — the budget sits between, so the banded
+# lowering passes a budget the dense lowering cannot meet, and a fusion
+# regression that re-materializes an [N, N] intermediate in the banded
+# path trips this without running a tick.
+
+PEER_SHAPE = dict(n=4096, log_len=1024, window=128, apply_batch=128,
+                  max_props=128, keep=100, static_members=False,
+                  log_chunk=128)
+PEER_TEMP_BUDGET = 224 * 1024 * 1024
+
+
+def _temp_bytes(cfg, ticks=8, prop_count=64, state=None):
+    st = init_state(cfg) if state is None else state
+    compiled = run_ticks.lower(st, cfg, ticks,
+                               prop_count=prop_count).compile()
+    stats = compiled.memory_analysis()
+    assert stats is not None, "backend exposes no memory analysis"
+    temp = stats.temp_size_in_bytes
+    assert temp > 0, "suspicious zero temp size — analysis not populated"
+    return temp
+
+
+def test_peer_tiled_compile_fits_budget_dense_cannot():
+    banded = _temp_bytes(SimConfig(**PEER_SHAPE, peer_chunk=1024))
+    dense = _temp_bytes(SimConfig(**PEER_SHAPE, peer_chunk=0))
+    assert banded <= PEER_TEMP_BUDGET, (
+        f"banded peer compile uses {banded / 2**20:.0f} MiB temp, over "
+        f"the {PEER_TEMP_BUDGET / 2**20:.0f} MiB budget — an [N, N] "
+        f"intermediate likely crept back into a quorum reduction")
+    assert dense > PEER_TEMP_BUDGET, (
+        f"dense peer compile uses only {dense / 2**20:.0f} MiB temp — the "
+        f"pin's premise (dense cannot meet the banded budget) no longer "
+        f"holds; re-measure and move PEER_TEMP_BUDGET")
+
+
+@pytest.mark.slow
+def test_sharded_32k_compile_has_no_full_peer_buffer():
+    """The n=32768 headline rung: row-sharded over the 8-virtual-device
+    mesh with banded peer reductions, the lowered program must never
+    materialize an UNSHARDED (replicated) [N, N] temp.  Per-device temps
+    at this shape are row slabs — [N/8, N] i32 is 512 MiB, and the scan
+    double-buffers a few of them: 2304 MiB measured when pinned.  The
+    budget adds ~20% compiler-drift headroom yet stays below the
+    smallest possible full-[N, N] addition (a replicated bool is 1 GiB,
+    an i32 4 GiB), so any quorum reduction falling back to a gathered
+    full-width intermediate trips it.  Compile-only: execution at this
+    scale is the accelerator headline; the CPU bench runs the reduced
+    4096-row rung of the same config (bench.py 32768-sharded)."""
+    from swarmkit_tpu.parallel import row_mesh, shard_rows
+
+    cfg = SimConfig(n=32768, log_len=256, window=32, apply_batch=32,
+                    max_props=32, keep=16, static_members=True,
+                    log_chunk=0, peer_chunk=1024)
+    assert cfg.peer_tiled and cfg.num_peer_chunks == 32
+    mesh = row_mesh(cfg.n)
+    assert len(mesh.devices.ravel()) == 8, "8-device CPU mesh missing"
+    st = shard_rows(init_state(cfg), mesh)
+    temp = _temp_bytes(cfg, ticks=4, prop_count=8, state=st)
+    assert temp <= 2816 * 1024 * 1024, (
+        f"sharded n=32768 compile uses {temp / 2**20:.0f} MiB temp "
+        f"(2304 MiB of row-slab scratch when pinned) — a replicated "
+        f"full [N, N] buffer (>= 1 GiB) was likely materialized in the "
+        f"banded tick")

@@ -365,6 +365,7 @@ class TestBenchRegimeScale:
     weak #3: nothing above n=64 was ever tested off-hardware). Small
     log_len keeps CPU time sane; the [N, N] code paths are what scale."""
 
+    @pytest.mark.slow  # tier-2: CPU-heavy, see ROADMAP tier-1 budget
     def test_1024_crash_and_drop(self):
         cfg = SimConfig(n=1024, log_len=256, window=32, apply_batch=64,
                         max_props=32, keep=16, seed=31,
@@ -386,6 +387,7 @@ class TestBenchRegimeScale:
             assert by.setdefault(a, c) == c, \
                 f"checksum divergence at applied={a}"
 
+    @pytest.mark.slow  # tier-2: CPU-heavy, see ROADMAP tier-1 budget
     def test_4096_election_and_steady_state(self):
         cfg = SimConfig(n=4096, log_len=256, window=32, apply_batch=64,
                         max_props=32, keep=16, seed=33,
@@ -630,6 +632,7 @@ class TestPipelinedAppends:
     """Windowed inflight pipelining (vendor MaxInflightMsgs + the
     probe/replicate Progress states) on the mailbox wire."""
 
+    @pytest.mark.slow  # tier-2: CPU-heavy, see ROADMAP tier-1 budget
     def test_throughput_scales_with_depth(self):
         """The point of pipelining: K appends in flight over a lat-2 wire
         must commit ~K times faster than inflight-1 (until proposal-bound)."""
@@ -966,6 +969,7 @@ class TestTiledLog:
             tick(lead_down, cnt8, t, "re-elect")
         assert len(leaders_of(st_u)), "no re-election happened"
 
+    @pytest.mark.slow  # tier-2: CPU-heavy, see ROADMAP tier-1 budget
     def test_dst_cross_check_equal_bitmasks(self):
         """64 fault schedules x 100 ticks through the DST explorer, once
         per kernel variant: zero violations on stock profiles and the SAME
@@ -986,3 +990,170 @@ class TestTiledLog:
         assert np.array_equal(res_t.viol, res_u.viol)
         assert np.array_equal(res_t.first_tick, res_u.first_tick)
         assert np.array_equal(res_t.bits_by_tick, res_u.bits_by_tick)
+
+
+class TestTiledPeer:
+    """The banded peer axis (0 < cfg.peer_chunk < n) rewrites every [N, N]
+    tally/reduction — CheckQuorum heard counts, vote/pre-vote/rejection
+    tallies, the commit bisection, heartbeat-ack quorum — as two-level
+    hierarchical passes over [N, peer_chunk] column bands.  Integer sums
+    are order-independent, so like the tiled log axis this is an
+    OPTIMIZATION, not a semantic: every SimState field must be
+    bit-identical to the dense kernel on every schedule, on both wires,
+    through elections, conf changes, crashes, and drops."""
+
+    PC = 8   # band width: n=16 gives two bands with boundary at column 8
+
+    @staticmethod
+    def _field_names():
+        import dataclasses
+
+        from swarmkit_tpu.raft.sim.state import SimState
+        return [f.name for f in dataclasses.fields(SimState)]
+
+    @staticmethod
+    def _fused_step():
+        from swarmkit_tpu.raft.sim.run import _payload_at
+        return jax.jit(
+            lambda st, cfg, alive, drop, cnt: step(
+                st, cfg, alive=alive, drop=drop, prop_count=cnt,
+                payload_fn=_payload_at),
+            static_argnames=("cfg",))
+
+    def _assert_identical(self, tag, t, golden, other, fields):
+        for f in fields:
+            g = np.asarray(getattr(golden, f))
+            v = np.asarray(getattr(other, f))
+            if not np.array_equal(g, v):
+                bad = np.argwhere(g != v)[:5]
+                raise AssertionError(
+                    f"{tag} tick {t}: field {f} diverged at {bad.tolist()}")
+
+    def test_validation(self):
+        base = dict(n=16, log_len=256, window=32, apply_batch=64,
+                    max_props=16, keep=8)
+        with pytest.raises(ValueError, match="peer_chunk"):
+            SimConfig(**base, peer_chunk=-8)
+        with pytest.raises(ValueError, match="multiple of 8"):
+            SimConfig(**base, peer_chunk=12)
+        with pytest.raises(ValueError, match="divide"):
+            SimConfig(**{**base, "n": 24}, peer_chunk=16)
+        assert SimConfig(**base, peer_chunk=8).peer_tiled
+        assert SimConfig(**base, peer_chunk=8).num_peer_chunks == 2
+        assert not SimConfig(**base, peer_chunk=0).peer_tiled
+        # the default chunk only tiles once n outgrows it
+        assert not SimConfig(**base).peer_tiled
+
+    @pytest.mark.parametrize(
+        "combo", [pytest.param("dynamic-sync", marks=pytest.mark.slow),
+                  "static-sync", "dynamic-mailbox"])
+    def test_bit_identity_under_faults(self, combo):
+        """300 faulted ticks (crashes, drops, leader transfers, bursty
+        fused proposals): the banded kernel vs the dense kernel, all
+        SimState fields compared every tick. static-sync + dynamic-mailbox
+        stay tier-1 (static/dynamic x both wires); dynamic-sync is
+        tier-2 for the CPU budget."""
+        static = combo.startswith("static")
+        base = dict(n=16, log_len=1024, window=64, apply_batch=64,
+                    max_props=64, keep=32, election_tick=14, seed=3,
+                    static_members=static)
+        if combo.endswith("mailbox"):
+            base.update(latency=2, latency_jitter=1, inflight=2)
+        cfg_b = SimConfig(**base, peer_chunk=self.PC)
+        cfg_d = SimConfig(**base, peer_chunk=0)
+        assert cfg_b.peer_tiled and not cfg_d.peer_tiled
+        step_fused = self._fused_step()
+        fields = self._field_names()
+        rng = np.random.default_rng(42)
+        st_b, st_d = init_state(cfg_b), init_state(cfg_d)
+        for t in range(300):
+            alive = jnp.asarray(rng.random(16) > 0.08)
+            drop = jnp.asarray(rng.random((16, 16)) < 0.05)
+            cnt = jnp.asarray(int(rng.integers(0, 49)), jnp.int32)
+            if t % 37 == 36:
+                leaders = np.flatnonzero(np.asarray(st_d.role) == LEADER)
+                if len(leaders):
+                    lid, tgt = int(leaders[0]), int(rng.integers(16))
+                    st_b = transfer_leadership(st_b, cfg_b, lid, tgt)
+                    st_d = transfer_leadership(st_d, cfg_d, lid, tgt)
+            st_b = step_fused(st_b, cfg_b, alive, drop, cnt)
+            st_d = step_fused(st_d, cfg_d, alive, drop, cnt)
+            self._assert_identical(f"{combo}/banded", t, st_d, st_b, fields)
+        assert int(np.asarray(st_d.commit).max()) > 100
+
+    def test_conf_change_quorum_shrink_at_band_boundary(self):
+        """Removes the rows on BOTH sides of the band boundary (columns 7
+        and 8 with peer_chunk=8) through committed CONF entries, then
+        deposes the leader so the shrunk cluster re-elects: the membership
+        fold inside each band and the hierarchical vote counts must track
+        the per-row views exactly (all fields bit-identical to dense on
+        every tick, and the 14-member re-election succeeds)."""
+        from swarmkit_tpu.raft.sim import propose_conf
+
+        base = dict(n=16, log_len=256, window=32, apply_batch=64,
+                    max_props=16, keep=8, election_tick=10, seed=5)
+        cfg_b = SimConfig(**base, peer_chunk=self.PC)
+        cfg_d = SimConfig(**base, peer_chunk=0)
+        fields = self._field_names()
+        st_b, st_d = init_state(cfg_b), init_state(cfg_d)
+        alive = jnp.ones(16, bool)
+
+        def tick(t, tag):
+            nonlocal st_b, st_d
+            st_b = step_j(st_b, cfg_b, alive=alive)
+            st_d = step_j(st_d, cfg_d, alive=alive)
+            self._assert_identical(tag, t, st_d, st_b, fields)
+
+        for t in range(120):
+            tick(t, "elect")
+            if len(leaders_of(st_d)):
+                break
+        (lead,) = leaders_of(st_d)
+        lead = int(lead)
+        # pick victims straddling the boundary, sparing the leader
+        victims = [v for v in (7, 8, 9) if v != lead][:2]
+        for v in victims:
+            st_b = propose_conf(st_b, cfg_b, jnp.asarray(v, jnp.int32),
+                                jnp.asarray(True))
+            st_d = propose_conf(st_d, cfg_d, jnp.asarray(v, jnp.int32),
+                                jnp.asarray(True))
+            for t in range(12):
+                tick(t, f"remove-{v}")
+        member = np.asarray(st_d.member)
+        others = [i for i in range(16) if i not in victims]
+        for v in victims:
+            assert not member[others, v].any(), f"removal of {v} not applied"
+        # depose the leader: the 14 survivors re-elect with quorum 8,
+        # counted hierarchically across the band boundary
+        alive = alive.at[lead].set(False)
+        for v in victims:
+            alive = alive.at[v].set(False)
+        for t in range(150):
+            tick(t, "re-elect")
+            new = [x for x in leaders_of(st_d) if x != lead]
+            if new:
+                break
+        assert [x for x in leaders_of(st_d) if x != lead], \
+            "no re-election with the shrunk quorum"
+
+    @pytest.mark.slow  # tier-2: CPU-heavy, see ROADMAP tier-1 budget
+    def test_dst_cross_check_equal_bitmasks(self):
+        """64 fault schedules x 100 ticks through the DST explorer (vmap
+        composes over the banded fori_loop passes), once per kernel
+        variant: zero violations on stock profiles and the SAME
+        per-schedule violation bitmask and per-tick bit trace."""
+        from swarmkit_tpu import dst
+
+        base = dict(n=16, log_len=64, window=8, apply_batch=16, max_props=8,
+                    keep=4, election_tick=10, seed=77)
+        cfg_b = SimConfig(**base, peer_chunk=self.PC)
+        cfg_d = SimConfig(**base, peer_chunk=0)
+        assert cfg_b.peer_tiled and not cfg_d.peer_tiled
+        batch, names = dst.make_batch(cfg_d, ticks=100, schedules=64, seed=9)
+        res_b = dst.explore(init_state(cfg_b), cfg_b, batch, profiles=names)
+        res_d = dst.explore(init_state(cfg_d), cfg_d, batch, profiles=names)
+        assert res_b.violating.size == 0, \
+            [dst.bits_to_names(int(res_b.viol[s])) for s in res_b.violating]
+        assert np.array_equal(res_b.viol, res_d.viol)
+        assert np.array_equal(res_b.first_tick, res_d.first_tick)
+        assert np.array_equal(res_b.bits_by_tick, res_d.bits_by_tick)

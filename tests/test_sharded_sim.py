@@ -74,6 +74,33 @@ class TestShardedEquivalence:
             f"log_term lost its row sharding: {spec}"
 
 
+    @pytest.mark.slow  # tier-2: CPU-heavy, see ROADMAP tier-1 budget
+    def test_banded_peer_sharded_bit_identical(self):
+        """The banded peer reductions (cfg.peer_chunk) compose with row
+        sharding: each [N, peer_chunk] column band is dynamic-sliced from
+        a row-sharded [N, N] matrix (device-local — rows stay put, the
+        column axis is replicated) and the [N, num_peer_chunks] partials
+        stay row-sharded.  Banded-sharded, banded-unsharded, and
+        dense-unsharded must agree on every field under faults."""
+        import dataclasses as _dc
+
+        cfg_b = _dc.replace(CFG, peer_chunk=8)
+        cfg_d = _dc.replace(CFG, peer_chunk=0)
+        assert cfg_b.peer_tiled and not cfg_d.peer_tiled
+        mesh = row_mesh(cfg_b.n)
+        kw = dict(prop_count=4, drop_rate=0.1, crash_every=10, down_for=3)
+        dense, _ = run_ticks(init_state(cfg_d), cfg_d, 60, **kw)
+        banded, _ = run_ticks(init_state(cfg_b), cfg_b, 60, **kw)
+        sharded, _ = run_ticks(shard_rows(init_state(cfg_b), mesh), cfg_b,
+                               60, **kw)
+        assert_states_identical(dense, banded)
+        assert_states_identical(dense, sharded)
+        spec = sharded.log_term.sharding.spec
+        assert spec and spec[0] == "managers", \
+            f"banded run lost its row sharding: {spec}"
+        assert int(committed_entries(sharded)) > 0
+
+
 class TestCollectiveLowering:
     def test_step_hlo_contains_cross_device_collectives(self):
         """VERDICT r02 weak #6: prove the sharded step is collective-based.

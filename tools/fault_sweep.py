@@ -23,10 +23,19 @@ Plans (swarmkit_tpu.raft.faults.FaultPlan): down, drop, partition, delay,
 crash — the crash plan also genuinely stops the victim process and
 restarts it from its state dir after ``heal()``.
 
+With ``--peer-chunk`` each selected plan is ALSO lowered to a device
+fault schedule (``raft.faults.plan_to_schedule``) and run through the DST
+kernel in the requested peer-axis lowering (``SimConfig.peer_chunk``,
+banded hierarchical quorum reductions) with a dense cross-check: the
+violation bitmasks and first-violation ticks must match bit-for-bit.
+This runs the sweep's fault vocabulary in either lowering without code
+edits; ``--peer-chunk 0`` pins the dense path only.
+
 Usage:
     python tools/fault_sweep.py                       # full sweep
     python tools/fault_sweep.py --wires grpc --plans crash,partition
     python tools/fault_sweep.py --seeds 2009343,7
+    python tools/fault_sweep.py --peer-chunk 8        # + device cross-check
 """
 
 from __future__ import annotations
@@ -444,6 +453,94 @@ async def _run_scenario(wire: str, plan_name: str, seed: int) -> dict:
 
 
 # --------------------------------------------------------------------------
+# device-side precheck: the same fault vocabulary in a chosen peer lowering
+
+
+def _device_plan(name: str, addrs: list[str]) -> FaultPlan:
+    """The host sweep's plan shapes rebuilt over synthetic kernel rows."""
+    lead, victim = addrs[0], addrs[1]
+    if name == "down":
+        return FaultPlan.down(victim)
+    if name == "drop":
+        return FaultPlan.drop(lead, victim, p=0.6)
+    if name == "partition":
+        return FaultPlan.split([victim], [a for a in addrs if a != victim])
+    if name == "delay":
+        return FaultPlan.delay(lead, victim, 2.0)
+    if name == "crash":
+        return FaultPlan.crash(victim)
+    raise ValueError(f"unknown fault plan {name!r}")
+
+
+def run_device_precheck(plans=PLANS, seeds=DEFAULT_SEEDS, peer_chunk: int = 8,
+                        n: int = 16, ticks: int = 60,
+                        verbose: bool = True) -> list[dict]:
+    """Lower every (plan, seed) to a device fault schedule and run it
+    through the DST kernel with ``SimConfig.peer_chunk=peer_chunk``.
+
+    When the chunk selects the banded lowering the run is cross-checked
+    against the dense kernel: violation bitmasks, first-violation ticks,
+    and per-tick bit traces must match exactly (the hierarchical quorum
+    reductions are integer sums, so any drift is a bug, not noise).
+    ``peer_chunk=0`` runs the dense lowering alone.
+    """
+    import jax
+    import numpy as np
+
+    from swarmkit_tpu import dst
+    from swarmkit_tpu.raft.sim.state import SimConfig, init_state
+
+    def _cfg(chunk: int, seed: int) -> SimConfig:
+        return SimConfig(n=n, log_len=64, window=8, apply_batch=16,
+                         max_props=8, keep=4, election_tick=10, seed=seed,
+                         log_chunk=0, peer_chunk=chunk)
+
+    def _run(cfg: SimConfig, sched):
+        batched = jax.tree_util.tree_map(lambda a: a[None], sched)
+        return dst.explore(init_state(cfg), cfg, batched, shard=False)
+
+    addrs = [f"row-{i}.sweep:4242" for i in range(n)]
+    rows = {a: i for i, a in enumerate(addrs)}
+    results = []
+    for plan_name in plans:
+        for seed in seeds:
+            t0 = time.monotonic()
+            cfg = _cfg(peer_chunk, seed)
+            sched = dst.from_fault_plan(
+                cfg, _device_plan(plan_name, addrs), rows, ticks=ticks,
+                inject_at=10, heal_at=40, seed=seed)
+            res = _run(cfg, sched)
+            ok, err = True, ""
+            notes = (f"viol=0x{int(res.viol[0]):x} "
+                     f"lowering={'banded' if cfg.peer_tiled else 'dense'}")
+            if cfg.peer_tiled:
+                ref = _run(_cfg(0, seed), sched)
+                same = (np.array_equal(res.viol, ref.viol)
+                        and np.array_equal(res.first_tick, ref.first_tick)
+                        and np.array_equal(res.bits_by_tick,
+                                           ref.bits_by_tick))
+                if not same:
+                    ok = False
+                    err = (f"banded/dense divergence: viol "
+                           f"{res.viol.tolist()} vs {ref.viol.tolist()}")
+                else:
+                    notes += " == dense"
+            results.append({"wire": f"device(pc={peer_chunk})",
+                            "plan": plan_name, "seed": seed, "ok": ok,
+                            "notes": notes, "error": err,
+                            "secs": round(time.monotonic() - t0, 2)})
+            if verbose:
+                r = results[-1]
+                state = "ok  " if ok else "FAIL"
+                line = (f"{state} {r['wire']:8s} {plan_name:10s} "
+                        f"seed={seed} ({r['secs']}s)  {notes}")
+                if not ok:
+                    line += f"  {err}"
+                print(line, flush=True)
+    return results
+
+
+# --------------------------------------------------------------------------
 # sweep driver
 
 
@@ -513,6 +610,10 @@ def main(argv=None) -> int:
                     help="dump a flight record (host spans + failure "
                          "provenance) here for every failing scenario; "
                          "inspect with tools/flight_view.py")
+    ap.add_argument("--peer-chunk", type=int, default=None, metavar="PC",
+                    help="also run every plan through the DST kernel in "
+                         "this peer-axis lowering (SimConfig.peer_chunk; "
+                         "0 = dense, else banded + dense cross-check)")
     args = ap.parse_args(argv)
 
     wires = [w for w in args.wires.split(",") if w]
@@ -525,7 +626,11 @@ def main(argv=None) -> int:
         if p not in PLANS:
             ap.error(f"unknown plan {p!r}")
 
-    results = run_sweep(wires, plans, seeds, flight_dir=args.flight_dir)
+    results = []
+    if args.peer_chunk is not None:
+        results += run_device_precheck(plans, seeds,
+                                       peer_chunk=args.peer_chunk)
+    results += run_sweep(wires, plans, seeds, flight_dir=args.flight_dir)
     failed = [r for r in results if not r["ok"]]
     print(f"\n{len(results) - len(failed)}/{len(results)} scenarios passed")
     return 1 if failed else 0
