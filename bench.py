@@ -198,6 +198,28 @@ class MeasureError(Exception):
     pass
 
 
+def _telemetry_probe(jax, cfg, election_tick: int, shard_fn):
+    """Short telemetry-enabled run on the measured shape: fresh state, enough
+    ticks to elect and fill the latency histograms, then a TelemetryObs
+    scrape into a private registry.  Runs SEPARATE from the timed loops so
+    the histogram plumbing never perturbs the headline number (its on-path
+    cost is the PERF.md A/B, not a bench tax); the small tick count bounds
+    the extra compile.  BENCH_TELEMETRY=0 skips it entirely."""
+    from dataclasses import replace
+
+    from swarmkit_tpu.metrics.registry import MetricsRegistry
+    from swarmkit_tpu.raft.sim import init_state, run_ticks
+    from swarmkit_tpu.telemetry import TelemetryObs
+
+    tcfg = replace(cfg, collect_telemetry=True)
+    ticks = max(4 * election_tick, 64)
+    st = shard_fn(init_state(tcfg))
+    st, _ = run_ticks(st, tcfg, ticks, prop_count=min(64, tcfg.max_props))
+    jax.block_until_ready(st.commit)
+    _pet_watchdog()
+    return TelemetryObs(registry=MetricsRegistry()).publish(st, tcfg)
+
+
 def measure(jax, n: int, entries: int, seed: int, election_tick: int,
             latency: int = 0, latency_jitter: int = 0, inflight: int = 1,
             log_len: int = 8192, read_batch: int = 0,
@@ -232,6 +254,10 @@ def measure(jax, n: int, entries: int, seed: int, election_tick: int,
     # negligible, but BENCH_COLLECT_STATS=0 restores the bare program.
     # BENCH_RECORD_EVENTS=1 turns the flight recorder on, measuring the
     # masked-scatter overhead of event capture (PERF.md A/B).
+    # BENCH_COLLECT_TELEMETRY=1 puts the telemetry plane ON the timed
+    # path (stamps + histogram folds + series ring), the PERF.md
+    # telemetry A/B; the default keeps the headline bare and measures
+    # latency via the separate post-run probe instead.
     cfg = SimConfig(n=n, log_len=log_len, window=2048, apply_batch=2048,
                     max_props=2048, keep=500, seed=seed,
                     election_tick=election_tick,
@@ -242,6 +268,8 @@ def measure(jax, n: int, entries: int, seed: int, election_tick: int,
                         "BENCH_COLLECT_STATS", "1") != "0",
                     record_events=os.environ.get(
                         "BENCH_RECORD_EVENTS", "0") == "1",
+                    collect_telemetry=os.environ.get(
+                        "BENCH_COLLECT_TELEMETRY", "0") == "1",
                     # peer_chunk picks the peer-axis lowering: None keeps
                     # the SimConfig default (banded hierarchical quorum
                     # reductions once n > peer_chunk), 0 pins the dense
@@ -327,6 +355,14 @@ def measure(jax, n: int, entries: int, seed: int, election_tick: int,
         out["reads"] = reads
         out["read_rate"] = reads / dt
         out["reads_blocked"] = int(reads_blocked(final))
+    if os.environ.get("BENCH_TELEMETRY", "1") != "0":
+        try:  # best-effort: latency numbers must never cost the bench number
+            with obs.timed("telemetry_probe"):
+                out["telemetry"] = _telemetry_probe(
+                    jax, cfg, election_tick, _shard)
+        except Exception as e:
+            log(f"telemetry probe failed (n={n}): {type(e).__name__}: "
+                f"{str(e)[:200]}")
     return out
 
 
@@ -343,11 +379,30 @@ def _bench_gauges(config: str, m: dict) -> None:
             config=config).set(m["t_compile"])
         obs_catalog.get(r, "swarm_bench_election_seconds").labels(
             config=config).set(m["t_elect_post"])
+        obs_catalog.get(r, "swarm_bench_election_ticks").labels(
+            config=config).set(m["election_ticks"])
         if "read_rate" in m:
             obs_catalog.get(r, "swarm_bench_reads_per_second").labels(
                 config=config).set(m["read_rate"])
+        commit = (m.get("telemetry") or {}).get("commit") or {}
+        for q, gauge in (("p50", "swarm_bench_commit_latency_ticks_p50"),
+                         ("p99", "swarm_bench_commit_latency_ticks_p99")):
+            if commit.get(q) is not None:
+                obs_catalog.get(r, gauge).labels(config=config).set(commit[q])
     except Exception as e:
         log(f"bench gauges failed: {e}")
+
+
+def _telemetry_json(m: dict) -> dict | None:
+    """Per-config telemetry excerpt for the JSON line (None if the probe
+    was skipped or produced no commits)."""
+    tel = m.get("telemetry") or {}
+    if not tel.get("enabled"):
+        return None
+    out = {"election_ticks": m["election_ticks"]}
+    for q in ("p50", "p99"):
+        out[f"commit_latency_ticks_{q}"] = (tel.get("commit") or {}).get(q)
+    return out
 
 
 def main() -> None:
@@ -429,6 +484,10 @@ def main() -> None:
     RESULT["election_ticks"] = m["election_ticks"]
     RESULT["election_s_incl_compile"] = round(m["t_elect"], 2)
     RESULT["election_s_post_compile"] = round(m["t_elect_post"], 3)
+    tel = _telemetry_json(m)
+    if tel is not None:
+        RESULT["commit_latency_ticks_p50"] = tel["commit_latency_ticks_p50"]
+        RESULT["commit_latency_ticks_p99"] = tel["commit_latency_ticks_p99"]
     log(f"leader elected in {m['election_ticks']} ticks "
         f"({m['t_elect']:.2f}s incl compile, {m['t_elect_post']:.3f}s "
         f"post-compile), election_tick={election_tick}; "
@@ -477,6 +536,8 @@ def main() -> None:
         extra: dict = {}
         RESULT["configs_entries_per_s"] = extra  # by reference: partial
         # results survive a SIGTERM mid-loop
+        tel_extra: dict = {}
+        RESULT["configs_telemetry"] = tel_extra  # same by-reference rule
         for name, cn, kw in (
             ("64-steady", 64, {}),
             ("1024-crash-every-100", 1024, {"crash_every": 100, "down_for": 5}),
@@ -561,6 +622,9 @@ def main() -> None:
                     ratio = bm["rate"] / dm["rate"]
                     _bench_gauges(f"{name}-dense", dm)
                     _bench_gauges(f"{name}-banded-pc{pc}", bm)
+                    bt = _telemetry_json(bm)
+                    if bt is not None:
+                        tel_extra[name] = bt
                     extra[name] = {
                         "dense": round(dm["rate"], 1),
                         f"banded_pc{pc}": round(bm["rate"], 1),
@@ -577,6 +641,9 @@ def main() -> None:
                              election_tick=election_tick_for(cn), **kw)
                 _bench_gauges(name, cm)
                 extra[name] = round(cm["rate"], 1)
+                ct = _telemetry_json(cm)
+                if ct is not None:
+                    tel_extra[name] = ct
                 log(f"config {name}: {cm['rate']:,.0f} entries/s "
                     f"(election {cm['election_ticks']} ticks)")
                 if "read_rate" in cm:

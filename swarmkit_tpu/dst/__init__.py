@@ -28,8 +28,8 @@ from swarmkit_tpu.dst.schedule import (
 )
 from swarmkit_tpu.dst.invariants import (
     BIT_NAMES, CHECKSUM_AGREEMENT, COMMIT_MONOTONIC, ELECTION_SAFETY,
-    LEADER_COMPLETENESS, LINEARIZABLE_READ, LOG_MATCHING, bits_to_names,
-    check_state, check_transition,
+    LEADER_COMPLETENESS, LINEARIZABLE_READ, LOG_MATCHING, SLO_COMMIT_P99,
+    bits_to_names, check_state, check_transition,
 )
 from swarmkit_tpu.dst.explore import ExploreResult, explore, postmortem
 from swarmkit_tpu.dst.repro import (
@@ -42,7 +42,7 @@ __all__ = [
     "make_batch", "make_schedule",
     "BIT_NAMES", "CHECKSUM_AGREEMENT", "COMMIT_MONOTONIC", "ELECTION_SAFETY",
     "LEADER_COMPLETENESS", "LINEARIZABLE_READ", "LOG_MATCHING",
-    "bits_to_names", "check_state", "check_transition",
+    "SLO_COMMIT_P99", "bits_to_names", "check_state", "check_transition",
     "ExploreResult", "explore", "postmortem",
     "capture_flight", "fault_count", "from_artifact", "load_artifact",
     "oracle_trace", "replay", "replay_artifact", "save_artifact", "shrink",

@@ -30,6 +30,15 @@ LINEARIZABLE_READ    no served read batch observed a state missing a
                      (cfg.read_batch > 0); the goal register is pure
                      oracle bookkeeping the serving decisions never
                      read, exactly like apply_chk for checksums.
+SLO_COMMIT_P99       OPTIONAL performance oracle (not a Raft safety
+                     property): the device-computed p99 propose->commit
+                     latency bucket edge exceeds
+                     cfg.slo_p99_commit_ticks.  Only checked when the
+                     bound is set (> 0, which requires
+                     cfg.collect_telemetry) and samples exist — latency
+                     anomalies flag protocol-level attacks (term
+                     inflation, election storms) long before a safety
+                     invariant trips.
 """
 
 from __future__ import annotations
@@ -47,6 +56,7 @@ LEADER_COMPLETENESS = 1 << 2
 COMMIT_MONOTONIC = 1 << 3
 CHECKSUM_AGREEMENT = 1 << 4
 LINEARIZABLE_READ = 1 << 5
+SLO_COMMIT_P99 = 1 << 6
 
 BIT_NAMES = {
     ELECTION_SAFETY: "election_safety",
@@ -55,6 +65,7 @@ BIT_NAMES = {
     COMMIT_MONOTONIC: "commit_monotonic",
     CHECKSUM_AGREEMENT: "checksum_agreement",
     LINEARIZABLE_READ: "linearizable_read",
+    SLO_COMMIT_P99: "slo_commit_p99",
 }
 ALL_BITS = tuple(BIT_NAMES)
 
@@ -120,7 +131,18 @@ def check_state(state: SimState, cfg: SimConfig) -> jnp.ndarray:
         read_bit = _bit(jnp.any(state.read_srv_idx < state.read_srv_goal),
                         LINEARIZABLE_READ)
 
-    return elect | match | complete | chk_bit | read_bit
+    # -- SLO_COMMIT_P99: optional latency oracle over the telemetry
+    # histogram (Python-gated on both the bound and the telemetry plane,
+    # so every existing sweep traces the same checker program)
+    slo_bit = jnp.uint32(0)
+    if cfg.slo_p99_commit_ticks > 0 and state.tel_commit_hist is not None:
+        from swarmkit_tpu.telemetry import series as _tel
+        total = jnp.sum(state.tel_commit_hist)
+        edge = _tel.percentile_edge_device(state.tel_commit_hist, 99)
+        slo_bit = _bit((total > 0) & (edge > cfg.slo_p99_commit_ticks),
+                       SLO_COMMIT_P99)
+
+    return elect | match | complete | chk_bit | read_bit | slo_bit
 
 
 def check_transition(prev: SimState, new: SimState) -> jnp.ndarray:

@@ -102,12 +102,19 @@ def capture_flight(cfg: SimConfig, schedule: FaultSchedule,
     The re-run STOPS right after `first_tick` (when known), so the ring's
     tail holds the ticks that produced the violation instead of whatever
     happened afterwards.  Determinism makes this exact: same schedule,
-    same seed, same trajectory — recording only adds the ring writes.
+    same seed, same trajectory — recording only adds the ring writes,
+    and the telemetry plane (also switched on here so the post-mortem
+    carries latency histograms and counter tracks) only adds write-only
+    side buffers no decision ever reads.  The violation verdict cannot
+    change either: cfg arrives with slo_p99_commit_ticks as the sweep
+    set it, so no oracle bit appears that the sweep didn't ask for.
     """
     from swarmkit_tpu.flightrec import record as flight_record
+    from swarmkit_tpu.telemetry import summarize_state
 
     rcfg = dataclasses.replace(cfg, record_events=True,
-                               event_ring=max(cfg.event_ring, 128))
+                               event_ring=max(cfg.event_ring, 128),
+                               collect_telemetry=True)
     schedule = jax.tree_util.tree_map(jnp.asarray, schedule)
     if first_tick >= 0:
         stop = min(int(schedule.ticks), first_tick + 1)
@@ -115,7 +122,7 @@ def capture_flight(cfg: SimConfig, schedule: FaultSchedule,
     final, viol, first = _replay_final(init_state(rcfg), rcfg, schedule,
                                        prop_count, mutation)
     rec = flight_record.capture(
-        final, trigger=trigger, obs=obs,
+        final, trigger=trigger, obs=obs, cfg=rcfg,
         meta={"mutation": mutation, "prop_count": prop_count,
               "violation_bits": int(viol),
               "violations": bits_to_names(int(viol)),
@@ -126,6 +133,7 @@ def capture_flight(cfg: SimConfig, schedule: FaultSchedule,
         "first_tick": int(first),
         "dropped": rec.dropped,
         "window": [e.to_dict() for e in rec.window(window)],
+        "telemetry": summarize_state(final, rcfg),
         "record": rec,
     }
 
@@ -350,6 +358,7 @@ def to_artifact(cfg: SimConfig, schedule: FaultSchedule, *, seed: int,
             "dropped": flight.get("dropped", []),
             "first_tick": flight.get("first_tick", -1),
             "violations": flight.get("violations", []),
+            "telemetry": flight.get("telemetry", {}),
         }
     return art
 

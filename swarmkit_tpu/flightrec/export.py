@@ -4,10 +4,14 @@ One timeline merges two clocks: device events are instants on a
 tick-as-microsecond axis (pid "sim", one tid track per simulated
 manager), host tracer spans are complete ("X") events on a wall-clock
 axis normalized to start at 0 (pid "host", one tid track per subsystem —
-the first dotted segment of the span name).  Both load in
-chrome://tracing and ui.perfetto.dev; :func:`validate_chrome_trace` is
-the dependency-free schema check the tests (and `flight_view.py
-export --check`) run on the output.
+the first dotted segment of the span name).  Telemetry time-series rows
+(FlightRecord.counters, from the on-device ring) render as Perfetto
+counter tracks ("C" phase, one track per series) on the sim tick axis,
+so a post-mortem shows commit rate / leader churn / occupancy curves
+next to the event instants.  Both load in chrome://tracing and
+ui.perfetto.dev; :func:`validate_chrome_trace` is the dependency-free
+schema check the tests (and `flight_view.py export --check`) run on the
+output.
 """
 
 from __future__ import annotations
@@ -34,11 +38,13 @@ def _meta(pid: int, name: str, tid: Optional[int] = None,
 
 
 def to_chrome_trace(events: Iterable = (), spans: Iterable[dict] = (),
-                    tick_us: float = 1.0) -> dict:
+                    tick_us: float = 1.0,
+                    counters: Iterable[dict] = ()) -> dict:
     """Build the trace dict.  `events` are FlightEvents (or dicts from a
-    saved record); `spans` are Span.to_dict() rows.  `tick_us` maps one
-    sim tick onto the µs timeline (ticks are unitless; 1 µs/tick keeps
-    the two clock domains visually comparable, not aligned)."""
+    saved record); `spans` are Span.to_dict() rows; `counters` are
+    FlightRecord.counters rows ({"name", "tick", "value"}).  `tick_us`
+    maps one sim tick onto the µs timeline (ticks are unitless; 1 µs/tick
+    keeps the two clock domains visually comparable, not aligned)."""
     trace_events: list[dict] = _meta(SIM_PID, "sim (device flight ring)")
     sim_tids = set()
     for e in events:
@@ -77,19 +83,37 @@ def to_chrome_trace(events: Iterable = (), spans: Iterable[dict] = (),
         for subsystem, tid in sorted(host_tids.items(), key=lambda kv: kv[1]):
             trace_events += _meta(HOST_PID, "", tid=tid, tname=subsystem)
 
+    # Counter tracks: Perfetto draws one area chart per (pid, name) "C"
+    # series; tid 0 keeps them pinned under the sim process header.  Rows
+    # are emitted in (name, tick) order so each track's timestamps are
+    # monotonic (the validator enforces this).
+    for c in sorted(counters, key=lambda c: (str(c["name"]), c["tick"])):
+        trace_events.append({
+            "ph": "C", "pid": SIM_PID, "tid": 0,
+            "ts": float(c["tick"]) * tick_us,
+            "name": f"telemetry.{c['name']}",
+            "args": {"value": float(c["value"])},
+        })
+
     return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
 
 
 def validate_chrome_trace(trace: dict) -> list[str]:
     """Schema problems (empty = valid).  Checks the JSON-object format:
     a traceEvents array whose members carry ph/pid/tid/name, numeric
-    ts (+dur for X phases), and JSON-serializable args."""
+    ts (+dur for X phases), and JSON-serializable args.  Counter ("C")
+    events additionally need numeric ts, an args object of numeric
+    values, non-decreasing timestamps per (pid, name) track, and one
+    track (pid, tid) per counter name — a name split across tids renders
+    as two half-empty charts in Perfetto."""
     problems: list[str] = []
     if not isinstance(trace, dict):
         return ["trace must be a JSON object"]
     evs = trace.get("traceEvents")
     if not isinstance(evs, list):
         return ["traceEvents must be an array"]
+    counter_last_ts: dict[tuple, float] = {}
+    counter_tid: dict[tuple, object] = {}
     for i, e in enumerate(evs):
         if not isinstance(e, dict):
             problems.append(f"event #{i} is not an object")
@@ -100,13 +124,32 @@ def validate_chrome_trace(trace: dict) -> list[str]:
             continue
         if e["ph"] not in ("i", "X", "M", "B", "E", "C"):
             problems.append(f"event #{i} has unknown phase {e['ph']!r}")
-        if e["ph"] in ("i", "X") and not isinstance(
+        if e["ph"] in ("i", "X", "C") and not isinstance(
                 e.get("ts"), (int, float)):
             problems.append(f"event #{i} ({e['ph']}) lacks numeric ts")
         if e["ph"] == "X" and not isinstance(e.get("dur"), (int, float)):
             problems.append(f"event #{i} (X) lacks numeric dur")
         if "args" in e and not isinstance(e["args"], dict):
             problems.append(f"event #{i} args is not an object")
+        if e["ph"] == "C" and isinstance(e.get("args"), dict) \
+                and isinstance(e.get("ts"), (int, float)):
+            bad = [k for k, v in e["args"].items()
+                   if not isinstance(v, (int, float)) or isinstance(v, bool)]
+            if bad:
+                problems.append(f"event #{i} (C) has non-numeric counter "
+                                f"values {sorted(bad)}")
+            track = (e["pid"], e["name"])
+            prev = counter_last_ts.get(track)
+            if prev is not None and e["ts"] < prev:
+                problems.append(
+                    f"event #{i} (C) timestamp {e['ts']} goes backwards on "
+                    f"counter track {e['name']!r} (prev {prev})")
+            counter_last_ts[track] = e["ts"]
+            seen_tid = counter_tid.setdefault(track, e["tid"])
+            if seen_tid != e["tid"]:
+                problems.append(
+                    f"event #{i} (C) counter {e['name']!r} spans tids "
+                    f"{seen_tid!r} and {e['tid']!r}; one track per series")
     try:
         json.dumps(trace)
     except (TypeError, ValueError) as exc:
@@ -116,7 +159,8 @@ def validate_chrome_trace(trace: dict) -> list[str]:
 
 def export_record(rec, path: str, tick_us: float = 1.0) -> dict:
     """FlightRecord -> chrome trace JSON file; returns the trace dict."""
-    trace = to_chrome_trace(rec.events, rec.spans, tick_us=tick_us)
+    trace = to_chrome_trace(rec.events, rec.spans, tick_us=tick_us,
+                            counters=getattr(rec, "counters", ()))
     with open(path, "w", encoding="utf-8") as f:
         json.dump(trace, f, indent=1)
     return trace

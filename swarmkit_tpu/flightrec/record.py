@@ -57,6 +57,10 @@ class FlightRecord:
     trigger: str = "manual"             # manual / dst_violation / scenario
     meta: dict = field(default_factory=dict)
     spans: list[dict] = field(default_factory=list)  # host tracer spans
+    # telemetry counter samples: {"name", "tick", "value"} rows decoded
+    # from the time-series ring (telemetry.decode_series), rendered as
+    # Perfetto counter tracks by flightrec/export.py
+    counters: list = field(default_factory=list)
 
     def window(self, last: int = 40) -> list[FlightEvent]:
         """The most recent `last` events — the post-mortem view."""
@@ -67,12 +71,18 @@ class FlightRecord:
                 "trigger": self.trigger, "meta": self.meta,
                 "dropped": list(self.dropped),
                 "events": [e.to_dict() for e in self.events],
-                "spans": self.spans}
+                "spans": self.spans,
+                "counters": self.counters}
 
 
 def capture(state, *, trigger: str = "manual", meta: Optional[dict] = None,
-            tracer=None, obs=None) -> FlightRecord:
-    """Decode `state`'s rings into a FlightRecord and publish metrics."""
+            tracer=None, obs=None, cfg=None) -> FlightRecord:
+    """Decode `state`'s rings into a FlightRecord and publish metrics.
+
+    Pass `cfg` (the SimConfig the state was built with) to also decode a
+    telemetry-enabled state's time-series ring into counter rows, so the
+    Perfetto export shows latency/throughput series next to the event
+    instants."""
     from swarmkit_tpu.metrics import catalog
     from swarmkit_tpu.metrics import registry as obs_registry
 
@@ -83,8 +93,15 @@ def capture(state, *, trigger: str = "manual", meta: Optional[dict] = None,
     dropped = [int(d) for d in np.asarray(dropped)]
     spans = ([s.to_dict() for s in tracer.finished()]
              if tracer is not None else [])
+    counters: list = []
+    if cfg is not None and getattr(state, "tel_series", None) is not None:
+        from swarmkit_tpu.telemetry import decode_series
+        for name, points in sorted(decode_series(state, cfg).items()):
+            counters += [{"name": name, "tick": t, "value": v}
+                         for t, v in points]
     rec = FlightRecord(events=events, dropped=dropped, n=len(dropped),
-                       trigger=trigger, meta=dict(meta or {}), spans=spans)
+                       trigger=trigger, meta=dict(meta or {}), spans=spans,
+                       counters=counters)
     _RECENT.append(rec)
 
     obs = obs or obs_registry.DEFAULT
@@ -121,7 +138,8 @@ def load_record(path: str) -> FlightRecord:
               for e in d["events"]]
     return FlightRecord(events=events, dropped=list(d["dropped"]),
                         n=int(d["n"]), trigger=d.get("trigger", "manual"),
-                        meta=d.get("meta", {}), spans=d.get("spans", []))
+                        meta=d.get("meta", {}), spans=d.get("spans", []),
+                        counters=d.get("counters", []))
 
 
 def summarize(rec: FlightRecord, last: int = 20) -> str:
@@ -148,6 +166,10 @@ def summarize(rec: FlightRecord, last: int = 20) -> str:
         lines += ["  " + e.describe() for e in rec.window(last)]
     if rec.spans:
         lines.append(f"host spans: {len(rec.spans)}")
+    if rec.counters:
+        series = sorted({c["name"] for c in rec.counters})
+        lines.append(f"telemetry counters: {len(rec.counters)} samples "
+                     f"across {len(series)} series ({', '.join(series)})")
     return "\n".join(lines)
 
 

@@ -33,6 +33,12 @@ class MetricSpec:
 _TICK_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
                  0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
 
+# Simulated-tick ladder for the on-device telemetry histograms.  Literal
+# floats rather than an import of telemetry/series.py (that module's
+# publisher imports this catalog); tools/metrics_lint.py check #6 pins
+# these to series.LATENCY_BUCKET_EDGES so they cannot drift.
+_TEL_TICK_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0)
+
 CATALOG: dict[str, MetricSpec] = {
     # ---- raft node (L3) --------------------------------------------------
     "swarm_raft_elections_started_total": MetricSpec(
@@ -139,6 +145,26 @@ CATALOG: dict[str, MetricSpec] = {
         "counter", "Flight-record captures, by trigger (manual / "
         "dst_violation / scenario_failure).", ("trigger",)),
 
+    # ---- on-device telemetry plane (telemetry/) --------------------------
+    "swarm_telemetry_commit_latency_ticks": MetricSpec(
+        "histogram", "Propose-to-commit latency in simulated ticks, "
+        "measured at the proposing leader for self-appended entries "
+        "(SimState.tel_commit_hist, cfg.collect_telemetry).", (),
+        _TEL_TICK_BUCKETS),
+    "swarm_telemetry_election_ticks": MetricSpec(
+        "histogram", "Election duration in simulated ticks, campaign "
+        "start to leadership (SimState.tel_elect_hist).", (),
+        _TEL_TICK_BUCKETS),
+    "swarm_telemetry_read_latency_ticks": MetricSpec(
+        "histogram", "Linearizable read-batch submit-to-settle latency "
+        "in simulated ticks, served and blocked outcomes both counted "
+        "(SimState.tel_read_hist, cfg.read_batch > 0).", (),
+        _TEL_TICK_BUCKETS),
+    "swarm_telemetry_series_value": MetricSpec(
+        "gauge", "Latest sample of an on-device time-series ring row "
+        "(SimState.tel_series), by series name "
+        "(telemetry/series.py SERIES_NAMES).", ("series",)),
+
     # ---- scheduler / dispatcher / store (L5) -----------------------------
     "swarm_scheduler_latency_seconds": MetricSpec(
         "histogram", "One scheduler tick: snapshot, score, and commit of "
@@ -188,6 +214,17 @@ CATALOG: dict[str, MetricSpec] = {
         ("config",)),
     "swarm_bench_election_seconds": MetricSpec(
         "gauge", "Election wall time on the cached program, by bench "
+        "config.", ("config",)),
+    "swarm_bench_commit_latency_ticks_p50": MetricSpec(
+        "gauge", "Median propose-to-commit latency in simulated ticks "
+        "from the bench telemetry probe (bucket upper edge), by bench "
+        "config.", ("config",)),
+    "swarm_bench_commit_latency_ticks_p99": MetricSpec(
+        "gauge", "p99 propose-to-commit latency in simulated ticks from "
+        "the bench telemetry probe (bucket upper edge), by bench "
+        "config.", ("config",)),
+    "swarm_bench_election_ticks": MetricSpec(
+        "gauge", "Simulated ticks until first leader election, by bench "
         "config.", ("config",)),
 }
 

@@ -155,6 +155,25 @@ class HistogramChild:
                 return
         self.counts[-1] += 1
 
+    def observe_bucket(self, index: int, count: int = 1,
+                       value: Optional[float] = None) -> None:
+        """Bulk-merge `count` pre-bucketed observations into bucket
+        `index` (len(buckets) = overflow).  For publishers whose source
+        is already a bucketed device histogram (telemetry plane): the
+        per-observation values are gone, so `sum` is approximated by the
+        bucket's upper edge unless the caller supplies a better `value`
+        per observation."""
+        if not 0 <= index < len(self.counts):
+            raise MetricError(
+                f"bucket index {index} out of range 0..{len(self.counts) - 1}")
+        if count < 0:
+            raise MetricError("histogram bucket counts only go up")
+        self.counts[index] += count
+        self.count += count
+        if value is None:
+            value = self.buckets[min(index, len(self.buckets) - 1)]
+        self.sum += count * float(value)
+
     def cumulative(self) -> list[int]:
         out, running = [], 0
         for c in self.counts:
@@ -315,6 +334,10 @@ class Histogram(MetricFamily):
 
     def observe(self, value: float) -> None:
         self._default().observe(value)
+
+    def observe_bucket(self, index: int, count: int = 1,
+                       value: Optional[float] = None) -> None:
+        self._default().observe_bucket(index, count, value)
 
     def time(self):
         """Context manager: observe the wall-clock duration of a block on

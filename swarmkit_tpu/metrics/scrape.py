@@ -1,0 +1,61 @@
+"""Cumulative-to-delta conversion for device-counter publishers.
+
+The kernel keeps *cumulative* counters on device (SimState.stats, the
+read tallies, the telemetry histograms).  Registry counters are also
+cumulative — so a publisher that calls ``fam.inc(cumulative)`` on every
+scrape double-counts the entire history each time.  KernelObs originally
+guarded this with per-instance ``_last`` lists, which breaks as soon as
+two publisher instances feed the same registry (bench.py builds a fresh
+KernelObs per measure() call): each instance re-baselines at zero and
+re-adds the other's history.
+
+The fix lives here, once, shared by KernelObs and TelemetryObs: one
+:class:`CounterDeltas` table *per registry* (weakly keyed, so throwaway
+test registries are collectible), keyed by series identity, converting a
+cumulative reading into the increment since the previous scrape of that
+registry — regardless of which publisher instance does the scraping.
+
+Reset semantics: a cumulative reading *below* the previous one means a
+new run (fresh SimState, counters restart at zero).  We re-baseline and
+return the full reading, so the first scrape of a new run is counted
+rather than silently dropped.  Within one run device counters are
+monotone, so this never misfires mid-run.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+
+from .registry import MetricsRegistry
+
+
+class CounterDeltas:
+    """Per-registry last-seen table for cumulative device counters."""
+
+    def __init__(self) -> None:
+        self._last: dict[tuple, int] = {}
+        self._lock = threading.Lock()
+
+    def advance(self, key: tuple, cumulative: int) -> int:
+        """Record `cumulative` for series `key`; return the delta since
+        the previous reading (or the full reading after a reset)."""
+        cumulative = int(cumulative)
+        with self._lock:
+            prev = self._last.get(key, 0)
+            self._last[key] = cumulative
+        return cumulative - prev if cumulative >= prev else cumulative
+
+
+_PER_REGISTRY: "weakref.WeakKeyDictionary[MetricsRegistry, CounterDeltas]" \
+    = weakref.WeakKeyDictionary()
+_GUARD = threading.Lock()
+
+
+def deltas_for(registry: MetricsRegistry) -> CounterDeltas:
+    """The (single) delta table attached to `registry`."""
+    with _GUARD:
+        table = _PER_REGISTRY.get(registry)
+        if table is None:
+            table = _PER_REGISTRY[registry] = CounterDeltas()
+        return table

@@ -1574,6 +1574,90 @@ def step(state: SimState, cfg: SimConfig,
         ev_fields = dict(ev_buf=ev_buf, ev_pos=ev_pos, ev_alive=alive,
                          ev_drop=drop_deg)
 
+    # Telemetry plane (cfg.collect_telemetry; telemetry/series.py owns the
+    # bucket ladder and series enum): stamp-and-fold latency histograms
+    # plus the strided time-series ring, from masks this tick already
+    # computed.  Python-gated exactly like the recorder block above, and
+    # deliberately OFF the [N, L] log axis: stamps live in the compact
+    # [N, PROP_RING] batch ring, so telemetry costs a few [N, 512] passes
+    # per tick instead of re-introducing the full-ring scans the tiled
+    # phases avoid.  Measurement semantics: propose->commit latency is
+    # observed at the PROPOSING leader for its self-appended entries
+    # (followers receive entries without client-arrival times, like a
+    # real cluster); the fold window (state.commit, commit] is exactly
+    # this tick's Phase D advance because roles settle in phases A/B,
+    # before any Phase C append could land on a row that reaches D as
+    # leader.
+    tel_fields = {}
+    if cfg.collect_telemetry and state.tel_commit_hist is not None:
+        from swarmkit_tpu.telemetry import series as _ts
+        bidx = state.tel_prop_idx
+        bcnt = state.tel_prop_cnt
+        btick = state.tel_prop_tick
+        if fused_prop:
+            # stamp this tick's fused appends as ONE batch record: every
+            # entry of the batch shares the propose tick, so the stamp is
+            # a single-column write, not a per-entry scatter
+            bs = now % _ts.PROP_RING
+            bidx = _ts.col_set(bidx, bs,
+                               jnp.where(prop_ok, prop_last0 + 1, NONE))
+            bcnt = _ts.col_set(
+                bcnt, bs, jnp.where(prop_ok, prop_cnt, 0).astype(I32))
+            btick = _ts.col_set(
+                btick, bs, jnp.where(prop_ok, now, NONE).astype(I32))
+        # election duration: campaign start -> win, in ticks.  A re-fired
+        # campaign (timeout while still candidate) restarts the clock —
+        # the histogram measures the successful attempt, matching how
+        # etcd's election metrics count per-campaign.  Same-tick wins
+        # (instant wire) stamp before folding and land in bucket 0.
+        estart = jnp.where(campaign | tn_ok, now, state.tel_elect_start)
+        tel_elect_hist = _ts.hist_fold(state.tel_elect_hist,
+                                       win & (estart >= 0), now - estart)
+        estart = jnp.where(win, NONE, estart)
+        # propose->commit: each batch record folds the slice of its index
+        # range covered by this tick's commit advance, weighted by the
+        # slice width.  Freshness (< PROP_RING ticks) retires lap-old
+        # records without explicit clearing; the step-down wipe below
+        # guards against a regained leadership folding another leader's
+        # entries at the same indexes.
+        lo = jnp.maximum(bidx, state.commit[:, None] + 1)
+        hi = jnp.minimum(bidx + bcnt - 1, commit[:, None])
+        cw = jnp.maximum(hi - lo + 1, 0)
+        cfold = can_commit[:, None] & (bidx != NONE) & (btick >= 0) \
+            & (now - btick < _ts.PROP_RING) & (cw > 0)
+        tel_commit_hist = _ts.hist_fold(state.tel_commit_hist, cfold,
+                                        now - btick, weight=cw)
+        # is_leader here is the settled post-A/B role this tick (the same
+        # mask Phase D commits under)
+        bidx = jnp.where(is_leader[:, None], bidx, NONE)
+        # read submit->settle: the submit stamp mirrors Phase R0's refill
+        # condition on the pre-tick registers (serve.py submit), so no
+        # mid-kernel read-path change is needed; served and refused
+        # batches both settle (a refusal is a completed client round
+        # trip too).
+        rsub = state.tel_read_submit
+        if reads_on:
+            tel_refill = alive & (state.read_pend == 0)
+            rsub = jnp.where(tel_refill, now, rsub)
+            rfold = (rd_served | rd_blocked) & (rsub >= 0)
+            tel_read_hist = _ts.hist_fold(state.tel_read_hist, rfold,
+                                          now - rsub)
+        else:
+            tel_read_hist = state.tel_read_hist
+        tel_vals = jnp.stack([
+            jnp.sum(commit - state.commit),              # commit_rate
+            jnp.sum(win.astype(I32)),                    # leader_changes
+            jnp.sum(last - snap_idx),                    # log_occupancy
+            (jnp.sum(jnp.where(rd_blocked, rd_blk_cnt, 0))
+             if reads_on else jnp.asarray(0, I32))])     # reads_blocked
+        tel_series = _ts.ring_write(state.tel_series, cfg.telemetry_stride,
+                                    now, tel_vals)
+        tel_fields = dict(
+            tel_prop_idx=bidx, tel_prop_cnt=bcnt, tel_prop_tick=btick,
+            tel_elect_start=estart, tel_read_submit=rsub,
+            tel_commit_hist=tel_commit_hist, tel_elect_hist=tel_elect_hist,
+            tel_read_hist=tel_read_hist, tel_series=tel_series)
+
     rd_fields = {}
     if reads_on:
         rd_fields = _rd.read_fields(read_regs)
@@ -1607,6 +1691,7 @@ def step(state: SimState, cfg: SimConfig,
         tick=state.tick + 1,
         stats=stats,
         **ev_fields,
+        **tel_fields,
         **rd_fields,
         **boxes,
     )
@@ -1649,8 +1734,23 @@ def propose(state: SimState, cfg: SimConfig, payloads: jax.Array,
     new_last = state.last + jnp.where(ok, count, 0).astype(I32)
     eye = jnp.eye(n, dtype=bool)
     match = jnp.where(ok[:, None] & eye, new_last[:, None], state.match)
+    tel_fields = {}
+    if cfg.collect_telemetry and state.tel_prop_idx is not None:
+        # telemetry stamp: one batch record in the (row, tick) ring — the
+        # whole append shares this client-arrival tick
+        from swarmkit_tpu.telemetry import series as _ts
+        bs = state.tick % _ts.PROP_RING
+        cnt = jnp.asarray(count, I32)
+        tel_fields = dict(
+            tel_prop_idx=_ts.col_set(state.tel_prop_idx, bs,
+                                     jnp.where(ok, state.last + 1, NONE)),
+            tel_prop_cnt=_ts.col_set(state.tel_prop_cnt, bs,
+                                     jnp.where(ok, cnt, 0).astype(I32)),
+            tel_prop_tick=_ts.col_set(
+                state.tel_prop_tick, bs,
+                jnp.where(ok, state.tick, NONE).astype(I32)))
     return dataclasses.replace(state, log_term=log_term, log_data=log_data,
-                               last=new_last, match=match)
+                               last=new_last, match=match, **tel_fields)
 
 
 def propose_dense(state: SimState, cfg: SimConfig,
@@ -1713,8 +1813,23 @@ def propose_dense(state: SimState, cfg: SimConfig,
     new_last = state.last + jnp.where(ok, count, 0).astype(I32)
     eye = jnp.eye(n, dtype=bool)
     match = jnp.where(ok[:, None] & eye, new_last[:, None], state.match)
+    tel_fields = {}
+    if cfg.collect_telemetry and state.tel_prop_idx is not None:
+        # telemetry stamp: identical batch record to propose()'s — the
+        # dense path changes how payloads are materialised, not the
+        # measurement semantics
+        from swarmkit_tpu.telemetry import series as _ts
+        bs = state.tick % _ts.PROP_RING
+        tel_fields = dict(
+            tel_prop_idx=_ts.col_set(state.tel_prop_idx, bs,
+                                     jnp.where(ok, state.last + 1, NONE)),
+            tel_prop_cnt=_ts.col_set(state.tel_prop_cnt, bs,
+                                     jnp.where(ok, count, 0).astype(I32)),
+            tel_prop_tick=_ts.col_set(
+                state.tel_prop_tick, bs,
+                jnp.where(ok, state.tick, NONE).astype(I32)))
     return dataclasses.replace(state, log_term=log_term, log_data=log_data,
-                               last=new_last, match=match)
+                               last=new_last, match=match, **tel_fields)
 
 
 def transfer_leadership(state: SimState, cfg: SimConfig, leader,

@@ -150,7 +150,11 @@ class KernelObs:
     - ``publish(state)``: fold the on-device cumulative event counters
       (``SimState.stats``, cfg.collect_stats) into the kernel counter
       families, incrementing by delta since the previous publish so
-      repeated calls are idempotent over the same state.
+      repeated calls are idempotent over the same state.  The last-seen
+      table lives on the REGISTRY (metrics/scrape.py), not this
+      instance, so several KernelObs feeding one registry — bench.py
+      builds a fresh one per measure() — cannot re-add each other's
+      cumulative history.
     """
 
     _STAT_NAMES = ("swarm_kernel_elections_started_total",
@@ -163,6 +167,7 @@ class KernelObs:
     def __init__(self, obs=None) -> None:
         from swarmkit_tpu.metrics import catalog as obs_catalog
         from swarmkit_tpu.metrics import registry as obs_registry
+        from swarmkit_tpu.metrics import scrape as obs_scrape
 
         self.obs = obs or obs_registry.DEFAULT
         self._m_tick = obs_catalog.get(self.obs, "swarm_kernel_tick_seconds")
@@ -170,8 +175,7 @@ class KernelObs:
                          for n in self._STAT_NAMES]
         self._m_reads = [obs_catalog.get(self.obs, n)
                          for n in self._READ_NAMES]
-        self._last = [0, 0, 0, 0]
-        self._last_reads = [0, 0]
+        self._deltas = obs_scrape.deltas_for(self.obs)
 
     def timed(self, call: str):
         return self._m_tick.labels(call=call).time()
@@ -183,19 +187,19 @@ class KernelObs:
         out: dict[str, int] = {}
         if state.stats is not None:
             cur = [int(v) for v in jax.device_get(state.stats)]
-            for fam, c, prev in zip(self._m_stats, cur, self._last):
-                if c > prev:
-                    fam.inc(c - prev)
-            self._last = cur
+            for name, fam, c in zip(self._STAT_NAMES, self._m_stats, cur):
+                d = self._deltas.advance((name,), c)
+                if d:
+                    fam.inc(d)
             out.update(zip(("elections_started", "elections_won",
                             "commit_advance", "apply_advance"), cur))
         if state.read_srv is not None:
             cur_r = [int(jax.device_get(reads_served(state))),
                      int(jax.device_get(reads_blocked(state)))]
-            for fam, c, prev in zip(self._m_reads, cur_r, self._last_reads):
-                if c > prev:
-                    fam.inc(c - prev)
-            self._last_reads = cur_r
+            for name, fam, c in zip(self._READ_NAMES, self._m_reads, cur_r):
+                d = self._deltas.advance((name,), c)
+                if d:
+                    fam.inc(d)
             out.update(zip(("reads_served", "reads_blocked"), cur_r))
         return out
 

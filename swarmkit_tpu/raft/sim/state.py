@@ -159,6 +159,21 @@ class SimConfig:
     # same tick clock, and the margin is what keeps lease expiry strictly
     # before the earliest rival election.
     lease_margin: int = 1
+    # On-device telemetry plane (telemetry/): carry fixed-bucket latency
+    # histograms (propose->commit, election duration, read submit->settle,
+    # all in ticks) plus a strided [series, window] time-series ring in
+    # SimState, folded at the end of every tick.  Off by default: like the
+    # flight recorder, the telemetry scatters are traced into the step
+    # program only when enabled, so the off path stays bit-identical to a
+    # telemetry-less build.  Scrape host-side with telemetry.TelemetryObs.
+    collect_telemetry: bool = False
+    telemetry_window: int = 64   # ring columns (stride-wide buckets) kept
+    telemetry_stride: int = 8    # ticks aggregated per ring column
+    # Optional steady-state latency SLO for the DST oracle: when > 0 (and
+    # collect_telemetry is on), dst/invariants.py raises SLO_COMMIT_P99
+    # if the device-computed p99 propose->commit latency bucket edge
+    # exceeds this many ticks.  0 disables the oracle bit.
+    slo_p99_commit_ticks: int = 0
 
     @property
     def lease_ticks(self) -> int:
@@ -270,6 +285,22 @@ class SimConfig:
                     f"num_chunks={self.num_chunks} or the banded pass "
                     f"covers the whole ring — raise log_len, raise "
                     f"log_chunk, or set log_chunk=0 to disable tiling")
+        if self.collect_telemetry:
+            if self.telemetry_stride < 1:
+                raise ValueError(
+                    f"telemetry_stride={self.telemetry_stride} must be "
+                    f">= 1 (ticks aggregated per ring column)")
+            if self.telemetry_window < 8:
+                raise ValueError(
+                    f"telemetry_window={self.telemetry_window} is too "
+                    f"small to hold a useful history; use >= 8 columns")
+        if self.slo_p99_commit_ticks < 0:
+            raise ValueError(f"slo_p99_commit_ticks must be >= 0, got "
+                             f"{self.slo_p99_commit_ticks}")
+        if self.slo_p99_commit_ticks > 0 and not self.collect_telemetry:
+            raise ValueError(
+                "slo_p99_commit_ticks needs the commit-latency histogram; "
+                "set collect_telemetry=True")
         if self.peer_chunk < 0:
             raise ValueError(f"peer_chunk must be >= 0, got {self.peer_chunk}")
         if self.peer_tiled:
@@ -391,6 +422,30 @@ class SimState:
     read_block: Optional[jax.Array] = None
     read_srv_idx: Optional[jax.Array] = None
     read_srv_goal: Optional[jax.Array] = None
+    # ---- telemetry plane (cfg.collect_telemetry; telemetry/) ------------
+    # Propose-batch ring [N, PROP_RING]: every entry a leader appends in
+    # one tick shares that tick's client-arrival stamp, so the stamps are
+    # per (row, tick-batch) — slot t % PROP_RING holds (first idx, count,
+    # tick) of the batch proposed at tick t, NONE/0 when the row was not
+    # an accepting leader.  This keeps the commit fold off the [N, L] log
+    # axis entirely (a full-ring pass per tick costs ~10x the tiled
+    # kernel's banded phases at the bench shape).  Records invalidate on
+    # step-down (a regained leadership may hold different entries at the
+    # same indexes) and by age (>= PROP_RING ticks, beyond the histogram's
+    # overflow edge).  tel_elect_start / tel_read_submit [N] mark campaign
+    # start / read-batch submit ticks (NONE = idle).  Aggregates:
+    # tel_*_hist [NUM_BUCKETS] i32 bucket counters (edges in
+    # telemetry/series.py); tel_series [NUM_SERIES, telemetry_window] is
+    # the strided time-series ring.
+    tel_prop_idx: Optional[jax.Array] = None
+    tel_prop_cnt: Optional[jax.Array] = None
+    tel_prop_tick: Optional[jax.Array] = None
+    tel_elect_start: Optional[jax.Array] = None
+    tel_read_submit: Optional[jax.Array] = None
+    tel_commit_hist: Optional[jax.Array] = None
+    tel_elect_hist: Optional[jax.Array] = None
+    tel_read_hist: Optional[jax.Array] = None
+    tel_series: Optional[jax.Array] = None
     # ---- in-flight mailboxes [N, N], only when cfg.mailboxes ------------
     # One slot per message class per directed edge; *_at holds deliver
     # tick + 1 (0 = empty).  Request classes index [sender, receiver];
@@ -514,7 +569,24 @@ def init_state(cfg: SimConfig,
                 lease_until=z(n), read_srv=z(n), read_block=z(n),
                 read_srv_idx=z(n), read_srv_goal=z(n))
            if cfg.read_batch > 0 else {}),
+        **(_telemetry_init(cfg) if cfg.collect_telemetry else {}),
     )
+
+
+def _telemetry_init(cfg: SimConfig) -> dict:
+    from swarmkit_tpu.telemetry import series as tel
+    n, i32 = cfg.n, jnp.int32
+    z = lambda *s: jnp.zeros(s, i32)  # noqa: E731
+    return dict(
+        tel_prop_idx=jnp.full((n, tel.PROP_RING), NONE, i32),
+        tel_prop_cnt=z(n, tel.PROP_RING),
+        tel_prop_tick=jnp.full((n, tel.PROP_RING), NONE, i32),
+        tel_elect_start=jnp.full((n,), NONE, i32),
+        tel_read_submit=jnp.full((n,), NONE, i32),
+        tel_commit_hist=z(tel.NUM_BUCKETS),
+        tel_elect_hist=z(tel.NUM_BUCKETS),
+        tel_read_hist=z(tel.NUM_BUCKETS),
+        tel_series=z(tel.NUM_SERIES, cfg.telemetry_window))
 
 
 def hash32(x: jax.Array) -> jax.Array:

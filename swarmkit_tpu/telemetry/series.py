@@ -1,0 +1,137 @@
+"""Device-side telemetry vocabulary and array ops.
+
+This module is the single ground truth for the on-device telemetry
+plane's layout: the fixed latency-bucket ladder, the time-series row
+indices of ``SimState.tel_series``, and the jittable fold/ring ops the
+kernel's end-of-tick telemetry block calls.  The host scrape schema
+(metrics/catalog.py ``swarm_telemetry_*`` specs) mirrors the ladder and
+the series names; tools/metrics_lint.py check #6 keeps the two in
+lockstep the same way check #5 pins flightrec/codes.py to the events
+counter.
+
+Everything here is tick-unit integer math: latencies are measured in
+simulated ticks (the only clock the kernel has), so the histograms are
+exact counters — p50/p99 read off them are true percentiles up to bucket
+resolution, with zero host traffic during the run.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+I32 = jnp.int32
+
+# Fixed histogram bucket UPPER edges, in ticks: a latency of t lands in
+# the first bucket with t <= edge; the extra last counter is overflow
+# (> 256 ticks).  Power-of-two ladder because the interesting spans are
+# log-spread: steady-state propose->commit is 0-1 ticks on the instant
+# wire and ~2*(latency+jitter) on the mailbox wire, elections take
+# [election_tick, 2*election_tick) plus collision retries.
+LATENCY_BUCKET_EDGES = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+NUM_BUCKETS = len(LATENCY_BUCKET_EDGES) + 1          # + overflow
+
+# Row indices of SimState.tel_series [NUM_SERIES, telemetry_window].
+SERIES_COMMIT_RATE = 0      # committed entries per stride bucket (sum)
+SERIES_LEADER_CHANGES = 1   # election wins per stride bucket (sum)
+SERIES_LOG_OCCUPANCY = 2    # sum over rows of last - snap_idx (gauge)
+SERIES_READS_BLOCKED = 3    # read ops refused per stride bucket (sum)
+NUM_SERIES = 4
+
+# Scrape-side names, index -> name (the lint pins these to the catalog's
+# swarm_telemetry_series_value label space and to the constants above).
+SERIES_NAMES = {
+    SERIES_COMMIT_RATE: "commit_rate",
+    SERIES_LEADER_CHANGES: "leader_changes",
+    SERIES_LOG_OCCUPANCY: "log_occupancy",
+    SERIES_READS_BLOCKED: "reads_blocked",
+}
+
+# Gauge-mode rows OVERWRITE within a stride bucket (last tick wins);
+# counter-mode rows accumulate ticks into the bucket.
+GAUGE_ROWS = (SERIES_LOG_OCCUPANCY,)
+
+# Propose-batch ring depth of SimState.tel_prop_* [N, PROP_RING]: slot
+# t % PROP_RING holds the (first idx, count, tick) of the batch a leader
+# appended at tick t.  Batches uncommitted after PROP_RING ticks age out
+# of measurement — 2x the histogram's overflow edge, so every latency
+# the bucket ladder can distinguish is covered.
+PROP_RING = 512
+
+
+def col_set(ring: jnp.ndarray, col: jnp.ndarray,
+            vals: jnp.ndarray) -> jnp.ndarray:
+    """Overwrite ring[:, col] with vals [N] via dynamic_update_slice.
+
+    `.at[:, col].set` with a traced column index lowers to a scatter,
+    which XLA:CPU executes element-at-a-time (the same serialization the
+    log-axis scatter-add hit); an [N, 1] slice update is a plain strided
+    store.
+    """
+    return jax.lax.dynamic_update_slice(
+        ring, vals[:, None], (jnp.asarray(0, I32), col.astype(I32)))
+
+
+def bucket_of(lat: jnp.ndarray) -> jnp.ndarray:
+    """Bucket index (0..NUM_BUCKETS-1) of tick-latency `lat` (any shape)."""
+    edges = jnp.asarray(LATENCY_BUCKET_EDGES, I32)
+    return jnp.sum((lat[..., None] > edges).astype(I32), axis=-1)
+
+
+def hist_fold(hist: jnp.ndarray, mask: jnp.ndarray, lat: jnp.ndarray,
+              weight: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Fold masked latencies into a [NUM_BUCKETS] counter vector; each
+    masked element contributes `weight` samples (1 when None — a batch of
+    entries sharing one propose tick folds as one weighted element).
+
+    SCATTER-FREE: one masked exceed-count reduction per bucket edge (9
+    dense passes over the operand), then bucket counts by differencing —
+    equivalent to scatter-adding bucket_of(lat) but lowered entirely to
+    vector reductions (a large flattened scatter-add serializes per
+    element; measured 19x slower on the n=256 bench shape on CPU).
+    Latencies of masked-out elements never contribute (garbage from
+    unstamped slots included).
+    """
+    m = mask.ravel()
+    w = m.astype(I32) if weight is None else jnp.where(m, weight.ravel(), 0)
+    lv = lat.ravel()
+    total = jnp.sum(w)
+    exceed = jnp.stack([jnp.sum(jnp.where(lv > e, w, 0))
+                        for e in LATENCY_BUCKET_EDGES])
+    zero = jnp.zeros((1,), I32)
+    counts = (jnp.concatenate([total[None], exceed])
+              - jnp.concatenate([exceed, zero]))
+    return hist + counts
+
+
+def ring_write(series: jnp.ndarray, stride: int, now: jnp.ndarray,
+               vals: jnp.ndarray) -> jnp.ndarray:
+    """Write this tick's [NUM_SERIES] sample into the strided ring.
+
+    Column of tick t is (t // stride) % window; the first tick of a
+    stride bucket resets the column (overwriting the sample from one
+    window-lap ago), later ticks accumulate (counter rows) or overwrite
+    (gauge rows).  The decoder (telemetry/obs.py decode_series)
+    reconstructs each column's absolute bucket from the final tick.
+    """
+    col = (now // stride) % series.shape[-1]
+    fresh = (now % stride) == 0
+    base = jnp.where(fresh, 0, series[:, col])
+    gauge = jnp.asarray([i in GAUGE_ROWS for i in range(NUM_SERIES)])
+    return col_set(series, col, jnp.where(gauge, vals, base + vals))
+
+
+def percentile_edge_device(hist: jnp.ndarray, q: int) -> jnp.ndarray:
+    """Upper edge (ticks) of the q-th percentile bucket, on device.
+
+    q is an integer percent.  The overflow bucket reads as int32 max so
+    any finite SLO bound trips on it.  On an empty histogram the result
+    is the first edge — callers gate on sum(hist) > 0 (the SLO oracle in
+    dst/invariants.py does).
+    """
+    total = jnp.sum(hist)
+    k = jnp.maximum((q * total + 99) // 100, 1)      # ceil(q% of total)
+    b = jnp.argmax(jnp.cumsum(hist) >= k).astype(I32)
+    edges_ext = jnp.asarray(
+        LATENCY_BUCKET_EDGES + (jnp.iinfo(jnp.int32).max,), I32)
+    return edges_ext[b]

@@ -1,0 +1,83 @@
+"""Slow wrapper around the bench regression gate (tools/bench_gate.py).
+
+Runs the gate against the repo's real BENCH_r*.json trajectory (must
+pass: the newest successful round is also the fastest so far) and
+against a copy with a synthetically collapsed final round (must fail).
+Slow-marked like the other tool wrappers; tier-1 skips it.
+"""
+
+import json
+import os
+import shutil
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+
+from bench_gate import main as gate_main  # noqa: E402
+from bench_gate import run_gate  # noqa: E402
+
+
+def _real_rounds():
+    paths = [os.path.join(REPO_ROOT, f) for f in sorted(os.listdir(REPO_ROOT))
+             if f.startswith("BENCH_r") and f.endswith(".json")
+             and f[len("BENCH_r"):-len(".json")].isdigit()]
+    if len(paths) < 2:
+        pytest.skip("needs a BENCH_r*.json trajectory in the repo root")
+    return paths
+
+
+@pytest.mark.slow
+def test_gate_passes_on_real_trajectory(capsys):
+    paths = _real_rounds()
+    report = run_gate(paths=paths)
+    assert report["ok"], report["failures"]
+    # the headline series must actually be gated, not vacuously absent
+    assert report["series"]["headline"]["gated"]
+    assert gate_main(paths) == 0
+    out = capsys.readouterr().out
+    assert "PASS" in out and "headline" in out
+
+
+@pytest.mark.slow
+def test_gate_fails_on_injected_regression(tmp_path, capsys):
+    paths = _real_rounds()
+    copies = []
+    for p in paths:
+        dst = tmp_path / os.path.basename(p)
+        shutil.copy(p, dst)
+        copies.append(str(dst))
+    # collapse every rate in the newest round far below any tolerance
+    last = copies[-1]
+    d = json.loads(open(last).read())
+    assert d.get("rc") == 0 and isinstance(d.get("parsed"), dict), \
+        "newest round must be a usable one for the gate to see the collapse"
+    d["parsed"]["value"] *= 0.05
+    cfgs = d["parsed"].get("configs_entries_per_s") or {}
+    for k, v in cfgs.items():
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            cfgs[k] = v * 0.05
+    with open(last, "w") as f:
+        json.dump(d, f)
+
+    report = run_gate(paths=copies)
+    assert not report["ok"]
+    assert any(r.startswith("headline") for r in report["failures"])
+    assert gate_main(copies) == 1
+    assert "FAIL" in capsys.readouterr().out
+
+
+@pytest.mark.slow
+def test_gate_skips_unusable_rounds(tmp_path):
+    # rc!=0 and unparsable rounds carry no signal and are skipped whole;
+    # with nothing left, the CLI fails loudly instead of passing vacuously
+    a = tmp_path / "BENCH_r01.json"
+    a.write_text(json.dumps({"rc": 1, "parsed": None}))
+    b = tmp_path / "BENCH_r02.json"
+    b.write_text("not json")
+    report = run_gate(paths=[str(a), str(b)])
+    assert report["ok"] and not report["series"]
+    assert len(report["skipped_rounds"]) == 2
+    assert gate_main([str(a), str(b)]) == 1

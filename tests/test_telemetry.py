@@ -1,0 +1,495 @@
+"""On-device telemetry plane (swarmkit_tpu/telemetry/).
+
+Covers the ISSUE 9 acceptance criteria at tier-1 size (n=5):
+
+- ``collect_telemetry=False`` is bit-identical to the seed behavior on all
+  three wires (instant, forced mailboxes, latency+jitter) — and turning it
+  ON perturbs nothing outside the ``tel_*`` side buffers;
+- the device-computed propose->commit latency histogram matches a host
+  oracle that replays the stamp/fold rules tick by tick (exact bucket
+  agreement, two wires);
+- the ring time-series decode reconstructs absolute ticks and counter
+  sums; histograms compose with vmap and with the tiled log/peer passes;
+- the host plane: TelemetryObs / KernelObs publish deltas-per-scrape
+  (double-scrape idempotence via metrics/scrape.py), percentile edges
+  agree between device and host, the Perfetto counter-track validator
+  rejects malformed traces, and the DST SLO oracle bit trips/clears.
+
+The end-to-end run -> scrape -> Perfetto export flow and the bench gate
+live in slow wrappers (this file's tail and tests/test_bench_gate.py).
+"""
+
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from swarmkit_tpu.flightrec import export as flight_export
+from swarmkit_tpu.flightrec import record as flight_record
+from swarmkit_tpu.metrics import catalog as obs_catalog
+from swarmkit_tpu.metrics.registry import MetricsRegistry
+from swarmkit_tpu.metrics.scrape import CounterDeltas, deltas_for
+from swarmkit_tpu.raft.sim.kernel import propose
+from swarmkit_tpu.raft.sim.run import KernelObs, run_ticks
+from swarmkit_tpu.raft.sim.state import (
+    LEADER, NONE, SimConfig, SimState, init_state,
+)
+from swarmkit_tpu.telemetry import (
+    TelemetryObs, decode_series, percentile_edge, summarize_state,
+)
+from swarmkit_tpu.telemetry import series as tel
+
+BASE = dict(n=5, log_len=64, window=8, apply_batch=16, max_props=8,
+            keep=4, election_tick=10, collect_stats=True)
+
+WIRES = {
+    "instant": {},
+    "mailbox": {"force_mailboxes": True},
+    "latency": {"latency": 2, "latency_jitter": 1, "inflight": 2},
+}
+
+
+def _cfg(seed=3, **kw):
+    return SimConfig(**{**BASE, **kw}, seed=seed)
+
+
+def _tel_on(cfg):
+    return dataclasses.replace(cfg, collect_telemetry=True,
+                               telemetry_window=8, telemetry_stride=8)
+
+
+@pytest.fixture(scope="module", params=[
+    "instant",
+    pytest.param("latency", marks=pytest.mark.slow),
+    pytest.param("mailbox", marks=pytest.mark.slow),
+])
+def wire_pair(request):
+    """(wire name, cfg off, cfg on, final off, final on): one 64-tick run
+    per wire per setting, shared by every assertion in this file.  The
+    instant wire stays tier-1; the mailbox/latency params ride tier-2
+    with the other compile-heavy wrappers (each costs ~9 s of compile on
+    the CPU box, against tier-1's tight wall budget)."""
+    off = _cfg(**WIRES[request.param])
+    on = _tel_on(off)
+    f_off, _ = run_ticks(init_state(off), off, 64, prop_count=2)
+    f_on, _ = run_ticks(init_state(on), on, 64, prop_count=2)
+    return request.param, off, on, f_off, f_on
+
+
+@pytest.fixture(scope="module", params=[
+    pytest.param("read", marks=pytest.mark.slow)])
+def read_pair(request):
+    """Same shape with the read path compiled in (4th wire for identity)."""
+    off = _cfg(seed=7, read_batch=4)
+    on = _tel_on(off)
+    f_off, _ = run_ticks(init_state(off), off, 64, prop_count=2)
+    f_on, _ = run_ticks(init_state(on), on, 64, prop_count=2)
+    return off, on, f_off, f_on
+
+
+def _assert_identical_outside_tel(f_off, f_on):
+    for f in dataclasses.fields(SimState):
+        a, b = getattr(f_off, f.name), getattr(f_on, f.name)
+        if f.name.startswith("tel_"):
+            assert a is None, f"{f.name} must stay None when telemetry is off"
+            continue
+        if a is None:
+            assert b is None, f.name
+            continue
+        assert np.array_equal(np.asarray(a), np.asarray(b)), \
+            f"telemetry perturbed {f.name}"
+
+
+class TestBitIdentity:
+    def test_off_state_has_no_tel_fields_and_on_does_not_perturb(
+            self, wire_pair):
+        _, _off, _on, f_off, f_on = wire_pair
+        _assert_identical_outside_tel(f_off, f_on)
+
+    def test_read_wire(self, read_pair):
+        _off, _on, f_off, f_on = read_pair
+        _assert_identical_outside_tel(f_off, f_on)
+
+
+class TestHistograms:
+    def test_commit_histogram_counts_commits(self, wire_pair):
+        name, _off, on, _f_off, f_on = wire_pair
+        hist = np.asarray(f_on.tel_commit_hist)
+        assert hist.sum() > 0
+        assert (hist >= 0).all()
+        if name == "instant":
+            # same-tick propose-and-commit stamps before folding: bucket 0
+            assert hist[0] == hist.sum()
+        if name == "latency":
+            # a 2-tick wire cannot commit in the propose tick
+            assert hist[0] == 0
+
+    def test_election_total_matches_kernel_stats(self, wire_pair):
+        _name, _off, _on, _f_off, f_on = wire_pair
+        won = int(np.asarray(f_on.stats)[1])
+        assert int(np.asarray(f_on.tel_elect_hist).sum()) == won > 0
+
+    def test_read_histogram_settles_batches(self, read_pair):
+        _off, on, _f_off, f_on = read_pair
+        hist = np.asarray(f_on.tel_read_hist)
+        assert 0 < hist.sum() <= 64 * on.n
+
+    def test_device_and_host_percentiles_agree(self, wire_pair):
+        _name, _off, _on, _f_off, f_on = wire_pair
+        counts = np.asarray(f_on.tel_commit_hist)
+        for q in (50, 99):
+            dev = int(tel.percentile_edge_device(f_on.tel_commit_hist, q))
+            assert dev == percentile_edge(counts, q)
+
+
+class TestCommitLatencyOracle:
+    """Device histogram == host replay of the stamp/fold rules."""
+
+    @pytest.mark.parametrize("wire_kw", [
+        {},
+        pytest.param({"latency": 2, "latency_jitter": 1, "inflight": 2},
+                     marks=pytest.mark.slow)],
+        ids=["instant", "latency"])
+    def test_exact_bucket_agreement(self, wire_kw):
+        props = 2
+        cfg = _tel_on(_cfg(seed=5, **wire_kw))
+        state = init_state(cfg)
+        stamps: dict = {}
+        hist = np.zeros(tel.NUM_BUCKETS, np.int64)
+        for _ in range(70):
+            pre_role = np.asarray(state.role)
+            pre_last = np.asarray(state.last)
+            pre_snap = np.asarray(state.snap_idx)
+            pre_commit = np.asarray(state.commit)
+            pre_tx = np.asarray(state.transferee)
+            memb = np.asarray(jnp.diagonal(state.member))
+            tick = int(state.tick)
+            state, _ = run_ticks(state, cfg, 1, prop_count=props)
+            post_role = np.asarray(state.role)
+            post_commit = np.asarray(state.commit)
+            for r in range(cfg.n):
+                # _leader_ok mirror on the pre-tick state
+                room = pre_last[r] + cfg.max_props - pre_snap[r] <= cfg.log_len
+                if pre_role[r] == LEADER and memb[r] and room \
+                        and pre_tx[r] == NONE:
+                    for idx in range(pre_last[r] + 1, pre_last[r] + 1 + props):
+                        stamps[(r, idx)] = tick
+                # Phase D fold mirror: only leader rows fold, over this
+                # tick's (commit_pre, commit_post] advance
+                if post_role[r] == LEADER and post_commit[r] > pre_commit[r]:
+                    for idx in range(pre_commit[r] + 1, post_commit[r] + 1):
+                        t0 = stamps.get((r, idx))
+                        if t0 is not None:
+                            lat = tick - t0
+                            b = sum(lat > e
+                                    for e in tel.LATENCY_BUCKET_EDGES)
+                            hist[b] += 1
+                # step-down wipe mirror: a row not leading after this
+                # tick drops all its batch records (its uncommitted
+                # entries may be truncated; a later leadership at the
+                # same indexes must not fold another term's stamps)
+                if post_role[r] != LEADER:
+                    for k in [k for k in stamps if k[0] == r]:
+                        del stamps[k]
+        assert hist.sum() > 0
+        np.testing.assert_array_equal(
+            np.asarray(state.tel_commit_hist), hist)
+
+
+class TestSeriesRing:
+    def test_decode_reconstructs_ticks_and_sums(self, wire_pair):
+        name, _off, on, _f_off, f_on = wire_pair
+        if name != "instant":
+            pytest.skip("one wire is enough for the decoder")
+        out = decode_series(f_on, on)
+        assert sorted(out) == sorted(tel.SERIES_NAMES.values())
+        for pts in out.values():
+            ticks = [t for t, _ in pts]
+            assert ticks == sorted(ticks)
+            assert all(t % on.telemetry_stride == 0 for t in ticks)
+        # 64 ticks == window(8) x stride(8): every commit is still in the
+        # ring, so the counter-row sum equals the total committed
+        assert sum(v for _, v in out["commit_rate"]) \
+            == int(np.asarray(f_on.commit).sum())
+        # gauge row: last point is the final tick's occupancy snapshot
+        assert out["log_occupancy"][-1][1] \
+            == int((np.asarray(f_on.last) - np.asarray(f_on.snap_idx)).sum())
+
+    def test_decode_on_fresh_state_is_empty(self):
+        cfg = _tel_on(_cfg())
+        out = decode_series(init_state(cfg), cfg)
+        assert all(pts == [] for pts in out.values())
+
+    def test_ring_write_gauge_vs_counter_rows(self):
+        series = jnp.zeros((tel.NUM_SERIES, 4), jnp.int32)
+        vals = jnp.full((tel.NUM_SERIES,), 3, jnp.int32)
+        s = tel.ring_write(series, 2, jnp.asarray(0, jnp.int32), vals)
+        s = tel.ring_write(s, 2, jnp.asarray(1, jnp.int32), vals)
+        col = np.asarray(s)[:, 0]
+        # counter rows accumulate within the stride bucket, gauges overwrite
+        for i in range(tel.NUM_SERIES):
+            assert col[i] == (3 if i in tel.GAUGE_ROWS else 6)
+
+
+@pytest.mark.slow
+class TestCompose:
+    def test_vmap_matches_individual_runs(self):
+        cfg = _tel_on(_cfg(seed=0))
+        seeds = (0, 1)
+        inits = [init_state(dataclasses.replace(cfg, seed=s)) for s in seeds]
+        singles = [run_ticks(st, cfg, 32, prop_count=1)[0] for st in inits]
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *inits)
+        finals, _ = jax.vmap(
+            lambda st: run_ticks(st, cfg, 32, prop_count=1))(stacked)
+        assert finals.tel_commit_hist.shape == (2, tel.NUM_BUCKETS)
+        for i in range(len(seeds)):
+            for fname in ("tel_commit_hist", "tel_elect_hist", "tel_series"):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(finals, fname))[i],
+                    np.asarray(getattr(singles[i], fname)), err_msg=fname)
+
+    def test_tiled_log_pass_matches_untiled(self):
+        base = dict(n=5, log_len=512, window=8, apply_batch=16, max_props=8,
+                    keep=4, election_tick=10, seed=2, collect_telemetry=True)
+        un = SimConfig(**base, log_chunk=0)
+        ti = SimConfig(**base, log_chunk=128)
+        assert ti.tiled and not un.tiled
+        f_un, _ = run_ticks(init_state(un), un, 48, prop_count=2)
+        f_ti, _ = run_ticks(init_state(ti), ti, 48, prop_count=2)
+        assert int(np.asarray(f_un.tel_commit_hist).sum()) > 0
+        for fname in ("tel_commit_hist", "tel_elect_hist", "tel_read_hist",
+                      "tel_series"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(f_un, fname)),
+                np.asarray(getattr(f_ti, fname)), err_msg=fname)
+
+    def test_banded_peer_pass_matches_dense(self):
+        base = dict(n=16, log_len=64, window=8, apply_batch=16, max_props=8,
+                    keep=4, election_tick=10, seed=4, collect_telemetry=True)
+        dense = SimConfig(**base, peer_chunk=0)
+        banded = SimConfig(**base, peer_chunk=8)
+        assert banded.peer_tiled and not dense.peer_tiled
+        f_d, _ = run_ticks(init_state(dense), dense, 40, prop_count=2)
+        f_b, _ = run_ticks(init_state(banded), banded, 40, prop_count=2)
+        assert int(np.asarray(f_d.tel_commit_hist).sum()) > 0
+        for fname in ("tel_commit_hist", "tel_elect_hist", "tel_series"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(f_d, fname)),
+                np.asarray(getattr(f_b, fname)), err_msg=fname)
+
+
+class TestHostApiStamps:
+    def test_propose_stamps_batch_record(self):
+        cfg = _tel_on(_cfg(seed=3))
+        st = init_state(cfg)
+        st = dataclasses.replace(st, role=st.role.at[0].set(LEADER))
+        payloads = jnp.arange(cfg.max_props, dtype=jnp.uint32)
+        st2 = propose(st, cfg, payloads, 2)
+        bs = int(st.tick) % tel.PROP_RING
+        bidx = np.asarray(st2.tel_prop_idx)
+        bcnt = np.asarray(st2.tel_prop_cnt)
+        btick = np.asarray(st2.tel_prop_tick)
+        assert bidx[0, bs] == int(st.last[0]) + 1
+        assert bcnt[0, bs] == 2
+        assert btick[0, bs] == int(st.tick)
+        # non-proposing rows get this tick's column cleared, not stamped
+        assert (bidx[1:, bs] == NONE).all() and (bcnt[1:, bs] == 0).all()
+        # the rest of the ring is untouched
+        other = np.ones(tel.PROP_RING, bool)
+        other[bs] = False
+        assert (bidx[:, other] == NONE).all()
+
+
+class TestObsPublishers:
+    def test_counter_deltas_unit(self):
+        d = CounterDeltas()
+        assert d.advance(("a",), 5) == 5
+        assert d.advance(("a",), 5) == 0
+        assert d.advance(("a",), 9) == 4
+        # device counter reset (new run): re-baseline, count the reading
+        assert d.advance(("a",), 3) == 3
+        assert d.advance(("b",), 2) == 2
+
+    def test_deltas_for_is_per_registry(self):
+        r1, r2 = MetricsRegistry(), MetricsRegistry()
+        assert deltas_for(r1) is deltas_for(r1)
+        assert deltas_for(r1) is not deltas_for(r2)
+
+    def test_telemetry_obs_double_scrape_is_idempotent(self, wire_pair):
+        name, _off, on, _f_off, f_on = wire_pair
+        if name != "instant":
+            pytest.skip("registry behavior is wire-independent")
+        reg = MetricsRegistry()
+        obs = TelemetryObs(registry=reg)
+        s1 = obs.publish(f_on, on)
+        s2 = obs.publish(f_on, on)
+        assert s1["commit"]["total"] == s2["commit"]["total"] > 0
+        fam = obs_catalog.get(reg, "swarm_telemetry_commit_latency_ticks")
+        child = fam._default()
+        np.testing.assert_array_equal(
+            np.asarray(child.counts), np.asarray(f_on.tel_commit_hist))
+        assert child.count == int(np.asarray(f_on.tel_commit_hist).sum())
+
+    def test_kernel_obs_double_scrape_is_idempotent(self, read_pair):
+        _off, on, _f_off, f_on = read_pair
+        reg = MetricsRegistry()
+        obs = KernelObs(obs=reg)
+        out1 = obs.publish(f_on)
+        out2 = obs.publish(f_on)
+        assert out1 == out2 and out1["reads_served"] > 0
+        served = obs_catalog.get(reg, "swarm_kernel_reads_served_total")
+        assert served.value == out1["reads_served"]
+        commits = obs_catalog.get(reg, "swarm_kernel_commit_advance_total")
+        assert commits.value == out1["commit_advance"]
+
+    def test_two_kernel_obs_share_one_registry_table(self, read_pair):
+        # the historical bug: two publishers over one registry each kept a
+        # private last-seen table, so the second re-added the cumulative
+        _off, on, _f_off, f_on = read_pair
+        reg = MetricsRegistry()
+        out = KernelObs(obs=reg).publish(f_on)
+        KernelObs(obs=reg).publish(f_on)
+        served = obs_catalog.get(reg, "swarm_kernel_reads_served_total")
+        assert served.value == out["reads_served"]
+
+    def test_summarize_state_disabled(self):
+        cfg = _cfg()
+        assert summarize_state(init_state(cfg), cfg) == {"enabled": False}
+
+
+class TestPercentiles:
+    def test_host_percentile_edges(self):
+        counts = np.zeros(tel.NUM_BUCKETS, int)
+        assert percentile_edge(counts, 99) is None
+        counts[0] = 99
+        counts[3] = 1
+        assert percentile_edge(counts, 50) == tel.LATENCY_BUCKET_EDGES[0]
+        assert percentile_edge(counts, 99) == tel.LATENCY_BUCKET_EDGES[0]
+        assert percentile_edge(counts, 100) == tel.LATENCY_BUCKET_EDGES[3]
+        # overflow bucket clamps to the largest finite edge (JSON-safe)
+        over = np.zeros(tel.NUM_BUCKETS, int)
+        over[-1] = 10
+        assert percentile_edge(over, 50) == tel.LATENCY_BUCKET_EDGES[-1]
+
+    def test_device_overflow_reads_as_int32_max(self):
+        hist = jnp.zeros((tel.NUM_BUCKETS,), jnp.int32).at[-1].set(5)
+        assert int(tel.percentile_edge_device(hist, 99)) \
+            == np.iinfo(np.int32).max
+
+    def test_bucket_of_is_total(self):
+        lats = jnp.asarray([0, 1, 2, 255, 256, 257, 100000], jnp.int32)
+        got = np.asarray(tel.bucket_of(lats))
+        np.testing.assert_array_equal(got, [0, 0, 1, 8, 8, 9, 9])
+
+
+class TestSloOracle:
+    def test_bit_trips_and_clears(self, wire_pair):
+        from swarmkit_tpu.dst.invariants import SLO_COMMIT_P99, check_state
+        name, _off, on, _f_off, f_on = wire_pair
+        if name != "latency":
+            pytest.skip("needs a wire with p99 > 1 tick")
+        tight = dataclasses.replace(on, slo_p99_commit_ticks=1)
+        loose = dataclasses.replace(on, slo_p99_commit_ticks=1 << 20)
+        assert int(check_state(f_on, tight)) & SLO_COMMIT_P99
+        assert not int(check_state(f_on, loose)) & SLO_COMMIT_P99
+        # empty histogram (no commits yet): bound set, bit clear
+        assert not int(check_state(init_state(tight), tight)) & SLO_COMMIT_P99
+
+    def test_bound_requires_telemetry(self):
+        with pytest.raises(ValueError):
+            _cfg(slo_p99_commit_ticks=5)
+
+
+class TestConfigValidation:
+    def test_window_and_stride_bounds(self):
+        with pytest.raises(ValueError):
+            _cfg(collect_telemetry=True, telemetry_window=4)
+        with pytest.raises(ValueError):
+            _cfg(collect_telemetry=True, telemetry_stride=0)
+        _cfg(collect_telemetry=True)  # defaults are valid
+
+
+class TestCounterTrackValidator:
+    def _trace(self, events):
+        return {"traceEvents": events}
+
+    def _c(self, name, ts, value=1.0, tid=0):
+        return {"ph": "C", "pid": 1, "tid": tid, "ts": ts, "name": name,
+                "args": {"value": value}}
+
+    def test_valid_counter_track_passes(self):
+        t = self._trace([self._c("a", 0), self._c("a", 1), self._c("b", 0)])
+        assert flight_export.validate_chrome_trace(t) == []
+
+    def test_backwards_timestamps_fail(self):
+        t = self._trace([self._c("a", 5), self._c("a", 3)])
+        assert any("backwards" in p
+                   for p in flight_export.validate_chrome_trace(t))
+
+    def test_split_tid_fails(self):
+        t = self._trace([self._c("a", 0, tid=0), self._c("a", 1, tid=1)])
+        assert any("one track per series" in p
+                   for p in flight_export.validate_chrome_trace(t))
+
+    def test_non_numeric_value_fails(self):
+        bad = [self._c("a", 0, value="high"), self._c("b", 0, value=True)]
+        problems = flight_export.validate_chrome_trace(self._trace(bad))
+        assert sum("non-numeric" in p for p in problems) == 2
+
+    def test_missing_ts_fails(self):
+        e = {"ph": "C", "pid": 1, "tid": 0, "name": "a",
+             "args": {"value": 1}}
+        assert any("lacks numeric ts" in p
+                   for p in flight_export.validate_chrome_trace(
+                       self._trace([e])))
+
+    def test_counter_events_sorted_per_track(self, wire_pair):
+        name, _off, on, _f_off, f_on = wire_pair
+        if name != "instant":
+            pytest.skip("one wire is enough for the exporter")
+        counters = [{"name": sname, "tick": t, "value": v}
+                    for sname, pts in decode_series(f_on, on).items()
+                    for t, v in pts]
+        trace = flight_export.to_chrome_trace((), (), counters=counters)
+        assert flight_export.validate_chrome_trace(trace) == []
+        cs = [e for e in trace["traceEvents"] if e["ph"] == "C"]
+        assert len(cs) == len(counters) > 0
+        assert all(e["name"].startswith("telemetry.") for e in cs)
+
+
+@pytest.mark.slow
+def test_telemetry_end_to_end(tmp_path, capsys):
+    """Full loop: recorded+telemetry run -> TelemetryObs scrape -> flight
+    record with counter tracks -> flight_view export --check (merged
+    flight+telemetry trace is schema-valid)."""
+    from tools.flight_view import main as flight_view_main
+
+    cfg = dataclasses.replace(_tel_on(_cfg(seed=11)),
+                              record_events=True, event_ring=128)
+    final, _ = run_ticks(init_state(cfg), cfg, 80, prop_count=2)
+
+    summary = TelemetryObs(registry=MetricsRegistry()).publish(final, cfg)
+    assert summary["enabled"] and summary["commit"]["total"] > 0
+    assert summary["commit"]["p99"] is not None
+
+    rec = flight_record.capture(final, trigger="manual", cfg=cfg,
+                                meta={"seed": 11})
+    path = tmp_path / "rec.json"
+    flight_record.save_record(rec, str(path))
+    loaded = flight_record.load_record(str(path))
+    assert loaded.counters == rec.counters and rec.counters
+
+    trace_path = tmp_path / "rec.trace.json"
+    assert flight_view_main(["export", str(path), "-o", str(trace_path),
+                             "--check"]) == 0
+    trace = json.loads(trace_path.read_text())
+    phases = {t["ph"] for t in trace["traceEvents"]}
+    assert {"i", "C"} <= phases, "merged flight + telemetry trace"
+    assert flight_export.validate_chrome_trace(trace) == []
+
+    assert flight_view_main(["summarize", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "telemetry counters" in out

@@ -1,0 +1,128 @@
+"""Bench regression gate: fail when the newest BENCH round collapses.
+
+Reads the BENCH_r*.json trajectory the driver leaves in the repo root
+(one file per round: ``{"n", "cmd", "rc", "tail", "parsed"}`` where
+``parsed`` is bench.py's one JSON line) and compares the NEWEST round
+against the best prior round, per series:
+
+- ``headline`` — ``parsed["value"]`` (committed entries/s);
+- one series per numeric entry of ``parsed["configs_entries_per_s"]``
+  ("skipped (cpu)"-style strings, A/B dicts like the densepeer
+  tripwire, and 0.0 placeholders are not rates and carry no signal).
+
+Rounds with ``rc != 0`` or no parsed line are skipped whole (r01/r02
+in this repo's own history: tunnel faults, not regressions).  A series
+needs at least two points — one historical, one current — to be gated;
+the gate FAILS iff the last point of any gated series falls below
+``(1 - tol) x`` the best previous point.  The default tolerance is wide
+(50%) because rounds run on whatever hardware the driver had that day —
+this is a collapse detector, not a benchmark diff.
+
+Usage:
+    python tools/bench_gate.py [--tol 0.5] [files...]
+
+Importable: ``run_gate(paths=None, tol=0.5) -> report dict`` (the slow
+pytest wrapper asserts on the report and on an injected regression).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _is_rate(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool) and v > 0
+
+
+def _series_points(rounds: list[tuple[str, dict]]) -> dict[str, list]:
+    """{series name: [(round name, rate), ...]} in round order."""
+    series: dict[str, list] = {}
+    for rname, parsed in rounds:
+        if _is_rate(parsed.get("value")):
+            series.setdefault("headline", []).append(
+                (rname, float(parsed["value"])))
+        cfgs = parsed.get("configs_entries_per_s")
+        for cname, cv in (cfgs or {}).items() if isinstance(cfgs, dict) else ():
+            if _is_rate(cv):
+                series.setdefault(cname, []).append((rname, float(cv)))
+    return series
+
+
+def run_gate(paths=None, tol: float = 0.5) -> dict:
+    """Evaluate the gate; returns the report dict (report["ok"] is the
+    verdict).  `paths` defaults to the repo-root BENCH_r*.json trajectory;
+    name-sorted so r01 < r02 < ... gives round order."""
+    if paths is None:
+        paths = glob.glob(os.path.join(_ROOT, "BENCH_r*.json"))
+    rounds: list[tuple[str, dict]] = []
+    skipped: list[str] = []
+    for p in sorted(paths, key=os.path.basename):
+        name = os.path.basename(p)
+        try:
+            with open(p, encoding="utf-8") as f:
+                d = json.load(f)
+        except (OSError, ValueError) as e:
+            skipped.append(f"{name}: unreadable ({e})")
+            continue
+        if d.get("rc") != 0 or not isinstance(d.get("parsed"), dict):
+            skipped.append(f"{name}: rc={d.get('rc')}, no usable parsed line")
+            continue
+        rounds.append((name, d["parsed"]))
+
+    report: dict = {"rounds": [n for n, _ in rounds],
+                    "skipped_rounds": skipped, "tol": tol,
+                    "series": {}, "failures": []}
+    for sname, pts in sorted(_series_points(rounds).items()):
+        entry: dict = {"points": pts, "gated": len(pts) >= 2}
+        if entry["gated"]:
+            baseline = max(v for _, v in pts[:-1])
+            last_round, last = pts[-1]
+            entry["baseline"] = baseline
+            entry["last"] = last
+            entry["ratio"] = round(last / baseline, 4)
+            if last < baseline * (1.0 - tol):
+                report["failures"].append(
+                    f"{sname}: {last:,.1f} entries/s in {last_round} is below "
+                    f"{1.0 - tol:.2f}x the best prior round ({baseline:,.1f})")
+        report["series"][sname] = entry
+    report["ok"] = not report["failures"]
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("files", nargs="*",
+                    help="BENCH round JSONs (default: repo-root BENCH_r*.json)")
+    ap.add_argument("--tol", type=float, default=0.5,
+                    help="allowed fractional drop vs best prior round "
+                         "(default 0.5)")
+    args = ap.parse_args(argv)
+
+    report = run_gate(paths=args.files or None, tol=args.tol)
+    for s in report["skipped_rounds"]:
+        print(f"skip  {s}", flush=True)
+    for sname, e in report["series"].items():
+        if e["gated"]:
+            print(f"gate  {sname}: last {e['last']:,.1f} vs baseline "
+                  f"{e['baseline']:,.1f} ({e['ratio']:.2f}x)", flush=True)
+        else:
+            print(f"info  {sname}: {len(e['points'])} point(s), not gated",
+                  flush=True)
+    for f in report["failures"]:
+        print(f"FAIL  {f}", flush=True)
+    if not report["series"]:
+        print("FAIL  no usable bench rounds found", flush=True)
+        return 1
+    print("PASS" if report["ok"] else
+          f"FAIL  {len(report['failures'])} regressed series", flush=True)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

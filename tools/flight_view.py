@@ -43,8 +43,9 @@ def cmd_summarize(args) -> int:
 
 def cmd_export(args) -> int:
     rec = flight_record.load_record(args.record)
-    trace = flight_export.to_chrome_trace(rec.events, rec.spans,
-                                          tick_us=args.tick_us)
+    trace = flight_export.to_chrome_trace(
+        rec.events, rec.spans, tick_us=args.tick_us,
+        counters=getattr(rec, "counters", ()))
     if args.check:
         problems = flight_export.validate_chrome_trace(trace)
         if problems:

@@ -24,6 +24,13 @@ Checks, in order:
    ``EDGE_*``/``BLOCK_*`` and ``EVENT_WIDTH`` excepted — must appear in
    ``CODE_NAMES``, so the device vocabulary and the scrape-side schema
    cannot drift apart.
+6. The telemetry plane stays in the same lockstep: every
+   ``swarm_telemetry_*`` histogram's bucket edges must equal
+   ``telemetry.series.LATENCY_BUCKET_EDGES`` (the catalog duplicates
+   them as literals to avoid an import cycle), the series gauge must be
+   labeled ``series=`` and publishable for every ``SERIES_NAMES`` entry,
+   and the ``SERIES_*`` index enum must mirror ``SERIES_NAMES`` exactly
+   (both directions), with ``NUM_BUCKETS``/``NUM_SERIES`` consistent.
 
 Importable (``run_lint`` returns the problem list) so the pytest wrapper
 in tests/test_metrics_lint.py runs it in-suite; the CLI exits nonzero on
@@ -172,6 +179,61 @@ def run_lint(repo_root: str | None = None) -> list[str]:
                 and attr not in code_names):
             problems.append(f"flightrec: event constant {attr} = {val} is "
                             "missing from CODE_NAMES")
+
+    # 6. telemetry wiring: the catalog's swarm_telemetry_* schema must stay
+    #    in lockstep with the device-side layout (telemetry/series.py) the
+    #    same way check #5 pins the flightrec vocabulary — bucket edges are
+    #    duplicated as literals in the catalog (import-cycle break), so
+    #    equality is enforced here instead of by construction
+    from swarmkit_tpu.telemetry import series as tel_series
+
+    want_buckets = tuple(float(e) for e in tel_series.LATENCY_BUCKET_EDGES)
+    for hname in ("swarm_telemetry_commit_latency_ticks",
+                  "swarm_telemetry_election_ticks",
+                  "swarm_telemetry_read_latency_ticks"):
+        spec = catalog.CATALOG.get(hname)
+        if spec is None or spec.kind != "histogram":
+            problems.append(f"telemetry: {hname!r} missing from the catalog "
+                            "or not a histogram")
+        elif tuple(spec.buckets or ()) != want_buckets:
+            problems.append(
+                f"telemetry: {hname!r} bucket edges {spec.buckets} diverge "
+                f"from telemetry.series.LATENCY_BUCKET_EDGES {want_buckets}")
+    sv_spec = catalog.CATALOG.get("swarm_telemetry_series_value")
+    if sv_spec is None or tuple(sv_spec.labels) != ("series",):
+        problems.append("telemetry: 'swarm_telemetry_series_value' must "
+                        "exist labeled by ('series',)")
+    else:
+        fam = catalog.get(MetricsRegistry(strict=True),
+                          "swarm_telemetry_series_value")
+        for sname in tel_series.SERIES_NAMES.values():
+            try:
+                fam.labels(series=sname).set(0)
+            except MetricError as e:
+                problems.append(f"telemetry: series {sname!r} cannot "
+                                f"publish: {e}")
+
+    #    ... and the series index enum cannot drift from SERIES_NAMES
+    #    (ring rows and the scrape/decode side both key on it)
+    tel_names = list(tel_series.SERIES_NAMES.values())
+    if len(set(tel_names)) != len(tel_names):
+        problems.append("telemetry: duplicate names in SERIES_NAMES")
+    for idx, sname in tel_series.SERIES_NAMES.items():
+        const = f"SERIES_{sname.upper()}"
+        if getattr(tel_series, const, None) != idx:
+            problems.append(
+                f"telemetry: SERIES_NAMES[{idx}] = {sname!r} but the module "
+                f"constant {const} = {getattr(tel_series, const, None)!r}")
+    for attr, val in vars(tel_series).items():
+        if (attr.startswith("SERIES_") and isinstance(val, int)
+                and attr != "SERIES_NAMES" and val not in tel_series.SERIES_NAMES):
+            problems.append(f"telemetry: series constant {attr} = {val} is "
+                            "missing from SERIES_NAMES")
+    if tel_series.NUM_BUCKETS != len(tel_series.LATENCY_BUCKET_EDGES) + 1:
+        problems.append("telemetry: NUM_BUCKETS must be "
+                        "len(LATENCY_BUCKET_EDGES) + 1")
+    if tel_series.NUM_SERIES != len(tel_series.SERIES_NAMES):
+        problems.append("telemetry: NUM_SERIES must equal len(SERIES_NAMES)")
     return problems
 
 
