@@ -23,8 +23,8 @@ Layout:
 """
 
 from swarmkit_tpu.dst.schedule import (
-    EXTRA_PROFILES, PROFILES, FaultSchedule, from_fault_plan, make_batch,
-    make_schedule,
+    EXTRA_PROFILES, PROFILES, FaultSchedule, apply_term_inflation,
+    from_fault_plan, make_batch, make_schedule,
 )
 from swarmkit_tpu.dst.invariants import (
     BIT_NAMES, CHECKSUM_AGREEMENT, COMMIT_MONOTONIC, ELECTION_SAFETY,
@@ -38,8 +38,8 @@ from swarmkit_tpu.dst.repro import (
 )
 
 __all__ = [
-    "EXTRA_PROFILES", "PROFILES", "FaultSchedule", "from_fault_plan",
-    "make_batch", "make_schedule",
+    "EXTRA_PROFILES", "PROFILES", "FaultSchedule", "apply_term_inflation",
+    "from_fault_plan", "make_batch", "make_schedule",
     "BIT_NAMES", "CHECKSUM_AGREEMENT", "COMMIT_MONOTONIC", "ELECTION_SAFETY",
     "LEADER_COMPLETENESS", "LINEARIZABLE_READ", "LOG_MATCHING",
     "SLO_COMMIT_P99", "bits_to_names", "check_state", "check_transition",

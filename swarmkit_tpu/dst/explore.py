@@ -32,7 +32,8 @@ from swarmkit_tpu import parallel
 from swarmkit_tpu.dst.invariants import (
     ALL_BITS, BIT_NAMES, check_state, check_transition,
 )
-from swarmkit_tpu.dst.schedule import FaultSchedule, effective_faults
+from swarmkit_tpu.dst.schedule import FaultSchedule, apply_term_inflation, \
+    effective_faults
 from swarmkit_tpu.raft.sim.kernel import propose_dense, step
 from swarmkit_tpu.raft.sim.run import _payload_at
 from swarmkit_tpu.raft.sim.state import LEADER, SimConfig, SimState
@@ -87,11 +88,19 @@ def broadcast_state(state: SimState, schedules: int) -> SimState:
         lambda a: jnp.broadcast_to(a, (schedules,) + a.shape), state)
 
 
-def _tick_one(st: SimState, cfg: SimConfig, drop_t, alive_t, tl_t, cc_t,
+def _tick_one(st: SimState, cfg: SimConfig, sched_t: FaultSchedule,
               prop_count: int, mutation: Optional[str]):
-    """Advance ONE cluster one tick under its schedule slice; returns the
-    new state and this tick's violation bits."""
-    alive, drop = effective_faults(st.role, drop_t, alive_t, tl_t, cc_t)
+    """Advance ONE cluster one tick under its schedule slice (a
+    FaultSchedule holding one tick's arrays); returns the new state and
+    this tick's violation bits."""
+    alive, drop = effective_faults(st.role, sched_t.drop, sched_t.alive,
+                                   sched_t.target_leader,
+                                   sched_t.crash_campaign)
+    if sched_t.term_inflate is not None:
+        # protocol-speaking adversary: force the flagged rows' election
+        # timers due BEFORE the step, so the kernel's own campaign path
+        # (PreVote-aware) realizes the action
+        st = apply_term_inflation(st, sched_t.term_inflate, alive)
     if prop_count:
         # fused propose (kernel.step docstring): one [N, L] write cond per
         # scan iteration keeps the vmapped log buffers in place
@@ -116,10 +125,8 @@ def _explore_compiled(batched: SimState, cfg: SimConfig,
     def body(carry, sched_t):
         st, acc = carry
         new, bits = jax.vmap(
-            lambda s, d, a, tl, cc: _tick_one(s, cfg, d, a, tl, cc,
-                                              prop_count, mutation)
-        )(st, sched_t.drop, sched_t.alive, sched_t.target_leader,
-          sched_t.crash_campaign)
+            lambda s, sch: _tick_one(s, cfg, sch, prop_count, mutation)
+        )(st, sched_t)
         return (new, acc | bits), bits
 
     schedules = schedule.target_leader.shape[0]

@@ -49,9 +49,7 @@ def _replay_compiled(state, cfg: SimConfig, schedule: FaultSchedule,
                      prop_count: int, mutation: Optional[str]):
     def body(carry, sched_t):
         st, acc = carry
-        new, bits = _tick_one(st, cfg, sched_t.drop, sched_t.alive,
-                              sched_t.target_leader, sched_t.crash_campaign,
-                              prop_count, mutation)
+        new, bits = _tick_one(st, cfg, sched_t, prop_count, mutation)
         return (new, acc | bits), bits
 
     init = (state, jnp.uint32(0))
@@ -79,9 +77,7 @@ def _replay_final(state, cfg: SimConfig, schedule: FaultSchedule,
                   prop_count: int, mutation: Optional[str]):
     def body(carry, sched_t):
         st, acc = carry
-        new, bits = _tick_one(st, cfg, sched_t.drop, sched_t.alive,
-                              sched_t.target_leader, sched_t.crash_campaign,
-                              prop_count, mutation)
+        new, bits = _tick_one(st, cfg, sched_t, prop_count, mutation)
         return (new, acc | bits), bits
 
     (final, viol), bits = jax.lax.scan(body, (state, jnp.uint32(0)),
@@ -144,11 +140,15 @@ def capture_flight(cfg: SimConfig, schedule: FaultSchedule,
 
 def fault_count(schedule: FaultSchedule) -> int:
     """Total injected fault-events: dropped edge-ticks + downed row-ticks
-    + active adversary-gate ticks (the shrinker's minimization metric)."""
+    + active adversary-gate ticks + forced-campaign row-ticks (the
+    shrinker's minimization metric)."""
+    inflate = 0 if schedule.term_inflate is None \
+        else int(np.asarray(schedule.term_inflate).sum())
     return (int(np.asarray(schedule.drop).sum())
             + int((~np.asarray(schedule.alive)).sum())
             + int(np.asarray(schedule.target_leader).sum())
-            + int(np.asarray(schedule.crash_campaign).sum()))
+            + int(np.asarray(schedule.crash_campaign).sum())
+            + inflate)
 
 
 def _clear_ticks(arrs: dict, lo: int, hi: int) -> dict:
@@ -157,6 +157,8 @@ def _clear_ticks(arrs: dict, lo: int, hi: int) -> dict:
     out["alive"][lo:hi] = True
     out["target_leader"][lo:hi] = False
     out["crash_campaign"][lo:hi] = False
+    if "term_inflate" in out:
+        out["term_inflate"][lo:hi] = False
     return out
 
 
@@ -177,7 +179,8 @@ def shrink(cfg: SimConfig, schedule: FaultSchedule, required_bits: int,
     evals = 0
 
     arrs = {f.name: np.asarray(getattr(schedule, f.name)).copy()
-            for f in dataclasses.fields(schedule)}
+            for f in dataclasses.fields(schedule)
+            if getattr(schedule, f.name) is not None}
 
     def still_fails(cand: dict) -> bool:
         nonlocal evals
@@ -213,13 +216,21 @@ def shrink(cfg: SimConfig, schedule: FaultSchedule, required_bits: int,
                 if still_fails(cand):
                     arrs = cand
 
-    # pass 3: clear whole-row outages, then each adversary gate
+    # pass 3: clear whole-row outages and forced-campaign histories, then
+    # each adversary gate
     for r in range(cfg.n):
         if (~arrs["alive"][:, r]).any():
             cand = {k: v.copy() for k, v in arrs.items()}
             cand["alive"][:, r] = True
             if still_fails(cand):
                 arrs = cand
+    if "term_inflate" in arrs:
+        for r in range(cfg.n):
+            if arrs["term_inflate"][:, r].any():
+                cand = {k: v.copy() for k, v in arrs.items()}
+                cand["term_inflate"][:, r] = False
+                if still_fails(cand):
+                    arrs = cand
     for gate in ("target_leader", "crash_campaign"):
         if arrs[gate].any():
             cand = {k: v.copy() for k, v in arrs.items()}
@@ -269,6 +280,8 @@ def oracle_trace(cfg: SimConfig, schedule: FaultSchedule,
     alive_s = np.asarray(schedule.alive)
     tl_s = np.asarray(schedule.target_leader)
     cc_s = np.asarray(schedule.crash_campaign)
+    ti_s = None if schedule.term_inflate is None \
+        else np.asarray(schedule.term_inflate)
 
     trace: list[dict] = []
     diverged_at = -1
@@ -277,6 +290,20 @@ def oracle_trace(cfg: SimConfig, schedule: FaultSchedule,
         leaders = role == LEADER
         drop = drop_s[t] | (tl_s[t] & (leaders[:, None] | leaders[None, :]))
         alive = alive_s[t] & ~(cc_s[t] & (role == CANDIDATE))
+        if ti_s is not None and ti_s[t].any():
+            # resolve the forced-campaign mask against the KERNEL's
+            # pre-step roles (like the gates above) and mirror the same
+            # timer force on both sides — apply_term_inflation on the
+            # kernel state, elapsed := timeout on the oracle's scheduler
+            force = ti_s[t] & alive & (role != LEADER)
+            elapsed = jnp.where(jnp.asarray(force),
+                                jnp.maximum(state.elapsed, state.timeout),
+                                state.elapsed)
+            state = dataclasses.replace(state, elapsed=elapsed)
+            for i in range(n):
+                if force[i]:
+                    oracle.elapsed[i] = max(oracle.elapsed[i],
+                                            oracle.timeout[i])
 
         payloads = np.zeros(cfg.max_props, np.uint32)
         if prop_count:
@@ -352,6 +379,9 @@ def to_artifact(cfg: SimConfig, schedule: FaultSchedule, *, seed: int,
                 np.nonzero(np.asarray(schedule.crash_campaign))[0].tolist(),
         },
     }
+    if schedule.term_inflate is not None:
+        it, ir = np.nonzero(np.asarray(schedule.term_inflate))
+        art["faults"]["term_inflate"] = np.stack([it, ir], axis=1).tolist()
     if flight is not None:
         art["flight"] = {
             "window": flight.get("window", []),
@@ -379,9 +409,18 @@ def from_artifact(art: dict):
         alive[t, r] = False
     tl[art["faults"]["target_leader"]] = True
     cc[art["faults"]["crash_campaign"]] = True
+    # pre-term_inflation artifacts have no key and replay the exact
+    # pre-extension program (term_inflate=None stays version 1)
+    ti = None
+    if "term_inflate" in art["faults"]:
+        ti = np.zeros((ticks, n), bool)
+        for t, r in art["faults"]["term_inflate"]:
+            ti[t, r] = True
+        ti = jnp.asarray(ti)
     schedule = FaultSchedule(drop=jnp.asarray(drop), alive=jnp.asarray(alive),
                              target_leader=jnp.asarray(tl),
-                             crash_campaign=jnp.asarray(cc))
+                             crash_campaign=jnp.asarray(cc),
+                             term_inflate=ti)
     return cfg, schedule, art["prop_count"], art["mutation"]
 
 

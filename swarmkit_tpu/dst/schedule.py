@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 from dataclasses import dataclass
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -37,7 +38,7 @@ I32 = jnp.int32
 # adversaries go in EXTRA_PROFILES and are requested explicitly.
 PROFILES = ("random_drop", "partition_flapper", "leader_targeted",
             "asymmetric_links", "crash_restart", "crash_during_campaign")
-EXTRA_PROFILES = ("stale_leader_reads",)
+EXTRA_PROFILES = ("stale_leader_reads", "term_inflation")
 
 
 @jax.tree_util.register_dataclass
@@ -51,12 +52,25 @@ class FaultSchedule:
                                        row that is CURRENTLY leader
     crash_campaign bool [.., T]        gate: rows CURRENTLY candidate are
                                        treated as crashed this tick
+    term_inflate   bool [.., T, N]     protocol-speaking adversary: the
+                                       flagged row's election timer is
+                                       forced due this tick, so it
+                                       spontaneously campaigns — with
+                                       pre_vote off every forced tick
+                                       bumps its term (term inflation);
+                                       with pre_vote on the campaign is a
+                                       non-binding poll and the term holds
+                                       (see ``apply_term_inflation``).
+                                       None = action absent (old artifacts
+                                       and the stock profiles trace the
+                                       exact pre-extension program).
     """
 
     drop: jax.Array
     alive: jax.Array
     target_leader: jax.Array
     crash_campaign: jax.Array
+    term_inflate: Optional[jax.Array] = None
 
     @property
     def ticks(self) -> int:
@@ -79,6 +93,28 @@ def effective_faults(role: jax.Array, drop_t: jax.Array, alive_t: jax.Array,
     drop = drop_t | isolate
     alive = alive_t & ~(crash_campaign_t & (role == CANDIDATE))
     return alive, drop
+
+
+def apply_term_inflation(state, term_inflate_t: jax.Array,
+                         alive: jax.Array):
+    """Pre-step transform realizing one tick of the ``term_inflate`` action.
+
+    Flagged live non-leader rows get their election timer forced to the
+    firing point, so the KERNEL's own campaign path runs this tick — the
+    adversary speaks the protocol instead of corrupting state.  The
+    consequences are therefore exactly raft's: with ``cfg.pre_vote`` off
+    the campaign bumps the row's term every forced tick (classic term
+    inflation, etcd issue #9333 shape); with PreVote on the same force
+    only starts a non-binding poll at term+1 — no bump until a quorum
+    grants, which CheckQuorum-leased voters refuse — so the documented
+    "PreVote neutralizes term inflation" claim is checked against the
+    real kernel, not a model of it.  Leaders are exempt (a leader's timer
+    drives CheckQuorum, not campaigns), matching the vendor HUP gate.
+    """
+    force = term_inflate_t & alive & (state.role != LEADER)
+    elapsed = jnp.where(force, jnp.maximum(state.elapsed, state.timeout),
+                        state.elapsed)
+    return dataclasses.replace(state, elapsed=elapsed)
 
 
 # ---------------------------------------------------------------------------
@@ -205,6 +241,30 @@ def _gen_stale_leader_reads(key, cfg: SimConfig, ticks: int
     return dataclasses.replace(_no_faults(cfg, ticks), drop=drop)
 
 
+def _gen_term_inflation(key, cfg: SimConfig, ticks: int) -> FaultSchedule:
+    """ROADMAP item 3's protocol-speaking adversary: ONE random victim row
+    is fully partitioned away on flapping windows AND fires its election
+    timer every windowed tick — the classic rejoin-storm shape (an
+    isolated node spins elections nobody hears).  The partition matters
+    mechanically, not just narratively: a reachable leader's same-tick
+    heartbeat resets the forced timer before the campaign check, so
+    without the cut the force mostly no-ops.  With pre_vote off the
+    victim's term climbs one notch per forced tick and drags the cluster
+    through term churn at every heal; with pre_vote on each forced
+    campaign is a non-binding poll the unreachable quorum never grants,
+    and the term stays near baseline —
+    ``tools/dst_sweep.py --term-inflation-demo`` pins the contrast."""
+    kv, kw = jax.random.split(key)
+    victim = jax.random.randint(kv, (), 0, cfg.n)
+    gate = _windows(kw, ticks, 2, max(3, cfg.election_tick))
+    is_victim = jnp.arange(cfg.n, dtype=I32) == victim
+    inflate = gate[:, None] & is_victim[None, :]
+    cut = is_victim[None, :, None] | is_victim[None, None, :]
+    drop = gate[:, None, None] & cut
+    return dataclasses.replace(_no_faults(cfg, ticks),
+                               drop=drop, term_inflate=inflate)
+
+
 _GENERATORS = {
     "random_drop": _gen_random_drop,
     "partition_flapper": _gen_partition_flapper,
@@ -213,6 +273,7 @@ _GENERATORS = {
     "crash_restart": _gen_crash_restart,
     "crash_during_campaign": _gen_crash_during_campaign,
     "stale_leader_reads": _gen_stale_leader_reads,
+    "term_inflation": _gen_term_inflation,
 }
 
 
@@ -249,9 +310,16 @@ def make_batch(cfg: SimConfig, ticks: int, schedules: int, seed: int,
         sub = jax.vmap(lambda k, g=gen: g(k, cfg, ticks))(keys)
         for pos, s in enumerate(idx):
             stacks[s] = jax.tree_util.tree_map(lambda a: a[pos], sub)
+    scheds = [stacks[s] for s in range(schedules)]
+    # a batch mixing term_inflation with inflation-less profiles must agree
+    # on tree structure: promote the Nones to all-False gates (value-
+    # identical — the transform is the identity on an all-False mask)
+    if any(s.term_inflate is not None for s in scheds):
+        zero = jnp.zeros((ticks, cfg.n), bool)
+        scheds = [dataclasses.replace(s, term_inflate=zero)
+                  if s.term_inflate is None else s for s in scheds]
     batch = jax.tree_util.tree_map(
-        lambda *leaves: jnp.stack(leaves),
-        *[stacks[s] for s in range(schedules)])
+        lambda *leaves: jnp.stack(leaves), *scheds)
     return batch, names
 
 

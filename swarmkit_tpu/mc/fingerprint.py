@@ -1,0 +1,127 @@
+"""SimState fingerprints: order-salted hash32 folds (Zobrist hashing).
+
+A fingerprint must be (a) computable on device inside the vmapped expand
+pass, (b) position-sensitive (swapping two rows' terms must change it),
+and (c) stable across processes — it feeds the dedup sets, the LTS node
+ids, and the cross-process stability test.  The construction is the model
+checker's classic Zobrist form: every uint32 word of the flattened state
+is XOR'd in as ``hash32(word ^ hash32(position))``, so each (position,
+value) pair contributes an independent pseudo-random mask and the fold is
+one vectorized hash + XOR-reduce, no sequential chain.  Two such folds
+with different salt constants give 64 bits: at the documented scopes
+(~1e6 states) the birthday bound is ~1e-7, and a collision can only MERGE
+two states (under-approximation — may hide, never fabricate, a
+violation).
+
+Everything here keys off `hash32` (raft/sim/state.py) — integer math
+only, independent of PYTHONHASHSEED and process identity.
+
+Fingerprints are comparable only between states of the SAME SimConfig:
+the flattened word stream is the register_dataclass leaf order, and which
+Optional field groups exist (reads, telemetry, mailboxes) is a cfg
+choice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+
+import jax
+import jax.numpy as jnp
+
+from swarmkit_tpu.raft.sim.state import SimState, hash32
+
+U32 = jnp.uint32
+
+_SALT1 = 0x9E3779B9   # golden-ratio constants, distinct per fold
+_SALT2 = 0x6A09E667
+
+
+def _words(state: SimState) -> jax.Array:
+    """[W] uint32: every leaf raveled and bit-widened, field order."""
+    ws = [leaf.astype(U32).ravel()
+          for leaf in jax.tree_util.tree_leaves(state)]
+    return jnp.concatenate(ws)
+
+
+def fingerprint(state: SimState) -> jax.Array:
+    """[2] uint32 (hi, lo) fingerprint of ONE state; vmap for a frontier."""
+    w = _words(state)
+    pos = jnp.arange(w.size, dtype=U32)
+    h1 = hash32(w ^ hash32(pos + U32(_SALT1)))
+    h2 = hash32((w + U32(_SALT2)) ^ hash32(pos ^ U32(_SALT2)))
+    f1 = jax.lax.reduce(h1, U32(0), jax.lax.bitwise_xor, (0,))
+    f2 = jax.lax.reduce(h2, U32(0), jax.lax.bitwise_xor, (0,))
+    return jnp.stack([f1, f2])
+
+
+# ---------------------------------------------------------------------------
+# node relabeling (the optional symmetry reduction)
+
+# [N, N(, K)] leaves permute BOTH leading axes; these carry node indices
+# as VALUES and remap them through the inverse permutation (NONE = -1
+# passes through).  Every other non-global field is a plain [N, ...] row
+# permute.
+_PAIR_FIELDS = frozenset((
+    "match", "next_", "granted", "rejected", "recent_active", "member",
+    "vreq_at", "vreq_term", "vreq_pre", "vresp_at", "vresp_term",
+    "vresp_grant", "vresp_pre", "app_at", "app_prev", "app_term",
+    "snp_at", "snp_term", "probing", "aresp_at", "aresp_term",
+    "aresp_match", "aresp_ok", "hb_at", "hb_term", "hb_commit",
+    "hbr_at", "hbr_term",
+))
+_INDEX_VALUED = frozenset(("vote", "lead", "transferee", "tn_from"))
+_GLOBAL_FIELDS = frozenset((
+    "tick", "stats", "tel_commit_hist", "tel_elect_hist", "tel_read_hist",
+    "tel_series",
+))
+
+
+def relabel_state(state: SimState, perm) -> SimState:
+    """Relabel nodes: new row k is old row perm[k], index values follow.
+
+    NOT behavior-preserving in general: ``rand_timeout(cfg, node, term)``
+    keys on the ROW INDEX, so a relabeled state draws different future
+    election timeouts than the original (its `timeout` field keeps the
+    permuted historical draws).  That is exactly why the symmetry-
+    canonical dedup below is an opt-in heuristic rather than part of the
+    exhaustive claim.
+    """
+    n = state.vote.shape[-1]
+    perm = jnp.asarray(perm, jnp.int32)
+    inv = jnp.zeros((n,), jnp.int32).at[perm].set(
+        jnp.arange(n, dtype=jnp.int32))
+
+    def remap(a):
+        return jnp.where(a >= 0, inv[jnp.clip(a, 0, n - 1)], a)
+
+    out = {}
+    for f in dataclasses.fields(state):
+        v = getattr(state, f.name)
+        if v is None or f.name in _GLOBAL_FIELDS:
+            out[f.name] = v
+        elif f.name in _PAIR_FIELDS:
+            out[f.name] = jnp.take(jnp.take(v, perm, axis=0), perm, axis=1)
+        elif f.name in _INDEX_VALUED:
+            out[f.name] = jnp.take(remap(v), perm, axis=0)
+        else:
+            out[f.name] = jnp.take(v, perm, axis=0)
+    return SimState(**out)
+
+
+def canonical_fingerprint(state: SimState, n: int) -> jax.Array:
+    """[2] uint32: lexicographic minimum of `fingerprint` over all n!
+    node relabelings — symmetric states collapse to one value.  Opt-in
+    (``exhaustive_scan(symmetry=True)``): see `relabel_state` for why
+    this reduction is a heuristic against the real kernel."""
+    best = None
+    for perm in itertools.permutations(range(n)):
+        fp = fingerprint(relabel_state(state, perm))
+        if best is None:
+            best = fp
+        else:
+            less = (fp[0] < best[0]) | ((fp[0] == best[0])
+                                        & (fp[1] < best[1]))
+            best = jnp.where(less, fp, best)
+    return best

@@ -1,0 +1,32 @@
+"""swarm_mc_* metric names — the device vocabulary's scrape-side schema.
+
+``tools/metrics_lint.py`` check #7 pins these constants to the catalog in
+both directions (every constant has a spec with exactly these labels,
+every swarm_mc_* spec has a constant), the same lockstep discipline the
+flight recorder (check #5) and telemetry plane (check #6) get.
+"""
+
+METRIC_BRANCHES = "swarm_mc_branches_total"
+METRIC_STATES = "swarm_mc_states_total"
+METRIC_VIOLATIONS = "swarm_mc_violations_total"
+METRIC_BRANCH_RATE = "swarm_mc_branches_per_second"
+METRIC_FRONTIER_PEAK = "swarm_mc_frontier_peak_states"
+METRIC_TRUNCATIONS = "swarm_mc_truncations_total"
+
+# name -> required label names, exactly as the catalog must declare them
+METRIC_NAMES = {
+    METRIC_BRANCHES: ("result",),          # clean | violation
+    METRIC_STATES: ("kind",),              # unique | duplicate
+    METRIC_VIOLATIONS: ("invariant",),     # dst BIT_NAMES values
+    METRIC_BRANCH_RATE: ("scope",),
+    METRIC_FRONTIER_PEAK: ("scope",),
+    METRIC_TRUNCATIONS: ("scope",),
+}
+
+# one valid value per label, for the lint's publishability probe
+SAMPLE_LABELS = {
+    "result": "clean",
+    "kind": "unique",
+    "invariant": "election_safety",
+    "scope": "n3h8",
+}

