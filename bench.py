@@ -224,7 +224,7 @@ def measure(jax, n: int, entries: int, seed: int, election_tick: int,
             latency: int = 0, latency_jitter: int = 0, inflight: int = 1,
             log_len: int = 8192, read_batch: int = 0,
             read_leases: bool = True, peer_chunk: int | None = None,
-            shard: bool = False, **run_kw):
+            active_rows: int | None = None, shard: bool = False, **run_kw):
     """Elect a leader, then time one compiled steady-state replication run of
     ~`entries` committed entries. Returns a dict of measurements; raises
     MeasureError if no leader emerges.
@@ -275,7 +275,13 @@ def measure(jax, n: int, entries: int, seed: int, election_tick: int,
                     # reductions once n > peer_chunk), 0 pins the dense
                     # [N, N] tallies (the densepeer tripwire's reference)
                     **({} if peer_chunk is None
-                       else {"peer_chunk": peer_chunk}))
+                       else {"peer_chunk": peer_chunk}),
+                    # active_rows picks the progress lowering: None keeps
+                    # the SimConfig default ([A, N] role-sparse slabs), 0
+                    # pins the dense elementwise per-peer writes (the
+                    # sparseprog tripwire's reference)
+                    **({} if active_rows is None
+                       else {"active_rows": active_rows}))
     # shard=True runs the whole flow row-sharded over the device mesh
     # (32768-sharded config): with the banded peer reductions the kernel
     # never materializes a full [N, N] intermediate, so each device only
@@ -566,6 +572,15 @@ def main() -> None:
             # banded lowering regressed, and dense collapsing means the
             # fallback did
             ("1024-densepeer", 1024, {"_peer_ab": True}),
+            # progress-lowering regression tripwire (handled specially
+            # below): the SAME shape measured with dense elementwise
+            # per-peer progress writes (active_rows=0) and with the
+            # role-sparse [A, N] slab lowering (active_rows=16); the
+            # pinned signal is the sparse/dense rate ratio — the sparse
+            # tick skips the O(N^2) progress writes entirely in steady
+            # state, so the ratio collapsing toward 1.0 means the slab
+            # lowering regressed (or the fallback is firing every tick)
+            ("4096-sparseprog", 4096, {"_sparse_ab": True}),
             # sharded headline rung: rows sharded over the device mesh
             # with banded peer reductions — no device ever materializes a
             # full [N, N] intermediate, only its row slab plus one
@@ -594,6 +609,12 @@ def main() -> None:
                     # banding is legal (peer_chunk scales with n below)
                     name = f"{name}-reduced-n256"
                     cn = 256
+                elif "sparseprog" in name:
+                    # the sparse-vs-dense progress ratio is measurable at
+                    # any n comfortably above active_rows; n=1024 keeps
+                    # the CPU A/B pair inside the budget
+                    name = f"{name}-reduced-n1024"
+                    cn = 1024
                 elif "sharded" in name:
                     # ISSUE 7: the 32k sharded rung runs CPU-reduced on
                     # the 8-virtual-device mesh; the no-[N,N]-buffer
@@ -635,6 +656,36 @@ def main() -> None:
                         RESULT.setdefault(
                             "note", f"peer-tiling tripwire: banded rate "
                             f"{bm['rate']:,.0f} < 0.7x dense "
+                            f"{dm['rate']:,.0f} at {name}")
+                    continue
+                if kw.pop("_sparse_ab", False):
+                    # sparseprog tripwire: one shape, both progress
+                    # lowerings; the pinned signal is the sparse/dense
+                    # rate ratio (steady state, so the slab path should
+                    # win outright — see PERF.md "Role-sparse progress")
+                    ar = 16
+                    dm = measure(jax, cn, target_entries, seed=7,
+                                 election_tick=election_tick_for(cn),
+                                 active_rows=0, **kw)
+                    sm = measure(jax, cn, target_entries, seed=7,
+                                 election_tick=election_tick_for(cn),
+                                 active_rows=ar, **kw)
+                    ratio = sm["rate"] / dm["rate"]
+                    _bench_gauges(f"{name}-dense", dm)
+                    _bench_gauges(f"{name}-sparse-a{ar}", sm)
+                    st_tel = _telemetry_json(sm)
+                    if st_tel is not None:
+                        tel_extra[name] = st_tel
+                    extra[name] = {
+                        "dense": round(dm["rate"], 1),
+                        f"sparse_a{ar}": round(sm["rate"], 1),
+                        "sparse_over_dense": round(ratio, 3)}
+                    log(f"config {name}: dense {dm['rate']:,.0f} vs sparse "
+                        f"{sm['rate']:,.0f} entries/s ({ratio:.2f}x)")
+                    if ratio < 1.0:
+                        RESULT.setdefault(
+                            "note", f"sparse-progress tripwire: sparse "
+                            f"rate {sm['rate']:,.0f} < dense "
                             f"{dm['rate']:,.0f} at {name}")
                     continue
                 cm = measure(jax, cn, target_entries, seed=7,

@@ -111,9 +111,11 @@ CATALOG: dict[str, MetricSpec] = {
         "gauge", "Analytic per-tick kernel bytes read+written by phase and "
         "kernel variant: the C/E/F log-buffer hot phases (tools/"
         "perf_model.py --tiled; variant tiled / full), the read path "
-        "(--reads; variant lease / readindex), and the peer-axis quorum "
+        "(--reads; variant lease / readindex), the peer-axis quorum "
         "reductions phase=votes|commit (--peer-tiled; variant banded / "
-        "dense).", ("phase", "variant")),
+        "dense), and the elementwise per-peer progress writes "
+        "phase=progress (--active-rows; variant sparse / dense).",
+        ("phase", "variant")),
     "swarm_kernel_elections_started_total": MetricSpec(
         "counter", "On-device cumulative campaigns across all rows "
         "(SimState.stats[0]).", ()),

@@ -91,7 +91,8 @@ import jax.numpy as jnp
 
 from swarmkit_tpu.raft.sim.state import (
     CANDIDATE, CONF_REMOVE, CONF_TAG, CONF_TARGET_MASK, FOLLOWER, LEADER,
-    NONE, SimConfig, SimState, hash32, latency_matrix, rand_timeout,
+    NONE, SimConfig, SimState, hash32, latency_at, latency_matrix,
+    rand_timeout,
 )
 
 I32 = jnp.int32
@@ -251,7 +252,8 @@ def step(state: SimState, cfg: SimConfig,
         prop_last0 = last
         prop_anchor = prop_last0 + prop_cnt
         last = last + jnp.where(prop_ok, prop_cnt, 0).astype(I32)
-        match = jnp.where(prop_ok[:, None] & eye, last[:, None], match)
+        # (the match-diagonal bump rides the first progress segment below,
+        # where match is held as an [A, N] slab under the sparse lowering)
 
     # Per-row membership views: every quorum decision counts over the
     # deciding row's APPLIED configuration (reference: each node's prs map
@@ -283,25 +285,37 @@ def step(state: SimState, cfg: SimConfig,
         PC, PG = cfg.peer_chunk, cfg.num_peer_chunks
 
         def _pband(x, j0):
-            """[N, peer_chunk] column band of an [N, N] matrix at j0."""
-            return jax.lax.dynamic_slice(x, (0, j0), (n, PC))
+            """[R, peer_chunk] column band of an [R, N] matrix at j0 (R is
+            n on the dense path, cfg.active_rows on a progress slab)."""
+            return jax.lax.dynamic_slice(x, (0, j0), (x.shape[0], PC))
+
+        def _peye_rows(rows_v, j0):
+            """Analytic eye band for arbitrary row ids: no [N, N] identity
+            materialized (rows_v is `node` dense, the slab ids sparse)."""
+            return rows_v[:, None] == (j0 + jnp.arange(PC,
+                                                       dtype=I32))[None, :]
 
         def _peye(j0):
-            """Analytic eye band: no [N, N] identity materialized."""
-            return node[:, None] == (j0 + jnp.arange(PC, dtype=I32))[None, :]
+            return _peye_rows(node, j0)
 
-        def _pcount(pred, masked=True):
+        def _pcount(pred, masked=True, mem=None, rows_n=None):
             """Per-row count of peers j with pred band true, hierarchical.
             `masked` folds the deciding row's membership view into each
-            band (the _mview analog; no-op under static_members)."""
+            band (the _mview analog; no-op under static_members).
+            mem/rows_n retarget the count at an [A, N] row slab for the
+            role-sparse progress path: mem is the slab's membership view,
+            rows_n its row count."""
+            mem_ = member if mem is None else mem
+            R0 = n if rows_n is None else rows_n
+
             def _grp(g, acc):
                 j0 = g * PC
                 p = pred(j0)
                 if masked and not static_m:
-                    p = p & _pband(member, j0)
+                    p = p & _pband(mem_, j0)
                 c = jnp.sum(p.astype(I32), axis=1)
                 return jax.lax.dynamic_update_slice(acc, c[:, None], (0, g))
-            parts = jax.lax.fori_loop(0, PG, _grp, jnp.zeros((n, PG), I32))
+            parts = jax.lax.fori_loop(0, PG, _grp, jnp.zeros((R0, PG), I32))
             return jnp.sum(parts, axis=1)
 
     if static_m:
@@ -341,480 +355,756 @@ def step(state: SimState, cfg: SimConfig,
     contact = jnp.where(alive, state.contact + 1, state.contact)
     hb_elapsed = jnp.where(is_leader, hb_elapsed + 1, hb_elapsed)
 
-    # CheckQuorum (vendor raft.go:536-560 tickHeartbeat + checkQuorumActive):
-    # every election_tick ticks a leader confirms it heard from a quorum of
-    # members since the last round; a partitioned stale leader steps down
-    # instead of lingering until a higher term reaches it.
-    check_due = is_leader & (elapsed >= cfg.election_tick)
-    if cfg.peer_tiled:
-        n_heard = _pcount(lambda j0: _pband(recent_active, j0) | _peye(j0))
-    else:
-        n_heard = jnp.sum(_mview(recent_active | eye).astype(I32), axis=1)
-    cq_fail = check_due & (n_heard < quorum_row)
-    role = jnp.where(cq_fail, FOLLOWER, role)
-    lead = jnp.where(cq_fail, NONE, lead)
-    elapsed = jnp.where(check_due, 0, elapsed)
-    # a quorum-confirmed leader re-arms its own lease (core CHECK_QUORUM)
-    contact = jnp.where(check_due & ~cq_fail, 0, contact)
-    recent_active = jnp.where(check_due[:, None], False, recent_active)
-    is_leader = (role == LEADER) & alive
-    # a transfer that hasn't completed within an election timeout is
-    # aborted so the leader can accept proposals again (vendor raft.go
-    # tickHeartbeat abortLeaderTransfer)
-    transferee = state.transferee
-    transferee = jnp.where(check_due, NONE, transferee)
-    transferee = jnp.where(role != LEADER, NONE, transferee)
+    # ---- role-sparse progress (cfg.active_rows_on): the active-row set --
+    # Only rows whose node is a leader or candidate ever MUTATE their own
+    # [N, N] progress view (match/next_/granted/rejected/recent_active, the
+    # per-edge mailbox slots, and the ack folds that feed them) — follower
+    # rows are dead weight on the row axis, though every row still acts as
+    # a RECEIVER along the full column axis.  The two progress segments
+    # below (_progress_a: Phase A matrix writes + Phase B + Phase C
+    # send/deliver; _progress_b: ack folds + progress integration +
+    # Phase D/R1 counts) therefore run on a compact [A, N] row slab
+    # gathered here and scattered back, with a bit-identical dense
+    # fallback cond on ticks where the active count exceeds A (election
+    # storms) — mirroring the tiled-log fallback contract.
+    #
+    # The active predicate is a strict SUPERSET of "mutates its row this
+    # tick", computed BEFORE any of this tick's role transitions:
+    #   - role != FOLLOWER: standing leaders/candidates (a row that wins
+    #     or campaigns mid-tick is covered by the terms below);
+    #   - elapsed >= timeout (live, self-member): may campaign this tick;
+    #   - tn_at > 0: a TIMEOUT_NOW delivery may force a campaign;
+    #   - active_ttl > 0: the drain window — a row keeps its slab seat for
+    #     2*(latency+latency_jitter)+2 ticks after leaving leadership, an
+    #     upper bound on the round-trip lifetime of anything it still has
+    #     in flight (in-flight acks must fold/clear on the slab).
+    # Supersets are safe: every slab update is masked by the same role
+    # conditions as the dense form, so an extra (or padding) row's slab
+    # values scatter back unchanged.  Bit-identity of the two lowerings is
+    # pinned by TestSparseProgress on all three wires plus the DST
+    # cross-check sweep.
+    sparse_on = cfg.active_rows_on
+    if sparse_on:
+        sp_act = (role != FOLLOWER) | (state.active_ttl > 0) \
+            | (alive & self_mem & (elapsed >= timeout)) | (state.tn_at > 0)
+        sp_fits = jnp.sum(sp_act.astype(I32)) <= cfg.active_rows
+        # stable sort: active rows first, in ascending row order — so slab
+        # argmax tie-breaks (lowest row wins) match the dense argmax
+        sp_rows = jnp.argsort(~sp_act, stable=True)[:cfg.active_rows] \
+            .astype(I32)
 
-    # TIMEOUT_NOW delivery (vendor stepFollower MsgTimeoutNow): the
-    # transfer target campaigns immediately — a REAL campaign even under
-    # PreVote, whose requests carry CAMPAIGN_TRANSFER and bypass leases.
-    tx_cand = state.tx_cand
-    tn_at, tn_term, tn_from = state.tn_at, state.tn_term, state.tn_from
-    tn_due = (tn_at > 0) & (state.tick + 1 >= tn_at)
-    # only followers act on an equal-term TIMEOUT_NOW (stepCandidate has no
-    # case for it); a higher-term one first demotes any non-leader to
-    # follower via the Step catch-up, which then campaigns.  The target must
-    # consider itself a member (promotable(), vendor stepFollower
-    # MsgTimeoutNow) — but the HUP conf gate does NOT apply (transfer
-    # campaigns bypass it by calling campaign directly).
-    tn_ok = tn_due & alive & self_mem & (role != LEADER) & (tn_term >= term) \
-        & ((role == FOLLOWER) | (tn_term > term))
-    # Step catch-up for a higher-term TIMEOUT_NOW: only the term carries
-    # through — role/vote/lead are immediately overwritten by the forced
-    # campaign below (vendor becomeFollower(m.Term) then campaign)
-    tn_newer = tn_ok & (tn_term > term)
-    term = jnp.where(tn_newer, tn_term, term)
-    tn_at = jnp.where(tn_due, 0, tn_at)
+    def _slabify(rows, dense):
+        """Row-slab toolkit for one progress segment instantiation.
 
-    # tickElection fires for any promotable non-leader whose timer expired
-    # (resetting the timer either way); the HUP step then refuses to
-    # campaign while a conf entry sits committed-but-unapplied (vendor
-    # raft.go Step MsgHup numOfPendingConf gate).
-    want_campaign = (alive & self_mem & (role != LEADER)
-                     & (elapsed >= timeout)) & ~tn_ok
-    elapsed = jnp.where(want_campaign, 0, elapsed)
-    campaign = want_campaign & ~state.hup_conf
-    if cfg.pre_vote:
-        # becomePreCandidate (vendor raft.go): a non-binding poll — no term
-        # bump, no vote change, no timeout re-randomization, and the known
-        # leader is KEPT (only the real campaign's reset clears it); only
-        # the vote tallies and the candidacy marker reset.
-        pre = jnp.where(campaign, True, pre)
-        role = jnp.where(campaign, CANDIDATE, role)
-        granted = jnp.where(campaign[:, None], eye, granted)
-        rejected = jnp.where(campaign[:, None], False, rejected)
-    else:
-        term = term + campaign.astype(I32)
-        vote = jnp.where(campaign, node, vote)
-        role = jnp.where(campaign, CANDIDATE, role)
-        lead = jnp.where(campaign, NONE, lead)
-        timeout = jnp.where(campaign, rand_timeout(cfg, node, term), timeout)
-        granted = jnp.where(campaign[:, None], eye, granted)
-        rejected = jnp.where(campaign[:, None], False, rejected)
-    tx_cand = tx_cand & ~campaign   # a timeout candidacy is never forced
-    # forced (transfer) campaign: always real, even under PreVote
-    term = term + tn_ok.astype(I32)
-    vote = jnp.where(tn_ok, node, vote)
-    role = jnp.where(tn_ok, CANDIDATE, role)
-    pre = pre & ~tn_ok
-    lead = jnp.where(tn_ok, NONE, lead)
-    elapsed = jnp.where(tn_ok, 0, elapsed)
-    timeout = jnp.where(tn_ok, rand_timeout(cfg, node, term), timeout)
-    granted = jnp.where(tn_ok[:, None], eye, granted)
-    rejected = jnp.where(tn_ok[:, None], False, rejected)
-    tx_cand = jnp.where(tn_ok, True, tx_cand)
+        dense=True is the reference lowering: every helper is the
+        identity, so the segment body IS the historical dense code op for
+        op (no gathers, no scatters — the fallback branch and the
+        active_rows=0 build stay bit-and-cost-identical to the pre-sparse
+        kernel).  dense=False gathers row-indexed operands into [A, N]
+        slabs and scatters merged rows back."""
+        if dense:
+            ident = lambda x: x                                # noqa: E731
+            sc = lambda full, slab: slab                       # noqa: E731
+            sfull = lambda vals, fill: vals                    # noqa: E731
+            return (n, ident, sc, sfull, eye, drop, drop.T, member,
+                    _mview)
+        R = cfg.active_rows
+        g = lambda x: x[rows]                                  # noqa: E731
 
-    # ---- Phase B: vote exchange ------------------------------------------
-    is_cand = (role == CANDIDATE) & alive
-    # CheckQuorum leader lease (vendor raft.go Step, checkQuorum branch): a
-    # receiver that heard from a live leader within the last election_tick
-    # ignores vote requests entirely — no term catch-up, no response —
-    # so a rejoining partitioned node cannot depose a healthy leader.
-    # Lease from LEADER CONTACT (not the election timer, which re-arms on
-    # every campaign attempt — core.py contact_elapsed rationale)
-    leased = (lead != NONE) & (contact < cfg.election_tick)      # [j]
-    if cfg.mailboxes:
-        # Device-mailbox wire (SURVEY §7): one in-flight message per class
-        # per directed edge; *_at stores deliver-tick+1 (0 = empty).  The
-        # drop matrix acts at SEND (a dropped message never enters the
-        # wire); receiver-side guards act at DELIVERY.
-        lat = latency_matrix(cfg, now)
-        vreq_at, vreq_term = state.vreq_at, state.vreq_term
-        vreq_pre = state.vreq_pre
-        vresp_at, vresp_term = state.vresp_at, state.vresp_term
-        vresp_grant, vresp_pre = state.vresp_grant, state.vresp_pre
-        # sends: candidates (re-)request on any edge with no message from
-        # the SAME candidacy (term, pre) still in flight (etcd does not
-        # retry within a term — the re-send on a cleared slot mirrors
-        # duplicate-tolerant voters)
-        free = (vreq_at == 0) | (vreq_term != term[:, None]) \
-            | (vreq_pre != pre[:, None])
-        # requests go only to peers in the CANDIDATE's view (etcd campaigns
-        # over its own prs map)
-        send_vr = _mview(is_cand[:, None] & ~eye & ~drop & free)
-        vreq_at = jnp.where(send_vr, now + 1 + lat, vreq_at)
-        vreq_term = jnp.where(send_vr, term[:, None], vreq_term)
-        vreq_pre = jnp.where(send_vr, pre[:, None], vreq_pre)
-        # deliveries: stale requests (sender no longer in the captured
-        # candidacy) vanish — candidate log state (last/last_term) is then
-        # safely readable at delivery, since candidates never append
-        due_vr = (vreq_at > 0) & (now + 1 >= vreq_at)
-        deliv = due_vr & (role[:, None] == CANDIDATE) \
-            & (term[:, None] == vreq_term) & (pre[:, None] == vreq_pre) \
-            & alive[None, :] & (~leased[None, :] | tx_cand[:, None])
-        req = deliv & ~pre[:, None]
-        preq = deliv & pre[:, None]
-        vreq_at = jnp.where(due_vr, 0, vreq_at)
-    else:
-        base_req = _mview(is_cand[:, None] & alive[None, :] & ~eye & ~drop
-                          & (~leased[None, :] | tx_cand[:, None]))
-        req = base_req & ~pre[:, None]
-        preq = base_req & pre[:, None]
+        def sc(full, slab):
+            """Merge a mutated [A, N] (or [A, N, K]) slab back."""
+            return full.at[rows].set(slab, unique_indices=True)
 
-    # -- PreVote exchange (vendor raft.go Step MsgPreVote): processed
-    # BEFORE real votes each tick (defined delivery order), against the
-    # receiver's pre-catch-up state; grants change NO receiver state.
+        def sfull(vals, fill):
+            """Scatter an [A] per-slab-row reduction to [N].  The fill
+            lands on inactive rows, whose dense value differs only where
+            downstream consumers are role-gated off anyway."""
+            base = jnp.full((n,) + vals.shape[1:], fill, vals.dtype)
+            return base.at[rows].set(vals, unique_indices=True)
+
+        eye_r = rows[:, None] == node[None, :]
+        member_r = member if static_m else member[rows]
+        mview_r = (lambda x: x) if static_m else (lambda x: x & member_r)
+        return (R, g, sc, sfull, eye_r, drop[rows], drop[:, rows].T,
+                member_r, mview_r)
+
+    # last/last_term are log-derived and Phase A/B never append, so both
+    # are hoisted ABOVE the progress segments: no [N, L] read ever enters
+    # the sparse/dense cond (the ring write cond stays the only cond that
+    # consumes the log carries).
     last_term = _term_own(cfg, log_term, snap_idx, snap_term, last, last)
     if fused_prop:
         # ring stores are still pending in Phase C; a proposing row's new
         # last entry carries its own pre-tick term
         last_term = jnp.where(prop_ok & (prop_cnt > 0), state.term,
                               last_term)
-    lt_i, lt_j = last_term[:, None], last_term[None, :]
-    log_ok = (lt_i > lt_j) | ((lt_i == lt_j) & (last[:, None] >= last[None, :]))
-    if cfg.pre_vote:
-        pv_term = jnp.where(preq, term[:, None] + 1, -1)  # message term
-        # below the receiver's term: silently ignored (core stale return)
-        pv_cur = preq & (pv_term >= term[None, :])
-        pv_can = (vote[None, :] == NONE) | (pv_term > term[None, :]) \
-            | (vote[None, :] == node[:, None])
-        pv_grant = pv_cur & pv_can & log_ok
-        # rejections count only when stamped with the candidacy's own term
-        # (a reject from a receiver already past term+1 is dropped in the
-        # wire; the lagging pre-candidate catches up via appends — D2')
-        pv_reject = pv_cur & ~pv_grant & (term[None, :] == term[:, None])
-        pre_cand = is_cand & pre
-        if cfg.mailboxes:
-            send_pv = (pv_grant | pv_reject) & ~drop.T
-            vresp_at = jnp.where(send_pv, now + 1 + lat.T, vresp_at)
-            vresp_term = jnp.where(send_pv, term[:, None], vresp_term)
-            vresp_pre = jnp.where(send_pv, True, vresp_pre)
-            vresp_grant = jnp.where(send_pv, pv_grant, vresp_grant)
-            due_pv = (vresp_at > 0) & (now + 1 >= vresp_at) & vresp_pre
-            rv_pv = due_pv & pre_cand[:, None] & (term[:, None] == vresp_term)
-            granted = granted | (rv_pv & vresp_grant)
-            rejected = rejected | (rv_pv & ~vresp_grant)
-            vresp_at = jnp.where(due_pv, 0, vresp_at)
-            pv_polled = jnp.any(rv_pv, axis=1)
-        else:
-            granted = granted | (pv_grant & ~drop.T & pre_cand[:, None])
-            rejected = rejected | (pv_reject & ~drop.T & pre_cand[:, None])
-            pv_polled = jnp.any((pv_grant | pv_reject) & ~drop.T
-                                & pre_cand[:, None], axis=1)
-        # Pre-quorum -> REAL campaign, evaluated BEFORE the real exchange
-        # (vendor stepCandidate transitions the moment the poll reaches
-        # quorum): bump term, vote self, reset tallies, re-randomize the
-        # timeout.  Real vote requests go out next send opportunity.
-        # Evaluated only on POLL EVENTS (fresh candidacy or a response
-        # arrival, core._poll call sites): a conf change shrinking the
-        # quorum must not retro-promote a stale tally between arrivals.
+
+    def _progress_a(rows, dense, term=term, vote=vote, role=role, lead=lead,
+                    elapsed=elapsed, contact=contact,
+                    hb_elapsed=hb_elapsed, timeout=timeout, pre=pre,
+                    last=last, commit=commit, pending_conf=pending_conf,
+                    is_leader=is_leader, match=match, next_=next_,
+                    granted=granted, rejected=rejected,
+                    recent_active=recent_active):
+        """Progress segment 1: Phase A's matrix tail (CheckQuorum count +
+        campaign tally resets), all of Phase B, and Phase C's send/deliver
+        half — every [N, N]/[N, N, K] op whose ROW index is a
+        leader/candidate — instantiated either dense (rows=node: the
+        historical lowering, op for op) or on the [A, N] active-row slab.
+        [N]-vector logic is row/column mixed and cheap, so it stays
+        verbatim at full width in both instantiations; only matrix
+        operands go through the slab toolkit.  Column-axis reductions over
+        the sender axis are exact on the slab because every true sender
+        row is active and padding rows reduce at the identity (-1 max /
+        big min / False any) via the same role masks the dense form uses;
+        slab argmaxes map back through `rows` (ascending, so ties break
+        identically) and are normalized with their any-gate (dense argmax
+        of an all-False column is 0, so the normalized form is
+        bit-identical there too)."""
+        (R, g, sc, sfull, eye_r, drop_r, dropT_r, member_r,
+         mview_r) = _slabify(rows, dense)
+        match0, next0, granted0, rejected0, ra0 = (
+            match, next_, granted, rejected, recent_active)
+        match, next_, granted = g(match), g(next_), g(granted)
+        rejected, recent_active = g(rejected), g(recent_active)
+        if fused_prop:
+            # the fused propose's match-diag store (deferred from the
+            # pre-segment cursor block: prop_ok rows are leaders, hence
+            # active, and nothing reads match before Phase B)
+            match = jnp.where(g(prop_ok)[:, None] & eye_r,
+                              g(last)[:, None], match)
+
+        # CheckQuorum (vendor raft.go:536-560 tickHeartbeat +
+        # checkQuorumActive): every election_tick ticks a leader confirms
+        # it heard from a quorum of members since the last round; a
+        # partitioned stale leader steps down instead of lingering until a
+        # higher term reaches it.
+        check_due = is_leader & (elapsed >= cfg.election_tick)
         if cfg.peer_tiled:
-            votes_pv = _pcount(lambda j0: _pband(granted, j0))
+            n_heard = sfull(_pcount(
+                lambda j0: _pband(recent_active, j0) | _peye_rows(rows, j0),
+                mem=member_r, rows_n=R), 0)
         else:
-            votes_pv = jnp.sum(_mview(granted).astype(I32), axis=1)
-        pre_win = pre_cand & (votes_pv >= quorum_row) \
-            & (campaign | pv_polled)
-        term = term + pre_win.astype(I32)
-        vote = jnp.where(pre_win, node, vote)
-        pre = jnp.where(pre_win, False, pre)
-        lead = jnp.where(pre_win, NONE, lead)  # becomeCandidate reset
-        elapsed = jnp.where(pre_win, 0, elapsed)
-        timeout = jnp.where(pre_win, rand_timeout(cfg, node, term), timeout)
-        granted = jnp.where(pre_win[:, None], eye, granted)
-        rejected = jnp.where(pre_win[:, None], False, rejected)
+            n_heard = sfull(jnp.sum(mview_r(recent_active | eye_r)
+                                    .astype(I32), axis=1), 0)
+        cq_fail = check_due & (n_heard < quorum_row)
+        role = jnp.where(cq_fail, FOLLOWER, role)
+        lead = jnp.where(cq_fail, NONE, lead)
+        elapsed = jnp.where(check_due, 0, elapsed)
+        # a quorum-confirmed leader re-arms its own lease (core
+        # CHECK_QUORUM)
+        contact = jnp.where(check_due & ~cq_fail, 0, contact)
+        recent_active = jnp.where(g(check_due)[:, None], False,
+                                  recent_active)
+        is_leader = (role == LEADER) & alive
+        # a transfer that hasn't completed within an election timeout is
+        # aborted so the leader can accept proposals again (vendor raft.go
+        # tickHeartbeat abortLeaderTransfer)
+        transferee = state.transferee
+        transferee = jnp.where(check_due, NONE, transferee)
+        transferee = jnp.where(role != LEADER, NONE, transferee)
 
-    # -- real vote exchange.
-    # Receiver-side term catch-up (Step m.Term > r.Term with MsgVote).
-    req_term = jnp.where(req, term[:, None], -1)
-    mt = jnp.max(req_term, axis=0)                               # [j]
-    newer = mt > term
-    term = jnp.where(newer, mt, term)
-    role = jnp.where(newer, FOLLOWER, role)
-    vote = jnp.where(newer, NONE, vote)
-    lead = jnp.where(newer, NONE, lead)
-    # become_follower(m.term) runs _reset: timer zeroed, timeout re-rolled
-    # at the new term (deterministic per (node, term))
-    elapsed = jnp.where(newer, 0, elapsed)
-    timeout = jnp.where(newer, rand_timeout(cfg, node, term), timeout)
-    is_cand = (role == CANDIDATE) & alive  # stepped-down candidates drop out
+        # TIMEOUT_NOW delivery (vendor stepFollower MsgTimeoutNow): the
+        # transfer target campaigns immediately — a REAL campaign even
+        # under PreVote, whose requests carry CAMPAIGN_TRANSFER and bypass
+        # leases.
+        tx_cand = state.tx_cand
+        tn_at, tn_term, tn_from = state.tn_at, state.tn_term, state.tn_from
+        tn_due = (tn_at > 0) & (state.tick + 1 >= tn_at)
+        # only followers act on an equal-term TIMEOUT_NOW (stepCandidate
+        # has no case for it); a higher-term one first demotes any
+        # non-leader to follower via the Step catch-up, which then
+        # campaigns.  The target must consider itself a member
+        # (promotable(), vendor stepFollower MsgTimeoutNow) — but the HUP
+        # conf gate does NOT apply (transfer campaigns bypass it by
+        # calling campaign directly).
+        tn_ok = tn_due & alive & self_mem & (role != LEADER) \
+            & (tn_term >= term) & ((role == FOLLOWER) | (tn_term > term))
+        # Step catch-up for a higher-term TIMEOUT_NOW: only the term
+        # carries through — role/vote/lead are immediately overwritten by
+        # the forced campaign below (vendor becomeFollower(m.Term) then
+        # campaign)
+        tn_newer = tn_ok & (tn_term > term)
+        term = jnp.where(tn_newer, tn_term, term)
+        tn_at = jnp.where(tn_due, 0, tn_at)
 
-    # (last_term / log_ok computed above the PreVote block; Phase B never
-    # mutates log state, so they stay valid here.)
-    can_vote = (vote[None, :] == NONE) | (vote[None, :] == node[:, None])
-    # Compare the SEND-TIME candidate term (req_term) with the receiver's
-    # post-catch-up term: a candidate whose own term was bumped this tick by
-    # a higher-term rival must not have its stale request treated as current.
-    cur = req & (req_term == term[None, :])  # requests at the rx's term
-    grantable = cur & can_vote & log_ok
-    any_grant = jnp.any(grantable, axis=0)                       # [j]
-    chosen_cand = jnp.argmax(grantable, axis=0).astype(I32)      # first True
-    grant_mat = grantable & (node[:, None] == chosen_cand[None, :])
-    vote = jnp.where(any_grant, chosen_cand, vote)
-    elapsed = jnp.where(any_grant, 0, elapsed)
-    # Responses travel j -> i; may be dropped independently. Requests that
-    # were processed at the receiver's term but not granted come back as
-    # rejections (vendor raft.go:988-1060 stepCandidate poll).
+        # tickElection fires for any promotable non-leader whose timer
+        # expired (resetting the timer either way); the HUP step then
+        # refuses to campaign while a conf entry sits
+        # committed-but-unapplied (vendor raft.go Step MsgHup
+        # numOfPendingConf gate).
+        want_campaign = (alive & self_mem & (role != LEADER)
+                         & (elapsed >= timeout)) & ~tn_ok
+        elapsed = jnp.where(want_campaign, 0, elapsed)
+        campaign = want_campaign & ~state.hup_conf
+        if cfg.pre_vote:
+            # becomePreCandidate (vendor raft.go): a non-binding poll — no
+            # term bump, no vote change, no timeout re-randomization, and
+            # the known leader is KEPT (only the real campaign's reset
+            # clears it); only the vote tallies and the candidacy marker
+            # reset.
+            pre = jnp.where(campaign, True, pre)
+            role = jnp.where(campaign, CANDIDATE, role)
+            granted = jnp.where(g(campaign)[:, None], eye_r, granted)
+            rejected = jnp.where(g(campaign)[:, None], False, rejected)
+        else:
+            term = term + campaign.astype(I32)
+            vote = jnp.where(campaign, node, vote)
+            role = jnp.where(campaign, CANDIDATE, role)
+            lead = jnp.where(campaign, NONE, lead)
+            timeout = jnp.where(campaign, rand_timeout(cfg, node, term),
+                                timeout)
+            granted = jnp.where(g(campaign)[:, None], eye_r, granted)
+            rejected = jnp.where(g(campaign)[:, None], False, rejected)
+        tx_cand = tx_cand & ~campaign  # a timeout candidacy is never forced
+        # forced (transfer) campaign: always real, even under PreVote
+        term = term + tn_ok.astype(I32)
+        vote = jnp.where(tn_ok, node, vote)
+        role = jnp.where(tn_ok, CANDIDATE, role)
+        pre = pre & ~tn_ok
+        lead = jnp.where(tn_ok, NONE, lead)
+        elapsed = jnp.where(tn_ok, 0, elapsed)
+        timeout = jnp.where(tn_ok, rand_timeout(cfg, node, term), timeout)
+        granted = jnp.where(g(tn_ok)[:, None], eye_r, granted)
+        rejected = jnp.where(g(tn_ok)[:, None], False, rejected)
+        tx_cand = jnp.where(tn_ok, True, tx_cand)
+
+        # ---- Phase B: vote exchange --------------------------------------
+        is_cand = (role == CANDIDATE) & alive
+        # CheckQuorum leader lease (vendor raft.go Step, checkQuorum
+        # branch): a receiver that heard from a live leader within the
+        # last election_tick ignores vote requests entirely — no term
+        # catch-up, no response — so a rejoining partitioned node cannot
+        # depose a healthy leader.  Lease from LEADER CONTACT (not the
+        # election timer, which re-arms on every campaign attempt —
+        # core.py contact_elapsed rationale)
+        leased = (lead != NONE) & (contact < cfg.election_tick)  # [j]
+        if cfg.mailboxes:
+            # Device-mailbox wire (SURVEY §7): one in-flight message per
+            # class per directed edge; *_at stores deliver-tick+1
+            # (0 = empty).  The drop matrix acts at SEND (a dropped
+            # message never enters the wire); receiver-side guards act at
+            # DELIVERY.  On the slab, latency rows rebuild analytically
+            # (latency_at) — no [N, N] latency matrix materializes.
+            if dense:
+                lat = latency_matrix(cfg, now)
+                lat_T = lat.T
+            else:
+                lat = latency_at(cfg, now, rows[:, None], node[None, :])
+                lat_T = latency_at(cfg, now, node[None, :], rows[:, None])
+            vreq_at, vreq_term = g(state.vreq_at), g(state.vreq_term)
+            vreq_pre = g(state.vreq_pre)
+            vresp_at, vresp_term = g(state.vresp_at), g(state.vresp_term)
+            vresp_grant, vresp_pre = (g(state.vresp_grant),
+                                      g(state.vresp_pre))
+            # sends: candidates (re-)request on any edge with no message
+            # from the SAME candidacy (term, pre) still in flight (etcd
+            # does not retry within a term — the re-send on a cleared slot
+            # mirrors duplicate-tolerant voters)
+            free = (vreq_at == 0) | (vreq_term != g(term)[:, None]) \
+                | (vreq_pre != g(pre)[:, None])
+            # requests go only to peers in the CANDIDATE's view (etcd
+            # campaigns over its own prs map)
+            send_vr = mview_r(g(is_cand)[:, None] & ~eye_r & ~drop_r
+                              & free)
+            vreq_at = jnp.where(send_vr, now + 1 + lat, vreq_at)
+            vreq_term = jnp.where(send_vr, g(term)[:, None], vreq_term)
+            vreq_pre = jnp.where(send_vr, g(pre)[:, None], vreq_pre)
+            # deliveries: stale requests (sender no longer in the captured
+            # candidacy) vanish — candidate log state (last/last_term) is
+            # then safely readable at delivery, since candidates never
+            # append
+            due_vr = (vreq_at > 0) & (now + 1 >= vreq_at)
+            deliv = due_vr & (g(role)[:, None] == CANDIDATE) \
+                & (g(term)[:, None] == vreq_term) \
+                & (g(pre)[:, None] == vreq_pre) \
+                & alive[None, :] & (~leased[None, :] | g(tx_cand)[:, None])
+            req = deliv & ~g(pre)[:, None]
+            preq = deliv & g(pre)[:, None]
+            vreq_at = jnp.where(due_vr, 0, vreq_at)
+        else:
+            base_req = mview_r(g(is_cand)[:, None] & alive[None, :]
+                               & ~eye_r & ~drop_r
+                               & (~leased[None, :] | g(tx_cand)[:, None]))
+            req = base_req & ~g(pre)[:, None]
+            preq = base_req & g(pre)[:, None]
+
+        # -- PreVote exchange (vendor raft.go Step MsgPreVote): processed
+        # BEFORE real votes each tick (defined delivery order), against
+        # the receiver's pre-catch-up state; grants change NO receiver
+        # state.  (last_term is hoisted above the segments — no log read
+        # in here.)
+        lt_i, lt_j = g(last_term)[:, None], last_term[None, :]
+        log_ok = (lt_i > lt_j) \
+            | ((lt_i == lt_j) & (g(last)[:, None] >= last[None, :]))
+        if cfg.pre_vote:
+            pv_term = jnp.where(preq, g(term)[:, None] + 1, -1)  # msg term
+            # below the receiver's term: silently ignored (core stale
+            # return)
+            pv_cur = preq & (pv_term >= term[None, :])
+            pv_can = (vote[None, :] == NONE) | (pv_term > term[None, :]) \
+                | (vote[None, :] == rows[:, None])
+            pv_grant = pv_cur & pv_can & log_ok
+            # rejections count only when stamped with the candidacy's own
+            # term (a reject from a receiver already past term+1 is
+            # dropped in the wire; the lagging pre-candidate catches up
+            # via appends — D2')
+            pv_reject = pv_cur & ~pv_grant \
+                & (term[None, :] == g(term)[:, None])
+            pre_cand = is_cand & pre
+            if cfg.mailboxes:
+                send_pv = (pv_grant | pv_reject) & ~dropT_r
+                vresp_at = jnp.where(send_pv, now + 1 + lat_T, vresp_at)
+                vresp_term = jnp.where(send_pv, g(term)[:, None],
+                                       vresp_term)
+                vresp_pre = jnp.where(send_pv, True, vresp_pre)
+                vresp_grant = jnp.where(send_pv, pv_grant, vresp_grant)
+                due_pv = (vresp_at > 0) & (now + 1 >= vresp_at) & vresp_pre
+                rv_pv = due_pv & g(pre_cand)[:, None] \
+                    & (g(term)[:, None] == vresp_term)
+                granted = granted | (rv_pv & vresp_grant)
+                rejected = rejected | (rv_pv & ~vresp_grant)
+                vresp_at = jnp.where(due_pv, 0, vresp_at)
+                pv_polled = sfull(jnp.any(rv_pv, axis=1), False)
+            else:
+                granted = granted | (pv_grant & ~dropT_r
+                                     & g(pre_cand)[:, None])
+                rejected = rejected | (pv_reject & ~dropT_r
+                                       & g(pre_cand)[:, None])
+                pv_polled = sfull(jnp.any((pv_grant | pv_reject) & ~dropT_r
+                                          & g(pre_cand)[:, None], axis=1),
+                                  False)
+            # Pre-quorum -> REAL campaign, evaluated BEFORE the real
+            # exchange (vendor stepCandidate transitions the moment the
+            # poll reaches quorum): bump term, vote self, reset tallies,
+            # re-randomize the timeout.  Real vote requests go out next
+            # send opportunity.  Evaluated only on POLL EVENTS (fresh
+            # candidacy or a response arrival, core._poll call sites): a
+            # conf change shrinking the quorum must not retro-promote a
+            # stale tally between arrivals.
+            if cfg.peer_tiled:
+                votes_pv = sfull(_pcount(
+                    lambda j0: _pband(granted, j0),
+                    mem=member_r, rows_n=R), 0)
+            else:
+                votes_pv = sfull(jnp.sum(mview_r(granted).astype(I32),
+                                         axis=1), 0)
+            pre_win = pre_cand & (votes_pv >= quorum_row) \
+                & (campaign | pv_polled)
+            term = term + pre_win.astype(I32)
+            vote = jnp.where(pre_win, node, vote)
+            pre = jnp.where(pre_win, False, pre)
+            lead = jnp.where(pre_win, NONE, lead)  # becomeCandidate reset
+            elapsed = jnp.where(pre_win, 0, elapsed)
+            timeout = jnp.where(pre_win, rand_timeout(cfg, node, term),
+                                timeout)
+            granted = jnp.where(g(pre_win)[:, None], eye_r, granted)
+            rejected = jnp.where(g(pre_win)[:, None], False, rejected)
+
+        # -- real vote exchange.
+        # Receiver-side term catch-up (Step m.Term > r.Term with MsgVote).
+        req_term = jnp.where(req, g(term)[:, None], -1)
+        mt = jnp.max(req_term, axis=0)                           # [j]
+        newer = mt > term
+        term = jnp.where(newer, mt, term)
+        role = jnp.where(newer, FOLLOWER, role)
+        vote = jnp.where(newer, NONE, vote)
+        lead = jnp.where(newer, NONE, lead)
+        # become_follower(m.term) runs _reset: timer zeroed, timeout
+        # re-rolled at the new term (deterministic per (node, term))
+        elapsed = jnp.where(newer, 0, elapsed)
+        timeout = jnp.where(newer, rand_timeout(cfg, node, term), timeout)
+        is_cand = (role == CANDIDATE) & alive  # stepped-down candidates
+        #                                        drop out
+
+        # (last_term / log_ok computed above the PreVote block; Phase B
+        # never mutates log state, so they stay valid here.)
+        can_vote = (vote[None, :] == NONE) | (vote[None, :] == rows[:, None])
+        # Compare the SEND-TIME candidate term (req_term) with the
+        # receiver's post-catch-up term: a candidate whose own term was
+        # bumped this tick by a higher-term rival must not have its stale
+        # request treated as current.
+        cur = req & (req_term == term[None, :])  # requests at the rx term
+        grantable = cur & can_vote & log_ok
+        any_grant = jnp.any(grantable, axis=0)                   # [j]
+        # first True; slab positions map back through `rows` (ascending,
+        # so the lowest-row tie-break is preserved), gated on any_grant
+        # (dense argmax of an all-False column is 0 — identical)
+        chosen_cand = jnp.where(any_grant,
+                                rows[jnp.argmax(grantable, axis=0)],
+                                0).astype(I32)
+        grant_mat = grantable & (rows[:, None] == chosen_cand[None, :])
+        vote = jnp.where(any_grant, chosen_cand, vote)
+        elapsed = jnp.where(any_grant, 0, elapsed)
+        # Responses travel j -> i; may be dropped independently. Requests
+        # that were processed at the receiver's term but not granted come
+        # back as rejections (vendor raft.go:988-1060 stepCandidate poll).
+        if cfg.mailboxes:
+            # enqueue responses on the reverse edge; a response already in
+            # flight on that edge is superseded (it addressed an older
+            # term and would be guard-dropped at delivery anyway)
+            send_vresp = cur & ~dropT_r
+            vresp_at = jnp.where(send_vresp, now + 1 + lat_T, vresp_at)
+            vresp_term = jnp.where(send_vresp, term[None, :], vresp_term)
+            vresp_pre = jnp.where(send_vresp, False, vresp_pre)
+            vresp_grant = jnp.where(send_vresp, grant_mat, vresp_grant)
+            due_vs = (vresp_at > 0) & (now + 1 >= vresp_at)
+            rvalid = due_vs & g(is_cand)[:, None] \
+                & (g(term)[:, None] == vresp_term) \
+                & (g(pre)[:, None] == vresp_pre)
+            granted = granted | (rvalid & vresp_grant)
+            rejected = rejected | (rvalid & ~vresp_grant)
+            vresp_at = jnp.where(due_vs, 0, vresp_at)
+            v_polled = sfull(jnp.any(rvalid & ~vresp_pre, axis=1), False)
+        else:
+            real_cand = is_cand & ~pre
+            resp_arrive = grant_mat & ~dropT_r
+            granted = granted | (resp_arrive & g(real_cand)[:, None])
+            reject_arrive = cur & ~grant_mat & ~dropT_r
+            rejected = rejected | (reject_arrive & g(real_cand)[:, None])
+            v_polled = sfull(jnp.any((resp_arrive | reject_arrive)
+                                     & g(real_cand)[:, None], axis=1),
+                             False)
+
+        # (pre-candidacies transitioned in the PreVote block above; a
+        # fresh pre-winner has granted=eye here, so with a single active
+        # voter it wins immediately — core's _campaign self-poll cascade.)
+        # Votes (and rejections) count only from peers in the candidate's
+        # OWN view — a grant from a node the candidacy's config no longer
+        # contains is dead weight (modern etcd tallies over the tracker
+        # config).  Win/lose evaluate only on POLL EVENTS (candidacy start
+        # or response arrival — core's _poll call sites): a conf change
+        # shrinking quorum between arrivals must not retro-promote a stale
+        # tally.
+        fresh_real = tn_ok | (pre_win if cfg.pre_vote else campaign)
+        # pre-candidacies poll on PreVote response arrivals (pv_polled is
+        # nonzero only on pre rows; the win line excludes them via ~pre)
+        polled = v_polled | pv_polled if cfg.pre_vote else v_polled
+        if cfg.peer_tiled:
+            votes = sfull(_pcount(lambda j0: _pband(granted, j0),
+                                  mem=member_r, rows_n=R), 0)
+        else:
+            votes = sfull(jnp.sum(mview_r(granted).astype(I32), axis=1), 0)
+        win = is_cand & ~pre & (votes >= quorum_row) & (fresh_real | polled)
+        # Rejection quorum: the candidate stands down (a REAL candidacy
+        # keeps term and vote; a pre-candidacy keeps both untouched by
+        # design) and waits out its timeout. A voter that granted earlier
+        # in the term never counts as a rejection — etcd's votes map
+        # records the FIRST response per voter (core._poll), and within
+        # one candidacy a grant can only precede a rejection (log/vote
+        # checks are monotone), so masking with ~granted reproduces
+        # first-response-wins exactly.
+        if cfg.peer_tiled:
+            n_rej = sfull(_pcount(
+                lambda j0: _pband(rejected, j0) & ~_pband(granted, j0),
+                mem=member_r, rows_n=R), 0)
+        else:
+            n_rej = sfull(jnp.sum(mview_r(rejected & ~granted).astype(I32),
+                                  axis=1), 0)
+        lose = is_cand & ~win & (n_rej >= quorum_row) & (fresh_real | polled)
+        role = jnp.where(lose, FOLLOWER, role)
+        lead = jnp.where(lose, NONE, lead)  # become_follower(term, NONE)
+        elapsed = jnp.where(lose, 0, elapsed)  # _reset zeroes the timer
+        pre = pre & ~lose
+        # becomeLeader: reset progress, append a no-op entry at the new
+        # term.
+        role = jnp.where(win, LEADER, role)
+        lead = jnp.where(win, node, lead)
+        hb_elapsed = jnp.where(win, 0, hb_elapsed)
+        elapsed = jnp.where(win, 0, elapsed)
+        contact = jnp.where(win, 0, contact)
+        # becomeLeader re-derives the propose gate from the uncommitted
+        # tail (vendor becomeLeader numOfPendingConf over (commit, last]);
+        # tail_conf is the end-of-previous-tick scan, still exact here
+        # because Phase A/B never append and propose() carries no conf
+        # entries.
+        pending_conf = jnp.where(win, state.tail_conf, pending_conf)
+        next_ = jnp.where(g(win)[:, None], (g(last) + 1)[:, None], next_)
+        match = jnp.where(g(win)[:, None], 0, match)
+        recent_active = jnp.where(g(win)[:, None], eye_r, recent_active)
+        if cfg.mailboxes:
+            # becomeLeader resets every Progress to StateProbe (vendor
+            # reset)
+            probing = jnp.where(g(win)[:, None], True, g(state.probing))
+        else:
+            probing = None
+        noop_term = term   # the winner's candidacy term, captured HERE:
+        #                    later catch-ups must not leak into the noop
+        #                    entry.  The untiled noop ring store runs just
+        #                    after the segment (win/noop_term are outputs);
+        #                    the tiled one rides Phase C's write cond.
+        last = last + win.astype(I32)
+        is_leader = (role == LEADER) & alive
+        match = jnp.where(g(win)[:, None] & eye_r, g(last)[:, None], match)
+
+        # ---- Phase C: append / heartbeat fan-out -------------------------
+        if cfg.mailboxes:
+            K = cfg.inflight
+            app_at, app_prev = g(state.app_at), g(state.app_prev)
+            app_term_box = g(state.app_term)
+            snp_at, snp_term_box = g(state.snp_at), g(state.snp_term)
+            term_e = g(term)[:, None]        # [i, 1] sender term per edge
+            term_k = g(term)[:, None, None]  # [i, 1, 1] per slot
+            # sends: up to K appends pipeline per edge (vendor
+            # MaxInflightMsgs) with one NEW message per tick; next_
+            # advances OPTIMISTICALLY by the entries known at send (etcd
+            # Replicate-state pipelining) and backtracks on rejection.
+            # Appends are EVENT-GATED (D1 closed, round 4): replicate
+            # edges send only when there is content; probe edges establish
+            # prev-match with one (possibly empty) append at a time; idle
+            # edges carry HEARTBEATS instead (below).
+            free_k = (app_at == 0) | (app_term_box != term_k)     # [i,j,K]
+            any_free = jnp.any(free_k, axis=2)
+            slot_sel = jnp.argmax(free_k, axis=2)                 # [i, j]
+            kh_idx = jnp.arange(cfg.ack_depth, dtype=I32)[None, None]
+            onehot = slot_sel[:, :, None] \
+                == jnp.arange(K, dtype=I32)[None, None]
+            inflight_same = jnp.any((app_at != 0)
+                                    & (app_term_box == term_k), axis=2)
+            snp_free = (snp_at == 0) | (snp_term_box != term_e)
+            prev_send = next_ - 1
+            can_ring_send = prev_send >= g(snap_idx)[:, None]
+            has_new = next_ <= g(last)[:, None]
+            send_base = mview_r(g(is_leader)[:, None] & ~eye_r & ~drop_r) \
+                & snp_free
+            # StateProbe: one append at a time, no pipelining;
+            # StateReplicate: pipeline while a slot is free (vendor
+            # progress.go)
+            may = jnp.where(probing, ~inflight_same, has_new)
+            s_app = send_base & can_ring_send & any_free & may
+            s_snp = send_base & ~can_ring_send  # snp_free in send_base
+            put = s_app[:, :, None] & onehot
+            app_at = jnp.where(put, (now + 1 + lat)[:, :, None], app_at)
+            app_prev = jnp.where(put, prev_send[:, :, None], app_prev)
+            app_term_box = jnp.where(put, term_k, app_term_box)
+            n_send = jnp.clip(g(last)[:, None] - prev_send, 0, cfg.window)
+            # optimistic advance only in replicate state (optimisticUpdate)
+            next_ = jnp.where(s_app & has_new & ~probing, next_ + n_send,
+                              next_)
+            snp_at = jnp.where(s_snp, now + 1 + lat, snp_at)
+            snp_term_box = jnp.where(s_snp, term_e, snp_term_box)
+
+            # -- heartbeat sends (etcd bcastHeartbeat, vendor
+            # raft.go:456-462): every heartbeat_tick each leader
+            # broadcasts MsgHeartbeat with the commit CAPTURED at send as
+            # min(match, commit); ack_depth slots suffice (one send per
+            # tick per edge, lifetime <= latency+jitter).
+            hb_at_box, hb_term_box = g(state.hb_at), g(state.hb_term)
+            hb_commit_box = g(state.hb_commit)
+            hbr_at_box, hbr_term_box = g(state.hbr_at), g(state.hbr_term)
+            hb_due_send = is_leader & (hb_elapsed >= cfg.heartbeat_tick)
+            hb_elapsed = jnp.where(hb_due_send, 0, hb_elapsed)
+            send_hb = mview_r(g(hb_due_send)[:, None] & ~eye_r & ~drop_r)
+            hb_free = hb_at_box == 0
+            hb_slot = jnp.argmax(hb_free, axis=2).astype(I32)
+            put_hb = send_hb[:, :, None] & (hb_slot[:, :, None] == kh_idx)
+            hb_at_box = jnp.where(put_hb, (now + 1 + lat)[:, :, None],
+                                  hb_at_box)
+            hb_term_box = jnp.where(put_hb, term_k, hb_term_box)
+            hb_commit_box = jnp.where(
+                put_hb,
+                jnp.minimum(match, g(commit)[:, None])[:, :, None],
+                hb_commit_box)
+
+            # -- heartbeat deliveries: processed BEFORE append deliveries
+            # (the oracle steps them first), so append validity below sees
+            # any demotion a higher-term heartbeat causes.  All due
+            # heartbeats integrate, aggregated; stale ones (sender no
+            # longer the leader of the captured term) vanish.
+            due_hb = (hb_at_box > 0) & (now + 1 >= hb_at_box)
+            valid_hb = due_hb & (g(role)[:, None, None] == LEADER) \
+                & (hb_term_box == term_k) & alive[None, :, None]
+            hb_at_box = jnp.where(due_hb, 0, hb_at_box)
+            mt_hb = jnp.max(jnp.where(valid_hb, hb_term_box, -1),
+                            axis=(0, 2))
+            newer_hb = mt_hb > term
+            term = jnp.where(newer_hb, mt_hb, term)
+            role = jnp.where(newer_hb, FOLLOWER, role)
+            vote = jnp.where(newer_hb, NONE, vote)
+            lead = jnp.where(newer_hb, NONE, lead)
+            elapsed = jnp.where(newer_hb, 0, elapsed)
+            timeout = jnp.where(newer_hb, rand_timeout(cfg, node, term),
+                                timeout)
+            cur_hb = valid_hb & (hb_term_box == term[None, :, None])
+            got_hb = jnp.any(cur_hb, axis=(0, 2))                 # [j]
+            # slab position -> node id through `rows`, gated on got_hb (a
+            # dense all-False argmax is 0 — the gate keeps that identical)
+            src_hb = jnp.where(
+                got_hb,
+                rows[jnp.argmax(jnp.any(cur_hb, axis=2), axis=0)],
+                0).astype(I32)
+            role = jnp.where(got_hb & (role == CANDIDATE), FOLLOWER, role)
+            lead = jnp.where(got_hb, src_hb, lead)
+            elapsed = jnp.where(got_hb, 0, elapsed)
+            contact = jnp.where(got_hb, 0, contact)
+            # commit_to(min(m.commit, last)) per message, as a max
+            hbc = jnp.max(jnp.where(cur_hb, hb_commit_box, -1),
+                          axis=(0, 2))
+            commit = jnp.where(
+                got_hb, jnp.maximum(commit, jnp.minimum(hbc, last)),
+                commit)
+            # one response per edge per tick (responses carry liveness)
+            send_hbr = jnp.any(cur_hb, axis=2) & ~dropT_r
+            hbr_free = hbr_at_box == 0
+            hbr_slot = jnp.argmax(hbr_free, axis=2).astype(I32)
+            put_hbr = send_hbr[:, :, None] \
+                & (hbr_slot[:, :, None] == kh_idx)
+            hbr_at_box = jnp.where(put_hbr, (now + 1 + lat_T)[:, :, None],
+                                   hbr_at_box)
+            hbr_term_box = jnp.where(put_hbr, term[None, :, None],
+                                     hbr_term_box)
+            term_k = g(term)[:, None, None]   # refresh: heartbeats may
+            term_e = g(term)[:, None]         # have caught senders up
+            # deliveries: the wire drains AT MOST ONE append per edge per
+            # tick — the smallest-prev deliverable one; later-due messages
+            # wait their turn.  Sender must still be the same-term leader,
+            # so ring reads at delivery see an immutable prefix; an append
+            # whose captured prev was compacted since send is
+            # undeliverable and drops (the freed slot lets a snapshot go
+            # out next tick).
+            due_k = (app_at > 0) & (now + 1 >= app_at)
+            lead_k = g(role)[:, None, None] == LEADER
+            valid_k = due_k & lead_k & (app_term_box == term_k) \
+                & alive[None, :, None] \
+                & (app_prev >= g(snap_idx)[:, None, None])
+            big = jnp.iinfo(jnp.int32).max
+            key = jnp.where(valid_k, app_prev, big)
+            sel_prev = jnp.min(key, axis=2)                       # [i, j]
+            sel_slot = jnp.argmin(key, axis=2)
+            send_app = jnp.any(valid_k, axis=2)
+            taken = send_app[:, :, None] \
+                & (sel_slot[:, :, None]
+                   == jnp.arange(K, dtype=I32)[None, None])
+            # clear the delivered slot and every due-but-invalid slot
+            app_at = jnp.where(taken | (due_k & ~valid_k), 0, app_at)
+            due_s = (snp_at > 0) & (now + 1 >= snp_at)
+            send_snap = due_s & (g(role)[:, None] == LEADER) \
+                & (term_e == snp_term_box) & alive[None, :]
+            prev_mat = sel_prev
+            snp_at = jnp.where(due_s, 0, snp_at)
+        else:
+            prev_mat = next_ - 1                                 # [i, j]
+            can_ring = prev_mat >= g(snap_idx)[:, None]
+            send_base = mview_r(g(is_leader)[:, None] & alive[None, :]
+                                & ~eye_r & ~drop_r)
+            send_app = send_base & can_ring
+            send_snap = send_base & ~can_ring
+
+        # Receiver-side term catch-up from append/snapshot senders.
+        msg_term = jnp.where(send_app | send_snap, g(term)[:, None], -1)
+        mt2 = jnp.max(msg_term, axis=0)
+        newer2 = mt2 > term
+        term = jnp.where(newer2, mt2, term)
+        role = jnp.where(newer2, FOLLOWER, role)
+        vote = jnp.where(newer2, NONE, vote)
+        lead = jnp.where(newer2, NONE, lead)
+        elapsed = jnp.where(newer2, 0, elapsed)
+        timeout = jnp.where(newer2, rand_timeout(cfg, node, term), timeout)
+
+        # Receiver picks its (unique) current-term leader, judged by the
+        # SEND-TIME sender term (a leader deposed this tick sent at its
+        # old term).  src_sel is the slab-LOCAL position (for indexing the
+        # [R, N] send matrices); src maps it to a node id for the log-row
+        # gathers in the dense middle section.
+        eligible = (send_app | send_snap) & (msg_term == term[None, :])
+        has_lmsg = jnp.any(eligible, axis=0)
+        src_sel = jnp.argmax(eligible, axis=0)
+        src = jnp.where(has_lmsg, rows[src_sel], 0).astype(I32)  # [j]
+        role = jnp.where(has_lmsg & (role == CANDIDATE), FOLLOWER, role)
+        lead = jnp.where(has_lmsg, src, lead)
+        elapsed = jnp.where(has_lmsg, 0, elapsed)
+        contact = jnp.where(has_lmsg, 0, contact)
+        is_leader = (role == LEADER) & alive
+
+        got_app = has_lmsg & send_app[src_sel, node]
+        got_snap = has_lmsg & send_snap[src_sel, node]
+        p = prev_mat[src_sel, node]                              # [j]
+
+        out = dict(
+            term=term, vote=vote, role=role, lead=lead, elapsed=elapsed,
+            contact=contact, hb_elapsed=hb_elapsed, timeout=timeout,
+            pre=pre, last=last, commit=commit, pending_conf=pending_conf,
+            campaign=campaign, tn_ok=tn_ok, transferee=transferee,
+            tn_at=tn_at, tn_term=tn_term, tn_from=tn_from, tx_cand=tx_cand,
+            win=win, noop_term=noop_term, is_leader=is_leader,
+            has_lmsg=has_lmsg, src=src, got_app=got_app, got_snap=got_snap,
+            p=p,
+            match=sc(match0, match), next_=sc(next0, next_),
+            granted=sc(granted0, granted),
+            rejected=sc(rejected0, rejected),
+            recent_active=sc(ra0, recent_active))
+        if cfg.mailboxes:
+            out.update(
+                probing=sc(state.probing, probing),
+                vreq_at=sc(state.vreq_at, vreq_at),
+                vreq_term=sc(state.vreq_term, vreq_term),
+                vreq_pre=sc(state.vreq_pre, vreq_pre),
+                vresp_at=sc(state.vresp_at, vresp_at),
+                vresp_term=sc(state.vresp_term, vresp_term),
+                vresp_grant=sc(state.vresp_grant, vresp_grant),
+                vresp_pre=sc(state.vresp_pre, vresp_pre),
+                app_at=sc(state.app_at, app_at),
+                app_prev=sc(state.app_prev, app_prev),
+                app_term=sc(state.app_term, app_term_box),
+                snp_at=sc(state.snp_at, snp_at),
+                snp_term=sc(state.snp_term, snp_term_box),
+                hb_at=sc(state.hb_at, hb_at_box),
+                hb_term=sc(state.hb_term, hb_term_box),
+                hb_commit=sc(state.hb_commit, hb_commit_box),
+                hbr_at=sc(state.hbr_at, hbr_at_box),
+                hbr_term=sc(state.hbr_term, hbr_term_box))
+        return out
+
+    # Dispatch segment 1.  Under the sparse lowering the tick pays the
+    # [A, N] slab branch whenever the active predicate fits; the dense
+    # branch is the bit-identical fallback (election storms).  Both
+    # branches return full-[N]/[N, N] pytrees, so the cond output aliases
+    # the state carries exactly like the historical dense code.
+    if sparse_on:
+        _oa = jax.lax.cond(sp_fits,
+                           lambda: _progress_a(sp_rows, False),
+                           lambda: _progress_a(node, True))
+    else:
+        _oa = _progress_a(node, True)
+    term, vote, role = _oa["term"], _oa["vote"], _oa["role"]
+    lead, elapsed, contact = _oa["lead"], _oa["elapsed"], _oa["contact"]
+    hb_elapsed, timeout, pre = _oa["hb_elapsed"], _oa["timeout"], _oa["pre"]
+    last, commit, pending_conf = (_oa["last"], _oa["commit"],
+                                  _oa["pending_conf"])
+    campaign, tn_ok, transferee = (_oa["campaign"], _oa["tn_ok"],
+                                   _oa["transferee"])
+    tn_at, tn_term, tn_from = _oa["tn_at"], _oa["tn_term"], _oa["tn_from"]
+    tx_cand, win, noop_term = _oa["tx_cand"], _oa["win"], _oa["noop_term"]
+    is_leader, has_lmsg, src = (_oa["is_leader"], _oa["has_lmsg"],
+                                _oa["src"])
+    got_app, got_snap, p = _oa["got_app"], _oa["got_snap"], _oa["p"]
+    match, next_, granted = _oa["match"], _oa["next_"], _oa["granted"]
+    rejected, recent_active = _oa["rejected"], _oa["recent_active"]
+    probing = _oa["probing"] if cfg.mailboxes else None
     if cfg.mailboxes:
-        # enqueue responses on the reverse edge; a response already in
-        # flight on that edge is superseded (it addressed an older term and
-        # would be guard-dropped at delivery anyway)
-        send_vresp = cur & ~drop.T
-        vresp_at = jnp.where(send_vresp, now + 1 + lat.T, vresp_at)
-        vresp_term = jnp.where(send_vresp, term[None, :], vresp_term)
-        vresp_pre = jnp.where(send_vresp, False, vresp_pre)
-        vresp_grant = jnp.where(send_vresp, grant_mat, vresp_grant)
-        due_vs = (vresp_at > 0) & (now + 1 >= vresp_at)
-        rvalid = due_vs & is_cand[:, None] \
-            & (term[:, None] == vresp_term) \
-            & (pre[:, None] == vresp_pre)
-        granted = granted | (rvalid & vresp_grant)
-        rejected = rejected | (rvalid & ~vresp_grant)
-        vresp_at = jnp.where(due_vs, 0, vresp_at)
-        v_polled = jnp.any(rvalid & ~vresp_pre, axis=1)
-    else:
-        real_cand = is_cand & ~pre
-        resp_arrive = grant_mat & ~drop.T
-        granted = granted | (resp_arrive & real_cand[:, None])
-        reject_arrive = cur & ~grant_mat & ~drop.T
-        rejected = rejected | (reject_arrive & real_cand[:, None])
-        v_polled = jnp.any((resp_arrive | reject_arrive)
-                           & real_cand[:, None], axis=1)
+        vreq_at, vreq_term = _oa["vreq_at"], _oa["vreq_term"]
+        vreq_pre = _oa["vreq_pre"]
+        vresp_at, vresp_term = _oa["vresp_at"], _oa["vresp_term"]
+        vresp_grant, vresp_pre = _oa["vresp_grant"], _oa["vresp_pre"]
+        app_at, app_prev = _oa["app_at"], _oa["app_prev"]
+        app_term_box = _oa["app_term"]
+        snp_at, snp_term_box = _oa["snp_at"], _oa["snp_term"]
+        hb_at_box, hb_term_box = _oa["hb_at"], _oa["hb_term"]
+        hb_commit_box = _oa["hb_commit"]
+        hbr_at_box, hbr_term_box = _oa["hbr_at"], _oa["hbr_term"]
 
-    # (pre-candidacies transitioned in the PreVote block above; a fresh
-    # pre-winner has granted=eye here, so with a single active voter it
-    # wins immediately — core's _campaign self-poll cascade.)
-    # Votes (and rejections) count only from peers in the candidate's OWN
-    # view — a grant from a node the candidacy's config no longer contains
-    # is dead weight (modern etcd tallies over the tracker config).
-    # Win/lose evaluate only on POLL EVENTS (candidacy start or response
-    # arrival — core's _poll call sites): a conf change shrinking quorum
-    # between arrivals must not retro-promote a stale tally.
-    fresh_real = tn_ok | (pre_win if cfg.pre_vote else campaign)
-    # pre-candidacies poll on PreVote response arrivals (pv_polled is
-    # nonzero only on pre rows; the win line excludes them via ~pre)
-    polled = v_polled | pv_polled if cfg.pre_vote else v_polled
-    if cfg.peer_tiled:
-        votes = _pcount(lambda j0: _pband(granted, j0))
-    else:
-        votes = jnp.sum(_mview(granted).astype(I32), axis=1)
-    win = is_cand & ~pre & (votes >= quorum_row) & (fresh_real | polled)
-    # Rejection quorum: the candidate stands down (a REAL candidacy keeps
-    # term and vote; a pre-candidacy keeps both untouched by design) and
-    # waits out its timeout. A voter that granted earlier in the term never
-    # counts as a rejection — etcd's votes map records the FIRST response
-    # per voter (core._poll), and within one candidacy a grant can only
-    # precede a rejection (log/vote checks are monotone), so masking with
-    # ~granted reproduces first-response-wins exactly.
-    if cfg.peer_tiled:
-        n_rej = _pcount(
-            lambda j0: _pband(rejected, j0) & ~_pband(granted, j0))
-    else:
-        n_rej = jnp.sum(_mview(rejected & ~granted).astype(I32), axis=1)
-    lose = is_cand & ~win & (n_rej >= quorum_row) & (fresh_real | polled)
-    role = jnp.where(lose, FOLLOWER, role)
-    lead = jnp.where(lose, NONE, lead)  # become_follower(term, NONE)
-    elapsed = jnp.where(lose, 0, elapsed)  # _reset zeroes the timer
-    pre = pre & ~lose
-    # becomeLeader: reset progress, append a no-op entry at the new term.
-    role = jnp.where(win, LEADER, role)
-    lead = jnp.where(win, node, lead)
-    hb_elapsed = jnp.where(win, 0, hb_elapsed)
-    elapsed = jnp.where(win, 0, elapsed)
-    contact = jnp.where(win, 0, contact)
-    # becomeLeader re-derives the propose gate from the uncommitted tail
-    # (vendor becomeLeader numOfPendingConf over (commit, last]); tail_conf
-    # is the end-of-previous-tick scan, still exact here because Phase A/B
-    # never append and propose() carries no conf entries.
-    pending_conf = jnp.where(win, state.tail_conf, pending_conf)
-    next_ = jnp.where(win[:, None], (last + 1)[:, None], next_)
-    match = jnp.where(win[:, None], 0, match)
-    recent_active = jnp.where(win[:, None], eye, recent_active)
-    if cfg.mailboxes:
-        # becomeLeader resets every Progress to StateProbe (vendor reset)
-        probing = jnp.where(win[:, None], True, state.probing)
-    else:
-        probing = None
-    noop_term = term   # the winner's candidacy term, captured HERE: later
-    #                    catch-ups must not leak into the noop entry
+    # The untiled noop ring store, deferred from Phase B (ring writes stay
+    # outside the progress segments — a write under the cond would carry
+    # the whole [N, L] log through both branches).  `last` is
+    # post-increment here: win rows store at their new last (the noop
+    # index); non-win rows read-modify-write their own slot unchanged —
+    # bit-identical to the historical in-phase store at _slot(last + 1).
     if not cfg.tiled:
-        noop_slot = _slot(cfg, last + 1)
+        noop_slot = _slot(cfg, jnp.where(win, last, last + 1))
         log_term = log_term.at[node, noop_slot].set(
-            jnp.where(win, term, log_term[node, noop_slot]))
+            jnp.where(win, noop_term, log_term[node, noop_slot]))
         log_data = log_data.at[node, noop_slot].set(
             jnp.where(win, U32(0), log_data[node, noop_slot]))
-    # else: the noop store rides Phase C's single write cond — a per-row
-    # scatter on the scan-carried [N, L] arrays defeats XLA's in-place
-    # aliasing and costs full-capacity log copies per tick
-    last = last + win.astype(I32)
-    is_leader = (role == LEADER) & alive
-    match = jnp.where(win[:, None] & eye, last[:, None], match)
-
-    # ---- Phase C: append / heartbeat fan-out -----------------------------
-    if cfg.mailboxes:
-        K = cfg.inflight
-        app_at, app_prev = state.app_at, state.app_prev
-        app_term_box = state.app_term
-        snp_at, snp_term_box = state.snp_at, state.snp_term
-        term_e = term[:, None]            # [i, 1] sender term per edge
-        term_k = term[:, None, None]      # [i, 1, 1] per slot
-        # sends: up to K appends pipeline per edge (vendor MaxInflightMsgs)
-        # with one NEW message per tick; next_ advances OPTIMISTICALLY by
-        # the entries known at send (etcd Replicate-state pipelining) and
-        # backtracks on rejection.  Appends are EVENT-GATED (D1 closed,
-        # round 4): replicate edges send only when there is content;
-        # probe edges establish prev-match with one (possibly empty)
-        # append at a time; idle edges carry HEARTBEATS instead (below).
-        free_k = (app_at == 0) | (app_term_box != term_k)         # [i,j,K]
-        any_free = jnp.any(free_k, axis=2)
-        slot_sel = jnp.argmax(free_k, axis=2)                     # [i, j]
-        kh_idx = jnp.arange(cfg.ack_depth, dtype=I32)[None, None]
-        onehot = slot_sel[:, :, None] == jnp.arange(K, dtype=I32)[None, None]
-        inflight_same = jnp.any((app_at != 0) & (app_term_box == term_k),
-                                axis=2)
-        snp_free = (snp_at == 0) | (snp_term_box != term_e)
-        prev_send = next_ - 1
-        can_ring_send = prev_send >= snap_idx[:, None]
-        has_new = next_ <= last[:, None]
-        send_base = _mview(is_leader[:, None] & ~eye & ~drop) & snp_free
-        # StateProbe: one append at a time, no pipelining; StateReplicate:
-        # pipeline while a slot is free (vendor progress.go)
-        may = jnp.where(probing, ~inflight_same, has_new)
-        s_app = send_base & can_ring_send & any_free & may
-        s_snp = send_base & ~can_ring_send  # snp_free already in send_base
-        put = s_app[:, :, None] & onehot
-        app_at = jnp.where(put, (now + 1 + lat)[:, :, None], app_at)
-        app_prev = jnp.where(put, prev_send[:, :, None], app_prev)
-        app_term_box = jnp.where(put, term_k, app_term_box)
-        n_send = jnp.clip(last[:, None] - prev_send, 0, cfg.window)
-        # optimistic advance only in replicate state (optimisticUpdate)
-        next_ = jnp.where(s_app & has_new & ~probing, next_ + n_send, next_)
-        snp_at = jnp.where(s_snp, now + 1 + lat, snp_at)
-        snp_term_box = jnp.where(s_snp, term_e, snp_term_box)
-
-        # -- heartbeat sends (etcd bcastHeartbeat, vendor raft.go:456-462):
-        # every heartbeat_tick each leader broadcasts MsgHeartbeat with the
-        # commit CAPTURED at send as min(match, commit); ack_depth slots
-        # suffice (one send per tick per edge, lifetime <= latency+jitter).
-        hb_at_box, hb_term_box = state.hb_at, state.hb_term
-        hb_commit_box = state.hb_commit
-        hbr_at_box, hbr_term_box = state.hbr_at, state.hbr_term
-        hb_due_send = is_leader & (hb_elapsed >= cfg.heartbeat_tick)
-        hb_elapsed = jnp.where(hb_due_send, 0, hb_elapsed)
-        send_hb = _mview(hb_due_send[:, None] & ~eye & ~drop)
-        hb_free = hb_at_box == 0
-        hb_slot = jnp.argmax(hb_free, axis=2).astype(I32)
-        put_hb = send_hb[:, :, None] & (hb_slot[:, :, None] == kh_idx)
-        hb_at_box = jnp.where(put_hb, (now + 1 + lat)[:, :, None], hb_at_box)
-        hb_term_box = jnp.where(put_hb, term_k, hb_term_box)
-        hb_commit_box = jnp.where(
-            put_hb, jnp.minimum(match, commit[:, None])[:, :, None],
-            hb_commit_box)
-
-        # -- heartbeat deliveries: processed BEFORE append deliveries (the
-        # oracle steps them first), so append validity below sees any
-        # demotion a higher-term heartbeat causes.  All due heartbeats
-        # integrate, aggregated; stale ones (sender no longer the leader
-        # of the captured term) vanish.
-        due_hb = (hb_at_box > 0) & (now + 1 >= hb_at_box)
-        valid_hb = due_hb & (role[:, None, None] == LEADER) \
-            & (hb_term_box == term_k) & alive[None, :, None]
-        hb_at_box = jnp.where(due_hb, 0, hb_at_box)
-        mt_hb = jnp.max(jnp.where(valid_hb, hb_term_box, -1), axis=(0, 2))
-        newer_hb = mt_hb > term
-        term = jnp.where(newer_hb, mt_hb, term)
-        role = jnp.where(newer_hb, FOLLOWER, role)
-        vote = jnp.where(newer_hb, NONE, vote)
-        lead = jnp.where(newer_hb, NONE, lead)
-        elapsed = jnp.where(newer_hb, 0, elapsed)
-        timeout = jnp.where(newer_hb, rand_timeout(cfg, node, term), timeout)
-        cur_hb = valid_hb & (hb_term_box == term[None, :, None])
-        got_hb = jnp.any(cur_hb, axis=(0, 2))                     # [j]
-        src_hb = jnp.argmax(jnp.any(cur_hb, axis=2), axis=0).astype(I32)
-        role = jnp.where(got_hb & (role == CANDIDATE), FOLLOWER, role)
-        lead = jnp.where(got_hb, src_hb, lead)
-        elapsed = jnp.where(got_hb, 0, elapsed)
-        contact = jnp.where(got_hb, 0, contact)
-        # commit_to(min(m.commit, last)) per message, aggregated as a max
-        hbc = jnp.max(jnp.where(cur_hb, hb_commit_box, -1), axis=(0, 2))
-        commit = jnp.where(got_hb,
-                           jnp.maximum(commit, jnp.minimum(hbc, last)),
-                           commit)
-        # one response per edge per tick (responses only carry liveness)
-        send_hbr = jnp.any(cur_hb, axis=2) & ~drop.T
-        hbr_free = hbr_at_box == 0
-        hbr_slot = jnp.argmax(hbr_free, axis=2).astype(I32)
-        put_hbr = send_hbr[:, :, None] & (hbr_slot[:, :, None] == kh_idx)
-        hbr_at_box = jnp.where(put_hbr, (now + 1 + lat.T)[:, :, None],
-                               hbr_at_box)
-        hbr_term_box = jnp.where(put_hbr, term[None, :, None], hbr_term_box)
-        term_k = term[:, None, None]   # refresh: heartbeats may have
-        term_e = term[:, None]         # caught senders' terms up
-        # deliveries: the wire drains AT MOST ONE append per edge per tick
-        # — the smallest-prev deliverable one; later-due messages wait
-        # their turn.  Sender must still be the same-term leader, so ring
-        # reads at delivery see an immutable prefix; an append whose
-        # captured prev was compacted since send is undeliverable and
-        # drops (the freed slot lets a snapshot go out next tick).
-        due_k = (app_at > 0) & (now + 1 >= app_at)
-        lead_k = role[:, None, None] == LEADER
-        valid_k = due_k & lead_k & (app_term_box == term_k) \
-            & alive[None, :, None] & (app_prev >= snap_idx[:, None, None])
-        big = jnp.iinfo(jnp.int32).max
-        key = jnp.where(valid_k, app_prev, big)
-        sel_prev = jnp.min(key, axis=2)                           # [i, j]
-        sel_slot = jnp.argmin(key, axis=2)
-        send_app = jnp.any(valid_k, axis=2)
-        taken = send_app[:, :, None] \
-            & (sel_slot[:, :, None] == jnp.arange(K, dtype=I32)[None, None])
-        # clear the delivered slot and every due-but-invalid (stale) slot
-        app_at = jnp.where(taken | (due_k & ~valid_k), 0, app_at)
-        due_s = (snp_at > 0) & (now + 1 >= snp_at)
-        send_snap = due_s & (role[:, None] == LEADER) \
-            & (term_e == snp_term_box) & alive[None, :]
-        prev_mat = sel_prev
-        snp_at = jnp.where(due_s, 0, snp_at)
-    else:
-        prev_mat = next_ - 1                                     # [i, j]
-        can_ring = prev_mat >= snap_idx[:, None]
-        send_base = _mview(is_leader[:, None] & alive[None, :]
-                           & ~eye & ~drop)
-        send_app = send_base & can_ring
-        send_snap = send_base & ~can_ring
-
-    # Receiver-side term catch-up from append/snapshot senders.
-    msg_term = jnp.where(send_app | send_snap, term[:, None], -1)
-    mt2 = jnp.max(msg_term, axis=0)
-    newer2 = mt2 > term
-    term = jnp.where(newer2, mt2, term)
-    role = jnp.where(newer2, FOLLOWER, role)
-    vote = jnp.where(newer2, NONE, vote)
-    lead = jnp.where(newer2, NONE, lead)
-    elapsed = jnp.where(newer2, 0, elapsed)
-    timeout = jnp.where(newer2, rand_timeout(cfg, node, term), timeout)
-
-    # Receiver picks its (unique) current-term leader, judged by the
-    # SEND-TIME sender term (a leader deposed this tick sent at its old term).
-    eligible = (send_app | send_snap) & (msg_term == term[None, :])
-    has_lmsg = jnp.any(eligible, axis=0)
-    src = jnp.argmax(eligible, axis=0).astype(I32)               # [j]
-    role = jnp.where(has_lmsg & (role == CANDIDATE), FOLLOWER, role)
-    lead = jnp.where(has_lmsg, src, lead)
-    elapsed = jnp.where(has_lmsg, 0, elapsed)
-    contact = jnp.where(has_lmsg, 0, contact)
-    is_leader = (role == LEADER) & alive
-
-    got_app = has_lmsg & send_app[src, node]
-    got_snap = has_lmsg & send_snap[src, node]
 
     # -- append receive. All sender-side log reads use the POST-noop local
     # arrays so a just-elected leader replicates its no-op in the same tick.
@@ -827,7 +1117,8 @@ def step(state: SimState, cfg: SimConfig,
     # tiling block above) with a full-pass fallback on straggler spread.
     last_src, snap_src = last[src], snap_idx[src]
 
-    p = prev_mat[src, node]                                      # [j]
+    # (p — the chosen sender's prev per receiver — comes out of the first
+    # progress segment; prev_mat itself never leaves the slab.)
     p_ring_term = log_term[src, _slot(cfg, p)]   # one element per row
     p_term_sent = jnp.where(
         p == snap_src, snap_term[src],
@@ -1097,190 +1388,319 @@ def step(state: SimState, cfg: SimConfig,
     resp_reject = got_app & ~prev_ok & ~stale
     reject_hint = last                                           # [j]
 
-    is_resp_tgt = node[:, None] == src[None, :]                  # [i, j]
     if cfg.mailboxes:
-        aresp_at, aresp_term = state.aresp_at, state.aresp_term
-        aresp_match, aresp_ok = state.aresp_match, state.aresp_ok
-        big = jnp.iinfo(jnp.int32).max
-        kr_idx = jnp.arange(cfg.ack_depth, dtype=I32)[None, None]
-        # enqueue into the first free slot — cfg.ack_depth guarantees one
-        # exists (acks arrive at most once per tick per edge and live at
-        # most latency+jitter ticks), so no eviction policy is needed
-        send_ar = is_resp_tgt & has_lmsg[None, :] & ~drop.T
-        free_r = aresp_at == 0
-        wslot = jnp.argmax(free_r, axis=2).astype(I32)
-        put_r = send_ar[:, :, None] & (wslot[:, :, None] == kr_idx)
-        aresp_at = jnp.where(put_r, (now + 1 + lat.T)[:, :, None], aresp_at)
-        aresp_term = jnp.where(put_r, term[None, :, None], aresp_term)
-        aresp_ok = jnp.where(put_r, resp_ok[None, :, None], aresp_ok)
-        aresp_match = jnp.where(
-            put_r,
-            jnp.where(resp_reject, reject_hint, resp_match)[None, :, None],
-            aresp_match)
-        # deliveries: ALL due acks integrate this tick, aggregated (ok:
-        # max match; reject: min hint — applied after the ok advance, the
-        # conservative order)
-        due_r = (aresp_at > 0) & (now + 1 >= aresp_at)
-        val_r = due_r & is_leader[:, None, None] \
-            & (term[:, None, None] == aresp_term)
-        ok_k = val_r & aresp_ok
-        rej_k = val_r & ~aresp_ok
-        ok_mat = jnp.any(ok_k, axis=2)
-        rej_mat = jnp.any(rej_k, axis=2)
-        resp_match_del = jnp.max(jnp.where(ok_k, aresp_match, -1), axis=2)
-        reject_hint_del = jnp.min(jnp.where(rej_k, aresp_match, big), axis=2)
-        aresp_at = jnp.where(due_r, 0, aresp_at)
-    else:
-        arrive_back = ~drop.T & is_resp_tgt & is_leader[:, None] \
-            & has_lmsg[None, :]
-        ok_mat = arrive_back & resp_ok[None, :]
-        rej_mat = arrive_back & resp_reject[None, :]
-        resp_match_del = resp_match[None, :]
-        reject_hint_del = reject_hint[None, :]
-    # any response marks the peer recently-active for CheckQuorum (even
-    # from a peer outside the current view: invisible there, since the
-    # CheckQuorum count masks by member and a re-add forces True anyway)
-    recent_active = recent_active | ok_mat | rej_mat
-    # ...but progress integration follows core's stepLeader exactly:
-    # responses from peers the config no longer contains are dropped
-    # (prs.get(m.frm) is None -> return).  The rejection path is receiver-
-    # visible (backtrack + pipeline flush change future deliveries), so
-    # this mask is required for core-exactness, not just hygiene.
-    ok_mat = _mview(ok_mat)
-    rej_mat = _mview(rej_mat)
-    if cfg.mailboxes:
-        # vendor stepLeader MsgAppResp: maybeUpdate advances match (and
-        # next to at least m+1); a match ADVANCE on a probing edge enters
-        # replicate with next = match+1 EXACTLY (becomeReplicate may lower
-        # an optimistic next)
-        adv = ok_mat & (resp_match_del > match)
-        to_repl = adv & probing
-        match = jnp.where(ok_mat, jnp.maximum(match, resp_match_del), match)
+        _b_in = (app_at, app_prev, app_term_box, snp_at, snp_term_box,
+                 hbr_at_box, hbr_term_box)
+
+    def _progress_b(rows, dense, match=match, next_=next_,
+                    recent_active=recent_active, probing=probing,
+                    tn_at=tn_at, tn_term=tn_term, tn_from=tn_from):
+        """Progress segment 2: ack folds, progress integration, transfer
+        completion, the Phase D bisect and the R1 ack counts — every
+        remaining [N, N] elementwise consumer of per-peer progress.  Same
+        contract as segment 1: `dense` instantiates the historical code
+        op-for-op, the slab instantiation is bit-identical on the rows it
+        scatters back (all matrix updates are gated on leadership, and
+        the active set is a superset of every row those gates can fire
+        on this tick)."""
+        (R, g, sc, sfull, eye_r, drop_r, dropT_r, member_r,
+         mview_r) = _slabify(rows, dense)
+        match1, next1, ra1 = match, next_, recent_active
+        match, next_, recent_active = g(match), g(next_), g(recent_active)
+        if cfg.mailboxes:
+            probing0 = probing
+            probing = g(probing)
+            if dense:
+                lat = latency_matrix(cfg, now)
+                lat_T = lat.T
+            else:
+                lat = latency_at(cfg, now, rows[:, None], node[None, :])
+                lat_T = latency_at(cfg, now, node[None, :], rows[:, None])
+
+        is_resp_tgt = rows[:, None] == src[None, :]              # [i, j]
+        if cfg.mailboxes:
+            (b_app_at, b_app_prev, b_app_term, b_snp_at, b_snp_term,
+             b_hbr_at, b_hbr_term) = _b_in
+            app_at, app_prev = g(b_app_at), g(b_app_prev)
+            app_term_box = g(b_app_term)
+            snp_at, snp_term_box = g(b_snp_at), g(b_snp_term)
+            hbr_at_box, hbr_term_box = g(b_hbr_at), g(b_hbr_term)
+            aresp_at, aresp_term = g(state.aresp_at), g(state.aresp_term)
+            aresp_match, aresp_ok = (g(state.aresp_match),
+                                     g(state.aresp_ok))
+            big = jnp.iinfo(jnp.int32).max
+            kr_idx = jnp.arange(cfg.ack_depth, dtype=I32)[None, None]
+            # enqueue into the first free slot — cfg.ack_depth guarantees
+            # one exists (acks arrive at most once per tick per edge and
+            # live at most latency+jitter ticks), so no eviction policy
+            # is needed
+            send_ar = is_resp_tgt & has_lmsg[None, :] & ~dropT_r
+            free_r = aresp_at == 0
+            wslot = jnp.argmax(free_r, axis=2).astype(I32)
+            put_r = send_ar[:, :, None] & (wslot[:, :, None] == kr_idx)
+            aresp_at = jnp.where(put_r, (now + 1 + lat_T)[:, :, None],
+                                 aresp_at)
+            aresp_term = jnp.where(put_r, term[None, :, None], aresp_term)
+            aresp_ok = jnp.where(put_r, resp_ok[None, :, None], aresp_ok)
+            aresp_match = jnp.where(
+                put_r,
+                jnp.where(resp_reject, reject_hint,
+                          resp_match)[None, :, None],
+                aresp_match)
+            # deliveries: ALL due acks integrate this tick, aggregated
+            # (ok: max match; reject: min hint — applied after the ok
+            # advance, the conservative order)
+            due_r = (aresp_at > 0) & (now + 1 >= aresp_at)
+            val_r = due_r & g(is_leader)[:, None, None] \
+                & (g(term)[:, None, None] == aresp_term)
+            ok_k = val_r & aresp_ok
+            rej_k = val_r & ~aresp_ok
+            ok_mat = jnp.any(ok_k, axis=2)
+            rej_mat = jnp.any(rej_k, axis=2)
+            resp_match_del = jnp.max(jnp.where(ok_k, aresp_match, -1),
+                                     axis=2)
+            reject_hint_del = jnp.min(jnp.where(rej_k, aresp_match, big),
+                                      axis=2)
+            aresp_at = jnp.where(due_r, 0, aresp_at)
+        else:
+            arrive_back = ~dropT_r & is_resp_tgt & g(is_leader)[:, None] \
+                & has_lmsg[None, :]
+            ok_mat = arrive_back & resp_ok[None, :]
+            rej_mat = arrive_back & resp_reject[None, :]
+            resp_match_del = resp_match[None, :]
+            reject_hint_del = reject_hint[None, :]
+        # pre-view response arrivals also feed the active-TTL drain
+        # tracking (a row draining in-flight acks must stay in the slab)
+        got_resp_r = jnp.any(ok_mat | rej_mat, axis=1)
+        # any response marks the peer recently-active for CheckQuorum
+        # (even from a peer outside the current view: invisible there,
+        # since the CheckQuorum count masks by member and a re-add forces
+        # True anyway)
+        recent_active = recent_active | ok_mat | rej_mat
+        # ...but progress integration follows core's stepLeader exactly:
+        # responses from peers the config no longer contains are dropped
+        # (prs.get(m.frm) is None -> return).  The rejection path is
+        # receiver-visible (backtrack + pipeline flush change future
+        # deliveries), so this mask is required for core-exactness, not
+        # just hygiene.
+        ok_mat = mview_r(ok_mat)
+        rej_mat = mview_r(rej_mat)
+        if cfg.mailboxes:
+            # vendor stepLeader MsgAppResp: maybeUpdate advances match
+            # (and next to at least m+1); a match ADVANCE on a probing
+            # edge enters replicate with next = match+1 EXACTLY
+            # (becomeReplicate may lower an optimistic next)
+            adv = ok_mat & (resp_match_del > match)
+            to_repl = adv & probing
+            match = jnp.where(ok_mat, jnp.maximum(match, resp_match_del),
+                              match)
+            next_ = jnp.where(
+                to_repl, resp_match_del + 1,
+                jnp.where(ok_mat, jnp.maximum(next_, resp_match_del + 1),
+                          next_))
+            probing = probing & ~to_repl
+        else:
+            match = jnp.where(ok_mat, jnp.maximum(match, resp_match_del),
+                              match)
+            next_ = jnp.where(ok_mat,
+                              jnp.maximum(next_, resp_match_del + 1),
+                              next_)
+        # Probe decrement (maybeDecrTo, coarse): jump next back to hint.
         next_ = jnp.where(
-            to_repl, resp_match_del + 1,
-            jnp.where(ok_mat, jnp.maximum(next_, resp_match_del + 1), next_))
-        probing = probing & ~to_repl
+            rej_mat,
+            jnp.maximum(1, jnp.minimum(next_ - 1, reject_hint_del + 1)),
+            next_)
+        if cfg.mailboxes:
+            probing = probing | rej_mat   # becomeProbe on rejection
+            # probe reset flush: optimistically pipelined appends beyond
+            # the conflict are now useless — clear the edge's same-term
+            # in-flight slots so the backtracked window goes out instead
+            # of waiting
+            app_at = jnp.where(
+                rej_mat[:, :, None]
+                & (app_term_box == g(term)[:, None, None]),
+                0, app_at)
+            # etcd re-sends IMMEDIATELY after maybeDecrTo (stepLeader
+            # APP_RESP reject -> send_append): enqueue the backtracked
+            # probe this tick.  Ring-reachable case only — the snapshot
+            # variant waits for the next send round on both sides.
+            snp_busy = (snp_at != 0) & (snp_term_box == g(term)[:, None])
+            prev_rs = next_ - 1
+            rs = mview_r(rej_mat & g(is_leader)[:, None] & ~eye_r
+                         & ~drop_r & ~snp_busy
+                         & (prev_rs >= g(snap_idx)[:, None]))
+            free_rs = (app_at == 0) \
+                | (app_term_box != g(term)[:, None, None])
+            rslot = jnp.argmax(free_rs, axis=2).astype(I32)
+            put_rs = rs[:, :, None] \
+                & (rslot[:, :, None]
+                   == jnp.arange(cfg.inflight, dtype=I32)[None, None])
+            app_at = jnp.where(put_rs, (now + 1 + lat)[:, :, None],
+                               app_at)
+            app_prev = jnp.where(put_rs, prev_rs[:, :, None], app_prev)
+            app_term_box = jnp.where(put_rs, g(term)[:, None, None],
+                                     app_term_box)
+            # heartbeat responses: liveness only (the etcd match<last
+            # resend trigger is unnecessary under send-time-drop wire
+            # semantics — nothing in flight can be lost, so slot clearing
+            # already guarantees probe retries)
+            due_hbr = (hbr_at_box > 0) & (now + 1 >= hbr_at_box)
+            val_hbr = due_hbr & g(is_leader)[:, None, None] \
+                & (g(term)[:, None, None] == hbr_term_box)
+            recent_active = recent_active | jnp.any(val_hbr, axis=2)
+            hbr_at_box = jnp.where(due_hbr, 0, hbr_at_box)
+            got_resp_r = got_resp_r | jnp.any(val_hbr, axis=(1, 2))
+
+        # -- leader transfer completion: once the target's log caught up,
+        # fire TIMEOUT_NOW on its wire slot (vendor stepLeader MsgAppResp
+        # transferee branch).  Single slot per target; concurrent
+        # transfers to one target are rare and last-writer-wins.
+        tgt = jnp.clip(transferee, 0, n - 1)
+        has_tx = is_leader & (transferee != NONE) & (tgt != node)
+        if not static_m:
+            tgt_mem = jnp.take_along_axis(member, tgt[:, None],
+                                          axis=1)[:, 0]
+            has_tx = has_tx & tgt_mem
+        tgt_r = g(tgt)
+        caught = g(has_tx) \
+            & (jnp.take_along_axis(match, tgt_r[:, None], axis=1)[:, 0]
+               == g(last))
+        if cfg.mailboxes:
+            tn_lat_r = jnp.take_along_axis(lat, tgt_r[:, None],
+                                           axis=1)[:, 0]
+        else:
+            tn_lat_r = jnp.zeros((R,), I32)
+        want_tn = caught & (tn_at[tgt_r] == 0) \
+            & ~jnp.take_along_axis(drop_r, tgt_r[:, None], axis=1)[:, 0]
+        send_tn = want_tn[:, None] & (tgt_r[:, None] == node[None, :])
+        any_tn = jnp.any(send_tn, axis=0)                        # [j]
+        tn_sel = jnp.argmax(send_tn, axis=0)   # lowest leader wins (rows
+        #                                        ascend with node id)
+        tn_src = jnp.where(any_tn, rows[tn_sel], 0).astype(I32)
+        tn_at = jnp.where(any_tn, now + 1 + tn_lat_r[tn_sel], tn_at)
+        tn_term = jnp.where(any_tn, term[tn_src], tn_term)
+        tn_from = jnp.where(any_tn, tn_src, tn_from)
+
+        # ---- Phase D: leader commit (quorum on the match row) ------------
+        # maybeCommit (vendor raft.go:478-486) takes the quorum-th largest
+        # match index. Equivalent decision, computed as the largest X in
+        # (commit, last] acked by a quorum — a fixed-depth binary search
+        # (range <= log_len, so ceil(log2(L))+1 rounds of compares)
+        # instead of sorting the match plane every tick.
+        match = jnp.where(g(is_leader)[:, None] & eye_r, g(last)[:, None],
+                          match)
+        q_row = quorum_row if static_m else g(quorum_row)
+        if cfg.peer_tiled:
+            # Banded bisect: the membership mask folds into each band
+            # compare (once per band) instead of materializing a full
+            # match_eff that every round re-compares.  Identity with the
+            # dense form: (where(member, match, -1) >= mid) ==
+            # member & (match >= mid) for every reachable mid
+            # (mid = (lo+hi+1)>>1 with lo, hi, match >= 0, so
+            # mid >= 0 > -1), and the integer band sums commute.
+            def _bisect(_, lo_hi):
+                lo, hi_b = lo_hi
+                mid = (lo + hi_b + 1) >> 1
+                cnt = _pcount(
+                    lambda j0: _pband(match, j0) >= mid[:, None],
+                    mem=member_r, rows_n=R)
+                ok = (cnt >= q_row) & (hi_b >= mid) & (mid > lo)
+                lo = jnp.where(ok, mid, lo)
+                hi_b = jnp.where(ok, hi_b, mid - 1)
+                return lo, hi_b
+        else:
+            match_eff = match if static_m else jnp.where(member_r, match,
+                                                         -1)
+
+            def _bisect(_, lo_hi):
+                lo, hi_b = lo_hi
+                mid = (lo + hi_b + 1) >> 1
+                cnt = jnp.sum((match_eff >= mid[:, None]).astype(I32),
+                              axis=1)
+                ok = (cnt >= q_row) & (hi_b >= mid) & (mid > lo)
+                lo = jnp.where(ok, mid, lo)
+                hi_b = jnp.where(ok, hi_b, mid - 1)
+                return lo, hi_b
+
+        iters = max(1, (cfg.log_len).bit_length() + 1)
+        mci_r, _ = jax.lax.fori_loop(0, iters, _bisect,
+                                     (g(commit), g(last)))
+        # inactive rows report mci = their own commit (a no-advance), so
+        # the is_leader-gated commit fold below is branch-independent
+        mci = mci_r if dense else commit.at[rows].set(
+            mci_r, unique_indices=True)
+
+        # ---- Phase R1 ack counts (raft/read/): the quorum confirmation
+        # reuses THIS tick's ack collective — the same ok/reject mats (and
+        # heartbeat responses on the mailbox wire) that just fed
+        # recent_active/progress — so a ReadIndex round costs no extra
+        # messages.  The lease/stamp decision itself runs after the
+        # segment (it reads the log for the own-term-commit guard).
+        rd_nack = None
+        if reads_on:
+            rd_ack = ok_mat | rej_mat
+            if cfg.mailboxes:
+                rd_ack = rd_ack | mview_r(jnp.any(val_hbr, axis=2))
+            if cfg.peer_tiled:
+                rd_nack_r = _pcount(
+                    lambda j0: _pband(rd_ack, j0) | _peye_rows(rows, j0),
+                    mem=member_r, rows_n=R)
+            else:
+                rd_nack_r = jnp.sum(mview_r(rd_ack | eye_r).astype(I32),
+                                    axis=1)
+            rd_nack = sfull(rd_nack_r, 0)
+
+        out = dict(
+            match=sc(match1, match), next_=sc(next1, next_),
+            recent_active=sc(ra1, recent_active),
+            tn_at=tn_at, tn_term=tn_term, tn_from=tn_from,
+            mci=mci, got_resp=sfull(got_resp_r, False))
+        if reads_on:
+            out["rd_nack"] = rd_nack
+        if cfg.mailboxes:
+            out.update(
+                probing=sc(probing0, probing),
+                app_at=sc(b_app_at, app_at),
+                app_prev=sc(b_app_prev, app_prev),
+                app_term=sc(b_app_term, app_term_box),
+                aresp_at=sc(state.aresp_at, aresp_at),
+                aresp_term=sc(state.aresp_term, aresp_term),
+                aresp_match=sc(state.aresp_match, aresp_match),
+                aresp_ok=sc(state.aresp_ok, aresp_ok),
+                hbr_at=sc(b_hbr_at, hbr_at_box))
+        return out
+
+    if sparse_on:
+        _ob = jax.lax.cond(sp_fits,
+                           lambda: _progress_b(sp_rows, False),
+                           lambda: _progress_b(node, True))
     else:
-        match = jnp.where(ok_mat, jnp.maximum(match, resp_match_del), match)
-        next_ = jnp.where(ok_mat,
-                          jnp.maximum(next_, resp_match_del + 1), next_)
-    # Probe decrement (maybeDecrTo, coarse): jump next back to the hint.
-    next_ = jnp.where(
-        rej_mat,
-        jnp.maximum(1, jnp.minimum(next_ - 1, reject_hint_del + 1)),
-        next_)
+        _ob = _progress_b(node, True)
+    match, next_ = _ob["match"], _ob["next_"]
+    recent_active = _ob["recent_active"]
+    tn_at, tn_term, tn_from = _ob["tn_at"], _ob["tn_term"], _ob["tn_from"]
+    mci, got_resp = _ob["mci"], _ob["got_resp"]
     if cfg.mailboxes:
-        probing = probing | rej_mat   # becomeProbe on rejection
-        # probe reset flush: optimistically pipelined appends beyond the
-        # conflict are now useless — clear the edge's same-term in-flight
-        # slots so the backtracked window goes out instead of waiting
-        app_at = jnp.where(
-            rej_mat[:, :, None] & (app_term_box == term[:, None, None]),
-            0, app_at)
-        # etcd re-sends IMMEDIATELY after maybeDecrTo (stepLeader
-        # APP_RESP reject -> send_append): enqueue the backtracked probe
-        # this tick.  Ring-reachable case only — the snapshot variant
-        # waits for the next send round on both sides.
-        snp_busy = (snp_at != 0) & (snp_term_box == term[:, None])
-        prev_rs = next_ - 1
-        rs = _mview(rej_mat & is_leader[:, None] & ~eye & ~drop
-                    & ~snp_busy & (prev_rs >= snap_idx[:, None]))
-        free_rs = (app_at == 0) | (app_term_box != term[:, None, None])
-        rslot = jnp.argmax(free_rs, axis=2).astype(I32)
-        put_rs = rs[:, :, None] \
-            & (rslot[:, :, None] == jnp.arange(K, dtype=I32)[None, None])
-        app_at = jnp.where(put_rs, (now + 1 + lat)[:, :, None], app_at)
-        app_prev = jnp.where(put_rs, prev_rs[:, :, None], app_prev)
-        app_term_box = jnp.where(put_rs, term[:, None, None], app_term_box)
-        # heartbeat responses: liveness only (the etcd match<last resend
-        # trigger is unnecessary under send-time-drop wire semantics —
-        # nothing in flight can be lost, so slot clearing already
-        # guarantees probe retries)
-        due_hbr = (hbr_at_box > 0) & (now + 1 >= hbr_at_box)
-        val_hbr = due_hbr & is_leader[:, None, None] \
-            & (term[:, None, None] == hbr_term_box)
-        recent_active = recent_active | jnp.any(val_hbr, axis=2)
-        hbr_at_box = jnp.where(due_hbr, 0, hbr_at_box)
+        probing = _ob["probing"]
+        app_at, app_prev = _ob["app_at"], _ob["app_prev"]
+        app_term_box = _ob["app_term"]
+        aresp_at, aresp_term = _ob["aresp_at"], _ob["aresp_term"]
+        aresp_match, aresp_ok = _ob["aresp_match"], _ob["aresp_ok"]
+        hbr_at_box = _ob["hbr_at"]
 
-    # -- leader transfer completion: once the target's log caught up,
-    # fire TIMEOUT_NOW on its wire slot (vendor stepLeader MsgAppResp
-    # transferee branch).  Single slot per target; concurrent transfers to
-    # one target are rare and last-writer-wins.
-    tgt = jnp.clip(transferee, 0, n - 1)
-    has_tx = is_leader & (transferee != NONE) & (tgt != node)
-    if not static_m:
-        tgt_mem = jnp.take_along_axis(member, tgt[:, None], axis=1)[:, 0]
-        has_tx = has_tx & tgt_mem
-    caught = has_tx & (match[node, tgt] == last)
-    if cfg.mailboxes:
-        tn_lat_i = lat[node, tgt]
-    else:
-        tn_lat_i = jnp.zeros((n,), I32)
-    want_tn = caught & (tn_at[tgt] == 0) & ~drop[node, tgt]
-    send_tn = want_tn[:, None] & (tgt[:, None] == node[None, :])  # [i, j]
-    any_tn = jnp.any(send_tn, axis=0)                             # [j]
-    tn_src = jnp.argmax(send_tn, axis=0).astype(I32)  # lowest leader wins
-    tn_at = jnp.where(any_tn, now + 1 + tn_lat_i[tn_src], tn_at)
-    tn_term = jnp.where(any_tn, term[tn_src], tn_term)
-    tn_from = jnp.where(any_tn, tn_src, tn_from)
-
-    # ---- Phase D: leader commit (quorum threshold on the match row) ------
-    # maybeCommit (vendor raft.go:478-486) takes the quorum-th largest match
-    # index. Equivalent decision, computed as the largest X in (commit, last]
-    # acked by a quorum — a fixed-depth binary search (range <= log_len, so
-    # ceil(log2(L))+1 rounds of [N, N] compares) instead of sorting [N, N]
-    # every tick.
-    match = jnp.where(is_leader[:, None] & eye, last[:, None], match)
-    if cfg.peer_tiled:
-        # Banded bisect: the membership mask folds into each band compare
-        # (once per band) instead of materializing a full [N, N] match_eff
-        # that every round re-compares.  Identity with the dense form:
-        # (where(member, match, -1) >= mid) == member & (match >= mid) for
-        # every reachable mid (mid = (lo+hi+1)>>1 with lo, hi, match >= 0,
-        # so mid >= 0 > -1), and the integer band sums commute.
-        def _bisect(_, lo_hi):
-            lo, hi_b = lo_hi
-            mid = (lo + hi_b + 1) >> 1
-            cnt = _pcount(lambda j0: _pband(match, j0) >= mid[:, None])
-            ok = (cnt >= quorum_row) & (hi_b >= mid) & (mid > lo)
-            lo = jnp.where(ok, mid, lo)
-            hi_b = jnp.where(ok, hi_b, mid - 1)
-            return lo, hi_b
-    else:
-        match_eff = match if static_m else jnp.where(member, match, -1)
-
-        def _bisect(_, lo_hi):
-            lo, hi_b = lo_hi
-            mid = (lo + hi_b + 1) >> 1
-            cnt = jnp.sum((match_eff >= mid[:, None]).astype(I32), axis=1)
-            ok = (cnt >= quorum_row) & (hi_b >= mid) & (mid > lo)
-            lo = jnp.where(ok, mid, lo)
-            hi_b = jnp.where(ok, hi_b, mid - 1)
-            return lo, hi_b
-
-    iters = max(1, (cfg.log_len).bit_length() + 1)
-    mci, _ = jax.lax.fori_loop(0, iters, _bisect, (commit, last))
+    # Commit fold, outside the segments (mci_term is a log read).
     mci_term = _term_own(cfg, log_term, snap_idx, snap_term, last, mci)
     can_commit = is_leader & (mci > commit) & (mci_term == term)
     commit = jnp.where(can_commit, mci, commit)
 
     # ---- Phase R1: lease renewal + ReadIndex stamping (raft/read/) -------
-    # Leadership confirmation reuses THIS tick's ack collective — the same
-    # [N, N] ok/reject mats (and heartbeat responses on the mailbox wire)
-    # that just fed recent_active/progress — so a ReadIndex round costs no
-    # extra messages.  A quorum of member acks in one tick both renews the
-    # tick-clock lease and, with the own-term-commit guard (the classic
-    # ReadIndex subtlety: a fresh leader's commit may lag the true
-    # frontier until its no-op commits), authorizes stamping the pending
-    # batch with the just-updated commit index.
+    # A quorum of member acks in one tick both renews the tick-clock lease
+    # and, with the own-term-commit guard (the classic ReadIndex subtlety:
+    # a fresh leader's commit may lag the true frontier until its no-op
+    # commits), authorizes stamping the pending batch with the
+    # just-updated commit index.
     if reads_on:
-        rd_ack = ok_mat | rej_mat
-        if cfg.mailboxes:
-            rd_ack = rd_ack | _mview(jnp.any(val_hbr, axis=2))
-        if cfg.peer_tiled:
-            rd_nack = _pcount(lambda j0: _pband(rd_ack, j0) | _peye(j0))
-        else:
-            rd_nack = jnp.sum(_mview(rd_ack | eye).astype(I32), axis=1)
+        rd_nack = _ob["rd_nack"]
         rd_is_leader = (role == LEADER) & alive
         rd_q_ok = rd_is_leader & (rd_nack >= quorum_row)
         rd_cterm_ok = (commit > 0) \
@@ -1444,6 +1864,21 @@ def step(state: SimState, cfg: SimConfig,
     pre = pre & (role == CANDIDATE)
     tx_cand = tx_cand & (role == CANDIDATE) & ~pre
     transferee = jnp.where(role == LEADER, transferee, NONE)
+
+    # Active-row TTL (sparse progress lowering): leaders/candidates pin
+    # their row hot; a row that just stepped down — or is still draining
+    # responses — keeps a countdown long enough to cover every in-flight
+    # message it could yet send, receive, or have answered
+    # (2*(latency+jitter) bounds the worst request+response round trip,
+    # +2 for the enqueue/deliver tick offsets).  Derived from end-of-tick
+    # values only, so both cond branches produce the same ttl bit-for-bit.
+    sp_fields = {}
+    if sparse_on:
+        ttl_w = 2 * (cfg.latency + cfg.latency_jitter) + 2
+        keep_hot = (role == CANDIDATE) | (role == LEADER) | got_resp
+        sp_fields = dict(active_ttl=jnp.where(
+            keep_hot, I32(ttl_w),
+            jnp.maximum(state.active_ttl - 1, 0)).astype(I32))
 
     # End-of-tick conf-gate scans, carried for the NEXT tick's Phase A/B
     # (exact there: nothing that runs before them mutates (applied, commit]
@@ -1690,6 +2125,7 @@ def step(state: SimState, cfg: SimConfig,
         hup_conf=hup_conf, tail_conf=tail_conf,
         tick=state.tick + 1,
         stats=stats,
+        **sp_fields,
         **ev_fields,
         **tel_fields,
         **rd_fields,

@@ -139,6 +139,22 @@ class SimConfig:
     # dense).  Chunks must divide n and be sublane-aligned (multiple of
     # 8); 128-multiples are recommended on real TPUs for lane alignment.
     peer_chunk: int = 1024
+    # Role-sparse per-peer progress (kernel.py sparse progress cond): slab
+    # height in rows.  Only rows whose node is a leader or candidate (plus
+    # rows still draining in-flight responses) ever mutate their [N, N]
+    # progress view — follower rows are dead weight — so when
+    # 0 < active_rows < n the kernel gathers those active rows into compact
+    # [A, N] slabs each tick, runs every elementwise progress/fan-out
+    # update (match/next_/granted/rejected bookkeeping and the ack folds
+    # that feed them) on the slabs, and scatters back.  Ticks where the
+    # active-row count exceeds A (election storms) take a bit-identical
+    # masked dense fallback, mirroring the tiled-log fallback contract
+    # (see TestSparseProgress).  active_rows=0 disables the sparse lowering
+    # explicitly; a value >= n disables it trivially (the default engages
+    # only on clusters larger than 16 rows).  Must be sublane-aligned
+    # (multiple of 8); the drain window that keeps in-flight responses
+    # active is 2*(latency + latency_jitter) + 2 ticks.
+    active_rows: int = 16
     # Linearizable read path (raft/read/): read_batch > 0 threads the
     # read-serving phases (R0 submit / R1 stamp / R2 settle) through the
     # tick and allocates the [N] read registers.  Each idle row auto-
@@ -214,6 +230,12 @@ class SimConfig:
     def num_peer_chunks(self) -> int:
         """Column bands per peer row (only meaningful when peer_tiled)."""
         return self.n // self.peer_chunk
+
+    @property
+    def active_rows_on(self) -> bool:
+        """True when the kernel compiles the role-sparse [A, N] progress
+        slabs instead of dense [N, N] elementwise progress writes."""
+        return 0 < self.active_rows < self.n
 
     @property
     def ack_depth(self) -> int:
@@ -315,6 +337,15 @@ class SimConfig:
                     f"peer_chunk={self.peer_chunk} must divide n={self.n} "
                     f"(the peer axis is sliced in whole column bands); set "
                     f"peer_chunk=0 to disable peer tiling")
+        if self.active_rows < 0:
+            raise ValueError(
+                f"active_rows must be >= 0, got {self.active_rows}")
+        if self.active_rows_on and self.active_rows % 8 != 0:
+            raise ValueError(
+                f"active_rows={self.active_rows} must be a multiple of 8 "
+                f"(sublane alignment for the gathered [A, N] progress "
+                f"slabs); set active_rows=0 to disable the sparse "
+                f"progress lowering")
 
 
 @jax.tree_util.register_dataclass
@@ -395,6 +426,14 @@ class SimState:
     # [0] campaigns started  [1] elections won
     # [2] sum of commit-index advance  [3] sum of applied-index advance
     stats: Optional[jax.Array] = None
+    # ---- role-sparse progress (cfg.active_rows_on; kernel.py) -----------
+    # active_ttl [N] i32: drain countdown keeping a row in the sparse
+    # active set while responses it solicited may still be in flight.
+    # Refreshed to 2*(latency + jitter) + 2 whenever the row ends a tick
+    # as leader/candidate or receives any response; decremented toward 0
+    # otherwise.  Rows with ttl == 0 and a follower role provably have no
+    # pending progress mutations, so the [A, N] slab can skip them.
+    active_ttl: Optional[jax.Array] = None
     # ---- flight recorder (cfg.record_events; flightrec/) ----------------
     # ev_buf [N, event_ring, 4] i32 rows of (tick, code, arg0, arg1);
     # ev_pos [N] is the CUMULATIVE events-written cursor per row (slot of
@@ -561,6 +600,7 @@ def init_state(cfg: SimConfig,
         tail_conf=jnp.zeros((n,), jnp.bool_),
         tick=jnp.zeros((), i32),
         stats=jnp.zeros((4,), i32) if cfg.collect_stats else None,
+        active_ttl=z(n) if cfg.active_rows_on else None,
         **(dict(ev_buf=z(n, cfg.event_ring, 4), ev_pos=z(n),
                 ev_alive=jnp.ones((n,), jnp.bool_), ev_drop=z(n))
            if cfg.record_events else {}),
@@ -615,20 +655,31 @@ def _initial_timeouts(cfg: SimConfig) -> jax.Array:
     return rand_timeout(cfg, node, jnp.zeros((cfg.n,), jnp.int32))
 
 
+def latency_at(cfg: SimConfig, tick: jax.Array, i: jax.Array,
+               j: jax.Array) -> jax.Array:
+    """Per-edge latency for arbitrary (broadcastable) sender/receiver index
+    arrays — the same hash latency_matrix uses, evaluated only at the
+    requested edges.  The role-sparse progress slabs (cfg.active_rows_on)
+    use this to rebuild [A, N] latency rows without materializing the full
+    [N, N] matrix; latency_matrix(cfg, t)[i, j] == latency_at(cfg, t, i, j)
+    bit-for-bit."""
+    shape = jnp.broadcast_shapes(jnp.shape(i), jnp.shape(j))
+    base = jnp.full(shape, cfg.latency, jnp.int32)
+    if cfg.latency_jitter == 0:
+        return base
+    h = hash32(i.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+               ^ j.astype(jnp.uint32) * jnp.uint32(0x01000193)
+               ^ tick.astype(jnp.uint32) * jnp.uint32(0xC2B2AE35)
+               ^ jnp.uint32(cfg.seed ^ 0x7A77))
+    return base + (h % jnp.uint32(cfg.latency_jitter + 1)).astype(jnp.int32)
+
+
 def latency_matrix(cfg: SimConfig, tick: jax.Array) -> jax.Array:
     """[N, N] per-message latency in ticks for messages SENT this tick:
     cfg.latency + hash(i, j, tick, seed) % (jitter+1).  Deterministic, so
     the oracle replays the identical schedule."""
-    n = cfg.n
-    base = jnp.full((n, n), cfg.latency, jnp.int32)
-    if cfg.latency_jitter == 0:
-        return base
-    i = jnp.arange(n, dtype=jnp.uint32)
-    h = hash32(i[:, None] * jnp.uint32(0x9E3779B1)
-               ^ i[None, :] * jnp.uint32(0x01000193)
-               ^ tick.astype(jnp.uint32) * jnp.uint32(0xC2B2AE35)
-               ^ jnp.uint32(cfg.seed ^ 0x7A77))
-    return base + (h % jnp.uint32(cfg.latency_jitter + 1)).astype(jnp.int32)
+    i = jnp.arange(cfg.n, dtype=jnp.uint32)
+    return latency_at(cfg, tick, i[:, None], i[None, :])
 
 
 def drop_matrix(cfg: SimConfig, tick: jax.Array, rate: float) -> jax.Array:

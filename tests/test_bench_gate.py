@@ -69,6 +69,28 @@ def test_gate_fails_on_injected_regression(tmp_path, capsys):
     assert "FAIL" in capsys.readouterr().out
 
 
+def test_gate_ab_ratio_series_extraction(tmp_path):
+    # A/B tripwire dicts (densepeer / sparseprog) surface their
+    # *_over_dense value as a gated <config>:ratio series; a collapsed
+    # lowering ratio fails the gate even when the raw rates hold steady
+    paths = []
+    for i, ratio in enumerate((1.5, 0.4), start=1):
+        p = tmp_path / f"BENCH_r0{i}.json"
+        p.write_text(json.dumps({"rc": 0, "parsed": {
+            "value": 100.0,
+            "configs_entries_per_s": {
+                "4096-sparseprog": {
+                    "dense": 10.0, "sparse_a16": 10.0 * ratio,
+                    "sparse_over_dense": ratio}}}}))
+        paths.append(str(p))
+    report = run_gate(paths=paths)
+    entry = report["series"]["4096-sparseprog:ratio"]
+    assert entry["gated"] and entry["last"] == 0.4
+    assert not report["ok"]
+    assert any(r.startswith("4096-sparseprog:ratio")
+               for r in report["failures"])
+
+
 @pytest.mark.slow
 def test_gate_skips_unusable_rounds(tmp_path):
     # rc!=0 and unparsable rounds carry no signal and are skipped whole;

@@ -12,24 +12,39 @@ trips this without running a single tick.
 
 CPU-backend numbers; the budget is about the program structure XLA emits,
 which the differential and DST suites pin for value-identity.
+
+Lever discipline: the tick kernel now has three independent lowering
+levers — log_chunk (tiled log axis), peer_chunk (banded quorum
+reductions), active_rows (role-sparse progress slabs) — and each budget
+was measured with ALL THREE at known settings.  Every pin passes all
+three explicitly and its comment names which one is under test, so a
+future lever (or a changed default) cannot silently move a pin's
+premise: a pin that fails after a default change is telling you to
+re-measure, not to relax the budget.
 """
+
+import re
 
 import pytest
 
 from swarmkit_tpu.raft.sim import SimConfig, init_state
 from swarmkit_tpu.raft.sim.run import run_ticks
 
-# Between the measured tiled high-water mark (~378 MB) and the full-pass
+# Between the measured tiled high-water mark (~464 MB: sparse progress
+# active_rows=16 adds the cond's slab branch and a couple of defensive
+# [N, N] copies over the dense-progress ~344 MB) and the full-pass log
 # kernel's (~709 MB): headroom for compiler drift, hard fail on any
-# full-width materialization creeping back in.
+# full-width [N, L] materialization creeping back in.
 TEMP_BUDGET_BYTES = 512 * 1024 * 1024
 
 
 def test_headline_tiled_compile_fits_temp_budget():
+    # Lever under test: log_chunk (tiled).  Held fixed: peer_chunk=1024
+    # (banded), active_rows=16 (sparse progress) — the headline defaults.
     cfg = SimConfig(n=4096, log_len=8192, window=2048, apply_batch=2048,
                     max_props=2048, keep=500, static_members=True,
-                    log_chunk=1024)
-    assert cfg.tiled
+                    log_chunk=1024, peer_chunk=1024, active_rows=16)
+    assert cfg.tiled and cfg.peer_tiled and cfg.active_rows_on
     st = init_state(cfg)
     compiled = run_ticks.lower(st, cfg, 8, prop_count=64).compile()
     stats = compiled.memory_analysis()
@@ -46,10 +61,15 @@ def test_small_tiled_compile_fits_scaled_budget():
     """Tier-1-sized version of the same pin (n=256): catches the same
     full-materialization regressions in seconds.  Budget scaling: tiled
     temp is dominated by per-row O(window)/O(band) scratch, so 1/16 the
-    rows gets 1/16 the budget (plus a small constant floor)."""
+    rows gets 1/16 the budget (plus a small constant floor).
+
+    Lever under test: log_chunk.  Held fixed: peer_chunk=0 (n=256 is
+    below the band size, so banding is off either way), active_rows=16
+    (sparse progress; measured 21.2 MiB vs 20.6 dense — well inside the
+    scaled budget)."""
     cfg = SimConfig(n=256, log_len=8192, window=2048, apply_batch=2048,
                     max_props=2048, keep=500, static_members=True,
-                    log_chunk=1024)
+                    log_chunk=1024, peer_chunk=0, active_rows=16)
     st = init_state(cfg)
     compiled = run_ticks.lower(st, cfg, 8, prop_count=64).compile()
     stats = compiled.memory_analysis()
@@ -67,14 +87,18 @@ def test_small_tiled_compile_fits_scaled_budget():
 # [N, N] i32 match_eff buffer (where(member, match, -1): 64 MiB at
 # n=4096) before bisecting; the banded kernel folds the member band into
 # each [N, peer_chunk] pass instead.  Measured when pinned: banded
-# 195 MiB vs dense 259 MiB temp — the budget sits between, so the banded
+# 195 MiB vs dense 258 MiB temp — the budget sits between, so the banded
 # lowering passes a budget the dense lowering cannot meet, and a fusion
 # regression that re-materializes an [N, N] intermediate in the banded
 # path trips this without running a tick.
+#
+# Lever under test: peer_chunk.  Held fixed: log_chunk=128 (tiled),
+# active_rows=16 (sparse progress; the quoted budgets were re-measured
+# with the slab lowering on — it adds ~15 MiB to both variants).
 
 PEER_SHAPE = dict(n=4096, log_len=1024, window=128, apply_batch=128,
                   max_props=128, keep=100, static_members=False,
-                  log_chunk=128)
+                  log_chunk=128, active_rows=16)
 PEER_TEMP_BUDGET = 224 * 1024 * 1024
 
 
@@ -102,13 +126,57 @@ def test_peer_tiled_compile_fits_budget_dense_cannot():
         f"holds; re-measure and move PEER_TEMP_BUDGET")
 
 
+# ---- role-sparse progress pins ----------------------------------------------
+# The [A, N] progress slabs (cfg.active_rows) exist so the steady-state
+# tick's elementwise per-peer writes — match/next/granted/rejection
+# bookkeeping and the ack folds feeding them — run at [A, N] instead of
+# [N, N].  Temp size cannot pin this one: the sparse program carries the
+# bit-identical dense fallback as the other lax.cond branch, so its
+# peak temp is a strict superset of the dense program's.  What IS
+# compile-visible is the slab working set itself: the optimized HLO of
+# the sparse lowering contains hundreds of [A, N]-shaped ops (gathers,
+# slab elementwise updates, scatter sources), and the dense elementwise
+# lowering contains exactly zero.  A is chosen so [A, N] collides with
+# no other shape in the program.
+
+SPARSE_SHAPE = dict(n=256, log_len=1024, window=128, apply_batch=128,
+                    max_props=128, keep=100, static_members=True,
+                    log_chunk=128, peer_chunk=64)
+
+
+def _slab_op_count(cfg, a, ticks=4, prop_count=8):
+    st = init_state(cfg)
+    txt = run_ticks.lower(st, cfg, ticks,
+                          prop_count=prop_count).compile().as_text()
+    return len(re.findall(rf"\[{a},{cfg.n}\]", txt))
+
+
+def test_sparse_progress_lowers_slab_writes_dense_does_not():
+    # Lever under test: active_rows.  Held fixed: log_chunk=128 (tiled),
+    # peer_chunk=64 (banded).  Measured when pinned: 998 [24, 256] ops
+    # in the sparse program, 0 in the dense one — the floor of 100 is
+    # compiler-drift headroom, not a tight bound.
+    sparse = _slab_op_count(SimConfig(**SPARSE_SHAPE, active_rows=24), 24)
+    dense = _slab_op_count(SimConfig(**SPARSE_SHAPE, active_rows=0), 24)
+    assert sparse >= 100, (
+        f"sparse progress compile has only {sparse} [24, 256]-shaped ops "
+        f"— the active_rows lowering is no longer running the per-peer "
+        f"progress updates on [A, N] slabs")
+    assert dense == 0, (
+        f"dense progress compile has {dense} [24, 256]-shaped ops — the "
+        f"pin's premise (the dense elementwise lowering emits no "
+        f"slab-shaped work) no longer holds; re-measure")
+
+
 @pytest.mark.slow
 def test_sharded_32k_compile_has_no_full_peer_buffer():
     """The n=32768 headline rung: row-sharded over the 8-virtual-device
     mesh with banded peer reductions, the lowered program must never
     materialize an UNSHARDED (replicated) [N, N] temp.  Per-device temps
     at this shape are row slabs — [N/8, N] i32 is 512 MiB, and the scan
-    double-buffers a few of them: 2304 MiB measured when pinned.  The
+    double-buffers a few of them: 2304 MiB measured when pinned (1920
+    MiB re-measured with active_rows=16 — the [A, N] slabs retire some
+    full row-slab temps even inside the cond).  The
     budget adds ~20% compiler-drift headroom yet stays below the
     smallest possible full-[N, N] addition (a replicated bool is 1 GiB,
     an i32 4 GiB), so any quorum reduction falling back to a gathered
@@ -117,9 +185,11 @@ def test_sharded_32k_compile_has_no_full_peer_buffer():
     4096-row rung of the same config (bench.py 32768-sharded)."""
     from swarmkit_tpu.parallel import row_mesh, shard_rows
 
+    # Lever under test: peer_chunk under sharding.  Held fixed:
+    # log_chunk=0 (L=256 is already small), active_rows=16 (sparse).
     cfg = SimConfig(n=32768, log_len=256, window=32, apply_batch=32,
                     max_props=32, keep=16, static_members=True,
-                    log_chunk=0, peer_chunk=1024)
+                    log_chunk=0, peer_chunk=1024, active_rows=16)
     assert cfg.peer_tiled and cfg.num_peer_chunks == 32
     mesh = row_mesh(cfg.n)
     assert len(mesh.devices.ravel()) == 8, "8-device CPU mesh missing"

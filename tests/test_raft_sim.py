@@ -1157,3 +1157,209 @@ class TestTiledPeer:
         assert np.array_equal(res_b.viol, res_d.viol)
         assert np.array_equal(res_b.first_tick, res_d.first_tick)
         assert np.array_equal(res_b.bits_by_tick, res_d.bits_by_tick)
+
+
+class TestSparseProgress:
+    """The role-sparse progress lowering (0 < cfg.active_rows < n) gathers
+    the rows whose node is a leader or candidate — plus rows still
+    draining in-flight responses — into [A, N] slabs, runs every
+    elementwise per-peer progress/fan-out update on the slabs, and
+    scatters back; ticks where the active count exceeds A take a
+    bit-identical dense fallback (mirroring the tiled-log contract).
+    Like the other lowering levers this is an OPTIMIZATION, not a
+    semantic: every SimState field except the bookkeeping active_ttl
+    vector (which only exists under the sparse lowering) must be
+    bit-identical to the dense elementwise kernel on every schedule,
+    on all three wires, through elections, storms, transfers, and conf
+    changes."""
+
+    A = 8  # slab height: n=16 forces the fallback once >8 rows go hot
+
+    @staticmethod
+    def _field_names():
+        import dataclasses
+
+        from swarmkit_tpu.raft.sim.state import SimState
+        return [f.name for f in dataclasses.fields(SimState)
+                if f.name != "active_ttl"]
+
+    _fused_step = staticmethod(TestTiledPeer._fused_step)
+    _assert_identical = TestTiledPeer._assert_identical
+
+    def test_validation(self):
+        base = dict(n=16, log_len=256, window=32, apply_batch=64,
+                    max_props=16, keep=8)
+        with pytest.raises(ValueError, match="active_rows"):
+            SimConfig(**base, active_rows=-8)
+        with pytest.raises(ValueError, match="multiple of 8"):
+            SimConfig(**base, active_rows=12)
+        assert SimConfig(**base, active_rows=8).active_rows_on
+        assert not SimConfig(**base, active_rows=0).active_rows_on
+        # the default slab height only engages once n outgrows it
+        assert not SimConfig(**base).active_rows_on
+        assert SimConfig(**{**base, "n": 24}).active_rows_on
+        st = init_state(SimConfig(**base, active_rows=8))
+        assert st.active_ttl is not None and st.active_ttl.shape == (16,)
+        assert init_state(SimConfig(**base, active_rows=0)).active_ttl is None
+
+    @pytest.mark.parametrize(
+        "combo", [pytest.param("dynamic-sync", marks=pytest.mark.slow),
+                  "static-sync", "dynamic-mailbox"])
+    def test_bit_identity_under_faults(self, combo):
+        """300 faulted ticks (crashes, drops, leader transfers, bursty
+        fused proposals): the [A, N] slab kernel vs the dense elementwise
+        kernel, all SimState fields compared every tick.  static-sync +
+        dynamic-mailbox stay tier-1 (static/dynamic x both wires);
+        dynamic-sync is tier-2 for the CPU budget."""
+        static = combo.startswith("static")
+        base = dict(n=16, log_len=1024, window=64, apply_batch=64,
+                    max_props=64, keep=32, election_tick=14, seed=3,
+                    static_members=static)
+        if combo.endswith("mailbox"):
+            base.update(latency=2, latency_jitter=1, inflight=2)
+        cfg_s = SimConfig(**base, active_rows=self.A)
+        cfg_d = SimConfig(**base, active_rows=0)
+        assert cfg_s.active_rows_on and not cfg_d.active_rows_on
+        step_fused = self._fused_step()
+        fields = self._field_names()
+        rng = np.random.default_rng(42)
+        st_s, st_d = init_state(cfg_s), init_state(cfg_d)
+        for t in range(300):
+            alive = jnp.asarray(rng.random(16) > 0.08)
+            drop = jnp.asarray(rng.random((16, 16)) < 0.05)
+            cnt = jnp.asarray(int(rng.integers(0, 49)), jnp.int32)
+            if t % 37 == 36:
+                leaders = np.flatnonzero(np.asarray(st_d.role) == LEADER)
+                if len(leaders):
+                    lid, tgt = int(leaders[0]), int(rng.integers(16))
+                    st_s = transfer_leadership(st_s, cfg_s, lid, tgt)
+                    st_d = transfer_leadership(st_d, cfg_d, lid, tgt)
+            st_s = step_fused(st_s, cfg_s, alive, drop, cnt)
+            st_d = step_fused(st_d, cfg_d, alive, drop, cnt)
+            self._assert_identical(f"{combo}/sparse", t, st_d, st_s, fields)
+        assert int(np.asarray(st_d.commit).max()) > 100
+
+    def test_forced_fallback_election_storm(self):
+        """Deterministic fallback exercise: drop every non-self edge so
+        all 16 rows time out and campaign simultaneously — the active-row
+        count blows past A=8, so the sparse kernel MUST take its dense
+        fallback branch while the storm lasts, and must hand back to the
+        slab path bit-identically once the partition heals and the
+        cluster settles on one leader."""
+        from swarmkit_tpu.raft.sim.state import FOLLOWER
+
+        base = dict(n=16, log_len=256, window=32, apply_batch=64,
+                    max_props=16, keep=8, election_tick=10, seed=5,
+                    static_members=True)
+        cfg_s = SimConfig(**base, active_rows=self.A)
+        cfg_d = SimConfig(**base, active_rows=0)
+        step_fused = self._fused_step()
+        fields = self._field_names()
+        st_s, st_d = init_state(cfg_s), init_state(cfg_d)
+        alive = jnp.ones(16, bool)
+        no_drop = jnp.zeros((16, 16), bool)
+        storm_drop = ~jnp.eye(16, dtype=bool)
+        cnt = jnp.asarray(4, jnp.int32)
+
+        def tick(t, tag, drop):
+            nonlocal st_s, st_d
+            st_s = step_fused(st_s, cfg_s, alive, drop, cnt)
+            st_d = step_fused(st_d, cfg_d, alive, drop, cnt)
+            self._assert_identical(tag, t, st_d, st_s, fields)
+
+        for t in range(120):
+            tick(t, "elect", no_drop)
+            if len(leaders_of(st_d)):
+                break
+        assert len(leaders_of(st_d)) == 1
+        # storm: nobody hears anybody, every row escalates to candidate
+        peak = 0
+        for t in range(60):
+            tick(t, "storm", storm_drop)
+            peak = max(peak,
+                       int(np.sum(np.asarray(st_d.role) != FOLLOWER)))
+        assert peak > cfg_s.active_rows, (
+            f"storm never exceeded A={cfg_s.active_rows} active rows "
+            f"(peak {peak}) — the fallback branch was not exercised")
+        # heal: one leader again, steady state back on the slab path
+        for t in range(150):
+            tick(t, "heal", no_drop)
+            if len(leaders_of(st_d)):
+                break
+        assert len(leaders_of(st_d)) == 1
+        for t in range(20):
+            tick(t, "steady", no_drop)
+
+    def test_conf_change_removes_active_row_mid_tick(self):
+        """Removes the LEADER — the one guaranteed-active row — through a
+        committed CONF entry while replication is in flight: the row
+        leaves the membership (and with it the active set) mid-stream,
+        the slab gather/scatter must track the shrunk view exactly, and —
+        once the shell stops the removed process (raft.go:2005, the alive
+        mask) — the 15 survivors re-elect bit-identically to dense."""
+        from swarmkit_tpu.raft.sim import propose_conf
+
+        base = dict(n=16, log_len=256, window=32, apply_batch=64,
+                    max_props=16, keep=8, election_tick=10, seed=5)
+        cfg_s = SimConfig(**base, active_rows=self.A)
+        cfg_d = SimConfig(**base, active_rows=0)
+        fields = self._field_names()
+        st_s, st_d = init_state(cfg_s), init_state(cfg_d)
+        alive = jnp.ones(16, bool)
+
+        def tick(t, tag):
+            nonlocal st_s, st_d
+            st_s = step_j(st_s, cfg_s, alive=alive)
+            st_d = step_j(st_d, cfg_d, alive=alive)
+            self._assert_identical(tag, t, st_d, st_s, fields)
+
+        def stop(row):
+            nonlocal alive
+            alive = alive.at[row].set(False)
+
+        for t in range(120):
+            tick(t, "elect")
+            if len(leaders_of(st_d)):
+                break
+        (lead,) = leaders_of(st_d)
+        lead = int(lead)
+        st_s = propose_conf(st_s, cfg_s, jnp.asarray(lead, jnp.int32),
+                            jnp.asarray(True))
+        st_d = propose_conf(st_d, cfg_d, jnp.asarray(lead, jnp.int32),
+                            jnp.asarray(True))
+        for t in range(25):
+            tick(t, f"remove-leader-{lead}")
+        member = np.asarray(st_d.member)
+        others = [i for i in range(16) if i != lead]
+        assert not member[others, lead].any(), "leader removal not applied"
+        stop(lead)  # shell stops the removed manager (raft.go:2005)
+        for t in range(150):
+            tick(t, "re-elect")
+            new = [x for x in leaders_of(st_d) if x != lead]
+            if new:
+                break
+        assert [x for x in leaders_of(st_d) if x != lead], \
+            "no re-election after removing the leader row"
+
+    @pytest.mark.slow  # tier-2: CPU-heavy, see ROADMAP tier-1 budget
+    def test_dst_cross_check_equal_bitmasks(self):
+        """64 fault schedules x 100 ticks through the DST explorer (vmap
+        lowers the sparse/dense lax.cond to a select, so BOTH branches
+        run on every schedule), once per progress lowering: zero
+        violations on stock profiles and the SAME per-schedule violation
+        bitmask and per-tick bit trace."""
+        from swarmkit_tpu import dst
+
+        base = dict(n=16, log_len=64, window=8, apply_batch=16, max_props=8,
+                    keep=4, election_tick=10, seed=77)
+        cfg_s = SimConfig(**base, active_rows=self.A)
+        cfg_d = SimConfig(**base, active_rows=0)
+        assert cfg_s.active_rows_on and not cfg_d.active_rows_on
+        batch, names = dst.make_batch(cfg_d, ticks=100, schedules=64, seed=9)
+        res_s = dst.explore(init_state(cfg_s), cfg_s, batch, profiles=names)
+        res_d = dst.explore(init_state(cfg_d), cfg_d, batch, profiles=names)
+        assert res_s.violating.size == 0, \
+            [dst.bits_to_names(int(res_s.viol[s])) for s in res_s.violating]
+        assert np.array_equal(res_s.viol, res_d.viol)
+        assert np.array_equal(res_s.first_tick, res_d.first_tick)
+        assert np.array_equal(res_s.bits_by_tick, res_d.bits_by_tick)

@@ -46,6 +46,23 @@ def add_common_args(ap: argparse.ArgumentParser) -> None:
                     "DST and model-checker artifacts alike)")
 
 
+def add_active_rows_arg(ap: argparse.ArgumentParser) -> None:
+    """The role-sparse progress lowering knob both sweep vocabularies
+    share (SimConfig.active_rows): 0 = dense elementwise per-peer
+    writes, a multiple of 8 below n = [A, N] slab lowering with the
+    dense fallback armed.  None leaves the SimConfig default."""
+    ap.add_argument("--active-rows", type=int, default=None, metavar="A",
+                    help="role-sparse progress lowering "
+                    "(SimConfig.active_rows): 0 = dense elementwise "
+                    "per-peer writes, a multiple of 8 < n = [A, N] slab "
+                    "kernel; default = SimConfig default")
+
+
+def active_rows_kw(active_rows) -> dict:
+    """SimConfig kwargs for an --active-rows value (None = default)."""
+    return {} if active_rows is None else {"active_rows": active_rows}
+
+
 def artifact_path(out, default_name: str) -> str:
     """Resolve --out (None | directory | file path) to a file path."""
     if out is None:

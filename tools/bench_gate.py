@@ -7,8 +7,12 @@ against the best prior round, per series:
 
 - ``headline`` — ``parsed["value"]`` (committed entries/s);
 - one series per numeric entry of ``parsed["configs_entries_per_s"]``
-  ("skipped (cpu)"-style strings, A/B dicts like the densepeer
-  tripwire, and 0.0 placeholders are not rates and carry no signal).
+  ("skipped (cpu)"-style strings and 0.0 placeholders are not rates and
+  carry no signal);
+- one ``<config>:ratio`` series per A/B dict entry (the densepeer and
+  sparseprog tripwires): the dict's ``*_over_dense`` value is gated like
+  a rate, so the banded/dense and sparse/dense lowering ratios are
+  standing regression tripwires, not just logged numbers.
 
 Rounds with ``rc != 0`` or no parsed line are skipped whole (r01/r02
 in this repo's own history: tunnel faults, not regressions).  A series
@@ -51,6 +55,13 @@ def _series_points(rounds: list[tuple[str, dict]]) -> dict[str, list]:
         for cname, cv in (cfgs or {}).items() if isinstance(cfgs, dict) else ():
             if _is_rate(cv):
                 series.setdefault(cname, []).append((rname, float(cv)))
+            elif isinstance(cv, dict):
+                # A/B tripwire entry (densepeer / sparseprog): gate the
+                # lowering ratio itself
+                for k, rv in cv.items():
+                    if k.endswith("_over_dense") and _is_rate(rv):
+                        series.setdefault(f"{cname}:ratio", []).append(
+                            (rname, float(rv)))
     return series
 
 
@@ -87,8 +98,9 @@ def run_gate(paths=None, tol: float = 0.5) -> dict:
             entry["last"] = last
             entry["ratio"] = round(last / baseline, 4)
             if last < baseline * (1.0 - tol):
+                unit = "" if sname.endswith(":ratio") else " entries/s"
                 report["failures"].append(
-                    f"{sname}: {last:,.1f} entries/s in {last_round} is below "
+                    f"{sname}: {last:,.1f}{unit} in {last_round} is below "
                     f"{1.0 - tol:.2f}x the best prior round ({baseline:,.1f})")
         report["series"][sname] = entry
     report["ok"] = not report["failures"]

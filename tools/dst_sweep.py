@@ -56,15 +56,18 @@ MUTATION_PROFILES = {
 
 
 def _cfg(n: int, seed: int, reads: int = 2,
-         peer_chunk=None) -> SimConfig:
+         peer_chunk=None, active_rows=None) -> SimConfig:
     """The DST cluster shape: small rows, small ring — schedule diversity,
     not cluster size, is the search dimension (mirrors the differential
     suite's CFG5).  `reads` enables the linearizable read path so the
     LINEARIZABLE_READ checker is armed (0 sweeps the read-free kernel).
     `peer_chunk` picks the peer-axis lowering (None = SimConfig default;
-    0 = dense; a divisor of n = hierarchical banded quorum counts), so
-    sweeps can run in either lowering without code edits."""
+    0 = dense; a divisor of n = hierarchical banded quorum counts) and
+    `active_rows` the progress lowering (0 = dense elementwise, a
+    multiple of 8 < n = [A, N] slabs), so sweeps can run in any lowering
+    without code edits."""
     kw = {} if peer_chunk is None else {"peer_chunk": peer_chunk}
+    kw.update(_cli_common.active_rows_kw(active_rows))
     return SimConfig(n=n, log_len=64, window=8, apply_batch=16, max_props=8,
                      keep=4, election_tick=10, seed=seed, read_batch=reads,
                      **kw)
@@ -73,9 +76,9 @@ def _cfg(n: int, seed: int, reads: int = 2,
 def run_sweep(schedules: int = 256, ticks: int = 100, seed: int = 0,
               n: int = 5, prop_count: int = 2, profiles=dst.PROFILES,
               mutation=None, reads: int = 2, verbose: bool = True,
-              peer_chunk=None) -> dict:
+              peer_chunk=None, active_rows=None) -> dict:
     """One explore() call; returns a result summary dict (importable)."""
-    cfg = _cfg(n, seed, reads, peer_chunk)
+    cfg = _cfg(n, seed, reads, peer_chunk, active_rows)
     batch, names = dst.make_batch(cfg, ticks=ticks, schedules=schedules,
                                   seed=seed, profiles=profiles)
     res = dst.explore(init_state(cfg), cfg, batch, profiles=names,
@@ -108,13 +111,14 @@ def run_mutation_demo(schedules: int = 24, ticks: int = 100, seed: int = 0,
                       n: int = 5, prop_count: int = 2,
                       mutation: str = DEFAULT_MUTATION,
                       out_path=None, profiles=None,
-                      verbose: bool = True, peer_chunk=None) -> dict:
+                      verbose: bool = True, peer_chunk=None,
+                      active_rows=None) -> dict:
     """Detect -> shrink -> dump -> replay one seeded mutation repro."""
     if profiles is None:
         profiles = MUTATION_PROFILES.get(mutation, dst.PROFILES)
     sweep = run_sweep(schedules, ticks, seed, n, prop_count, profiles,
                       mutation=mutation, verbose=verbose,
-                      peer_chunk=peer_chunk)
+                      peer_chunk=peer_chunk, active_rows=active_rows)
     res, batch, names, cfg = (sweep["_result"], sweep["_batch"],
                               sweep["_names"], sweep["_cfg"])
     demo = {"mutation": mutation, "caught": bool(len(res.violating)),
@@ -252,6 +256,7 @@ def main(argv=None) -> int:
                     "divisor of --n (multiple of 8) = hierarchical banded "
                     "quorum counts; default = SimConfig default (dense at "
                     "DST cluster sizes)")
+    _cli_common.add_active_rows_arg(ap)
     ap.add_argument("--mutate", default=None,
                     help="run ONLY a mutation sweep with this broken-kernel "
                     "knob (e.g. commit_no_quorum) instead of stock+demo")
@@ -282,12 +287,14 @@ def main(argv=None) -> int:
         demo = run_mutation_demo(args.schedules, args.ticks, args.seed,
                                  args.n, prop_count, args.mutate,
                                  out_path=args.out,
-                                 peer_chunk=args.peer_chunk)
+                                 peer_chunk=args.peer_chunk,
+                                 active_rows=args.active_rows)
         return 0 if demo["caught"] and demo.get("replay_matches") else 1
 
     sweep = run_sweep(args.schedules, args.ticks, args.seed, args.n,
                       prop_count, profiles, reads=args.reads,
-                      peer_chunk=args.peer_chunk)
+                      peer_chunk=args.peer_chunk,
+                      active_rows=args.active_rows)
     ok = sweep["violations"] == 0
     if not ok:
         res, names = sweep["_result"], sweep["_names"]
@@ -302,7 +309,8 @@ def main(argv=None) -> int:
                 min(args.schedules, 24), args.ticks, args.seed, args.n,
                 prop_count, mutation,
                 out_path=args.out if mutation == DEFAULT_MUTATION else None,
-                peer_chunk=args.peer_chunk)
+                peer_chunk=args.peer_chunk,
+                active_rows=args.active_rows)
             ok = ok and demo["caught"] and demo.get("replay_matches", False)
 
     print("PASS" if ok else "FAIL", flush=True)

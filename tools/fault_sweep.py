@@ -29,13 +29,17 @@ kernel in the requested peer-axis lowering (``SimConfig.peer_chunk``,
 banded hierarchical quorum reductions) with a dense cross-check: the
 violation bitmasks and first-violation ticks must match bit-for-bit.
 This runs the sweep's fault vocabulary in either lowering without code
-edits; ``--peer-chunk 0`` pins the dense path only.
+edits; ``--peer-chunk 0`` pins the dense path only.  ``--active-rows``
+does the same for the role-sparse progress lowering
+(``SimConfig.active_rows``): a nonzero A runs the [A, N] slab kernel
+with a dense-progress cross-check, 0 pins the dense elementwise path.
 
 Usage:
     python tools/fault_sweep.py                       # full sweep
     python tools/fault_sweep.py --wires grpc --plans crash,partition
     python tools/fault_sweep.py --seeds 2009343,7
     python tools/fault_sweep.py --peer-chunk 8        # + device cross-check
+    python tools/fault_sweep.py --active-rows 8       # + sparse cross-check
 """
 
 from __future__ import annotations
@@ -55,6 +59,8 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools import _cli_common  # noqa: E402
 
 from swarmkit_tpu.api import Annotations, Node as ApiNode, NodeSpec  # noqa: E402
 from swarmkit_tpu.metrics.registry import MetricsRegistry  # noqa: E402
@@ -474,15 +480,20 @@ def _device_plan(name: str, addrs: list[str]) -> FaultPlan:
 
 def run_device_precheck(plans=PLANS, seeds=DEFAULT_SEEDS, peer_chunk: int = 8,
                         n: int = 16, ticks: int = 60,
-                        verbose: bool = True) -> list[dict]:
+                        verbose: bool = True,
+                        active_rows=None) -> list[dict]:
     """Lower every (plan, seed) to a device fault schedule and run it
-    through the DST kernel with ``SimConfig.peer_chunk=peer_chunk``.
+    through the DST kernel with ``SimConfig.peer_chunk=peer_chunk`` and
+    ``SimConfig.active_rows=active_rows`` (None = default).
 
     When the chunk selects the banded lowering the run is cross-checked
     against the dense kernel: violation bitmasks, first-violation ticks,
     and per-tick bit traces must match exactly (the hierarchical quorum
     reductions are integer sums, so any drift is a bug, not noise).
-    ``peer_chunk=0`` runs the dense lowering alone.
+    ``peer_chunk=0`` runs the dense lowering alone.  Likewise, when
+    ``active_rows`` selects the role-sparse progress slabs the run is
+    cross-checked against the dense elementwise progress kernel
+    (``active_rows=0``) under the same peer lowering.
     """
     import jax
     import numpy as np
@@ -490,10 +501,11 @@ def run_device_precheck(plans=PLANS, seeds=DEFAULT_SEEDS, peer_chunk: int = 8,
     from swarmkit_tpu import dst
     from swarmkit_tpu.raft.sim.state import SimConfig, init_state
 
-    def _cfg(chunk: int, seed: int) -> SimConfig:
+    def _cfg(chunk: int, seed: int, ar=active_rows) -> SimConfig:
         return SimConfig(n=n, log_len=64, window=8, apply_batch=16,
                          max_props=8, keep=4, election_tick=10, seed=seed,
-                         log_chunk=0, peer_chunk=chunk)
+                         log_chunk=0, peer_chunk=chunk,
+                         **_cli_common.active_rows_kw(ar))
 
     def _run(cfg: SimConfig, sched):
         batched = jax.tree_util.tree_map(lambda a: a[None], sched)
@@ -512,7 +524,8 @@ def run_device_precheck(plans=PLANS, seeds=DEFAULT_SEEDS, peer_chunk: int = 8,
             res = _run(cfg, sched)
             ok, err = True, ""
             notes = (f"viol=0x{int(res.viol[0]):x} "
-                     f"lowering={'banded' if cfg.peer_tiled else 'dense'}")
+                     f"lowering={'banded' if cfg.peer_tiled else 'dense'}"
+                     + ("+sparse" if cfg.active_rows_on else ""))
             if cfg.peer_tiled:
                 ref = _run(_cfg(0, seed), sched)
                 same = (np.array_equal(res.viol, ref.viol)
@@ -524,8 +537,22 @@ def run_device_precheck(plans=PLANS, seeds=DEFAULT_SEEDS, peer_chunk: int = 8,
                     err = (f"banded/dense divergence: viol "
                            f"{res.viol.tolist()} vs {ref.viol.tolist()}")
                 else:
-                    notes += " == dense"
-            results.append({"wire": f"device(pc={peer_chunk})",
+                    notes += " == dense-peer"
+            if ok and cfg.active_rows_on:
+                ref = _run(_cfg(peer_chunk, seed, ar=0), sched)
+                same = (np.array_equal(res.viol, ref.viol)
+                        and np.array_equal(res.first_tick, ref.first_tick)
+                        and np.array_equal(res.bits_by_tick,
+                                           ref.bits_by_tick))
+                if not same:
+                    ok = False
+                    err = (f"sparse/dense progress divergence: viol "
+                           f"{res.viol.tolist()} vs {ref.viol.tolist()}")
+                else:
+                    notes += " == dense-progress"
+            wire = f"device(pc={peer_chunk}" + (
+                f",ar={active_rows})" if active_rows is not None else ")")
+            results.append({"wire": wire,
                             "plan": plan_name, "seed": seed, "ok": ok,
                             "notes": notes, "error": err,
                             "secs": round(time.monotonic() - t0, 2)})
@@ -614,6 +641,7 @@ def main(argv=None) -> int:
                     help="also run every plan through the DST kernel in "
                          "this peer-axis lowering (SimConfig.peer_chunk; "
                          "0 = dense, else banded + dense cross-check)")
+    _cli_common.add_active_rows_arg(ap)
     args = ap.parse_args(argv)
 
     wires = [w for w in args.wires.split(",") if w]
@@ -627,9 +655,11 @@ def main(argv=None) -> int:
             ap.error(f"unknown plan {p!r}")
 
     results = []
-    if args.peer_chunk is not None:
-        results += run_device_precheck(plans, seeds,
-                                       peer_chunk=args.peer_chunk)
+    if args.peer_chunk is not None or args.active_rows is not None:
+        results += run_device_precheck(
+            plans, seeds,
+            peer_chunk=args.peer_chunk if args.peer_chunk is not None else 8,
+            active_rows=args.active_rows)
     results += run_sweep(wires, plans, seeds, flight_dir=args.flight_dir)
     failed = [r for r in results if not r["ok"]]
     print(f"\n{len(results) - len(failed)}/{len(results)} scenarios passed")

@@ -11,13 +11,17 @@ registers the axon TPU platform and ignores JAX_PLATFORMS):
    timed in isolation over realistic array shapes, attributing the delta.
 
 Usage: python tools/perf_model.py [--quick] [--tiled {on,off,both}]
-                                  [--peer-tiled {on,off,both}] [--reads]
+                                  [--peer-tiled {on,off,both}]
+                                  [--active-rows {on,off,both}] [--reads]
 Prints a markdown report to stdout (paste into PERF.md).  --tiled runs the
 chunked-log-axis A/B instead (ms/tick per variant plus the analytic
 swarm_kernel_bytes_touched{phase=...,variant=...} gauges).  --peer-tiled
 runs the peer-axis A/B: hierarchical banded quorum reductions
 (SimConfig.peer_chunk) vs dense [N, N] tallies on the [N, N]-dominated
-shape, with phase="votes"|"commit" bytes rows.  --reads runs
+shape, with phase="votes"|"commit" bytes rows.  --active-rows runs the
+role-sparse progress A/B: [A, N] slab per-peer state writes
+(SimConfig.active_rows) vs the dense elementwise kernel, with
+phase="progress" bytes rows.  --reads runs
 the linearizable-read A/B instead: tick-clock leases on (lease-valid
 leaders serve with zero extra collectives) vs off (every batch waits for
 a ReadIndex quorum confirmation), reads/s + ms/tick per wire, plus the
@@ -62,17 +66,17 @@ def _phase_gauge(phase: str, ms: float) -> None:
         phase=phase).set(ms)
 
 
-def steady_rate(n: int, ticks: int = 64, static: bool = False, **kw):
-    """Per-tick ms + entries/s for the bench steady-state flow."""
-    kw.setdefault("log_len", 8192)
-    cfg = SimConfig(n=n, window=2048, apply_batch=2048,
-                    max_props=2048, keep=500, seed=42, election_tick=16,
-                    static_members=static, **kw)
+def _steady_harness(cfg: SimConfig, ticks: int):
+    """The A/B steady-state scaffold every report variant shares: elect a
+    leader, warm the jit cache with one full run, then take the best-of-3
+    wall time of a `ticks`-tick steady-state scan.  Returns
+    (ms_per_tick, best_wall_seconds, start_state, final_state) so callers
+    can derive entries/s / reads/s deltas from the same run."""
     st = init_state(cfg)
     with OBS.timed("run_until_leader"):
         st, _ = run_until_leader(st, cfg, max_ticks=512)
         jax.block_until_ready(st.term)
-    assert bool(has_leader(st)), f"no leader at n={n}"
+    assert bool(has_leader(st)), f"no leader at n={cfg.n}"
     warm, _ = run_ticks(st, cfg, ticks, prop_count=cfg.max_props)
     jax.block_until_ready(warm.commit)
     best = float("inf")
@@ -82,12 +86,22 @@ def steady_rate(n: int, ticks: int = 64, static: bool = False, **kw):
             fin, _ = run_ticks(st, cfg, ticks, prop_count=cfg.max_props)
             jax.block_until_ready(fin.commit)
         best = min(best, time.perf_counter() - t0)
+    return best / ticks * 1e3, best, st, fin
+
+
+def steady_rate(n: int, ticks: int = 64, static: bool = False, **kw):
+    """Per-tick ms + entries/s for the bench steady-state flow."""
+    kw.setdefault("log_len", 8192)
+    cfg = SimConfig(n=n, window=2048, apply_batch=2048,
+                    max_props=2048, keep=500, seed=42, election_tick=16,
+                    static_members=static, **kw)
+    ms, best, st, fin = _steady_harness(cfg, ticks)
     ents = int(committed_entries(fin)) - int(committed_entries(st))
     rate = ents / best
     g = obs_catalog.get(OBS.obs, "swarm_bench_entries_per_second")
     g.labels(config=f"perf-model-n{n}-"
              f"{'static' if static else 'dynamic'}").set(rate)
-    return best / ticks * 1e3, rate
+    return ms, rate
 
 
 def _time_jit(fn, *args, reps: int = 20):
@@ -219,21 +233,8 @@ def peer_steady(n: int, chunk: int, ticks: int = 32, static: bool = True):
     cfg = SimConfig(n=n, log_len=4096, window=256, apply_batch=256,
                     max_props=256, keep=500, seed=42, election_tick=16,
                     static_members=static, log_chunk=256, peer_chunk=chunk)
-    st = init_state(cfg)
-    with OBS.timed("run_until_leader"):
-        st, _ = run_until_leader(st, cfg, max_ticks=512)
-        jax.block_until_ready(st.term)
-    assert bool(has_leader(st)), f"no leader at n={n}"
-    warm, _ = run_ticks(st, cfg, ticks, prop_count=cfg.max_props)
-    jax.block_until_ready(warm.commit)
-    best = float("inf")
-    for _ in range(3):
-        with OBS.timed("run_ticks"):
-            t0 = time.perf_counter()
-            fin, _ = run_ticks(st, cfg, ticks, prop_count=cfg.max_props)
-            jax.block_until_ready(fin.commit)
-        best = min(best, time.perf_counter() - t0)
-    return best / ticks * 1e3
+    ms, _, _, _ = _steady_harness(cfg, ticks)
+    return ms
 
 
 def peer_micro(n: int, chunk: int, reps: int = 10):
@@ -389,6 +390,78 @@ def peer_report(mode: str, quick: bool) -> None:
         print(row + " |")
 
 
+def sparse_steady(n: int, active_rows: int, ticks: int = 32):
+    """Per-tick ms + entries/s on the elementwise-progress-dominated
+    shape: log axis tiled, peer reductions banded, small cursor work —
+    exactly the residual O(N^2) the role-sparse slabs rewrite.  With
+    active_rows=0 the tick pays the historical dense per-peer writes;
+    with 0 < A < n the steady-state tick runs them on [A, n] slabs."""
+    cfg = SimConfig(n=n, log_len=4096, window=256, apply_batch=256,
+                    max_props=256, keep=500, seed=42, election_tick=16,
+                    static_members=True, log_chunk=256,
+                    peer_chunk=min(1024, n), active_rows=active_rows)
+    ms, best, st, fin = _steady_harness(cfg, ticks)
+    ents = int(committed_entries(fin)) - int(committed_entries(st))
+    return ms, ents / best
+
+
+def _progress_bytes_touched(n: int, active_rows: int, variant: str) -> None:
+    """Publish the analytic per-tick elementwise progress traffic as
+    swarm_kernel_bytes_touched{phase="progress",variant=...}.
+
+    The per-peer progress state a steady-state tick rewrites is two
+    [rows, N] i32 planes (match, next), three [rows, N] bool planes
+    (granted, rejection hints, recent_active), and the two [rows, N]
+    bool ack-fold intermediates (ok/reject) that feed them: 13 bytes per
+    (row, peer) cell.  dense: rows = N, every tick.  sparse: rows = A
+    plus the [N] i32 TTL vector and the [A] gather/scatter index
+    traffic; the [N, N] planes are only touched on the A-row scatter
+    band."""
+    g = obs_catalog.get(OBS.obs, "swarm_kernel_bytes_touched")
+    cell = 2 * 4 + 3 * 1 + 2 * 1
+    if active_rows:
+        g.labels(phase="progress", variant=variant).set(
+            active_rows * n * cell + n * 4 + active_rows * 4)
+    else:
+        g.labels(phase="progress", variant=variant).set(n * n * cell)
+
+
+def sparse_report(mode: str, quick: bool) -> None:
+    """--active-rows {on,off,both}: A/B the role-sparse [A, N] progress
+    slabs (SimConfig.active_rows) against the dense elementwise per-peer
+    writes on the progress-dominated shape (log tiled, peers banded,
+    static_members, synchronous wire)."""
+    variants = {"on": ("sparse",), "off": ("dense",),
+                "both": ("dense", "sparse")}[mode]
+    points = [(1024, 16)]
+    if not quick:
+        points.append((4096, 16))
+    print("\n## Role-sparse progress A/B (static_members, synchronous "
+          "wire, log_chunk=256, peer_chunk banded)\n")
+    print("Steady state has one leader and no candidates, so the sparse "
+          "tick gathers the A hot rows, runs every per-peer progress "
+          "write at [A, n], and scatters back; active_rows=0 is the "
+          "historical dense elementwise kernel.  Best-of-3 wall times; "
+          "the sparse/dense ratio is the stable signal.\n")
+    print("| n | active_rows | " + " | ".join(
+        f"{v} ms/tick" for v in variants)
+        + " | " + " | ".join(f"{v} entries/s" for v in variants)
+        + (" | speedup |" if len(variants) == 2 else " |"))
+    print("|---|---|" + "---|" * (2 * len(variants) + (len(variants) == 2)))
+    for n, a in points:
+        ms, eps = {}, {}
+        for v in variants:
+            ar = a if v == "sparse" else 0
+            ms[v], eps[v] = sparse_steady(n, ar)
+            _progress_bytes_touched(n, ar, v)
+        row = (f"| {n} | {a} | "
+               + " | ".join(f"{ms[v]:.2f}" for v in variants) + " | "
+               + " | ".join(f"{eps[v]:,.0f}" for v in variants))
+        if len(variants) == 2:
+            row += f" | {ms['dense'] / ms['sparse']:.2f}x"
+        print(row + " |")
+
+
 def read_steady(n: int, ticks: int = 64, leases: bool = True, **kw):
     """Per-tick ms + reads/s + entries/s with the read path compiled in
     (32 reads per row per refill, leases on or off)."""
@@ -396,23 +469,10 @@ def read_steady(n: int, ticks: int = 64, leases: bool = True, **kw):
     cfg = SimConfig(n=n, window=2048, apply_batch=2048, max_props=2048,
                     keep=500, seed=42, election_tick=16, static_members=True,
                     read_batch=32, read_leases=leases, **kw)
-    st = init_state(cfg)
-    with OBS.timed("run_until_leader"):
-        st, _ = run_until_leader(st, cfg, max_ticks=512)
-        jax.block_until_ready(st.term)
-    assert bool(has_leader(st)), f"no leader at n={n}"
-    warm, _ = run_ticks(st, cfg, ticks, prop_count=cfg.max_props)
-    jax.block_until_ready(warm.commit)
-    best = float("inf")
-    for _ in range(3):
-        with OBS.timed("run_ticks"):
-            t0 = time.perf_counter()
-            fin, _ = run_ticks(st, cfg, ticks, prop_count=cfg.max_props)
-            jax.block_until_ready(fin.commit)
-        best = min(best, time.perf_counter() - t0)
+    ms, best, st, fin = _steady_harness(cfg, ticks)
     reads = int(reads_served(fin)) - int(reads_served(st))
     ents = int(committed_entries(fin)) - int(committed_entries(st))
-    return best / ticks * 1e3, reads / best, ents / best
+    return ms, reads / best, ents / best
 
 
 def _read_bytes_touched(n: int) -> None:
@@ -470,6 +530,17 @@ def main():
     quick = "--quick" in sys.argv
     if "--reads" in sys.argv:
         reads_report(quick)
+        print("\n## Live metrics (registry render)\n")
+        print("```")
+        print(obs_registry.DEFAULT.render().rstrip())
+        print("```")
+        return
+    if "--active-rows" in sys.argv:
+        mode = sys.argv[sys.argv.index("--active-rows") + 1]
+        if mode not in ("on", "off", "both"):
+            raise SystemExit(
+                f"--active-rows {mode}: expected on, off, or both")
+        sparse_report(mode, quick)
         print("\n## Live metrics (registry render)\n")
         print("```")
         print(obs_registry.DEFAULT.render().rstrip())
