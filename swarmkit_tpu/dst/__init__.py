@@ -23,12 +23,15 @@ Layout:
 """
 
 from swarmkit_tpu.dst.schedule import (
-    EXTRA_PROFILES, PROFILES, FaultSchedule, apply_term_inflation,
+    ATTACK_LEAVES, ATTACK_PROFILES, ATTACK_SIGNATURE_CODES, EXTRA_PROFILES,
+    PROFILES, FaultSchedule, apply_append_flood, apply_rejoin_campaign,
+    apply_term_inflation, apply_transfer_abuse, apply_vote_equivocation,
     from_fault_plan, make_batch, make_schedule,
 )
 from swarmkit_tpu.dst.invariants import (
     BIT_NAMES, CHECKSUM_AGREEMENT, COMMIT_MONOTONIC, ELECTION_SAFETY,
-    LEADER_COMPLETENESS, LINEARIZABLE_READ, LOG_MATCHING, SLO_COMMIT_P99,
+    LEADER_COMPLETENESS, LINEARIZABLE_READ, LOG_MATCHING, SAFETY_BITS,
+    SLO_COMMIT_P99, SLO_LEADER_CHURN, SLO_LOG_OCCUPANCY,
     bits_to_names, check_state, check_transition,
 )
 from swarmkit_tpu.dst.explore import ExploreResult, explore, postmortem
@@ -38,11 +41,15 @@ from swarmkit_tpu.dst.repro import (
 )
 
 __all__ = [
-    "EXTRA_PROFILES", "PROFILES", "FaultSchedule", "apply_term_inflation",
-    "from_fault_plan", "make_batch", "make_schedule",
+    "ATTACK_LEAVES", "ATTACK_PROFILES", "ATTACK_SIGNATURE_CODES",
+    "EXTRA_PROFILES", "PROFILES", "FaultSchedule", "apply_append_flood",
+    "apply_rejoin_campaign", "apply_term_inflation", "apply_transfer_abuse",
+    "apply_vote_equivocation", "from_fault_plan", "make_batch",
+    "make_schedule",
     "BIT_NAMES", "CHECKSUM_AGREEMENT", "COMMIT_MONOTONIC", "ELECTION_SAFETY",
     "LEADER_COMPLETENESS", "LINEARIZABLE_READ", "LOG_MATCHING",
-    "SLO_COMMIT_P99", "bits_to_names", "check_state", "check_transition",
+    "SAFETY_BITS", "SLO_COMMIT_P99", "SLO_LEADER_CHURN",
+    "SLO_LOG_OCCUPANCY", "bits_to_names", "check_state", "check_transition",
     "ExploreResult", "explore", "postmortem",
     "capture_flight", "fault_count", "from_artifact", "load_artifact",
     "oracle_trace", "replay", "replay_artifact", "save_artifact", "shrink",

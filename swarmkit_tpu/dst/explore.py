@@ -32,8 +32,11 @@ from swarmkit_tpu import parallel
 from swarmkit_tpu.dst.invariants import (
     ALL_BITS, BIT_NAMES, check_state, check_transition,
 )
-from swarmkit_tpu.dst.schedule import FaultSchedule, apply_term_inflation, \
-    effective_faults
+from swarmkit_tpu.dst.schedule import (
+    ATTACK_LEAVES, FaultSchedule, apply_append_flood, apply_rejoin_campaign,
+    apply_term_inflation, apply_transfer_abuse, apply_vote_equivocation,
+    effective_faults,
+)
 from swarmkit_tpu.raft.sim.kernel import propose_dense, step
 from swarmkit_tpu.raft.sim.run import _payload_at
 from swarmkit_tpu.raft.sim.state import LEADER, SimConfig, SimState
@@ -96,11 +99,21 @@ def _tick_one(st: SimState, cfg: SimConfig, sched_t: FaultSchedule,
     alive, drop = effective_faults(st.role, sched_t.drop, sched_t.alive,
                                    sched_t.target_leader,
                                    sched_t.crash_campaign)
+    # protocol-speaking adversary verbs, in schedule.py's documented
+    # composition order (inflate -> rejoin -> equivocate -> transfer ->
+    # flood): each forces the flagged rows' state BEFORE the step, so the
+    # kernel's own paths (PreVote, vote guard, cooldown, inflight cap)
+    # realize — or refuse — the action
     if sched_t.term_inflate is not None:
-        # protocol-speaking adversary: force the flagged rows' election
-        # timers due BEFORE the step, so the kernel's own campaign path
-        # (PreVote-aware) realizes the action
         st = apply_term_inflation(st, sched_t.term_inflate, alive)
+    if sched_t.rejoin_campaign is not None:
+        st = apply_rejoin_campaign(st, sched_t.rejoin_campaign, alive)
+    if sched_t.vote_equivocate is not None:
+        st = apply_vote_equivocation(st, sched_t.vote_equivocate, alive)
+    if sched_t.transfer_abuse is not None:
+        st = apply_transfer_abuse(st, cfg, sched_t.transfer_abuse, alive)
+    if sched_t.append_flood is not None:
+        st = apply_append_flood(st, cfg, sched_t.append_flood, alive)
     if prop_count:
         # fused propose (kernel.step docstring): one [N, L] write cond per
         # scan iteration keeps the vmapped log buffers in place
@@ -222,6 +235,13 @@ def explore(state: SimState, cfg: SimConfig, schedule: FaultSchedule,
         if hits:
             m_viol.labels(invariant=BIT_NAMES[bit]).inc(hits)
     m_rate.labels(config=f"n{cfg.n}x{schedule.ticks}t").set(rate)
+    m_att = catalog.get(obs, "swarm_dst_attack_ticks_total")
+    for attack, leaf in ATTACK_LEAVES.items():
+        gate = getattr(schedule, leaf)
+        if gate is not None:
+            fired = int(np.asarray(jax.device_get(gate)).sum())
+            if fired:
+                m_att.labels(attack=attack).inc(fired)
 
     return ExploreResult(viol=viol, first_tick=first, bits_by_tick=bits,
                          final_state=final, profiles=list(profiles),

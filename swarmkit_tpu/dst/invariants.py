@@ -39,6 +39,23 @@ SLO_COMMIT_P99       OPTIONAL performance oracle (not a Raft safety
                      anomalies flag protocol-level attacks (term
                      inflation, election storms) long before a safety
                      invariant trips.
+SLO_LEADER_CHURN     OPTIONAL availability oracle: cumulative election
+                     wins (the election-histogram mass) exceed
+                     cfg.slo_leader_changes.  Bounds the residual cost
+                     of the disruptive_rejoin / transfer_abuse defenses
+                     — a defended cluster may still change leaders, but
+                     only this many times over the run.  Needs
+                     cfg.collect_telemetry like SLO_COMMIT_P99.
+SLO_LOG_OCCUPANCY    OPTIONAL backpressure oracle: some row's uncommitted
+                     tail max(last - commit) exceeds cfg.slo_log_occupancy.
+                     The witness that the append_flood defense
+                     (prop_inflight_cap) keeps ring/compaction pressure
+                     bounded — the cap gates acceptance on exactly this
+                     tail, while total occupancy sum(last - snap_idx)
+                     would count committed-but-uncompacted entries a
+                     HEALTHY flooded leader legitimately accumulates
+                     (compaction is lazy).  Computed straight from cursor
+                     state, so it needs no telemetry plane.
 """
 
 from __future__ import annotations
@@ -57,6 +74,8 @@ COMMIT_MONOTONIC = 1 << 3
 CHECKSUM_AGREEMENT = 1 << 4
 LINEARIZABLE_READ = 1 << 5
 SLO_COMMIT_P99 = 1 << 6
+SLO_LEADER_CHURN = 1 << 7
+SLO_LOG_OCCUPANCY = 1 << 8
 
 BIT_NAMES = {
     ELECTION_SAFETY: "election_safety",
@@ -66,8 +85,16 @@ BIT_NAMES = {
     CHECKSUM_AGREEMENT: "checksum_agreement",
     LINEARIZABLE_READ: "linearizable_read",
     SLO_COMMIT_P99: "slo_commit_p99",
+    SLO_LEADER_CHURN: "slo_leader_churn",
+    SLO_LOG_OCCUPANCY: "slo_log_occupancy",
 }
 ALL_BITS = tuple(BIT_NAMES)
+# Bits whose violation leaves the kernel in a state CORRECT raft cannot
+# represent (e.g. two leaders sharing a term after vote_equivocation) —
+# the differential oracle is only comparable over the clean prefix of
+# such runs.  The SLO_* bits are telemetry bounds: state stays legal.
+SAFETY_BITS = (ELECTION_SAFETY | LOG_MATCHING | LEADER_COMPLETENESS
+               | COMMIT_MONOTONIC | CHECKSUM_AGREEMENT | LINEARIZABLE_READ)
 
 
 def bits_to_names(bits: int) -> list[str]:
@@ -142,7 +169,28 @@ def check_state(state: SimState, cfg: SimConfig) -> jnp.ndarray:
         slo_bit = _bit((total > 0) & (edge > cfg.slo_p99_commit_ticks),
                        SLO_COMMIT_P99)
 
-    return elect | match | complete | chk_bit | read_bit | slo_bit
+    # -- SLO_LEADER_CHURN: the availability bound on the rejoin/transfer
+    # defenses — cumulative election wins over the run stay under the
+    # budget (gated like SLO_COMMIT_P99: bound set + telemetry carried)
+    churn_bit = jnp.uint32(0)
+    if cfg.slo_leader_changes > 0 and state.tel_elect_hist is not None:
+        churn_bit = _bit(jnp.sum(state.tel_elect_hist)
+                         > cfg.slo_leader_changes, SLO_LEADER_CHURN)
+
+    # -- SLO_LOG_OCCUPANCY: the append_flood backpressure witness —
+    # every row's uncommitted tail stays under the budget.  The tail is
+    # what prop_inflight_cap gates acceptance on (kernel _leader_ok), so
+    # the defended bound is cap - 1 + max_props regardless of flood
+    # duration, while an UNDEFENDED isolated leader grows its tail by
+    # max_props per flooded tick until the ring's room check stops it.
+    # Pure cursor arithmetic, so only the bound gates it.
+    occ_bit = jnp.uint32(0)
+    if cfg.slo_log_occupancy > 0:
+        occ_bit = _bit(jnp.max(state.last - state.commit)
+                       > cfg.slo_log_occupancy, SLO_LOG_OCCUPANCY)
+
+    return (elect | match | complete | chk_bit | read_bit | slo_bit
+            | churn_bit | occ_bit)
 
 
 def check_transition(prev: SimState, new: SimState) -> jnp.ndarray:

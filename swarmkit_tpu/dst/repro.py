@@ -33,8 +33,8 @@ import numpy as np
 
 from swarmkit_tpu.dst.explore import _tick_one
 from swarmkit_tpu.dst.invariants import bits_to_names
-from swarmkit_tpu.dst.schedule import FaultSchedule
-from swarmkit_tpu.raft.sim.state import CANDIDATE, LEADER, SimConfig, \
+from swarmkit_tpu.dst.schedule import _OPTIONAL_LEAVES, FaultSchedule
+from swarmkit_tpu.raft.sim.state import CANDIDATE, LEADER, NONE, SimConfig, \
     init_state
 
 ARTIFACT_VERSION = 1
@@ -140,15 +140,16 @@ def capture_flight(cfg: SimConfig, schedule: FaultSchedule,
 
 def fault_count(schedule: FaultSchedule) -> int:
     """Total injected fault-events: dropped edge-ticks + downed row-ticks
-    + active adversary-gate ticks + forced-campaign row-ticks (the
+    + active adversary-gate ticks + attack-verb gate ticks (the
     shrinker's minimization metric)."""
-    inflate = 0 if schedule.term_inflate is None \
-        else int(np.asarray(schedule.term_inflate).sum())
+    verbs = sum(int(np.asarray(getattr(schedule, leaf)).sum())
+                for leaf in _OPTIONAL_LEAVES
+                if getattr(schedule, leaf) is not None)
     return (int(np.asarray(schedule.drop).sum())
             + int((~np.asarray(schedule.alive)).sum())
             + int(np.asarray(schedule.target_leader).sum())
             + int(np.asarray(schedule.crash_campaign).sum())
-            + inflate)
+            + verbs)
 
 
 def _clear_ticks(arrs: dict, lo: int, hi: int) -> dict:
@@ -157,8 +158,9 @@ def _clear_ticks(arrs: dict, lo: int, hi: int) -> dict:
     out["alive"][lo:hi] = True
     out["target_leader"][lo:hi] = False
     out["crash_campaign"][lo:hi] = False
-    if "term_inflate" in out:
-        out["term_inflate"][lo:hi] = False
+    for leaf in _OPTIONAL_LEAVES:
+        if leaf in out:
+            out[leaf][lo:hi] = False
     return out
 
 
@@ -224,13 +226,21 @@ def shrink(cfg: SimConfig, schedule: FaultSchedule, required_bits: int,
             cand["alive"][:, r] = True
             if still_fails(cand):
                 arrs = cand
-    if "term_inflate" in arrs:
-        for r in range(cfg.n):
-            if arrs["term_inflate"][:, r].any():
-                cand = {k: v.copy() for k, v in arrs.items()}
-                cand["term_inflate"][:, r] = False
-                if still_fails(cand):
-                    arrs = cand
+    for leaf, shape in _OPTIONAL_LEAVES.items():
+        if leaf not in arrs:
+            continue
+        if shape == "TN":
+            for r in range(cfg.n):
+                if arrs[leaf][:, r].any():
+                    cand = {k: v.copy() for k, v in arrs.items()}
+                    cand[leaf][:, r] = False
+                    if still_fails(cand):
+                        arrs = cand
+        elif arrs[leaf].any():
+            cand = {k: v.copy() for k, v in arrs.items()}
+            cand[leaf][:] = False
+            if still_fails(cand):
+                arrs = cand
     for gate in ("target_leader", "crash_campaign"):
         if arrs[gate].any():
             cand = {k: v.copy() for k, v in arrs.items()}
@@ -255,7 +265,8 @@ def _kernel_view(state) -> dict:
 
 def oracle_trace(cfg: SimConfig, schedule: FaultSchedule,
                  prop_count: int = 2, mutation: Optional[str] = None,
-                 stop_after_first: bool = True) -> dict:
+                 stop_after_first: bool = True,
+                 until: Optional[int] = None) -> dict:
     """Replay one schedule through kernel AND host oracle, comparing every
     comparable field per tick (the `run_differential` protocol).
 
@@ -264,10 +275,19 @@ def oracle_trace(cfg: SimConfig, schedule: FaultSchedule,
     so a mutated (or genuinely buggy) kernel diverges from the correct
     oracle at a deterministic tick, and the returned trace names the first
     differing fields with both sides' values.
+
+    `until` bounds the comparison to ticks t < until.  Callers replaying
+    an adversary-induced SAFETY violation pass the first violating tick:
+    past it the kernel is in a state correct raft cannot represent (e.g.
+    two leaders in one term after vote_equivocation), so the two sides'
+    resolutions of the impossible state are incomparable by construction.
     """
     from swarmkit_tpu.raft.sim.kernel import propose, step
     from swarmkit_tpu.raft.sim.oracle import OracleCluster
     from swarmkit_tpu.dst.explore import apply_mutation
+    from swarmkit_tpu.dst.schedule import (
+        _flood_payload, apply_append_flood, apply_transfer_abuse,
+    )
 
     _step = jax.jit(step, static_argnames=("cfg",))
     _propose = jax.jit(propose, static_argnames=("cfg",))
@@ -280,22 +300,37 @@ def oracle_trace(cfg: SimConfig, schedule: FaultSchedule,
     alive_s = np.asarray(schedule.alive)
     tl_s = np.asarray(schedule.target_leader)
     cc_s = np.asarray(schedule.crash_campaign)
-    ti_s = None if schedule.term_inflate is None \
-        else np.asarray(schedule.term_inflate)
+    def _opt(leaf):
+        arr = getattr(schedule, leaf)
+        return None if arr is None else np.asarray(arr)
+
+    ti_s = _opt("term_inflate")
+    rj_s = _opt("rejoin_campaign")
+    eq_s = _opt("vote_equivocate")
+    fl_s = _opt("append_flood")
+    tx_s = _opt("transfer_abuse")
 
     trace: list[dict] = []
     diverged_at = -1
-    for t in range(schedule.ticks):
+    stop = schedule.ticks if until is None else min(until, schedule.ticks)
+    for t in range(stop):
         role = np.asarray(state.role)
         leaders = role == LEADER
         drop = drop_s[t] | (tl_s[t] & (leaders[:, None] | leaders[None, :]))
         alive = alive_s[t] & ~(cc_s[t] & (role == CANDIDATE))
-        if ti_s is not None and ti_s[t].any():
-            # resolve the forced-campaign mask against the KERNEL's
-            # pre-step roles (like the gates above) and mirror the same
-            # timer force on both sides — apply_term_inflation on the
-            # kernel state, elapsed := timeout on the oracle's scheduler
-            force = ti_s[t] & alive & (role != LEADER)
+        # resolve the forced-campaign mask against the KERNEL's pre-step
+        # roles (like the gates above) and mirror the same timer force on
+        # both sides — elapsed := timeout on the oracle's scheduler.
+        # term_inflate and rejoin_campaign share the transform (they
+        # differ only in how their generators gate it), so one merged
+        # mask keeps the mirror exact under composition.
+        force = np.zeros(n, bool)
+        if ti_s is not None:
+            force |= ti_s[t]
+        if rj_s is not None:
+            force |= rj_s[t]
+        force &= alive & (role != LEADER)
+        if force.any():
             elapsed = jnp.where(jnp.asarray(force),
                                 jnp.maximum(state.elapsed, state.timeout),
                                 state.elapsed)
@@ -304,6 +339,40 @@ def oracle_trace(cfg: SimConfig, schedule: FaultSchedule,
                 if force[i]:
                     oracle.elapsed[i] = max(oracle.elapsed[i],
                                             oracle.timeout[i])
+        if eq_s is not None and eq_s[t].any():
+            # adversarial vote wipe, resolved against the kernel's
+            # pre-step vote registers; core's vote is 1-based (0 = none)
+            wipe = eq_s[t] & alive & (np.asarray(state.vote) != NONE)
+            if wipe.any():
+                state = dataclasses.replace(
+                    state, vote=jnp.where(jnp.asarray(wipe), NONE,
+                                          state.vote))
+                for i in range(n):
+                    if wipe[i]:
+                        oracle.nodes[i].vote = 0
+        if tx_s is not None and tx_s[t].any():
+            # kernel side realizes the request through the cooldown gate;
+            # the oracle mirror only holds with the defense off
+            # (transfer_cooldown_ticks=0), which is how differential
+            # sweeps run — oracle.transfer repeats are no-ops like the
+            # kernel's `changed` gate
+            tgt = int(np.argmax(tx_s[t]))
+            state = apply_transfer_abuse(state, cfg, jnp.asarray(tx_s[t]),
+                                         jnp.asarray(alive))
+            for i in range(n):
+                if leaders[i] and alive[i] and i != tgt:
+                    oracle.transfer(i, tgt)
+        if fl_s is not None and fl_s[t]:
+            # flood: cfg.max_props dense proposals on every accepting
+            # leader; the oracle replays the SAME device-computed
+            # payloads through its propose phase (room/transfer gates
+            # mirror _leader_ok with defenses off)
+            cnt = cfg.max_props
+            fl_pl = np.asarray(_flood_payload(
+                state.tick, jnp.arange(cnt, dtype=jnp.uint32)))
+            state = apply_append_flood(state, cfg, jnp.asarray(fl_s[t]),
+                                       jnp.asarray(alive))
+            oracle._phase_propose(alive, fl_pl, cnt)
 
         payloads = np.zeros(cfg.max_props, np.uint32)
         if prop_count:
@@ -379,9 +448,17 @@ def to_artifact(cfg: SimConfig, schedule: FaultSchedule, *, seed: int,
                 np.nonzero(np.asarray(schedule.crash_campaign))[0].tolist(),
         },
     }
-    if schedule.term_inflate is not None:
-        it, ir = np.nonzero(np.asarray(schedule.term_inflate))
-        art["faults"]["term_inflate"] = np.stack([it, ir], axis=1).tolist()
+    # attack-verb leaves go in sparse (absent leaf = absent key, so old
+    # artifacts and verb-less schedules keep the exact pre-extension JSON)
+    for leaf, shape in _OPTIONAL_LEAVES.items():
+        arr = getattr(schedule, leaf)
+        if arr is None:
+            continue
+        if shape == "TN":
+            it, ir = np.nonzero(np.asarray(arr))
+            art["faults"][leaf] = np.stack([it, ir], axis=1).tolist()
+        else:
+            art["faults"][leaf] = np.nonzero(np.asarray(arr))[0].tolist()
     if flight is not None:
         art["flight"] = {
             "window": flight.get("window", []),
@@ -409,18 +486,23 @@ def from_artifact(art: dict):
         alive[t, r] = False
     tl[art["faults"]["target_leader"]] = True
     cc[art["faults"]["crash_campaign"]] = True
-    # pre-term_inflation artifacts have no key and replay the exact
-    # pre-extension program (term_inflate=None stays version 1)
-    ti = None
-    if "term_inflate" in art["faults"]:
-        ti = np.zeros((ticks, n), bool)
-        for t, r in art["faults"]["term_inflate"]:
-            ti[t, r] = True
-        ti = jnp.asarray(ti)
+    # artifacts predating a verb carry no key for it and replay the exact
+    # pre-extension program (the leaf stays None; still version 1)
+    verbs = {}
+    for leaf, shape in _OPTIONAL_LEAVES.items():
+        if leaf not in art["faults"]:
+            continue
+        if shape == "TN":
+            m = np.zeros((ticks, n), bool)
+            for t, r in art["faults"][leaf]:
+                m[t, r] = True
+        else:
+            m = np.zeros((ticks,), bool)
+            m[art["faults"][leaf]] = True
+        verbs[leaf] = jnp.asarray(m)
     schedule = FaultSchedule(drop=jnp.asarray(drop), alive=jnp.asarray(alive),
                              target_leader=jnp.asarray(tl),
-                             crash_campaign=jnp.asarray(cc),
-                             term_inflate=ti)
+                             crash_campaign=jnp.asarray(cc), **verbs)
     return cfg, schedule, art["prop_count"], art["mutation"]
 
 
@@ -449,5 +531,14 @@ def replay_artifact(art, with_trace: bool = True) -> dict:
                              and first == art["first_tick"]),
     }
     if with_trace:
-        out["oracle"] = oracle_trace(cfg, schedule, prop_count, mutation)
+        # adversary-induced safety violations (no mutation) put the
+        # kernel into spec-unrepresentable territory at the violation
+        # tick; compare the oracle only over the clean prefix there.
+        # Mutation artifacts keep the full trace — the divergence IS
+        # the diagnostic localizing the injected kernel bug.
+        from swarmkit_tpu.dst.invariants import SAFETY_BITS
+        until = (first if mutation is None and (viol & SAFETY_BITS)
+                 and first >= 0 else None)
+        out["oracle"] = oracle_trace(cfg, schedule, prop_count, mutation,
+                                     until=until)
     return out

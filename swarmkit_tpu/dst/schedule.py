@@ -28,9 +28,12 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from swarmkit_tpu.raft.sim.state import CANDIDATE, LEADER, SimConfig
+from swarmkit_tpu.raft.sim.state import (
+    CANDIDATE, LEADER, NONE, SimConfig, hash32,
+)
 
 I32 = jnp.int32
+U32 = jnp.uint32
 
 # Named adversary profiles (ISSUE 3 tentpole part 1).  `make_batch` deals
 # them round-robin across the schedule axis.  PROFILES is the default
@@ -38,7 +41,28 @@ I32 = jnp.int32
 # adversaries go in EXTRA_PROFILES and are requested explicitly.
 PROFILES = ("random_drop", "partition_flapper", "leader_targeted",
             "asymmetric_links", "crash_restart", "crash_during_campaign")
-EXTRA_PROFILES = ("stale_leader_reads", "term_inflation")
+# The arXiv:2601.00273 attack suite (ISSUE 15): each profile drives one
+# counted FaultSchedule verb below, and each verb has a matching kernel
+# defense knob (see SimConfig) whose cost is bounded by an SLO invariant.
+ATTACK_PROFILES = ("disruptive_rejoin", "vote_equivocation",
+                   "append_flood", "transfer_abuse")
+EXTRA_PROFILES = ("stale_leader_reads", "term_inflation") + ATTACK_PROFILES
+# Per-attack wiring, pinned by tools/metrics_lint.py check #8: the
+# FaultSchedule leaf each profile drives (gate firings feed the
+# swarm_dst_attack_ticks_total counter) and the flightrec signature code
+# its apply verb emits.
+ATTACK_LEAVES = {
+    "disruptive_rejoin": "rejoin_campaign",
+    "vote_equivocation": "vote_equivocate",
+    "append_flood": "append_flood",
+    "transfer_abuse": "transfer_abuse",
+}
+ATTACK_SIGNATURE_CODES = {
+    "disruptive_rejoin": "ATTACK_REJOIN",
+    "vote_equivocation": "ATTACK_EQUIVOCATE",
+    "append_flood": "ATTACK_FLOOD",
+    "transfer_abuse": "ATTACK_TRANSFER",
+}
 
 
 @jax.tree_util.register_dataclass
@@ -64,6 +88,37 @@ class FaultSchedule:
                                        None = action absent (old artifacts
                                        and the stock profiles trace the
                                        exact pre-extension program).
+    rejoin_campaign bool [.., T, N]    disruptive-rejoin barrage: the
+                                       flagged row's election timer is
+                                       forced due (same mechanics as
+                                       term_inflate, distinct signature /
+                                       schedule shape: paired with a
+                                       partition that HEALS, so the
+                                       barrage lands on a reachable
+                                       cluster).  Neutralized by
+                                       PreVote + CheckQuorum.
+    vote_equivocate bool [.., T, N]    crash-restart-without-fsync: the
+                                       flagged row's in-memory `vote` is
+                                       wiped, so it may grant a SECOND
+                                       candidate in the same term
+                                       (ElectionSafety trips) unless the
+                                       kernel's persisted-vote guard
+                                       (cfg.vote_guard) is on.
+    append_flood    bool [.., T]       targeted client flood: every row
+                                       currently accepting proposals gets
+                                       cfg.max_props extra dense appends
+                                       this tick, driving ring/Phase-F
+                                       compaction pressure.  Bounded by
+                                       cfg.prop_inflight_cap.
+    transfer_abuse  bool [.., T, N]    leadership-transfer abuse: every
+                                       current leader is asked to
+                                       transfer to the (lowest) flagged
+                                       row this tick — repeated
+                                       TimeoutNow thrash.  Bounded by
+                                       cfg.transfer_cooldown_ticks.
+
+    All five action leaves default to None = absent, so old artifacts and
+    the stock profiles keep tracing the exact pre-extension program.
     """
 
     drop: jax.Array
@@ -71,6 +126,10 @@ class FaultSchedule:
     target_leader: jax.Array
     crash_campaign: jax.Array
     term_inflate: Optional[jax.Array] = None
+    rejoin_campaign: Optional[jax.Array] = None
+    vote_equivocate: Optional[jax.Array] = None
+    append_flood: Optional[jax.Array] = None
+    transfer_abuse: Optional[jax.Array] = None
 
     @property
     def ticks(self) -> int:
@@ -115,6 +174,121 @@ def apply_term_inflation(state, term_inflate_t: jax.Array,
     elapsed = jnp.where(force, jnp.maximum(state.elapsed, state.timeout),
                         state.elapsed)
     return dataclasses.replace(state, elapsed=elapsed)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 15 attack verbs.  Each is a pre-step transform like
+# apply_term_inflation: pure in (state, schedule slice), shapes row-local
+# (vmap-safe), and emitting its flightrec signature when the state carries
+# an event ring.  COMPOSITION ORDER (explore/repro apply them in this
+# fixed sequence so two active attacks never silently mask each other):
+#   term_inflate -> rejoin_campaign -> vote_equivocate -> transfer_abuse
+#   -> append_flood
+# The timer verbs commute (both take max(elapsed, timeout)); the vote wipe
+# touches only `vote`; transfer_abuse runs BEFORE append_flood so a
+# transfer it starts correctly blocks the flood's proposals on that
+# leader — the same refusal a real client would see.
+
+
+def _emit_attack(state, mask, code: int, a0, a1):
+    """Append an attack-signature event on masked rows (no-op when the
+    state carries no ring — attacks never change the traced program of a
+    recorder-off run)."""
+    if state.ev_buf is None:
+        return state
+    from swarmkit_tpu.flightrec import codes as _fc
+    ev_buf, ev_pos = _fc.ring_append(state.ev_buf, state.ev_pos, mask,
+                                     state.tick, code, a0, a1)
+    return dataclasses.replace(state, ev_buf=ev_buf, ev_pos=ev_pos)
+
+
+def apply_rejoin_campaign(state, rejoin_t: jax.Array, alive: jax.Array):
+    """One tick of the ``rejoin_campaign`` action (disruptive rejoin,
+    arXiv:2601.00273): flagged live non-leader rows get their election
+    timer forced due, so the kernel's own campaign path fires — the same
+    protocol-speaking mechanics as ``apply_term_inflation``, but the
+    generator pairs it with a partition that HEALS, so the barrage lands
+    on a reachable cluster and (defense off) deposes the standing leader
+    every round.  PreVote turns the barrage into non-binding polls and
+    the CheckQuorum lease makes contacted voters ignore them; the demo
+    bounds the residual churn with SLO_LEADER_CHURN."""
+    force = rejoin_t & alive & (state.role != LEADER)
+    elapsed = jnp.where(force, jnp.maximum(state.elapsed, state.timeout),
+                        state.elapsed)
+    out = dataclasses.replace(state, elapsed=elapsed)
+    from swarmkit_tpu.flightrec import codes as _fc
+    return _emit_attack(out, force, _fc.ATTACK_REJOIN, state.term,
+                        state.timeout)
+
+
+def apply_vote_equivocation(state, equiv_t: jax.Array, alive: jax.Array):
+    """One tick of the ``vote_equivocate`` action: wipe the flagged row's
+    in-memory vote — the crash-restart-without-fsync fault model, under
+    which the row may grant a SECOND candidate in the same term and
+    ElectionSafety trips.  The kernel's persisted-vote guard
+    (cfg.vote_guard) shadows every vote into vg_vote/vg_term, which this
+    verb deliberately CANNOT touch — with the guard on, the dual grant is
+    unrepresentable."""
+    wipe = equiv_t & alive & (state.vote != NONE)
+    vote = jnp.where(wipe, NONE, state.vote)
+    out = dataclasses.replace(state, vote=vote)
+    from swarmkit_tpu.flightrec import codes as _fc
+    return _emit_attack(out, wipe, _fc.ATTACK_EQUIVOCATE, state.vote,
+                        state.term)
+
+
+def _flood_payload(tick, k):
+    """Deterministic on-device flood payloads (distinct from the sweep
+    drivers' own payload streams so log-matching stays meaningful)."""
+    return hash32(tick.astype(U32) * U32(0x9E3779B9) ^ k ^ U32(0xF100D))
+
+
+def apply_append_flood(state, cfg: SimConfig, flood_t: jax.Array,
+                       alive: jax.Array):
+    """One tick of the ``append_flood`` action: every row currently
+    accepting proposals takes cfg.max_props EXTRA dense appends — the
+    targeted client flood that drives ring occupancy into Phase-F
+    compaction pressure.  With cfg.prop_inflight_cap set the leader
+    refuses the flood while its uncommitted tail is at the cap (the same
+    ProposalDropped a real client sees), and SLO_LOG_OCCUPANCY witnesses
+    the bound."""
+    from swarmkit_tpu.raft.sim.kernel import propose_dense
+    cnt = jnp.where(flood_t, cfg.max_props, 0).astype(I32)
+    sig = flood_t & alive & (state.role == LEADER)
+    out = propose_dense(state, cfg, _flood_payload, cnt, alive)
+    from swarmkit_tpu.flightrec import codes as _fc
+    return _emit_attack(out, sig, _fc.ATTACK_FLOOD,
+                        jnp.broadcast_to(cnt, (cfg.n,)),
+                        state.last - state.commit)
+
+
+def apply_transfer_abuse(state, cfg: SimConfig, abuse_t: jax.Array,
+                         alive: jax.Array):
+    """One tick of the ``transfer_abuse`` action: every live current
+    leader is asked to transfer leadership to the (lowest) flagged row —
+    the repeated-TimeoutNow thrash attack.  Mirrors
+    ``kernel.transfer_leadership`` semantics row-wise, INCLUDING the
+    cooldown consult: with cfg.transfer_cooldown_ticks set a leader that
+    just fired a TIMEOUT_NOW refuses the repeat request, and
+    SLO_LEADER_CHURN bounds the residual thrash."""
+    n = cfg.n
+    node = jnp.arange(n, dtype=I32)
+    has_tgt = jnp.any(abuse_t)
+    tgt = jnp.argmax(abuse_t).astype(I32)          # lowest flagged row
+    req = (state.role == LEADER) & alive & has_tgt & (node != tgt)
+    req = req & jnp.take(state.member, tgt, axis=1)   # leader's own view
+    ok = req
+    cool = jnp.zeros((n,), I32)
+    if cfg.transfer_cooldown_ticks > 0 and state.tx_cool is not None:
+        cool = state.tx_cool
+        ok = ok & (cool == 0)
+    changed = ok & (state.transferee != tgt)
+    transferee = jnp.where(changed, tgt, state.transferee)
+    elapsed = jnp.where(changed, 0, state.elapsed)
+    out = dataclasses.replace(state, transferee=transferee, elapsed=elapsed)
+    from swarmkit_tpu.flightrec import codes as _fc
+    return _emit_attack(out, req, _fc.ATTACK_TRANSFER,
+                        jnp.broadcast_to(tgt, (n,)), cool)
 
 
 # ---------------------------------------------------------------------------
@@ -265,6 +439,142 @@ def _gen_term_inflation(key, cfg: SimConfig, ticks: int) -> FaultSchedule:
                                drop=drop, term_inflate=inflate)
 
 
+def _gen_disruptive_rejoin(key, cfg: SimConfig, ticks: int
+                           ) -> FaultSchedule:
+    """arXiv:2601.00273 disruptive-rejoin shape: ONE random victim row is
+    fully partitioned for ~2 election timeouts and fires its election
+    timer every cut tick (inflating its term with pre_vote off), then the
+    partition HEALS while the barrage keeps firing for ~3 more timeouts —
+    the healed node rejoins with a high term and a campaign storm.  With
+    the defenses off (pre_vote=False, check_quorum=False) every barrage
+    tick deposes the standing leader; with them on the barrage is
+    lease-refused non-binding polls and churn stays at the initial
+    election — ``tools/dst_sweep.py --disruptive-rejoin-demo`` pins the
+    contrast under an SLO_LEADER_CHURN budget."""
+    kv, ks = jax.random.split(key)
+    T = cfg.election_tick
+    victim = jax.random.randint(kv, (), 0, cfg.n)
+    start = jax.random.randint(ks, (), 2 * T,
+                               max(2 * T + 1, ticks - 5 * T))
+    heal = start + 2 * T
+    t = jnp.arange(ticks, dtype=I32)
+    cut_gate = (t >= start) & (t < heal)                         # [T]
+    # one campaign every OTHER election timeout, not per tick: each
+    # firing deposes the standing leader and LETS the re-election finish
+    # (randomized timeouts make that up to 2T), so the damage lands in
+    # completed leader changes — the churn histogram counts wins; a
+    # per-tick barrage would just hold the cluster leaderless, which
+    # SLO_LEADER_CHURN cannot see.  The barrage runs to the end of the
+    # run: longer sweeps see proportionally more churn.
+    barrage = (t >= start) & ((t - start) % (2 * T) == 0)
+    is_victim = jnp.arange(cfg.n, dtype=I32) == victim
+    touches = is_victim[None, :, None] | is_victim[None, None, :]
+    drop = cut_gate[:, None, None] & touches
+    rejoin = barrage[:, None] & is_victim[None, :]
+    return dataclasses.replace(_no_faults(cfg, ticks), drop=drop,
+                               rejoin_campaign=rejoin)
+
+
+def _gen_vote_equivocation(key, cfg: SimConfig, ticks: int
+                           ) -> FaultSchedule:
+    """Faulty voters that forget their persisted grant (crash-restart
+    without fsyncing the vote) under engineered rival candidacies.
+
+    Two quorums among n rows overlap in at least ``f = 2*quorum - n``
+    rows, so exactly f equivocating voters suffice for a dual election.
+    Rows A and B are forced to campaign on the SAME tick k (same new
+    term).  On tick k each rival is kept one voter short of quorum: A's
+    requests reach only the f designated equivocators (who grant A), B's
+    only his q-1-f loyalists — no one wins, so every log stays empty and
+    the later grants cannot be refused on log freshness.  From tick k+1
+    the equivocators' vote registers are wiped every tick and A's
+    requests to them are cut, so B's re-request lands on an empty
+    register and they grant the SAME term twice; meanwhile the remaining
+    bystanders (cut from B) grant A.  Both rivals reach quorum on tick
+    k+1: two leaders in one term, the textbook ElectionSafety violation.
+    cfg.vote_guard (the WAL-shadow register the wipe cannot touch) makes
+    the second grant unrepresentable and A wins alone.  Runs are expected
+    with check_quorum=False on BOTH sides of the defense comparison (the
+    CheckQuorum lease refuses re-requests for the unrelated reason of
+    fresh leader contact, masking the hole this profile exists to
+    expose)."""
+    kp, kt = jax.random.split(key)
+    n = cfg.n
+    T = cfg.election_tick
+    q = n // 2 + 1
+    f = 2 * q - n                    # equivocators needed (1 odd, 2 even)
+    perm = jax.random.permutation(kp, jnp.arange(n, dtype=I32))
+    pos = jnp.zeros((n,), I32).at[perm].set(jnp.arange(n, dtype=I32))
+    a, b = perm[0], perm[1]
+    is_v = (pos >= 2) & (pos < 2 + f)            # equivocating voters
+    is_loy = (pos >= 2 + f) & (pos < 1 + q)      # B's q-1-f loyalists
+    is_x = pos >= 1 + q                          # A's k+1 bystanders
+    k = jax.random.randint(kt, (), 1, max(2, min(T, ticks - 3)))
+    t = jnp.arange(ticks, dtype=I32)
+    row = jnp.arange(n, dtype=I32)
+    at_k = t == k
+    after = t > k
+    # both rivals' election timers forced due on tick k -> same new term
+    rejoin = at_k[:, None] & ((row == a) | (row == b))[None, :]
+    row_a, row_b = row == a, row == b
+    # tick k: A reaches only the equivocators, B only his loyalists
+    cut_k = (row_a[:, None] & (~is_v & ~row_a)[None, :]) \
+        | (row_b[:, None] & (~is_loy & ~row_b)[None, :])
+    # afterwards: A never reaches the equivocators again (their empty
+    # logs stay empty and their re-grant goes to B), B never reaches the
+    # bystanders or A (they complete A's quorum undisturbed)
+    cut_after = (row_a[:, None] & (is_v | row_b)[None, :]) \
+        | (row_b[:, None] & (is_x | row_a)[None, :])
+    drop = (at_k[:, None, None] & cut_k[None, :, :]) \
+        | (after[:, None, None] & cut_after[None, :, :])
+    equiv = after[:, None] & is_v[None, :]
+    return dataclasses.replace(_no_faults(cfg, ticks), drop=drop,
+                               rejoin_campaign=rejoin,
+                               vote_equivocate=equiv)
+
+
+def _gen_append_flood(key, cfg: SimConfig, ticks: int) -> FaultSchedule:
+    """Targeted client flood against an isolated leader: once the first
+    election has settled, a ~2-timeout window isolates whoever currently
+    leads (the straggler-making cut) while every tick of the window
+    stuffs cfg.max_props extra appends into all proposal-accepting rows.
+    The quorum-less leader cannot commit, so its uncommitted tail races
+    toward ring capacity — compaction pressure with nothing to compact.
+    cfg.prop_inflight_cap caps the tail at the client interface and
+    SLO_LOG_OCCUPANCY witnesses the bound."""
+    ks, kd = jax.random.split(key)
+    T = cfg.election_tick
+    start = jax.random.randint(ks, (), 2 * T,
+                               max(2 * T + 1, ticks - 3 * T))
+    t = jnp.arange(ticks, dtype=I32)
+    window = (t >= start) & (t < start + 2 * T)                  # [T]
+    drop = (jax.random.uniform(kd, (ticks, cfg.n, cfg.n)) < 0.02)
+    return dataclasses.replace(_no_faults(cfg, ticks), drop=drop,
+                               target_leader=window,
+                               append_flood=window)
+
+
+def _gen_transfer_abuse(key, cfg: SimConfig, ticks: int) -> FaultSchedule:
+    """Leadership ping-pong: after the first election settles, two random
+    rows alternate as the demanded transfer target on a fast flap, so
+    every standing leader is immediately asked to hand off — each
+    completed handoff is a TIMEOUT_NOW election (leader churn with no
+    fault cover).  cfg.transfer_cooldown_ticks rate-limits the handoffs
+    and SLO_LEADER_CHURN bounds the residual."""
+    ka, kb, kw = jax.random.split(key, 3)
+    T = cfg.election_tick
+    a = jax.random.randint(ka, (), 0, cfg.n)
+    b = jax.random.randint(kb, (), 0, cfg.n)
+    t = jnp.arange(ticks, dtype=I32)
+    settled = t >= 2 * T
+    flip = _windows(kw, ticks, 2, max(3, T // 2))
+    row = jnp.arange(cfg.n, dtype=I32)
+    tgt = jnp.where(flip, a, b)                                  # [T]
+    abuse = settled[:, None] & (row[None, :] == tgt[:, None])
+    return dataclasses.replace(_no_faults(cfg, ticks),
+                               transfer_abuse=abuse)
+
+
 _GENERATORS = {
     "random_drop": _gen_random_drop,
     "partition_flapper": _gen_partition_flapper,
@@ -274,6 +584,10 @@ _GENERATORS = {
     "crash_during_campaign": _gen_crash_during_campaign,
     "stale_leader_reads": _gen_stale_leader_reads,
     "term_inflation": _gen_term_inflation,
+    "disruptive_rejoin": _gen_disruptive_rejoin,
+    "vote_equivocation": _gen_vote_equivocation,
+    "append_flood": _gen_append_flood,
+    "transfer_abuse": _gen_transfer_abuse,
 }
 
 
@@ -286,6 +600,19 @@ def make_schedule(cfg: SimConfig, ticks: int, profile: str,
                        f"known: {PROFILES + EXTRA_PROFILES}")
     key = jax.random.fold_in(jax.random.PRNGKey(seed), index)
     return gen(key, cfg, ticks)
+
+
+# FaultSchedule leaves that default to None (old artifacts keep tracing
+# the pre-extension program) and their gate shape: "T" -> [ticks],
+# "TN" -> [ticks, n].  make_batch promotes absent leaves to all-False
+# zeros of this shape when any schedule in the batch carries the leaf.
+_OPTIONAL_LEAVES = {
+    "term_inflate": "TN",
+    "rejoin_campaign": "TN",
+    "vote_equivocate": "TN",
+    "append_flood": "T",
+    "transfer_abuse": "TN",
+}
 
 
 def make_batch(cfg: SimConfig, ticks: int, schedules: int, seed: int,
@@ -311,13 +638,15 @@ def make_batch(cfg: SimConfig, ticks: int, schedules: int, seed: int,
         for pos, s in enumerate(idx):
             stacks[s] = jax.tree_util.tree_map(lambda a: a[pos], sub)
     scheds = [stacks[s] for s in range(schedules)]
-    # a batch mixing term_inflation with inflation-less profiles must agree
-    # on tree structure: promote the Nones to all-False gates (value-
-    # identical — the transform is the identity on an all-False mask)
-    if any(s.term_inflate is not None for s in scheds):
-        zero = jnp.zeros((ticks, cfg.n), bool)
-        scheds = [dataclasses.replace(s, term_inflate=zero)
-                  if s.term_inflate is None else s for s in scheds]
+    # a batch mixing attack profiles with attack-less ones must agree on
+    # tree structure: promote absent optional leaves to all-False gates
+    # (value-identical — every verb is the identity on an all-False mask)
+    for leaf, shape in _OPTIONAL_LEAVES.items():
+        if any(getattr(s, leaf) is not None for s in scheds):
+            dims = (ticks,) if shape == "T" else (ticks, cfg.n)
+            zero = jnp.zeros(dims, bool)
+            scheds = [dataclasses.replace(s, **{leaf: zero})
+                      if getattr(s, leaf) is None else s for s in scheds]
     batch = jax.tree_util.tree_map(
         lambda *leaves: jnp.stack(leaves), *scheds)
     return batch, names

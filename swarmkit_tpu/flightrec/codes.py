@@ -28,6 +28,12 @@ EVENT_WIDTH = 4  # (tick, code, arg0, arg1)
 #   READ_SERVED      arg0=applied idx served  arg1=batch size (reads)
 #   READ_BLOCKED     arg0=reads refused       arg1=BLOCK_* reason
 #   LEASE_EXPIRED    arg0=lease expiry tick   arg1=reads bounced with it
+# Attack signatures (ISSUE 15: emitted by the dst/schedule.py adversary
+# verbs on the row the attack targets, when the state carries a ring):
+#   ATTACK_REJOIN    arg0=row's term          arg1=row's timeout
+#   ATTACK_EQUIVOCATE arg0=wiped vote         arg1=row's term
+#   ATTACK_FLOOD     arg0=extra proposals     arg1=leader uncommitted tail
+#   ATTACK_TRANSFER  arg0=requested target    arg1=cooldown remaining
 ELECTION_WON = 1
 TERM_BUMP = 2
 COMMIT_ADVANCE = 3
@@ -38,6 +44,10 @@ APPEND_REJECT = 7
 READ_SERVED = 8
 READ_BLOCKED = 9
 LEASE_EXPIRED = 10
+ATTACK_REJOIN = 11
+ATTACK_EQUIVOCATE = 12
+ATTACK_FLOOD = 13
+ATTACK_TRANSFER = 14
 
 CODE_NAMES = {
     ELECTION_WON: "ELECTION_WON",
@@ -50,6 +60,10 @@ CODE_NAMES = {
     READ_SERVED: "READ_SERVED",
     READ_BLOCKED: "READ_BLOCKED",
     LEASE_EXPIRED: "LEASE_EXPIRED",
+    ATTACK_REJOIN: "ATTACK_REJOIN",
+    ATTACK_EQUIVOCATE: "ATTACK_EQUIVOCATE",
+    ATTACK_FLOOD: "ATTACK_FLOOD",
+    ATTACK_TRANSFER: "ATTACK_TRANSFER",
 }
 
 # FAULT_EDGE arg0 values: row went down / came back / its drop degree

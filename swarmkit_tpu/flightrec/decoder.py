@@ -42,6 +42,10 @@ class FlightEvent:
             "READ_BLOCKED": f"reads={a0} "
                             f"reason={_BLOCK_NAMES.get(a1, a1)}",
             "LEASE_EXPIRED": f"expired_at={a0} bounced={a1}",
+            "ATTACK_REJOIN": f"term={a0} timeout={a1}",
+            "ATTACK_EQUIVOCATE": f"wiped_vote=n{a0} term={a1}",
+            "ATTACK_FLOOD": f"extra={a0} tail={a1}",
+            "ATTACK_TRANSFER": f"target=n{a0} cooldown={a1}",
         }.get(self.name)
         if self.code == FAULT_EDGE:
             edge = _EDGE_NAMES.get(a0, f"edge_{a0}")

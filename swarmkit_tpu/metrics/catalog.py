@@ -203,6 +203,10 @@ CATALOG: dict[str, MetricSpec] = {
     "swarm_dst_shrink_rounds_total": MetricSpec(
         "counter", "Counterexample-shrinker replay evaluations, by verdict "
         "on the candidate fault clearing (removed / required).", ("result",)),
+    "swarm_dst_attack_ticks_total": MetricSpec(
+        "counter", "Adversary verb gate firings lowered into explored "
+        "schedules, by attack profile (dst/schedule.py ATTACK_PROFILES).",
+        ("attack",)),
 
     # ---- exhaustive model checker (mc/) ----------------------------------
     # Names and label sets are pinned to swarmkit_tpu/mc/metrics.py by

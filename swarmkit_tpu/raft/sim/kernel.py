@@ -354,6 +354,14 @@ def step(state: SimState, cfg: SimConfig,
     elapsed = jnp.where(alive, elapsed + 1, elapsed)
     contact = jnp.where(alive, state.contact + 1, state.contact)
     hb_elapsed = jnp.where(is_leader, hb_elapsed + 1, hb_elapsed)
+    # transfer-abuse cooldown (cfg.transfer_cooldown_ticks): count down one
+    # tick here; the register re-arms in _progress_b on the row whose
+    # TIMEOUT_NOW actually fired, and the request sites
+    # (transfer_leadership, dst apply_transfer_abuse) refuse new targets
+    # while it is nonzero.
+    tx_cool = None
+    if cfg.transfer_cooldown_ticks > 0 and state.tx_cool is not None:
+        tx_cool = jnp.maximum(state.tx_cool - 1, 0)
 
     # ---- role-sparse progress (cfg.active_rows_on): the active-row set --
     # Only rows whose node is a leader or candidate ever MUTATE their own
@@ -473,29 +481,43 @@ def step(state: SimState, cfg: SimConfig,
             # active, and nothing reads match before Phase B)
             match = jnp.where(g(prop_ok)[:, None] & eye_r,
                               g(last)[:, None], match)
+        if cfg.vote_guard:
+            # persisted-vote guard (the WAL-shadow defense for the
+            # vote_equivocation adversary): a durable (term, candidate)
+            # record written alongside EVERY vote assignment and never
+            # cleared by schedule verbs — so an adversarial wipe of
+            # `vote` cannot make this row grant a SECOND candidate in
+            # the same term.  Redundant (hence bit-identical) on stock
+            # runs: vote == NONE at term t implies vg_term < t there.
+            vg_vote, vg_term = state.vg_vote, state.vg_term
 
         # CheckQuorum (vendor raft.go:536-560 tickHeartbeat +
         # checkQuorumActive): every election_tick ticks a leader confirms
         # it heard from a quorum of members since the last round; a
         # partitioned stale leader steps down instead of lingering until a
-        # higher term reaches it.
+        # higher term reaches it.  Python-gated by cfg.check_quorum
+        # (True = the historical unconditional program; False exists only
+        # for the disruptive_rejoin defense-off demo — the periodic timer
+        # still drives the transfer abort below either way).
         check_due = is_leader & (elapsed >= cfg.election_tick)
-        if cfg.peer_tiled:
-            n_heard = sfull(_pcount(
-                lambda j0: _pband(recent_active, j0) | _peye_rows(rows, j0),
-                mem=member_r, rows_n=R), 0)
-        else:
-            n_heard = sfull(jnp.sum(mview_r(recent_active | eye_r)
-                                    .astype(I32), axis=1), 0)
-        cq_fail = check_due & (n_heard < quorum_row)
-        role = jnp.where(cq_fail, FOLLOWER, role)
-        lead = jnp.where(cq_fail, NONE, lead)
+        if cfg.check_quorum:
+            if cfg.peer_tiled:
+                n_heard = sfull(_pcount(
+                    lambda j0: _pband(recent_active, j0)
+                    | _peye_rows(rows, j0),
+                    mem=member_r, rows_n=R), 0)
+            else:
+                n_heard = sfull(jnp.sum(mview_r(recent_active | eye_r)
+                                        .astype(I32), axis=1), 0)
+            cq_fail = check_due & (n_heard < quorum_row)
+            role = jnp.where(cq_fail, FOLLOWER, role)
+            lead = jnp.where(cq_fail, NONE, lead)
+            # a quorum-confirmed leader re-arms its own lease (core
+            # CHECK_QUORUM)
+            contact = jnp.where(check_due & ~cq_fail, 0, contact)
+            recent_active = jnp.where(g(check_due)[:, None], False,
+                                      recent_active)
         elapsed = jnp.where(check_due, 0, elapsed)
-        # a quorum-confirmed leader re-arms its own lease (core
-        # CHECK_QUORUM)
-        contact = jnp.where(check_due & ~cq_fail, 0, contact)
-        recent_active = jnp.where(g(check_due)[:, None], False,
-                                  recent_active)
         is_leader = (role == LEADER) & alive
         # a transfer that hasn't completed within an election timeout is
         # aborted so the leader can accept proposals again (vendor raft.go
@@ -550,6 +572,9 @@ def step(state: SimState, cfg: SimConfig,
         else:
             term = term + campaign.astype(I32)
             vote = jnp.where(campaign, node, vote)
+            if cfg.vote_guard:
+                vg_vote = jnp.where(campaign, node, vg_vote)
+                vg_term = jnp.where(campaign, term, vg_term)
             role = jnp.where(campaign, CANDIDATE, role)
             lead = jnp.where(campaign, NONE, lead)
             timeout = jnp.where(campaign, rand_timeout(cfg, node, term),
@@ -560,6 +585,9 @@ def step(state: SimState, cfg: SimConfig,
         # forced (transfer) campaign: always real, even under PreVote
         term = term + tn_ok.astype(I32)
         vote = jnp.where(tn_ok, node, vote)
+        if cfg.vote_guard:
+            vg_vote = jnp.where(tn_ok, node, vg_vote)
+            vg_term = jnp.where(tn_ok, term, vg_term)
         role = jnp.where(tn_ok, CANDIDATE, role)
         pre = pre & ~tn_ok
         lead = jnp.where(tn_ok, NONE, lead)
@@ -578,7 +606,10 @@ def step(state: SimState, cfg: SimConfig,
         # depose a healthy leader.  Lease from LEADER CONTACT (not the
         # election timer, which re-arms on every campaign attempt —
         # core.py contact_elapsed rationale)
-        leased = (lead != NONE) & (contact < cfg.election_tick)  # [j]
+        if cfg.check_quorum:
+            leased = (lead != NONE) & (contact < cfg.election_tick)  # [j]
+        else:
+            leased = jnp.zeros((n,), bool)   # defense off: no lease
         if cfg.mailboxes:
             # Device-mailbox wire (SURVEY §7): one in-flight message per
             # class per directed edge; *_at stores deliver-tick+1
@@ -693,6 +724,9 @@ def step(state: SimState, cfg: SimConfig,
                 & (campaign | pv_polled)
             term = term + pre_win.astype(I32)
             vote = jnp.where(pre_win, node, vote)
+            if cfg.vote_guard:
+                vg_vote = jnp.where(pre_win, node, vg_vote)
+                vg_term = jnp.where(pre_win, term, vg_term)
             pre = jnp.where(pre_win, False, pre)
             lead = jnp.where(pre_win, NONE, lead)  # becomeCandidate reset
             elapsed = jnp.where(pre_win, 0, elapsed)
@@ -720,6 +754,13 @@ def step(state: SimState, cfg: SimConfig,
         # (last_term / log_ok computed above the PreVote block; Phase B
         # never mutates log state, so they stay valid here.)
         can_vote = (vote[None, :] == NONE) | (vote[None, :] == rows[:, None])
+        if cfg.vote_guard:
+            # the durable record outlives an adversarial wipe of `vote`:
+            # a row that already voted this term may only re-grant the
+            # SAME candidate (a restarted voter re-sending a duplicate
+            # grant is raft-legal; a conflicting grant is not)
+            can_vote = can_vote & ((vg_term[None, :] < term[None, :])
+                                   | (vg_vote[None, :] == rows[:, None]))
         # Compare the SEND-TIME candidate term (req_term) with the
         # receiver's post-catch-up term: a candidate whose own term was
         # bumped this tick by a higher-term rival must not have its stale
@@ -735,6 +776,9 @@ def step(state: SimState, cfg: SimConfig,
                                 0).astype(I32)
         grant_mat = grantable & (rows[:, None] == chosen_cand[None, :])
         vote = jnp.where(any_grant, chosen_cand, vote)
+        if cfg.vote_guard:
+            vg_vote = jnp.where(any_grant, chosen_cand, vg_vote)
+            vg_term = jnp.where(any_grant, term, vg_term)
         elapsed = jnp.where(any_grant, 0, elapsed)
         # Responses travel j -> i; may be dropped independently. Requests
         # that were processed at the receiver's term but not granted come
@@ -1033,6 +1077,8 @@ def step(state: SimState, cfg: SimConfig,
             granted=sc(granted0, granted),
             rejected=sc(rejected0, rejected),
             recent_active=sc(ra0, recent_active))
+        if cfg.vote_guard:
+            out.update(vg_vote=vg_vote, vg_term=vg_term)
         if cfg.mailboxes:
             out.update(
                 probing=sc(state.probing, probing),
@@ -1080,6 +1126,9 @@ def step(state: SimState, cfg: SimConfig,
     got_app, got_snap, p = _oa["got_app"], _oa["got_snap"], _oa["p"]
     match, next_, granted = _oa["match"], _oa["next_"], _oa["granted"]
     rejected, recent_active = _oa["rejected"], _oa["recent_active"]
+    vg_fields = {}
+    if cfg.vote_guard:
+        vg_fields = dict(vg_vote=_oa["vg_vote"], vg_term=_oa["vg_term"])
     probing = _oa["probing"] if cfg.mailboxes else None
     if cfg.mailboxes:
         vreq_at, vreq_term = _oa["vreq_at"], _oa["vreq_term"]
@@ -1580,6 +1629,12 @@ def step(state: SimState, cfg: SimConfig,
         tn_at = jnp.where(any_tn, now + 1 + tn_lat_r[tn_sel], tn_at)
         tn_term = jnp.where(any_tn, term[tn_src], tn_term)
         tn_from = jnp.where(any_tn, tn_src, tn_from)
+        if cfg.transfer_cooldown_ticks > 0:
+            # transfer-abuse cooldown re-arm: the row that FIRED a
+            # TIMEOUT_NOW refuses new transfer targets for the next
+            # cfg.transfer_cooldown_ticks ticks (applied after the
+            # segment — tx_cool is a plain [N] register)
+            tn_fired = sfull(want_tn, False)
 
         # ---- Phase D: leader commit (quorum on the match row) ------------
         # maybeCommit (vendor raft.go:478-486) takes the quorum-th largest
@@ -1657,6 +1712,8 @@ def step(state: SimState, cfg: SimConfig,
             mci=mci, got_resp=sfull(got_resp_r, False))
         if reads_on:
             out["rd_nack"] = rd_nack
+        if cfg.transfer_cooldown_ticks > 0:
+            out["tn_fired"] = tn_fired
         if cfg.mailboxes:
             out.update(
                 probing=sc(probing0, probing),
@@ -1680,6 +1737,9 @@ def step(state: SimState, cfg: SimConfig,
     recent_active = _ob["recent_active"]
     tn_at, tn_term, tn_from = _ob["tn_at"], _ob["tn_term"], _ob["tn_from"]
     mci, got_resp = _ob["mci"], _ob["got_resp"]
+    if tx_cool is not None:
+        tx_cool = jnp.where(_ob["tn_fired"],
+                            I32(cfg.transfer_cooldown_ticks), tx_cool)
     if cfg.mailboxes:
         probing = _ob["probing"]
         app_at, app_prev = _ob["app_at"], _ob["app_prev"]
@@ -2125,6 +2185,8 @@ def step(state: SimState, cfg: SimConfig,
         hup_conf=hup_conf, tail_conf=tail_conf,
         tick=state.tick + 1,
         stats=stats,
+        **vg_fields,
+        **({} if tx_cool is None else dict(tx_cool=tx_cool)),
         **sp_fields,
         **ev_fields,
         **tel_fields,
@@ -2141,6 +2203,11 @@ def _leader_ok(state: SimState, cfg: SimConfig, alive=None):
     is_leader = (state.role == LEADER) & jnp.diagonal(state.member)
     room = (state.last + cfg.max_props - state.snap_idx) <= cfg.log_len
     ok = is_leader & room & (state.transferee == NONE)
+    if cfg.prop_inflight_cap > 0:
+        # append-flood defense: a leader refuses new proposals while its
+        # uncommitted tail is at the cap, so a flooding client drains the
+        # ring instead of driving it into compaction pressure
+        ok = ok & ((state.last - state.commit) < cfg.prop_inflight_cap)
     if alive is not None:
         ok = ok & alive
     return ok
@@ -2279,6 +2346,10 @@ def transfer_leadership(state: SimState, cfg: SimConfig, leader,
     target = jnp.asarray(target, I32)
     is_l = (state.role[leader] == LEADER) & (target != leader) \
         & state.member[leader, target]
+    if cfg.transfer_cooldown_ticks > 0 and state.tx_cool is not None:
+        # transfer-abuse defense: refuse new targets while the cooldown
+        # from this leader's last fired TIMEOUT_NOW is still counting down
+        is_l = is_l & (state.tx_cool[leader] == 0)
     changed = is_l & (state.transferee[leader] != target)
     transferee = state.transferee.at[leader].set(
         jnp.where(changed, target, state.transferee[leader]))

@@ -385,18 +385,25 @@ class OracleCluster:
             # have heard from a quorum since its last round (kernel Phase A)
             if up[i] and nd.state == core.LEADER \
                     and self.elapsed[i] >= cfg.election_tick:
-                members = {p - 1 for p in nd.prs}
-                heard = (self.recent_active[i] | {i}) & members
-                if len(heard) < nd.quorum():
-                    nd.become_follower(nd.term, core.NONE)
+                if cfg.check_quorum:
+                    members = {p - 1 for p in nd.prs}
+                    heard = (self.recent_active[i] | {i}) & members
+                    if len(heard) < nd.quorum():
+                        nd.become_follower(nd.term, core.NONE)
+                    else:
+                        # transfer not completed within an election
+                        # timeout: abort (kernel Phase A; vendor
+                        # tickHeartbeat); a quorum-confirmed leader
+                        # re-arms its own lease
+                        nd._abort_leader_transfer()
+                        self.contact[i] = 0
+                    self.recent_active[i] = set()
                 else:
-                    # transfer not completed within an election timeout:
-                    # abort (kernel Phase A; vendor tickHeartbeat); a
-                    # quorum-confirmed leader re-arms its own lease
+                    # defense off (kernel gates only the step-down and
+                    # lease re-arm; the periodic transfer abort and the
+                    # timer reset run either way)
                     nd._abort_leader_transfer()
-                    self.contact[i] = 0
                 self.elapsed[i] = 0
-                self.recent_active[i] = set()
         # TIMEOUT_NOW deliveries land between CheckQuorum and the timeout
         # campaigns (kernel Phase A order)
         self._transfer_deliver(up)
@@ -631,7 +638,7 @@ class OracleCluster:
         # reproduces the kernel's max-term catch-up + lowest-index grant.
         # Lease flags snapshot BEFORE any vote is delivered (kernel computes
         # `leased` once from post-Phase-A state).
-        leased = [nodes[j].lead != core.NONE
+        leased = [cfg.check_quorum and nodes[j].lead != core.NONE
                   and self.contact[j] < cfg.election_tick
                   for j in range(n)]
         # capture candidacies BEFORE any exchange (kernel send sets are
@@ -773,7 +780,7 @@ class OracleCluster:
                                          nd.term, is_pre)
         # request deliveries (lease snapshot BEFORE any vote is stepped);
         # prevote requests process before real ones (kernel phase order)
-        leased = [nodes[j].lead != core.NONE
+        leased = [cfg.check_quorum and nodes[j].lead != core.NONE
                   and self.contact[j] < cfg.election_tick
                   for j in range(n)]
         due = sorted(k for k, v in self.vreq.items() if v[0] <= now)

@@ -190,6 +190,49 @@ class SimConfig:
     # if the device-computed p99 propose->commit latency bucket edge
     # exceeds this many ticks.  0 disables the oracle bit.
     slo_p99_commit_ticks: int = 0
+    # ---- adversary-suite defense knobs (dst/schedule.py attack verbs) ----
+    # Every default below reproduces the pre-suite compiled program
+    # bit-for-bit: check_quorum=True keeps the lease + periodic step-down
+    # that were previously unconditional, and the three new defenses are
+    # Python-gated OFF so their registers/ops are never traced.
+    #
+    # CheckQuorum (raft dissertation §4.2.3, previously always-on): the
+    # voter lease on (pre)vote requests plus the leader's periodic
+    # heard-from-a-quorum step-down.  False exists ONLY so the
+    # disruptive_rejoin adversary demo can show the undefended election
+    # storm; production configs keep it True.
+    check_quorum: bool = True
+    # Persisted-vote guard (the vote_equivocation defense): carry a
+    # durable WAL-analog (vg_vote, vg_term) that records every granted
+    # vote and is consulted alongside the volatile `vote` register.  The
+    # equivocation verb wipes `vote` (a crash-restart without fsync); with
+    # the guard on, a second same-term grant is unrepresentable because
+    # the WAL shadow still pins the first choice.  Decision-identical to
+    # the stock kernel when no verb tampers with `vote`.
+    vote_guard: bool = False
+    # Leadership-transfer cooldown (the transfer_abuse defense): after a
+    # row fires TIMEOUT_NOW for a transfer, it refuses further transfer
+    # requests for this many ticks (transfer_leadership and the
+    # transfer_abuse verb both consult the register).  0 disables the
+    # register entirely.
+    transfer_cooldown_ticks: int = 0
+    # Per-row proposal inflight cap (the append_flood defense): a leader
+    # whose uncommitted backlog (last - commit) has reached this many
+    # entries refuses new proposals until the pipeline drains — bounding
+    # the ring/Phase-F compaction pressure a targeted append flood can
+    # build.  0 disables the cap.
+    prop_inflight_cap: int = 0
+    # Leadership-churn SLO for the DST oracle: when > 0 (and
+    # collect_telemetry is on), dst/invariants.py raises SLO_LEADER_CHURN
+    # if the cumulative election-win count (sum of tel_elect_hist) exceeds
+    # this bound — the disruptive_rejoin / transfer_abuse witness.
+    slo_leader_changes: int = 0
+    # Log-occupancy SLO for the DST oracle: when > 0, dst/invariants.py
+    # raises SLO_LOG_OCCUPANCY if any row's uncommitted tail
+    # (last - commit, the quantity prop_inflight_cap gates acceptance
+    # on) exceeds this bound — the append_flood witness.  With the cap
+    # on, the tail never exceeds prop_inflight_cap - 1 + max_props.
+    slo_log_occupancy: int = 0
 
     @property
     def lease_ticks(self) -> int:
@@ -323,6 +366,22 @@ class SimConfig:
             raise ValueError(
                 "slo_p99_commit_ticks needs the commit-latency histogram; "
                 "set collect_telemetry=True")
+        if self.transfer_cooldown_ticks < 0:
+            raise ValueError(f"transfer_cooldown_ticks must be >= 0, got "
+                             f"{self.transfer_cooldown_ticks}")
+        if self.prop_inflight_cap < 0:
+            raise ValueError(f"prop_inflight_cap must be >= 0, got "
+                             f"{self.prop_inflight_cap}")
+        if self.slo_leader_changes < 0:
+            raise ValueError(f"slo_leader_changes must be >= 0, got "
+                             f"{self.slo_leader_changes}")
+        if self.slo_leader_changes > 0 and not self.collect_telemetry:
+            raise ValueError(
+                "slo_leader_changes needs the election histogram; "
+                "set collect_telemetry=True")
+        if self.slo_log_occupancy < 0:
+            raise ValueError(f"slo_log_occupancy must be >= 0, got "
+                             f"{self.slo_log_occupancy}")
         if self.peer_chunk < 0:
             raise ValueError(f"peer_chunk must be >= 0, got {self.peer_chunk}")
         if self.peer_tiled:
@@ -434,6 +493,21 @@ class SimState:
     # otherwise.  Rows with ttl == 0 and a follower role provably have no
     # pending progress mutations, so the [A, N] slab can skip them.
     active_ttl: Optional[jax.Array] = None
+    # ---- adversary-defense registers (Python-gated by SimConfig) --------
+    # vg_vote/vg_term [N] i32 (cfg.vote_guard): the durably-persisted vote
+    # record — (candidate granted, term it was granted at).  Written
+    # alongside every `vote` assignment, NEVER cleared by schedule verbs
+    # (the vote_equivocation attack wipes only the volatile `vote`
+    # register, modeling a restart that lost the unsynced WAL tail), and
+    # consulted by Phase B's can_vote so a second same-term grant is
+    # unrepresentable.  NONE/NONE = never voted.
+    vg_vote: Optional[jax.Array] = None
+    vg_term: Optional[jax.Array] = None
+    # tx_cool [N] i32 (cfg.transfer_cooldown_ticks > 0): ticks until this
+    # row accepts another leadership-transfer request.  Armed to the
+    # cooldown span when the row fires TIMEOUT_NOW for a completing
+    # transfer; decremented toward 0 each tick.
+    tx_cool: Optional[jax.Array] = None
     # ---- flight recorder (cfg.record_events; flightrec/) ----------------
     # ev_buf [N, event_ring, 4] i32 rows of (tick, code, arg0, arg1);
     # ev_pos [N] is the CUMULATIVE events-written cursor per row (slot of
@@ -601,6 +675,10 @@ def init_state(cfg: SimConfig,
         tick=jnp.zeros((), i32),
         stats=jnp.zeros((4,), i32) if cfg.collect_stats else None,
         active_ttl=z(n) if cfg.active_rows_on else None,
+        **(dict(vg_vote=jnp.full((n,), NONE, i32),
+                vg_term=jnp.full((n,), NONE, i32))
+           if cfg.vote_guard else {}),
+        **(dict(tx_cool=z(n)) if cfg.transfer_cooldown_ticks > 0 else {}),
         **(dict(ev_buf=z(n, cfg.event_ring, 4), ev_pos=z(n),
                 ev_alive=jnp.ones((n,), jnp.bool_), ev_drop=z(n))
            if cfg.record_events else {}),

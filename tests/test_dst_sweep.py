@@ -49,3 +49,27 @@ def test_dst_sweep_stale_read_mutation_demo(tmp_path):
     # localization comes from the LINEARIZABLE_READ bit + flight window
     assert demo["oracle_diverged_at"] == -1
     assert demo["flight_events"] > 0
+
+
+@pytest.mark.slow
+def test_disruptive_rejoin_demo_neutralized():
+    from tools.dst_sweep import run_disruptive_rejoin_demo
+    demo = run_disruptive_rejoin_demo(verbose=False)
+    assert demo["defense_off"]["churn_violations"] > 0, demo
+    assert demo["defense_on"]["violations"] == 0, demo
+    # PreVote + CheckQuorum hold churn at the SLO bound while the
+    # undefended run deposes the leader on every barrage
+    assert demo["defense_on"]["max_leader_changes"] \
+        < demo["defense_off"]["max_leader_changes"], demo
+    assert demo["neutralized"], demo
+
+
+@pytest.mark.slow
+def test_transfer_abuse_demo_neutralized():
+    from tools.dst_sweep import run_transfer_abuse_demo
+    demo = run_transfer_abuse_demo(verbose=False)
+    assert demo["defense_off"]["churn_violations"] > 0, demo
+    assert demo["defense_on"]["violations"] == 0, demo
+    assert demo["defense_on"]["max_leader_changes"] \
+        < demo["defense_off"]["max_leader_changes"], demo
+    assert demo["neutralized"], demo

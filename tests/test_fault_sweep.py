@@ -23,3 +23,19 @@ def test_fault_sweep_wire(wire):
     assert len(results) == len(PLANS) * len(PINNED_SEEDS)
     failed = [r for r in results if not r["ok"]]
     assert not failed, f"fault sweep scenarios failed on {wire}: {failed}"
+
+
+@pytest.mark.slow
+def test_attack_sweep_all_scenarios(tmp_path):
+    # the arXiv:2601.00273 attack suite: every scenario must be caught
+    # defense-off, shrunk to a replay-exact artifact with the oracle in
+    # lockstep, and come back clean defense-on; host wires have no
+    # state-injection seam, so each contributes an explicit skip row
+    from tools.fault_sweep import ATTACK_SCENARIOS, run_attack_sweep
+    results = run_attack_sweep(out_dir=str(tmp_path), verbose=False)
+    device = [r for r in results if r["wire"] == "device"]
+    skips = [r for r in results if r.get("skipped")]
+    assert len(device) == len(ATTACK_SCENARIOS)
+    assert len(skips) == len(ATTACK_SCENARIOS) * len(WIRES)
+    failed = [r for r in device if not r["ok"]]
+    assert not failed, f"attack scenarios failed: {failed}"

@@ -256,8 +256,14 @@ def test_dst_catches_stale_lease_read_mutation():
     from swarmkit_tpu import dst
 
     cfg = small_cfg(read_batch=2, seed=0)
+    # the attack profiles in EXTRA_PROFILES trip their own safety/SLO
+    # bits BY DESIGN against an undefended config (tests/test_threat_model.py
+    # owns that coverage) — this self-test pins the read-path mutation,
+    # so it sweeps only the attack-less extras
+    profiles = tuple(p for p in dst.EXTRA_PROFILES
+                     if p not in dst.ATTACK_PROFILES)
     batch, names = dst.make_batch(cfg, ticks=100, schedules=12, seed=0,
-                                  profiles=dst.EXTRA_PROFILES)
+                                  profiles=profiles)
     res = dst.explore(init_state(cfg), cfg, batch, profiles=names,
                       prop_count=2, mutation="stale_lease_read")
     assert len(res.violating) > 0

@@ -46,6 +46,16 @@ def add_common_args(ap: argparse.ArgumentParser) -> None:
                     "DST and model-checker artifacts alike)")
 
 
+def add_demo_arg(ap: argparse.ArgumentParser, name: str,
+                 help_text: str) -> None:
+    """Register a ``--<name>-demo`` flag.  The seed-pinned adversary
+    demos (term-inflation, disruptive-rejoin, transfer-abuse) share one
+    CLI idiom: run ONLY the named defense-off vs defense-on scenario,
+    print the headline contrast, and exit 0 iff the defense neutralizes
+    the attack with zero violations."""
+    ap.add_argument(f"--{name}-demo", action="store_true", help=help_text)
+
+
 def add_active_rows_arg(ap: argparse.ArgumentParser) -> None:
     """The role-sparse progress lowering knob both sweep vocabularies
     share (SimConfig.active_rows): 0 = dense elementwise per-peer

@@ -221,6 +221,103 @@ def run_term_inflation_demo(schedules: int = 8, ticks: int = 60,
     return out
 
 
+def run_disruptive_rejoin_demo(schedules: int = 8, ticks: int = 120,
+                               seed: int = 7, n: int = 5,
+                               prop_count: int = 2,
+                               verbose: bool = True) -> dict:
+    """Seed-pinned rejoin-storm demo: the `disruptive_rejoin` adversary
+    heals a partitioned victim and has it campaign on every OTHER timeout
+    from then on.  Without PreVote + CheckQuorum each barrage deposes the
+    standing leader (every re-election lands in the churn histogram);
+    with both defenses the rejoiner's poll is non-binding and leaseholding
+    voters ignore it, so the cluster keeps its first leader.  The
+    SLO_LEADER_CHURN bound witnesses the contrast: defense-off trips it,
+    defense-on stays clean."""
+    import dataclasses
+
+    import numpy as np
+
+    out = {"schedules": schedules, "ticks": ticks, "seed": seed, "n": n}
+    base = dataclasses.replace(_cfg(n, seed, reads=0),
+                               collect_telemetry=True, slo_leader_changes=2)
+    for key, (pv, cq) in (("defense_off", (False, False)),
+                          ("defense_on", (True, True))):
+        cfg = dataclasses.replace(base, pre_vote=pv, check_quorum=cq)
+        batch, names = dst.make_batch(cfg, ticks=ticks, schedules=schedules,
+                                      seed=seed,
+                                      profiles=("disruptive_rejoin",))
+        res = dst.explore(init_state(cfg), cfg, batch, profiles=names,
+                          prop_count=prop_count)
+        wins = np.asarray(res.final_state.tel_elect_hist) \
+            .reshape(schedules, -1).sum(axis=1)
+        out[key] = {
+            "max_leader_changes": int(wins.max()),
+            "churn_violations":
+                int(((res.viol & dst.SLO_LEADER_CHURN) != 0).sum()),
+            "violations": int((res.viol != 0).sum()),
+        }
+    out["neutralized"] = (out["defense_off"]["churn_violations"] > 0
+                          and out["defense_on"]["violations"] == 0)
+    if verbose:
+        print(f"disruptive_rejoin x{schedules} schedules x {ticks} ticks: "
+              f"{out['defense_off']['max_leader_changes']} leader changes "
+              f"without PreVote+CheckQuorum "
+              f"({out['defense_off']['churn_violations']} SLO_LEADER_CHURN "
+              f"trips) vs {out['defense_on']['max_leader_changes']} with "
+              f"them ({out['defense_on']['violations']} violations) — "
+              f"{'defenses neutralize the rejoin storm' if out['neutralized'] else 'NOT neutralized'}",
+              flush=True)
+    return out
+
+
+def run_transfer_abuse_demo(schedules: int = 8, ticks: int = 120,
+                            seed: int = 7, n: int = 5, prop_count: int = 2,
+                            cooldown: int = 60,
+                            verbose: bool = True) -> dict:
+    """Seed-pinned transfer-thrash demo: the `transfer_abuse` adversary
+    keeps requesting leadership transfers toward alternating targets.
+    Without a cooldown every accepted TimeoutNow completes an election
+    (leadership ping-pongs dozens of times per run); with
+    `transfer_cooldown_ticks` a leader grants at most one transfer per
+    window, so churn stays near the single initial election.  The
+    SLO_LEADER_CHURN bound witnesses the contrast."""
+    import dataclasses
+
+    import numpy as np
+
+    out = {"schedules": schedules, "ticks": ticks, "seed": seed, "n": n,
+           "cooldown": cooldown}
+    base = dataclasses.replace(_cfg(n, seed, reads=0),
+                               collect_telemetry=True, slo_leader_changes=8)
+    for key, cool in (("defense_off", 0), ("defense_on", cooldown)):
+        cfg = dataclasses.replace(base, transfer_cooldown_ticks=cool)
+        batch, names = dst.make_batch(cfg, ticks=ticks, schedules=schedules,
+                                      seed=seed, profiles=("transfer_abuse",))
+        res = dst.explore(init_state(cfg), cfg, batch, profiles=names,
+                          prop_count=prop_count)
+        wins = np.asarray(res.final_state.tel_elect_hist) \
+            .reshape(schedules, -1).sum(axis=1)
+        out[key] = {
+            "max_leader_changes": int(wins.max()),
+            "churn_violations":
+                int(((res.viol & dst.SLO_LEADER_CHURN) != 0).sum()),
+            "violations": int((res.viol != 0).sum()),
+        }
+    out["neutralized"] = (out["defense_off"]["churn_violations"] > 0
+                          and out["defense_on"]["violations"] == 0)
+    if verbose:
+        print(f"transfer_abuse x{schedules} schedules x {ticks} ticks: "
+              f"{out['defense_off']['max_leader_changes']} leader changes "
+              f"without a transfer cooldown "
+              f"({out['defense_off']['churn_violations']} SLO_LEADER_CHURN "
+              f"trips) vs {out['defense_on']['max_leader_changes']} with "
+              f"cooldown={cooldown} ({out['defense_on']['violations']} "
+              f"violations) — "
+              f"{'cooldown neutralizes the thrash' if out['neutralized'] else 'NOT neutralized'}",
+              flush=True)
+    return out
+
+
 def replay_artifact_file(path: str, verbose: bool = True) -> dict:
     verdict = dst.replay_artifact(path)
     if verbose:
@@ -262,9 +359,15 @@ def main(argv=None) -> int:
                     "knob (e.g. commit_no_quorum) instead of stock+demo")
     ap.add_argument("--no-mutation-demo", action="store_true",
                     help="skip the detection self-test after the sweep")
-    ap.add_argument("--term-inflation-demo", action="store_true",
-                    help="run ONLY the seed-pinned PreVote-neutralizes-"
-                    "term-inflation scenario and exit")
+    _cli_common.add_demo_arg(ap, "term-inflation",
+                             "run ONLY the seed-pinned PreVote-neutralizes-"
+                             "term-inflation scenario and exit")
+    _cli_common.add_demo_arg(ap, "disruptive-rejoin",
+                             "run ONLY the seed-pinned PreVote+CheckQuorum-"
+                             "neutralize-rejoin-storm scenario and exit")
+    _cli_common.add_demo_arg(ap, "transfer-abuse",
+                             "run ONLY the seed-pinned cooldown-neutralizes-"
+                             "transfer-thrash scenario and exit")
     args = ap.parse_args(argv)
     prop_count = 2 if args.prop_count is None else args.prop_count
 
@@ -276,6 +379,21 @@ def main(argv=None) -> int:
         demo = run_term_inflation_demo(
             min(args.schedules, 8), min(args.ticks, 60),
             args.seed if args.seed else 7, args.n, prop_count)
+        return 0 if demo["neutralized"] else 1
+
+    # the attack demos pin their tick counts: the churn bounds they
+    # assert against are calibrated to the 120-tick window (a longer run
+    # legitimately accumulates more cooldown-paced transfers)
+    if args.disruptive_rejoin_demo:
+        demo = run_disruptive_rejoin_demo(
+            min(args.schedules, 8), seed=args.seed if args.seed else 7,
+            n=args.n, prop_count=prop_count)
+        return 0 if demo["neutralized"] else 1
+
+    if args.transfer_abuse_demo:
+        demo = run_transfer_abuse_demo(
+            min(args.schedules, 8), seed=args.seed if args.seed else 7,
+            n=args.n, prop_count=prop_count)
         return 0 if demo["neutralized"] else 1
 
     profiles = tuple(p for p in args.profiles.split(",") if p)

@@ -34,12 +34,22 @@ does the same for the role-sparse progress lowering
 (``SimConfig.active_rows``): a nonzero A runs the [A, N] slab kernel
 with a dense-progress cross-check, 0 pins the dense elementwise path.
 
+With ``--attacks`` the sweep instead runs the Byzantine-ish adversary
+scenarios (``ATTACK_SCENARIOS``): for each attack a seed-pinned DST
+sweep must catch the named invariant bit with the defense off, shrink
+the first counterexample to a replay-exact artifact, hold the
+differential oracle in lockstep, and come back clean with the defense
+on.  These verbs force device kernel state directly, so they run on the
+device wire only — each host wire gets an explicit skip row (see
+``ATTACK_WIRE_SKIP``) rather than a silent coverage gap.
+
 Usage:
     python tools/fault_sweep.py                       # full sweep
     python tools/fault_sweep.py --wires grpc --plans crash,partition
     python tools/fault_sweep.py --seeds 2009343,7
     python tools/fault_sweep.py --peer-chunk 8        # + device cross-check
     python tools/fault_sweep.py --active-rows 8       # + sparse cross-check
+    python tools/fault_sweep.py --attacks all         # adversary pipeline
 """
 
 from __future__ import annotations
@@ -72,6 +82,49 @@ from swarmkit_tpu.utils.clock import FakeClock, SystemClock  # noqa: E402
 WIRES = ("inproc", "devmesh", "grpc")
 PLANS = ("down", "drop", "partition", "delay", "crash")
 DEFAULT_SEEDS = (2009343,)
+
+# Byzantine-ish adversary scenarios (--attacks): each row pins the
+# defense-off / defense-on SimConfig deltas, the invariant bit that
+# witnesses the attack, and the schedule shape validated end-to-end by
+# the DST pipeline (catch -> shrink -> artifact -> replay -> oracle).
+# These run on the DEVICE wire only — the verbs are FaultSchedule leaves
+# that force kernel state arrays (vote registers, election timers,
+# transfer requests) between ticks, and the three host wires expose no
+# equivalent state-injection seam on a live Node — so the sweep emits an
+# explicit per-wire skip row for inproc/devmesh/grpc instead of
+# silently narrowing coverage.
+ATTACK_SCENARIOS = {
+    "disruptive_rejoin": dict(
+        off=dict(pre_vote=False, check_quorum=False,
+                 collect_telemetry=True, slo_leader_changes=2),
+        on=dict(pre_vote=True, check_quorum=True,
+                collect_telemetry=True, slo_leader_changes=2),
+        ticks=120, prop_count=2, bit="slo_leader_churn",
+        defense="PreVote + CheckQuorum"),
+    "vote_equivocation": dict(
+        # check_quorum off on BOTH sides: the lease refuses the rival's
+        # re-requests for the unrelated reason of fresh leader contact,
+        # which would mask the vote-guard hole under test
+        off=dict(check_quorum=False),
+        on=dict(check_quorum=False, vote_guard=True),
+        ticks=40, prop_count=2, bit="election_safety",
+        defense="persisted-vote guard"),
+    "append_flood": dict(
+        off=dict(slo_log_occupancy=24),
+        on=dict(slo_log_occupancy=24, prop_inflight_cap=8),
+        ticks=120, prop_count=0, bit="slo_log_occupancy",
+        defense="per-row inflight cap"),
+    "transfer_abuse": dict(
+        off=dict(collect_telemetry=True, slo_leader_changes=8),
+        on=dict(collect_telemetry=True, slo_leader_changes=8,
+                transfer_cooldown_ticks=60),
+        ticks=120, prop_count=2, bit="slo_leader_churn",
+        defense="transfer cooldown"),
+}
+
+ATTACK_WIRE_SKIP = (
+    "attack verbs force kernel state arrays between ticks; host Node "
+    "wires have no state-injection seam (device-only by design)")
 
 
 def _free_port() -> int:
@@ -568,6 +621,105 @@ def run_device_precheck(plans=PLANS, seeds=DEFAULT_SEEDS, peer_chunk: int = 8,
 
 
 # --------------------------------------------------------------------------
+# adversary attack scenarios (device wire): full counterexample pipeline
+
+
+def run_attack_sweep(attacks=None, seed: int = 7, schedules: int = 8,
+                     n: int = 5, out_dir: Optional[str] = None,
+                     wires=WIRES, verbose: bool = True) -> list[dict]:
+    """Seed-pinned end-to-end run of each ATTACK_SCENARIOS row.
+
+    For every attack: the defense-off sweep must CATCH it (the named
+    invariant bit trips), the first counterexample is shrunk and dumped
+    as a replayable artifact (replay must reproduce bits + first tick
+    exactly, the differential oracle must stay in lockstep over the
+    clean prefix), and the defense-on sweep over the SAME schedules must
+    come back violation-free.  Host wires get explicit skip rows — see
+    ATTACK_WIRE_SKIP."""
+    import dataclasses
+
+    from swarmkit_tpu import dst
+    from swarmkit_tpu.raft.sim.state import SimConfig, init_state
+
+    attacks = list(attacks or ATTACK_SCENARIOS)
+    base = SimConfig(n=n, log_len=64, window=8, apply_batch=16, max_props=8,
+                     keep=4, election_tick=10, seed=seed)
+    bit_of = {name: bit for bit, name in dst.BIT_NAMES.items()}
+    results = []
+    for attack in attacks:
+        sc = ATTACK_SCENARIOS[attack]
+        t0 = time.monotonic()
+        off = dataclasses.replace(base, **sc["off"])
+        on = dataclasses.replace(base, **sc["on"])
+        bit = bit_of[sc["bit"]]
+        ok, err, notes = True, "", ""
+        try:
+            batch, names = dst.make_batch(off, ticks=sc["ticks"],
+                                          schedules=schedules, seed=seed,
+                                          profiles=(attack,))
+            r_off = dst.explore(init_state(off), off, batch, profiles=names,
+                                prop_count=sc["prop_count"])
+            caught = [int(s) for s in r_off.violating
+                      if int(r_off.viol[s]) & bit]
+            if not caught:
+                raise AssertionError(
+                    f"defense-off sweep never tripped {sc['bit']}")
+            r_on = dst.explore(init_state(on), on, batch, profiles=names,
+                               prop_count=sc["prop_count"])
+            if int((r_on.viol != 0).sum()):
+                raise AssertionError(
+                    f"defense-on ({sc['defense']}) not clean: "
+                    f"{[hex(int(v)) for v in r_on.viol]}")
+            s = caught[0]
+            one = batch.slice(s)
+            before = dst.fault_count(one)
+            small, evals = dst.shrink(off, one, bit, sc["prop_count"])
+            v2, f2 = dst.replay(off, small, sc["prop_count"])
+            art = dst.to_artifact(off, small, seed=seed, profile=attack,
+                                  index=s, prop_count=sc["prop_count"],
+                                  mutation=None, viol=v2, first_tick=f2)
+            path = _cli_common.artifact_path(
+                None if out_dir is None else out_dir.rstrip(os.sep) + os.sep,
+                f"dst_attack_{attack}.json")
+            dst.save_artifact(path, art)
+            verdict = dst.replay_artifact(path)
+            if not verdict["matches_recorded"]:
+                raise AssertionError("artifact replay did not reproduce "
+                                     "the recorded violation")
+            tr = verdict["oracle"]
+            if tr["diverged_at"] != -1:
+                raise AssertionError(f"differential oracle diverged at "
+                                     f"tick {tr['diverged_at']}")
+            notes = (f"caught {len(caught)}/{schedules} ({sc['bit']}), "
+                     f"shrunk {before}->{dst.fault_count(small)} "
+                     f"fault-events in {evals} replays, replay exact, "
+                     f"oracle lockstep, defense-on ({sc['defense']}) "
+                     f"clean [{path}]")
+        except AssertionError as e:
+            ok, err = False, str(e)
+        results.append({"wire": "device", "plan": attack, "seed": seed,
+                        "ok": ok, "notes": notes, "error": err,
+                        "secs": round(time.monotonic() - t0, 2)})
+        if verbose:
+            r = results[-1]
+            state = "ok  " if ok else "FAIL"
+            line = (f"{state} {'device':8s} {attack:18s} seed={seed} "
+                    f"({r['secs']}s)  {notes}")
+            if not ok:
+                line += f"  {err}"
+            print(line, flush=True)
+        for wire in wires:
+            results.append({"wire": wire, "plan": attack, "seed": seed,
+                            "ok": True, "skipped": ATTACK_WIRE_SKIP,
+                            "notes": f"SKIP: {ATTACK_WIRE_SKIP}",
+                            "secs": 0.0})
+            if verbose:
+                print(f"skip {wire:8s} {attack:18s} seed={seed} "
+                      f"({ATTACK_WIRE_SKIP})", flush=True)
+    return results
+
+
+# --------------------------------------------------------------------------
 # sweep driver
 
 
@@ -641,6 +793,12 @@ def main(argv=None) -> int:
                     help="also run every plan through the DST kernel in "
                          "this peer-axis lowering (SimConfig.peer_chunk; "
                          "0 = dense, else banded + dense cross-check)")
+    ap.add_argument("--attacks", default=None, metavar="LIST",
+                    help=f"run ONLY the seed-pinned adversary attack "
+                    f"scenarios ('all' or a comma list from "
+                    f"{tuple(ATTACK_SCENARIOS)}): device-wire "
+                    f"counterexample pipeline + explicit per-host-wire "
+                    f"skip rows (the verbs have no host seam)")
     _cli_common.add_active_rows_arg(ap)
     args = ap.parse_args(argv)
 
@@ -653,6 +811,21 @@ def main(argv=None) -> int:
     for p in plans:
         if p not in PLANS:
             ap.error(f"unknown plan {p!r}")
+
+    if args.attacks:
+        attacks = (list(ATTACK_SCENARIOS) if args.attacks == "all"
+                   else [a for a in args.attacks.split(",") if a])
+        for a in attacks:
+            if a not in ATTACK_SCENARIOS:
+                ap.error(f"unknown attack {a!r}; "
+                         f"known: {tuple(ATTACK_SCENARIOS)}")
+        results = run_attack_sweep(attacks, seed=seeds[0], wires=wires,
+                                   out_dir=args.flight_dir)
+        failed = [r for r in results if not r["ok"]]
+        ran = [r for r in results if "skipped" not in r]
+        print(f"\n{len(ran) - len(failed)}/{len(ran)} attack scenarios "
+              f"passed ({len(results) - len(ran)} host-wire skips)")
+        return 1 if failed else 0
 
     results = []
     if args.peer_chunk is not None or args.active_rows is not None:

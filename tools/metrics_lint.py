@@ -31,6 +31,16 @@ Checks, in order:
    labeled ``series=`` and publishable for every ``SERIES_NAMES`` entry,
    and the ``SERIES_*`` index enum must mirror ``SERIES_NAMES`` exactly
    (both directions), with ``NUM_BUCKETS``/``NUM_SERIES`` consistent.
+7. The model-checker names (``mc/metrics.py METRIC_NAMES``) and the
+   ``swarm_mc_*`` catalog entries mirror each other exactly, and every
+   declared label publishes with its sample value.
+8. The dst attack suite stays wired end to end: every profile in
+   ``dst.schedule.ATTACK_PROFILES`` is requestable (EXTRA_PROFILES +
+   generator), drives a real FaultSchedule leaf (``ATTACK_LEAVES``),
+   owns a flightrec signature code (``ATTACK_SIGNATURE_CODES`` naming a
+   ``CODE_NAMES`` entry), and publishes under
+   ``swarm_dst_attack_ticks_total{attack=...}`` — an attack verb cannot
+   land without scrape-side accounting and a post-mortem signature.
 
 Importable (``run_lint`` returns the problem list) so the pytest wrapper
 in tests/test_metrics_lint.py runs it in-suite; the CLI exits nonzero on
@@ -274,6 +284,53 @@ def run_lint(repo_root: str | None = None) -> list[str]:
         if lb not in mc_metrics.SAMPLE_LABELS:
             problems.append(f"mc: label {lb!r} missing from "
                             "mc.metrics.SAMPLE_LABELS")
+
+    # 8. attack-suite wiring: the dst adversary profiles, their schedule
+    #    leaves, their flightrec signature codes, and the attack counter
+    #    stay in the same lockstep as #5-#7
+    import dataclasses as _dc
+
+    from swarmkit_tpu.dst import schedule as dst_schedule
+
+    att_fam = None
+    att_spec = catalog.CATALOG.get("swarm_dst_attack_ticks_total")
+    if att_spec is None or tuple(att_spec.labels) != ("attack",):
+        problems.append("attacks: 'swarm_dst_attack_ticks_total' must "
+                        "exist labeled by ('attack',)")
+    else:
+        att_fam = catalog.get(MetricsRegistry(strict=True),
+                              "swarm_dst_attack_ticks_total")
+    sched_fields = {f.name for f in
+                    _dc.fields(dst_schedule.FaultSchedule)}
+    for prof in dst_schedule.ATTACK_PROFILES:
+        if prof not in dst_schedule.EXTRA_PROFILES:
+            problems.append(f"attacks: profile {prof!r} missing from "
+                            "EXTRA_PROFILES (make_schedule can't name it)")
+        if prof not in dst_schedule._GENERATORS:
+            problems.append(f"attacks: profile {prof!r} has no "
+                            "_GENERATORS entry")
+        leaf = dst_schedule.ATTACK_LEAVES.get(prof)
+        if leaf is None or leaf not in sched_fields:
+            problems.append(f"attacks: profile {prof!r} has no "
+                            f"FaultSchedule leaf (ATTACK_LEAVES -> {leaf!r})")
+        cname = dst_schedule.ATTACK_SIGNATURE_CODES.get(prof)
+        if cname is None \
+                or cname not in flight_codes.CODE_NAMES.values():
+            problems.append(
+                f"attacks: profile {prof!r} signature code {cname!r} is "
+                "not a flightrec CODE_NAMES entry")
+        if att_fam is not None:
+            try:
+                att_fam.labels(attack=prof).inc(0)
+            except MetricError as e:
+                problems.append(f"attacks: profile {prof!r} cannot "
+                                f"publish: {e}")
+    for extra in sorted((set(dst_schedule.ATTACK_LEAVES)
+                         | set(dst_schedule.ATTACK_SIGNATURE_CODES))
+                        - set(dst_schedule.ATTACK_PROFILES)):
+        problems.append(f"attacks: {extra!r} wired in ATTACK_LEAVES/"
+                        "ATTACK_SIGNATURE_CODES but absent from "
+                        "ATTACK_PROFILES")
     return problems
 
 
