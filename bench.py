@@ -222,9 +222,10 @@ def _telemetry_probe(jax, cfg, election_tick: int, shard_fn):
 
 def measure(jax, n: int, entries: int, seed: int, election_tick: int,
             latency: int = 0, latency_jitter: int = 0, inflight: int = 1,
-            log_len: int = 8192, read_batch: int = 0,
+            log_len: int = 8192, window: int = 2048, read_batch: int = 0,
             read_leases: bool = True, peer_chunk: int | None = None,
-            active_rows: int | None = None, shard: bool = False, **run_kw):
+            active_rows: int | None = None, shard: bool = False,
+            fsync_lag_ticks: int = 0, ack_gating: bool = False, **run_kw):
     """Elect a leader, then time one compiled steady-state replication run of
     ~`entries` committed entries. Returns a dict of measurements; raises
     MeasureError if no leader emerges.
@@ -258,7 +259,7 @@ def measure(jax, n: int, entries: int, seed: int, election_tick: int,
     # path (stamps + histogram folds + series ring), the PERF.md
     # telemetry A/B; the default keeps the headline bare and measures
     # latency via the separate post-run probe instead.
-    cfg = SimConfig(n=n, log_len=log_len, window=2048, apply_batch=2048,
+    cfg = SimConfig(n=n, log_len=log_len, window=window, apply_batch=2048,
                     max_props=2048, keep=500, seed=seed,
                     election_tick=election_tick,
                     latency=latency, latency_jitter=latency_jitter,
@@ -281,7 +282,13 @@ def measure(jax, n: int, entries: int, seed: int, election_tick: int,
                     # pins the dense elementwise per-peer writes (the
                     # sparseprog tripwire's reference)
                     **({} if active_rows is None
-                       else {"active_rows": active_rows}))
+                       else {"active_rows": active_rows}),
+                    # fsync_lag_ticks arms the per-row storage model (the
+                    # durability boundary); 0 keeps the storage-off
+                    # config literally identical to the pre-storage bench
+                    **({} if fsync_lag_ticks == 0
+                       else {"fsync_lag_ticks": fsync_lag_ticks,
+                             "ack_gating": ack_gating}))
     # shard=True runs the whole flow row-sharded over the device mesh
     # (32768-sharded config): with the banded peer reductions the kernel
     # never materializes a full [N, N] intermediate, so each device only
@@ -564,6 +571,15 @@ def main() -> None:
             # served reads/s must stay >= 10x committed entries/s.
             ("256-readmix-99to1", 256,
              {"read_batch": 99 * 2048 // 256}),
+            # durability A/B (handled specially below): the SAME shape
+            # storage-off and with the full storage model armed
+            # (fsync_lag_ticks=4 + ack-gating); the pinned signal is the
+            # gated/bare rate ratio — the fsync round is O(N) cursor
+            # arithmetic and gating only re-clamps existing ack folds,
+            # so the ratio collapsing below ~0.8x means the storage
+            # plane leaked into a hot phase (PERF.md "Durability
+            # boundary": expected within noise of 1.0x)
+            ("256-fsyncgate", 256, {"_storage_ab": True}),
             # peer-lowering regression tripwire (handled specially below):
             # the SAME shape measured dense (peer_chunk=0, full [N, N]
             # tallies) and banded (hierarchical quorum reductions); the
@@ -656,6 +672,47 @@ def main() -> None:
                         RESULT.setdefault(
                             "note", f"peer-tiling tripwire: banded rate "
                             f"{bm['rate']:,.0f} < 0.7x dense "
+                            f"{dm['rate']:,.0f} at {name}")
+                    continue
+                if kw.pop("_storage_ab", False):
+                    # fsyncgate tripwire: one shape, bare vs the armed
+                    # storage model; the pinned signal is the gated/bare
+                    # rate ratio (bench_gate tracks it as
+                    # 256-fsyncgate:ratio via the _over_dense key).
+                    # BOTH sides get an append window deep enough to
+                    # cover the fsync pipeline (window > (k+1) *
+                    # max_props): durable acks lag k ticks, and a
+                    # window that cannot hold k rounds of in-flight
+                    # entries throttles replication to window/k per
+                    # tick — ~1/k of the bare rate, the
+                    # under-provisioning cliff PERF.md documents —
+                    # which would measure provisioning, not the
+                    # storage model's compute cost
+                    k = 4
+                    depth = dict(log_len=32768, window=(k + 1) * 2048 + 512)
+                    dm = measure(jax, cn, target_entries, seed=7,
+                                 election_tick=election_tick_for(cn),
+                                 **depth, **kw)
+                    gm = measure(jax, cn, target_entries, seed=7,
+                                 election_tick=election_tick_for(cn),
+                                 fsync_lag_ticks=k, ack_gating=True,
+                                 **depth, **kw)
+                    ratio = gm["rate"] / dm["rate"]
+                    _bench_gauges(f"{name}-dense", dm)
+                    _bench_gauges(f"{name}-gated-k{k}", gm)
+                    gt = _telemetry_json(gm)
+                    if gt is not None:
+                        tel_extra[name] = gt
+                    extra[name] = {
+                        "dense": round(dm["rate"], 1),
+                        f"gated_k{k}": round(gm["rate"], 1),
+                        "gated_over_dense": round(ratio, 3)}
+                    log(f"config {name}: bare {dm['rate']:,.0f} vs gated "
+                        f"{gm['rate']:,.0f} entries/s ({ratio:.2f}x)")
+                    if ratio < 0.8:
+                        RESULT.setdefault(
+                            "note", f"storage tripwire: gated rate "
+                            f"{gm['rate']:,.0f} < 0.8x bare "
                             f"{dm['rate']:,.0f} at {name}")
                     continue
                 if kw.pop("_sparse_ab", False):

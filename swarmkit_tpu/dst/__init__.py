@@ -24,14 +24,17 @@ Layout:
 
 from swarmkit_tpu.dst.schedule import (
     ATTACK_LEAVES, ATTACK_PROFILES, ATTACK_SIGNATURE_CODES, EXTRA_PROFILES,
-    PROFILES, FaultSchedule, apply_append_flood, apply_rejoin_campaign,
-    apply_term_inflation, apply_transfer_abuse, apply_vote_equivocation,
+    PROFILES, STORAGE_LEAVES, STORAGE_PROFILES, STORAGE_SIGNATURE_CODES,
+    FaultSchedule, apply_append_flood, apply_disk_stall, apply_lost_tail,
+    apply_rejoin_campaign, apply_snap_corrupt, apply_term_inflation,
+    apply_torn_write, apply_transfer_abuse, apply_vote_equivocation,
     from_fault_plan, make_batch, make_schedule,
 )
 from swarmkit_tpu.dst.invariants import (
-    BIT_NAMES, CHECKSUM_AGREEMENT, COMMIT_MONOTONIC, ELECTION_SAFETY,
-    LEADER_COMPLETENESS, LINEARIZABLE_READ, LOG_MATCHING, SAFETY_BITS,
-    SLO_COMMIT_P99, SLO_LEADER_CHURN, SLO_LOG_OCCUPANCY,
+    BIT_NAMES, CHECKSUM_AGREEMENT, COMMIT_MONOTONIC, DURABILITY,
+    ELECTION_SAFETY, LEADER_COMPLETENESS, LINEARIZABLE_READ, LOG_MATCHING,
+    RECOVERY_MONOTONIC, SAFETY_BITS, SLO_COMMIT_P99, SLO_FSYNC_LAG,
+    SLO_LEADER_CHURN, SLO_LOG_OCCUPANCY,
     bits_to_names, check_state, check_transition,
 )
 from swarmkit_tpu.dst.explore import ExploreResult, explore, postmortem
@@ -42,14 +45,17 @@ from swarmkit_tpu.dst.repro import (
 
 __all__ = [
     "ATTACK_LEAVES", "ATTACK_PROFILES", "ATTACK_SIGNATURE_CODES",
-    "EXTRA_PROFILES", "PROFILES", "FaultSchedule", "apply_append_flood",
-    "apply_rejoin_campaign", "apply_term_inflation", "apply_transfer_abuse",
-    "apply_vote_equivocation", "from_fault_plan", "make_batch",
-    "make_schedule",
-    "BIT_NAMES", "CHECKSUM_AGREEMENT", "COMMIT_MONOTONIC", "ELECTION_SAFETY",
-    "LEADER_COMPLETENESS", "LINEARIZABLE_READ", "LOG_MATCHING",
-    "SAFETY_BITS", "SLO_COMMIT_P99", "SLO_LEADER_CHURN",
-    "SLO_LOG_OCCUPANCY", "bits_to_names", "check_state", "check_transition",
+    "EXTRA_PROFILES", "PROFILES", "STORAGE_LEAVES", "STORAGE_PROFILES",
+    "STORAGE_SIGNATURE_CODES", "FaultSchedule", "apply_append_flood",
+    "apply_disk_stall", "apply_lost_tail", "apply_rejoin_campaign",
+    "apply_snap_corrupt", "apply_term_inflation", "apply_torn_write",
+    "apply_transfer_abuse", "apply_vote_equivocation", "from_fault_plan",
+    "make_batch", "make_schedule",
+    "BIT_NAMES", "CHECKSUM_AGREEMENT", "COMMIT_MONOTONIC", "DURABILITY",
+    "ELECTION_SAFETY", "LEADER_COMPLETENESS", "LINEARIZABLE_READ",
+    "LOG_MATCHING", "RECOVERY_MONOTONIC", "SAFETY_BITS", "SLO_COMMIT_P99",
+    "SLO_FSYNC_LAG", "SLO_LEADER_CHURN", "SLO_LOG_OCCUPANCY",
+    "bits_to_names", "check_state", "check_transition",
     "ExploreResult", "explore", "postmortem",
     "capture_flight", "fault_count", "from_artifact", "load_artifact",
     "oracle_trace", "replay", "replay_artifact", "save_artifact", "shrink",

@@ -33,9 +33,10 @@ from swarmkit_tpu.dst.invariants import (
     ALL_BITS, BIT_NAMES, check_state, check_transition,
 )
 from swarmkit_tpu.dst.schedule import (
-    ATTACK_LEAVES, FaultSchedule, apply_append_flood, apply_rejoin_campaign,
-    apply_term_inflation, apply_transfer_abuse, apply_vote_equivocation,
-    effective_faults,
+    ATTACK_LEAVES, STORAGE_LEAVES, FaultSchedule, apply_append_flood,
+    apply_disk_stall, apply_lost_tail, apply_rejoin_campaign,
+    apply_snap_corrupt, apply_term_inflation, apply_torn_write,
+    apply_transfer_abuse, apply_vote_equivocation, effective_faults,
 )
 from swarmkit_tpu.raft.sim.kernel import propose_dense, step
 from swarmkit_tpu.raft.sim.run import _payload_at
@@ -114,6 +115,22 @@ def _tick_one(st: SimState, cfg: SimConfig, sched_t: FaultSchedule,
         st = apply_transfer_abuse(st, cfg, sched_t.transfer_abuse, alive)
     if sched_t.append_flood is not None:
         st = apply_append_flood(st, cfg, sched_t.append_flood, alive)
+    # storage-fault verbs (all no-ops on a storage-off state); lost_tail
+    # and torn_write legally regress volatile commit/applied, so their
+    # rows are excused from COMMIT_MONOTONIC for exactly this transition
+    recovering = None
+    if st.sync_mark is not None:
+        if sched_t.disk_stall is not None:
+            st = apply_disk_stall(st, sched_t.disk_stall, alive)
+        if sched_t.snap_corrupt is not None:
+            st = apply_snap_corrupt(st, sched_t.snap_corrupt, alive)
+        if sched_t.lost_tail is not None:
+            st = apply_lost_tail(st, sched_t.lost_tail, alive)
+            recovering = sched_t.lost_tail
+        if sched_t.torn_write is not None:
+            st = apply_torn_write(st, sched_t.torn_write, alive)
+            recovering = sched_t.torn_write if recovering is None \
+                else recovering | sched_t.torn_write
     if prop_count:
         # fused propose (kernel.step docstring): one [N, L] write cond per
         # scan iteration keeps the vmapped log buffers in place
@@ -123,7 +140,7 @@ def _tick_one(st: SimState, cfg: SimConfig, sched_t: FaultSchedule,
     else:
         new = step(st, cfg, alive=alive, drop=drop)
     new = apply_mutation(new, cfg, mutation)
-    bits = check_state(new, cfg) | check_transition(st, new)
+    bits = check_state(new, cfg) | check_transition(st, new, recovering)
     return new, bits
 
 
@@ -236,7 +253,7 @@ def explore(state: SimState, cfg: SimConfig, schedule: FaultSchedule,
             m_viol.labels(invariant=BIT_NAMES[bit]).inc(hits)
     m_rate.labels(config=f"n{cfg.n}x{schedule.ticks}t").set(rate)
     m_att = catalog.get(obs, "swarm_dst_attack_ticks_total")
-    for attack, leaf in ATTACK_LEAVES.items():
+    for attack, leaf in {**ATTACK_LEAVES, **STORAGE_LEAVES}.items():
         gate = getattr(schedule, leaf)
         if gate is not None:
             fired = int(np.asarray(jax.device_get(gate)).sum())

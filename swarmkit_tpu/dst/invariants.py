@@ -56,6 +56,30 @@ SLO_LOG_OCCUPANCY    OPTIONAL backpressure oracle: some row's uncommitted
                      HEALTHY flooded leader legitimately accumulates
                      (compaction is lazy).  Computed straight from cursor
                      state, so it needs no telemetry plane.
+DURABILITY           no entry ever acked-as-committed is absent from
+                     every log after any crash schedule:
+                     max(ack_frontier) <= max(last) cluster-wide.
+                     ack_frontier is pure oracle bookkeeping (the
+                     running max of observed commit, never read by
+                     decisions, never touched by storage verbs), so the
+                     check is exactly "did a storage fault delete
+                     something the cluster told a client was committed".
+                     Only checked when the storage model is armed
+                     (cfg.fsync_lag_ticks >= 1): with ack-gating off and
+                     lost_tail armed it must trip; with gating on it
+                     must not.
+RECOVERY_MONOTONIC   recovery never regresses a row's durable commit
+                     record: dur_commit is non-decreasing across every
+                     tick, storage verbs included (transition check,
+                     storage-gated like DURABILITY).
+SLO_FSYNC_LAG        OPTIONAL durability-lag oracle: some row's unsynced
+                     suffix max(last - sync_mark) exceeds
+                     cfg.slo_fsync_lag.  The witness that bounds a
+                     disk_stall brownout — prop_inflight_cap stops the
+                     suffix growing at the client interface, so the
+                     defended bound is the cap plus the commit/sync
+                     spread, while an undefended stall grows it by the
+                     propose rate per stalled tick.
 """
 
 from __future__ import annotations
@@ -76,6 +100,9 @@ LINEARIZABLE_READ = 1 << 5
 SLO_COMMIT_P99 = 1 << 6
 SLO_LEADER_CHURN = 1 << 7
 SLO_LOG_OCCUPANCY = 1 << 8
+DURABILITY = 1 << 9
+RECOVERY_MONOTONIC = 1 << 10
+SLO_FSYNC_LAG = 1 << 11
 
 BIT_NAMES = {
     ELECTION_SAFETY: "election_safety",
@@ -87,14 +114,19 @@ BIT_NAMES = {
     SLO_COMMIT_P99: "slo_commit_p99",
     SLO_LEADER_CHURN: "slo_leader_churn",
     SLO_LOG_OCCUPANCY: "slo_log_occupancy",
+    DURABILITY: "durability",
+    RECOVERY_MONOTONIC: "recovery_monotonic",
+    SLO_FSYNC_LAG: "slo_fsync_lag",
 }
 ALL_BITS = tuple(BIT_NAMES)
 # Bits whose violation leaves the kernel in a state CORRECT raft cannot
-# represent (e.g. two leaders sharing a term after vote_equivocation) —
-# the differential oracle is only comparable over the clean prefix of
-# such runs.  The SLO_* bits are telemetry bounds: state stays legal.
+# represent (e.g. two leaders sharing a term after vote_equivocation, or
+# an acked-as-committed entry deleted from every log by lost_tail) — the
+# differential oracle is only comparable over the clean prefix of such
+# runs.  The SLO_* bits are telemetry bounds: state stays legal.
 SAFETY_BITS = (ELECTION_SAFETY | LOG_MATCHING | LEADER_COMPLETENESS
-               | COMMIT_MONOTONIC | CHECKSUM_AGREEMENT | LINEARIZABLE_READ)
+               | COMMIT_MONOTONIC | CHECKSUM_AGREEMENT | LINEARIZABLE_READ
+               | DURABILITY | RECOVERY_MONOTONIC)
 
 
 def bits_to_names(bits: int) -> list[str]:
@@ -189,14 +221,44 @@ def check_state(state: SimState, cfg: SimConfig) -> jnp.ndarray:
         occ_bit = _bit(jnp.max(state.last - state.commit)
                        > cfg.slo_log_occupancy, SLO_LOG_OCCUPANCY)
 
+    # -- DURABILITY: every entry the cluster ever counted committed still
+    # exists on SOME log (Python-gated on the storage model, so
+    # storage-off sweeps trace the exact prior checker program)
+    dur_bit = jnp.uint32(0)
+    if state.ack_frontier is not None:
+        dur_bit = _bit(jnp.max(state.ack_frontier) > jnp.max(state.last),
+                       DURABILITY)
+
+    # -- SLO_FSYNC_LAG: the disk_stall brownout bound — every row's
+    # unsynced suffix stays under the budget (bound set + storage armed)
+    flag_bit = jnp.uint32(0)
+    if cfg.slo_fsync_lag > 0 and state.sync_mark is not None:
+        flag_bit = _bit(jnp.max(state.last - state.sync_mark)
+                        > cfg.slo_fsync_lag, SLO_FSYNC_LAG)
+
     return (elect | match | complete | chk_bit | read_bit | slo_bit
-            | churn_bit | occ_bit)
+            | churn_bit | occ_bit | dur_bit | flag_bit)
 
 
-def check_transition(prev: SimState, new: SimState) -> jnp.ndarray:
+def check_transition(prev: SimState, new: SimState,
+                     recovering=None) -> jnp.ndarray:
     """uint32 bitmask of the across-one-tick invariants (the kernel models
-    durable state: even a crashed/restarted row never loses its commit)."""
-    regress = jnp.any(new.commit < prev.commit) \
-        | jnp.any(new.applied < prev.applied) \
+    durable state: even a crashed/restarted row never loses its commit).
+
+    `recovering` (bool [N], optional) marks rows a storage-fault verb
+    legally truncated THIS tick — lost_tail / torn_write rebuild volatile
+    commit/applied from durable registers, the one sanctioned regression.
+    Their durable record is still pinned: RECOVERY_MONOTONIC checks
+    dur_commit never falls for ANY row, recovering or not."""
+    commit_ok = new.commit >= prev.commit
+    applied_ok = new.applied >= prev.applied
+    if recovering is not None:
+        commit_ok = commit_ok | recovering
+        applied_ok = applied_ok | recovering
+    regress = jnp.any(~commit_ok) | jnp.any(~applied_ok) \
         | jnp.any(new.applied > new.commit)
-    return _bit(regress, COMMIT_MONOTONIC)
+    bits = _bit(regress, COMMIT_MONOTONIC)
+    if new.dur_commit is not None and prev.dur_commit is not None:
+        bits = bits | _bit(jnp.any(new.dur_commit < prev.dur_commit),
+                           RECOVERY_MONOTONIC)
+    return bits

@@ -286,7 +286,9 @@ def oracle_trace(cfg: SimConfig, schedule: FaultSchedule,
     from swarmkit_tpu.raft.sim.oracle import OracleCluster
     from swarmkit_tpu.dst.explore import apply_mutation
     from swarmkit_tpu.dst.schedule import (
-        _flood_payload, apply_append_flood, apply_transfer_abuse,
+        _flood_payload, apply_append_flood, apply_disk_stall,
+        apply_lost_tail, apply_snap_corrupt, apply_torn_write,
+        apply_transfer_abuse,
     )
 
     _step = jax.jit(step, static_argnames=("cfg",))
@@ -309,6 +311,13 @@ def oracle_trace(cfg: SimConfig, schedule: FaultSchedule,
     eq_s = _opt("vote_equivocate")
     fl_s = _opt("append_flood")
     tx_s = _opt("transfer_abuse")
+    storage_leaves = {leaf: _opt(leaf) for leaf in
+                      ("disk_stall", "snap_corrupt", "lost_tail",
+                       "torn_write")}
+    storage_verbs = {"disk_stall": apply_disk_stall,
+                     "snap_corrupt": apply_snap_corrupt,
+                     "lost_tail": apply_lost_tail,
+                     "torn_write": apply_torn_write}
 
     trace: list[dict] = []
     diverged_at = -1
@@ -373,6 +382,16 @@ def oracle_trace(cfg: SimConfig, schedule: FaultSchedule,
             state = apply_append_flood(state, cfg, jnp.asarray(fl_s[t]),
                                        jnp.asarray(alive))
             oracle._phase_propose(alive, fl_pl, cnt)
+        # storage-fault verbs mirror on the KERNEL side only: the host
+        # oracle models a perfect disk (no sync_mark register), so a
+        # compared range must stop before the first storage verb fires —
+        # which replay_artifact's SAFETY_BITS `until` does for
+        # DURABILITY artifacts (the verb tick IS the violation tick).
+        if state.sync_mark is not None:
+            for leaf, arr in storage_leaves.items():
+                if arr is not None and arr[t].any():
+                    state = storage_verbs[leaf](state, jnp.asarray(arr[t]),
+                                                jnp.asarray(alive))
 
         payloads = np.zeros(cfg.max_props, np.uint32)
         if prop_count:

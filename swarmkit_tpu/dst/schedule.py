@@ -46,7 +46,16 @@ PROFILES = ("random_drop", "partition_flapper", "leader_targeted",
 # defense knob (see SimConfig) whose cost is bounded by an SLO invariant.
 ATTACK_PROFILES = ("disruptive_rejoin", "vote_equivocation",
                    "append_flood", "transfer_abuse")
-EXTRA_PROFILES = ("stale_leader_reads", "term_inflation") + ATTACK_PROFILES
+# The ISSUE 16 storage-fault suite: each profile drives one storage leaf
+# below.  These adversaries attack the durable/volatile boundary instead
+# of the wire, so they require the storage model (cfg.fsync_lag_ticks
+# >= 1) — the verbs are pure no-ops on a storage-off state — and the
+# matching defense is the ack-gating contract (cfg.ack_gating) plus the
+# SLO_FSYNC_LAG budget.
+STORAGE_PROFILES = ("lost_tail", "torn_write", "snap_corrupt",
+                    "disk_stall")
+EXTRA_PROFILES = ("stale_leader_reads", "term_inflation") \
+    + ATTACK_PROFILES + STORAGE_PROFILES
 # Per-attack wiring, pinned by tools/metrics_lint.py check #8: the
 # FaultSchedule leaf each profile drives (gate firings feed the
 # swarm_dst_attack_ticks_total counter) and the flightrec signature code
@@ -62,6 +71,21 @@ ATTACK_SIGNATURE_CODES = {
     "vote_equivocation": "ATTACK_EQUIVOCATE",
     "append_flood": "ATTACK_FLOOD",
     "transfer_abuse": "ATTACK_TRANSFER",
+}
+# Per-storage-fault wiring, pinned by tools/metrics_lint.py check #9:
+# the FaultSchedule leaf each storage profile drives and the flightrec
+# signature code its apply verb emits.
+STORAGE_LEAVES = {
+    "lost_tail": "lost_tail",
+    "torn_write": "torn_write",
+    "snap_corrupt": "snap_corrupt",
+    "disk_stall": "disk_stall",
+}
+STORAGE_SIGNATURE_CODES = {
+    "lost_tail": "RECOVER_TRUNCATE",
+    "torn_write": "RECOVER_TORN",
+    "snap_corrupt": "SNAP_CORRUPT",
+    "disk_stall": "FSYNC_STALL",
 }
 
 
@@ -116,8 +140,33 @@ class FaultSchedule:
                                        row this tick — repeated
                                        TimeoutNow thrash.  Bounded by
                                        cfg.transfer_cooldown_ticks.
+    lost_tail       bool [.., T, N]    storage fault (needs the storage
+                                       model armed): the flagged row
+                                       crashed with an unsynced log
+                                       suffix — its disk image truncates
+                                       back to sync_mark and volatile
+                                       state rebuilds from durable
+                                       registers only.  Fired on the
+                                       crash tick itself (the frozen
+                                       image is what the revived row
+                                       boots from).
+    torn_write      bool [.., T, N]    storage fault: recovery finds the
+                                       row's LAST durable entry
+                                       checksum-broken (torn sector), so
+                                       both last and sync_mark truncate
+                                       one below the watermark.
+    snap_corrupt    bool [.., T, N]    storage fault: a snapshot arriving
+                                       at the flagged row this tick fails
+                                       its restore checksum — refused
+                                       under ack_gating, installed-and-
+                                       poisoned without it.
+    disk_stall      bool [.., T, N]    storage fault: the flagged row's
+                                       fsync makes no progress this tick;
+                                       under ack_gating its acks and vote
+                                       grants lag with it, bounded by
+                                       SLO_FSYNC_LAG.
 
-    All five action leaves default to None = absent, so old artifacts and
+    All action leaves default to None = absent, so old artifacts and
     the stock profiles keep tracing the exact pre-extension program.
     """
 
@@ -130,6 +179,10 @@ class FaultSchedule:
     vote_equivocate: Optional[jax.Array] = None
     append_flood: Optional[jax.Array] = None
     transfer_abuse: Optional[jax.Array] = None
+    lost_tail: Optional[jax.Array] = None
+    torn_write: Optional[jax.Array] = None
+    snap_corrupt: Optional[jax.Array] = None
+    disk_stall: Optional[jax.Array] = None
 
     @property
     def ticks(self) -> int:
@@ -183,11 +236,16 @@ def apply_term_inflation(state, term_inflate_t: jax.Array,
 # an event ring.  COMPOSITION ORDER (explore/repro apply them in this
 # fixed sequence so two active attacks never silently mask each other):
 #   term_inflate -> rejoin_campaign -> vote_equivocate -> transfer_abuse
-#   -> append_flood
+#   -> append_flood -> disk_stall -> snap_corrupt -> lost_tail
+#   -> torn_write
 # The timer verbs commute (both take max(elapsed, timeout)); the vote wipe
 # touches only `vote`; transfer_abuse runs BEFORE append_flood so a
 # transfer it starts correctly blocks the flood's proposals on that
-# leader — the same refusal a real client would see.
+# leader — the same refusal a real client would see.  The storage verbs
+# run after all wire-level attacks: disk_stall/snap_corrupt only set the
+# one-tick flags the kernel consults, while lost_tail then torn_write
+# rewrite the log frontier itself — torn_write last so its strictly
+# deeper truncation wins if a schedule ever arms both on one row.
 
 
 def _emit_attack(state, mask, code: int, a0, a1):
@@ -289,6 +347,119 @@ def apply_transfer_abuse(state, cfg: SimConfig, abuse_t: jax.Array,
     from swarmkit_tpu.flightrec import codes as _fc
     return _emit_attack(out, req, _fc.ATTACK_TRANSFER,
                         jnp.broadcast_to(tgt, (n,)), cool)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 16 storage-fault verbs.  Same pre-step-transform contract, but
+# the target is the durable/volatile boundary: each verb is a pure no-op
+# unless the storage model is armed (state.sync_mark is not None), so a
+# storage-off run's traced program cannot change.
+
+
+def _recover_fields(state, g, new_last):
+    """The shared recovery rebuild: volatile state on `g` rows restarts
+    from durable registers only.  commit re-clamps to the surviving log
+    frontier, apply restarts from the snapshot (Phase E re-runs the
+    checksummed scan over the surviving prefix, re-deriving apply_chk
+    along the way — a poisoned chain cannot survive recovery), and the
+    in-flight read batch plus lease die with the process.  dur_commit is
+    deliberately NOT touched: it is the durable record RECOVERY_MONOTONIC
+    pins, and the kernel alone advances it."""
+    last = jnp.where(g, new_last, state.last)
+    fields = dict(
+        last=last,
+        commit=jnp.where(g, jnp.minimum(state.commit, last), state.commit),
+        applied=jnp.where(g, state.snap_idx, state.applied),
+        apply_chk=jnp.where(g, state.snap_chk, state.apply_chk))
+    if state.read_pend is not None:
+        fields.update(
+            read_pend=jnp.where(g, 0, state.read_pend),
+            read_goal=jnp.where(g, 0, state.read_goal),
+            read_idx=jnp.where(g, NONE, state.read_idx),
+            lease_until=jnp.where(g, 0, state.lease_until))
+    return fields
+
+
+def apply_lost_tail(state, lost_t: jax.Array, alive: jax.Array):
+    """One tick of the ``lost_tail`` action: the flagged row crashed with
+    an unsynced log suffix, so its disk image truncates back to the
+    durable watermark — last falls to max(sync_mark, snap_idx) and
+    volatile state rebuilds from durable registers (`_recover_fields`).
+    Liveness is NOT consulted: the generator fires the gate on the crash
+    tick itself and the verb rewrites the then-frozen image, which is
+    exactly what the revived row boots from.  With cfg.ack_gating on,
+    every acked-as-committed entry lies at or below a quorum's
+    sync_marks, so the truncation can never remove one and DURABILITY
+    holds under ANY lost_tail schedule; with gating off a correlated
+    crash deletes acked entries from every log and DURABILITY trips —
+    the contrast ``fault_sweep.py --storage`` pins."""
+    if state.sync_mark is None:
+        return state
+    new_last = jnp.maximum(jnp.minimum(state.last, state.sync_mark),
+                           state.snap_idx)
+    out = dataclasses.replace(state,
+                              **_recover_fields(state, lost_t, new_last))
+    from swarmkit_tpu.flightrec import codes as _fc
+    return _emit_attack(out, lost_t & (state.last > new_last),
+                        _fc.RECOVER_TRUNCATE, new_last,
+                        state.last - new_last)
+
+
+def apply_torn_write(state, torn_t: jax.Array, alive: jax.Array):
+    """One tick of the ``torn_write`` action: recovery's checksummed WAL
+    scan finds the flagged row's LAST durable entry broken (a torn
+    sector under the crash — the disk acknowledged an fsync it did not
+    complete), so last AND sync_mark truncate one below the watermark,
+    max(sync_mark - 1, snap_idx), and volatile state rebuilds as in
+    ``apply_lost_tail``.  Unlike lost_tail this removes an entry the row
+    counted durable — a lying disk — so ack-gating alone cannot defend a
+    fully correlated tear; the surviving defense is replication (any row
+    the schedule spares still holds the committed prefix), which is
+    exactly the f-of-n boundary the storage sweep pins."""
+    if state.sync_mark is None:
+        return state
+    new_last = jnp.maximum(state.sync_mark - 1, state.snap_idx)
+    fields = _recover_fields(state, torn_t, new_last)
+    fields["sync_mark"] = jnp.where(torn_t, new_last, state.sync_mark)
+    out = dataclasses.replace(state, **fields)
+    from swarmkit_tpu.flightrec import codes as _fc
+    return _emit_attack(out, torn_t & (state.sync_mark > new_last),
+                        _fc.RECOVER_TORN, new_last, state.sync_mark)
+
+
+def apply_disk_stall(state, stall_t: jax.Array, alive: jax.Array):
+    """One tick of the ``disk_stall`` action: the flagged live row's
+    fsync makes no progress this tick (the kernel's sync round skips it
+    and, under cfg.ack_gating, it refuses vote grants — a stalled disk
+    cannot persist the vote record).  The flag is transient; a sustained
+    stall is a run of flagged ticks.  Acks lag with the watermark and
+    commit stalls boundedly: SLO_FSYNC_LAG budgets the unsynced suffix
+    and cfg.prop_inflight_cap caps its growth at the client interface."""
+    if state.fsync_stall is None:
+        return state
+    g = stall_t & alive
+    out = dataclasses.replace(state, fsync_stall=state.fsync_stall | g)
+    from swarmkit_tpu.flightrec import codes as _fc
+    return _emit_attack(out, g, _fc.FSYNC_STALL,
+                        state.last - state.sync_mark, state.sync_mark)
+
+
+def apply_snap_corrupt(state, corrupt_t: jax.Array, alive: jax.Array):
+    """One tick of the ``snap_corrupt`` action: any snapshot arriving at
+    the flagged live row this tick fails its checksum at restore.  With
+    cfg.ack_gating the row refuses the install and keeps its state (the
+    sender's unadvanced progress re-sends — the re-request); without it
+    the corrupt image installs and poisons the apply/snap checksum
+    chain, which CHECKSUM_AGREEMENT catches at the next cross-row
+    comparison.  The flag is transient, so the post-window re-request
+    installs clean."""
+    if state.snap_bad is None:
+        return state
+    g = corrupt_t & alive
+    out = dataclasses.replace(state, snap_bad=state.snap_bad | g)
+    from swarmkit_tpu.flightrec import codes as _fc
+    return _emit_attack(out, g, _fc.SNAP_CORRUPT, state.snap_idx,
+                        state.commit)
 
 
 # ---------------------------------------------------------------------------
@@ -575,6 +746,95 @@ def _gen_transfer_abuse(key, cfg: SimConfig, ticks: int) -> FaultSchedule:
                                transfer_abuse=abuse)
 
 
+def _gen_lost_tail(key, cfg: SimConfig, ticks: int) -> FaultSchedule:
+    """Correlated power loss: EVERY row crashes on the same tick (drawn
+    after the first election settles) for a short outage, and each loses
+    its unsynced log suffix — the cluster-wide fsync gap that is the
+    classic acked-then-lost Raft failure.  With cfg.ack_gating off and a
+    lazy fsync policy, commit outruns every sync_mark and the shared
+    truncation deletes acked-as-committed entries from all n logs
+    (DURABILITY trips); with gating on a commit implies a durable quorum
+    and the identical schedule is clean."""
+    ks, kd = jax.random.split(key)
+    T = cfg.election_tick
+    crash_at = jax.random.randint(ks, (), 2 * T, max(2 * T + 1, ticks - 3))
+    down_for = jax.random.randint(kd, (), 2, max(3, T))
+    t = jnp.arange(ticks, dtype=I32)
+    downed = (t >= crash_at) & (t < crash_at + down_for)           # [T]
+    alive = jnp.broadcast_to(~downed[:, None], (ticks, cfg.n))
+    lost = jnp.broadcast_to((t == crash_at)[:, None], (ticks, cfg.n))
+    return dataclasses.replace(_no_faults(cfg, ticks), alive=alive,
+                               lost_tail=lost)
+
+
+def _gen_torn_write(key, cfg: SimConfig, ticks: int) -> FaultSchedule:
+    """ONE victim row crashes mid-run and recovery finds its last durable
+    entry torn — the single-disk lying-fsync fault.  Replication covers
+    it: every committed entry survives on the other n-1 logs, the victim
+    re-fetches its truncated tail, and the sweep pins the run clean under
+    gating while counting the RECOVER_TORN signature.  (A correlated
+    all-row tear is deliberately NOT this generator — that is beyond any
+    quorum system's fault model.)"""
+    kv, ks, kd = jax.random.split(key, 3)
+    T = cfg.election_tick
+    victim = jax.random.randint(kv, (), 0, cfg.n)
+    crash_at = jax.random.randint(ks, (), 2 * T, max(2 * T + 1, ticks - 3))
+    down_for = jax.random.randint(kd, (), 2, max(3, T))
+    t = jnp.arange(ticks, dtype=I32)
+    is_v = jnp.arange(cfg.n, dtype=I32) == victim
+    downed = ((t >= crash_at) & (t < crash_at + down_for))[:, None] \
+        & is_v[None, :]
+    torn = (t == crash_at)[:, None] & is_v[None, :]
+    return dataclasses.replace(_no_faults(cfg, ticks), alive=~downed,
+                               torn_write=torn)
+
+
+def _gen_snap_corrupt(key, cfg: SimConfig, ticks: int) -> FaultSchedule:
+    """ONE victim row is crashed long enough to fall behind the leader's
+    Phase-F compaction horizon, then restarts and the leader must send a
+    SNAPSHOT — which fails its restore checksum on every tick of the
+    post-restart window.  Under cfg.ack_gating the victim refuses each
+    corrupt install and keeps re-requesting; after the window the clean
+    re-send installs and the victim catches up.  Without gating the
+    first corrupt image installs and poisons the checksum chain
+    (CHECKSUM_AGREEMENT trips).  The cut is a CRASH, not a partition: an
+    isolated-but-ticking victim would campaign itself into a high-term
+    candidate the lease-protected cluster ignores (the PreVote rejoin
+    livelock), and a candidate never installs the snapshot under test."""
+    kv, ks = jax.random.split(key)
+    T = cfg.election_tick
+    victim = jax.random.randint(kv, (), 0, cfg.n)
+    start = jax.random.randint(ks, (), 2 * T, max(2 * T + 1, ticks - 8 * T))
+    heal = start + 5 * T
+    t = jnp.arange(ticks, dtype=I32)
+    cut = (t >= start) & (t < heal)                                # [T]
+    is_v = jnp.arange(cfg.n, dtype=I32) == victim
+    alive = ~(cut[:, None] & is_v[None, :])
+    bad = ((t >= heal) & (t < heal + 2 * T))[:, None] & is_v[None, :]
+    return dataclasses.replace(_no_faults(cfg, ticks), alive=alive,
+                               snap_corrupt=bad)
+
+
+def _gen_disk_stall(key, cfg: SimConfig, ticks: int) -> FaultSchedule:
+    """A random MAJORITY of rows shares a slow disk: their fsyncs freeze
+    on flapping windows that straddle the election timeout.  Under
+    cfg.ack_gating the stalled quorum's acks lag with their watermarks
+    and commit stalls for the window — the bounded brownout whose
+    unsynced suffix SLO_FSYNC_LAG budgets (cfg.prop_inflight_cap caps
+    its growth at the client interface)."""
+    kq, kw = jax.random.split(key)
+    q = cfg.n // 2 + 1
+    perm = jax.random.permutation(kq, jnp.arange(cfg.n, dtype=I32))
+    pos = jnp.zeros((cfg.n,), I32).at[perm].set(
+        jnp.arange(cfg.n, dtype=I32))
+    stalled = pos < q
+    T = cfg.election_tick
+    gate = _windows(kw, ticks, T, 3 * T)
+    settled = jnp.arange(ticks, dtype=I32) >= 2 * T
+    stall = (gate & settled)[:, None] & stalled[None, :]
+    return dataclasses.replace(_no_faults(cfg, ticks), disk_stall=stall)
+
+
 _GENERATORS = {
     "random_drop": _gen_random_drop,
     "partition_flapper": _gen_partition_flapper,
@@ -588,6 +848,10 @@ _GENERATORS = {
     "vote_equivocation": _gen_vote_equivocation,
     "append_flood": _gen_append_flood,
     "transfer_abuse": _gen_transfer_abuse,
+    "lost_tail": _gen_lost_tail,
+    "torn_write": _gen_torn_write,
+    "snap_corrupt": _gen_snap_corrupt,
+    "disk_stall": _gen_disk_stall,
 }
 
 
@@ -612,6 +876,10 @@ _OPTIONAL_LEAVES = {
     "vote_equivocate": "TN",
     "append_flood": "T",
     "transfer_abuse": "TN",
+    "lost_tail": "TN",
+    "torn_write": "TN",
+    "snap_corrupt": "TN",
+    "disk_stall": "TN",
 }
 
 

@@ -34,6 +34,15 @@ EVENT_WIDTH = 4  # (tick, code, arg0, arg1)
 #   ATTACK_EQUIVOCATE arg0=wiped vote         arg1=row's term
 #   ATTACK_FLOOD     arg0=extra proposals     arg1=leader uncommitted tail
 #   ATTACK_TRANSFER  arg0=requested target    arg1=cooldown remaining
+# Storage signatures (ISSUE 16 durability boundary: FSYNC_ADVANCE and
+# RECOVER_REJECT_SNAP come from the kernel, the RECOVER_*/FSYNC_STALL/
+# SNAP_CORRUPT verbs from dst/schedule.py storage-fault leaves):
+#   FSYNC_ADVANCE    arg0=new sync_mark       arg1=entries synced
+#   RECOVER_TRUNCATE arg0=new last (lost_tail) arg1=entries truncated
+#   RECOVER_REJECT_SNAP arg0=sending row      arg1=kept snap_idx
+#   RECOVER_TORN     arg0=new last (torn)     arg1=old sync_mark
+#   FSYNC_STALL      arg0=unsynced suffix     arg1=row's sync_mark
+#   SNAP_CORRUPT     arg0=row's snap_idx      arg1=row's commit
 ELECTION_WON = 1
 TERM_BUMP = 2
 COMMIT_ADVANCE = 3
@@ -48,6 +57,12 @@ ATTACK_REJOIN = 11
 ATTACK_EQUIVOCATE = 12
 ATTACK_FLOOD = 13
 ATTACK_TRANSFER = 14
+FSYNC_ADVANCE = 15
+RECOVER_TRUNCATE = 16
+RECOVER_REJECT_SNAP = 17
+RECOVER_TORN = 18
+FSYNC_STALL = 19
+SNAP_CORRUPT = 20
 
 CODE_NAMES = {
     ELECTION_WON: "ELECTION_WON",
@@ -64,6 +79,12 @@ CODE_NAMES = {
     ATTACK_EQUIVOCATE: "ATTACK_EQUIVOCATE",
     ATTACK_FLOOD: "ATTACK_FLOOD",
     ATTACK_TRANSFER: "ATTACK_TRANSFER",
+    FSYNC_ADVANCE: "FSYNC_ADVANCE",
+    RECOVER_TRUNCATE: "RECOVER_TRUNCATE",
+    RECOVER_REJECT_SNAP: "RECOVER_REJECT_SNAP",
+    RECOVER_TORN: "RECOVER_TORN",
+    FSYNC_STALL: "FSYNC_STALL",
+    SNAP_CORRUPT: "SNAP_CORRUPT",
 }
 
 # FAULT_EDGE arg0 values: row went down / came back / its drop degree

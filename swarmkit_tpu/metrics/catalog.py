@@ -135,6 +135,15 @@ CATALOG: dict[str, MetricSpec] = {
         "counter", "On-device cumulative read ops refused (leadership lost "
         "or lease expired with the batch unstamped) summed over rows "
         "(SimState.read_block).", ()),
+    "swarm_kernel_fsync_lag": MetricSpec(
+        "gauge", "Widest unsynced log suffix max(last - sync_mark) across "
+        "rows at last publish (cfg.fsync_lag_ticks >= 1; the quantity "
+        "SLO_FSYNC_LAG budgets under disk_stall).", ()),
+    "swarm_kernel_durable_commit_advance_total": MetricSpec(
+        "counter", "On-device cumulative durable-commit advance summed "
+        "over rows (SimState.dur_commit, the register RECOVERY_MONOTONIC "
+        "pins; trails swarm_kernel_commit_advance_total by the fsync "
+        "policy's lag).", ()),
 
     # ---- flight recorder (flightrec/) ------------------------------------
     "swarm_flightrec_events_total": MetricSpec(

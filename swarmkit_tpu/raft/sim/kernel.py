@@ -363,6 +363,29 @@ def step(state: SimState, cfg: SimConfig,
     if cfg.transfer_cooldown_ticks > 0 and state.tx_cool is not None:
         tx_cool = jnp.maximum(state.tx_cool - 1, 0)
 
+    # ---- storage model (cfg.storage_on): the fsync round ----------------
+    # The durable watermark chases the PRE-TICK last (state.last, before
+    # the fused propose's cursor bump above): an entry appended this tick
+    # is never durable the same tick.  Fsync completes only on cadence
+    # ticks (tick % fsync_lag_ticks == fsync_lag_ticks - 1, so 1 = every
+    # tick), syncs at most fsync_batch entries per round (0 = unlimited),
+    # and is frozen on crashed rows and rows whose disk the disk_stall
+    # verb is holding.  Vote-record writes are NOT on this policy: they
+    # are write-through (etcd MustSync — the grant path fsyncs the vote
+    # synchronously before responding), which is why a stalled disk
+    # refuses grants below rather than lagging them.
+    storage_on = cfg.storage_on and state.sync_mark is not None
+    sync_mark = fsync_did = None
+    if storage_on:
+        sync_mark = state.sync_mark
+        fs_due = (now % cfg.fsync_lag_ticks) == cfg.fsync_lag_ticks - 1
+        sync_inc = jnp.maximum(state.last - sync_mark, 0)
+        if cfg.fsync_batch > 0:
+            sync_inc = jnp.minimum(sync_inc, cfg.fsync_batch)
+        sync_ok = alive & ~state.fsync_stall & fs_due
+        sync_mark = sync_mark + jnp.where(sync_ok, sync_inc, 0)
+        fsync_did = sync_ok
+
     # ---- role-sparse progress (cfg.active_rows_on): the active-row set --
     # Only rows whose node is a leader or candidate ever MUTATE their own
     # [N, N] progress view (match/next_/granted/rejected/recent_active, the
@@ -481,9 +504,10 @@ def step(state: SimState, cfg: SimConfig,
             # active, and nothing reads match before Phase B)
             match = jnp.where(g(prop_ok)[:, None] & eye_r,
                               g(last)[:, None], match)
-        if cfg.vote_guard:
+        if cfg.has_vote_guard:
             # persisted-vote guard (the WAL-shadow defense for the
-            # vote_equivocation adversary): a durable (term, candidate)
+            # vote_equivocation adversary, subsumed by the full storage
+            # model's durable register set): a durable (term, candidate)
             # record written alongside EVERY vote assignment and never
             # cleared by schedule verbs — so an adversarial wipe of
             # `vote` cannot make this row grant a SECOND candidate in
@@ -572,7 +596,7 @@ def step(state: SimState, cfg: SimConfig,
         else:
             term = term + campaign.astype(I32)
             vote = jnp.where(campaign, node, vote)
-            if cfg.vote_guard:
+            if cfg.has_vote_guard:
                 vg_vote = jnp.where(campaign, node, vg_vote)
                 vg_term = jnp.where(campaign, term, vg_term)
             role = jnp.where(campaign, CANDIDATE, role)
@@ -585,7 +609,7 @@ def step(state: SimState, cfg: SimConfig,
         # forced (transfer) campaign: always real, even under PreVote
         term = term + tn_ok.astype(I32)
         vote = jnp.where(tn_ok, node, vote)
-        if cfg.vote_guard:
+        if cfg.has_vote_guard:
             vg_vote = jnp.where(tn_ok, node, vg_vote)
             vg_term = jnp.where(tn_ok, term, vg_term)
         role = jnp.where(tn_ok, CANDIDATE, role)
@@ -724,7 +748,7 @@ def step(state: SimState, cfg: SimConfig,
                 & (campaign | pv_polled)
             term = term + pre_win.astype(I32)
             vote = jnp.where(pre_win, node, vote)
-            if cfg.vote_guard:
+            if cfg.has_vote_guard:
                 vg_vote = jnp.where(pre_win, node, vg_vote)
                 vg_term = jnp.where(pre_win, term, vg_term)
             pre = jnp.where(pre_win, False, pre)
@@ -754,13 +778,19 @@ def step(state: SimState, cfg: SimConfig,
         # (last_term / log_ok computed above the PreVote block; Phase B
         # never mutates log state, so they stay valid here.)
         can_vote = (vote[None, :] == NONE) | (vote[None, :] == rows[:, None])
-        if cfg.vote_guard:
+        if cfg.has_vote_guard:
             # the durable record outlives an adversarial wipe of `vote`:
             # a row that already voted this term may only re-grant the
             # SAME candidate (a restarted voter re-sending a duplicate
             # grant is raft-legal; a conflicting grant is not)
             can_vote = can_vote & ((vg_term[None, :] < term[None, :])
                                    | (vg_vote[None, :] == rows[:, None]))
+        if storage_on and cfg.ack_gating:
+            # a stalled disk (disk_stall verb) cannot persist the vote
+            # record before replying (etcd MustSync), so the grant is
+            # refused outright; PreVote polls are non-binding and need
+            # no persistence, hence stay un-gated
+            can_vote = can_vote & ~state.fsync_stall[None, :]
         # Compare the SEND-TIME candidate term (req_term) with the
         # receiver's post-catch-up term: a candidate whose own term was
         # bumped this tick by a higher-term rival must not have its stale
@@ -776,7 +806,7 @@ def step(state: SimState, cfg: SimConfig,
                                 0).astype(I32)
         grant_mat = grantable & (rows[:, None] == chosen_cand[None, :])
         vote = jnp.where(any_grant, chosen_cand, vote)
-        if cfg.vote_guard:
+        if cfg.has_vote_guard:
             vg_vote = jnp.where(any_grant, chosen_cand, vg_vote)
             vg_term = jnp.where(any_grant, term, vg_term)
         elapsed = jnp.where(any_grant, 0, elapsed)
@@ -1077,7 +1107,7 @@ def step(state: SimState, cfg: SimConfig,
             granted=sc(granted0, granted),
             rejected=sc(rejected0, rejected),
             recent_active=sc(ra0, recent_active))
-        if cfg.vote_guard:
+        if cfg.has_vote_guard:
             out.update(vg_vote=vg_vote, vg_term=vg_term)
         if cfg.mailboxes:
             out.update(
@@ -1127,7 +1157,7 @@ def step(state: SimState, cfg: SimConfig,
     match, next_, granted = _oa["match"], _oa["next_"], _oa["granted"]
     rejected, recent_active = _oa["rejected"], _oa["recent_active"]
     vg_fields = {}
-    if cfg.vote_guard:
+    if cfg.has_vote_guard:
         vg_fields = dict(vg_vote=_oa["vg_vote"], vg_term=_oa["vg_term"])
     probing = _oa["probing"] if cfg.mailboxes else None
     if cfg.mailboxes:
@@ -1215,6 +1245,16 @@ def step(state: SimState, cfg: SimConfig,
     already = (snap_idx[src] <= last) & (have_term == snap_term[src])
     advance = got_snap & (snap_idx[src] > commit)
     do_restore = advance & ~already
+    snap_refuse = None
+    if storage_on and cfg.ack_gating:
+        # snap_corrupt defense (checksum verified BEFORE install): the
+        # flagged arrival is refused outright — state kept, no ack-side
+        # progress for the sender, so the unadvanced next_ re-sends the
+        # snapshot next round and a clean copy installs then.  Without
+        # gating the corrupt image installs below and poisons the
+        # checksum chain (the CHECKSUM_AGREEMENT witness).
+        snap_refuse = do_restore & state.snap_bad
+        do_restore = do_restore & ~state.snap_bad
 
     if cfg.tiled:
         # Window extraction: every entry VALUE the append pass can copy this
@@ -1419,6 +1459,23 @@ def step(state: SimState, cfg: SimConfig,
     new_snap_chk = jnp.where(do_restore, snap_chk[r_src], snap_chk)
     new_snap_idx = jnp.where(do_restore, snap_idx[r_src], snap_idx)
     snap_term, snap_chk, snap_idx = new_snap_term, new_snap_chk, new_snap_idx
+    if storage_on:
+        if not cfg.ack_gating:
+            # gating off: the corrupt image (snap_corrupt verb) installs
+            # unverified — its decoded state differs from what the
+            # checksum claims, modeled as a poisoned apply/snap checksum
+            # chain.  CHECKSUM_AGREEMENT trips once the row's applied
+            # frontier meets another row's.
+            poison = do_restore & state.snap_bad
+            apply_chk = jnp.where(poison, apply_chk ^ U32(0xBAD5EED5),
+                                  apply_chk)
+            snap_chk = jnp.where(poison, snap_chk ^ U32(0xBAD5EED5),
+                                 snap_chk)
+        # an installed snapshot is durable at install (the receiver
+        # fsyncs it before acking — etcd applies snapshots through the
+        # synchronous Ready path), so the watermark jumps with it
+        sync_mark = jnp.where(do_restore,
+                              jnp.maximum(sync_mark, snap_idx), sync_mark)
     # The snapshot carries the sender's configuration (SnapshotMeta.voters;
     # core._restore rebuilds prs from it): adopt the sender's view.  Conf
     # entries in (snap_idx, sender.applied] are re-applied later via the
@@ -1433,9 +1490,31 @@ def step(state: SimState, cfg: SimConfig,
     # leader's progress un-wedges even if the original ack was dropped.
     resp_match = jnp.where(stale & got_app, commit0,
                            jnp.where(got_snap, commit, lastnewi))
+    if storage_on and cfg.ack_gating:
+        # ack-gating (the etcd/raft persistence contract — Ready/Advance:
+        # fsync BEFORE MsgAppResp): a follower acks only the prefix its
+        # durable watermark covers.  Snapshot acks are never clamped in
+        # effect (sync_mark jumped to the installed watermark above).
+        # The leader's max-fold makes a clamped ack pure under-report,
+        # and the unsolicited durable-frontier ack in _progress_b below
+        # re-acks the suffix once a later fsync round covers it.
+        resp_match = jnp.minimum(resp_match, sync_mark)
+        dur_match = jnp.minimum(last, sync_mark)                 # [j]
+        fsync_ack = fsync_did & (lead != NONE) & (role == FOLLOWER)
     resp_ok = accept | got_snap | (stale & got_app)
     resp_reject = got_app & ~prev_ok & ~stale
     reject_hint = last                                           # [j]
+
+    # Leader self-ack cap: under ack-gating a leader counts ITSELF in the
+    # commit quorum only up to its own durable watermark (etcd: the
+    # leader's Ready loop fsyncs before marking its own progress) — so a
+    # committed entry is durable on a FULL quorum including the leader,
+    # the property the DURABILITY invariant needs.  Without the storage
+    # model this is `last` verbatim (bit-identical trace).
+    if storage_on and cfg.ack_gating:
+        self_ack_cap = jnp.minimum(last, sync_mark)
+    else:
+        self_ack_cap = last
 
     if cfg.mailboxes:
         _b_in = (app_at, app_prev, app_term_box, snp_at, snp_term_box,
@@ -1496,6 +1575,31 @@ def step(state: SimState, cfg: SimConfig,
                 jnp.where(resp_reject, reject_hint,
                           resp_match)[None, :, None],
                 aresp_match)
+            if storage_on and cfg.ack_gating:
+                # unsolicited durable-frontier ack (etcd emits MsgAppResp
+                # from the Ready loop AFTER the fsync lands): every fsync
+                # round a follower re-acks min(last, sync_mark) to its
+                # known leader, so a suffix whose delivery ack was
+                # clamped still commits once durable — without this the
+                # event-gated append wire has no re-ack trigger and the
+                # tail would never commit.  Best-effort enqueue (skipped
+                # when the edge's ack slots are all busy — re-attempted
+                # next fsync round, so no deadlock and no slot eviction).
+                fa_tgt = jnp.clip(lead, 0, n - 1)
+                send_fa = (rows[:, None] == fa_tgt[None, :]) \
+                    & fsync_ack[None, :] & ~dropT_r & ~eye_r
+                free_f = aresp_at == 0
+                fa_slot = jnp.argmax(free_f, axis=2).astype(I32)
+                put_f = send_fa[:, :, None] \
+                    & (fa_slot[:, :, None] == kr_idx) \
+                    & jnp.any(free_f, axis=2)[:, :, None]
+                aresp_at = jnp.where(put_f, (now + 1 + lat_T)[:, :, None],
+                                     aresp_at)
+                aresp_term = jnp.where(put_f, term[None, :, None],
+                                       aresp_term)
+                aresp_ok = jnp.where(put_f, True, aresp_ok)
+                aresp_match = jnp.where(put_f, dur_match[None, :, None],
+                                        aresp_match)
             # deliveries: ALL due acks integrate this tick, aggregated
             # (ok: max match; reject: min hint — applied after the ok
             # advance, the conservative order)
@@ -1642,8 +1746,8 @@ def step(state: SimState, cfg: SimConfig,
         # (commit, last] acked by a quorum — a fixed-depth binary search
         # (range <= log_len, so ceil(log2(L))+1 rounds of compares)
         # instead of sorting the match plane every tick.
-        match = jnp.where(g(is_leader)[:, None] & eye_r, g(last)[:, None],
-                          match)
+        match = jnp.where(g(is_leader)[:, None] & eye_r,
+                          g(self_ack_cap)[:, None], match)
         q_row = quorum_row if static_m else g(quorum_row)
         if cfg.peer_tiled:
             # Banded bisect: the membership mask folds into each band
@@ -1917,6 +2021,12 @@ def step(state: SimState, cfg: SimConfig,
     snap_term = jnp.where(do_compact, nst, snap_term)
     snap_chk = jnp.where(do_compact, nsc, snap_chk)
     snap_idx = jnp.where(do_compact, new_snap, snap_idx)
+    if storage_on:
+        # a compacted-to snapshot is durable by construction (compaction
+        # only discards APPLIED entries, and writing the snapshot is the
+        # fsync); this also pins the global invariant sync_mark >=
+        # snap_idx that the lost_tail truncation rule relies on
+        sync_mark = jnp.maximum(sync_mark, snap_idx)
 
     # invariants: `pre`/`tx_cand` mark live candidacies only (any
     # transition away from CANDIDATE clears them), and `transferee` only
@@ -1990,6 +2100,23 @@ def step(state: SimState, cfg: SimConfig,
     # to the program so host metrics can read kernel activity from a [4]
     # vector instead of diffing full states (see metrics/catalog.py
     # swarm_kernel_* families).
+    # Storage end-of-tick folds: the durable commit record is the running
+    # max of min(commit, sync_mark) (what this row has both learned
+    # committed and covered durably — RECOVERY_MONOTONIC pins it);
+    # ack_frontier is pure oracle bookkeeping (running max of commit, the
+    # DURABILITY witness — no verb and no decision ever reads it).  The
+    # transient verb flags (fsync_stall, snap_bad) are one-tick inputs,
+    # consumed above and cleared here.
+    storage_fields = {}
+    if storage_on:
+        storage_fields = dict(
+            sync_mark=sync_mark,
+            dur_commit=jnp.maximum(state.dur_commit,
+                                   jnp.minimum(commit, sync_mark)),
+            ack_frontier=jnp.maximum(state.ack_frontier, commit),
+            fsync_stall=jnp.zeros((n,), bool),
+            snap_bad=jnp.zeros((n,), bool))
+
     stats = state.stats
     if cfg.collect_stats and stats is not None:
         stats = stats + jnp.stack([
@@ -2050,6 +2177,11 @@ def step(state: SimState, cfg: SimConfig,
         _emit(do_restore, _fc.SNAPSHOT_RESTORE, src, snap_idx)
         _emit(commit > state.commit, _fc.COMMIT_ADVANCE, commit,
               commit - state.commit)
+        if storage_on:
+            _emit(sync_mark > state.sync_mark, _fc.FSYNC_ADVANCE,
+                  sync_mark, sync_mark - state.sync_mark)
+            if snap_refuse is not None:
+                _emit(snap_refuse, _fc.RECOVER_REJECT_SNAP, src, snap_idx)
         if cfg.tiled:
             # cluster-wide event: one row (0) records the fallback so the
             # ring doesn't burn N slots on every full-pass tick
@@ -2186,6 +2318,7 @@ def step(state: SimState, cfg: SimConfig,
         tick=state.tick + 1,
         stats=stats,
         **vg_fields,
+        **storage_fields,
         **({} if tx_cool is None else dict(tx_cool=tx_cool)),
         **sp_fields,
         **ev_fields,

@@ -77,6 +77,20 @@ divergences.
     apply + compaction on crashed rows; _phase_propose*/_phase_def consult
     `up` identically.
 
+ D6 STORAGE: the oracle models a PERFECT DISK.  It has no sync_mark
+    register, no fsync cadence, and no storage-fault verbs — core.Raft
+    persists everything the moment it is written, exactly stock etcd
+    with an ideal WAL.  The kernel's durability boundary
+    (cfg.fsync_lag_ticks / ack_gating, the lost_tail/torn_write/
+    snap_corrupt/disk_stall FaultSchedule leaves) is therefore mirrored
+    on the KERNEL side only: dst.repro.oracle_trace stops a compared
+    range before the first storage verb fires (replay_artifact's
+    `until` bound), so the differential gate still certifies the clean
+    prefix while the DURABILITY/RECOVERY_MONOTONIC invariant bits own
+    the faulted suffix.  Ack-gating with a clean disk is transparent by
+    construction (acks merely lag; no decision changes), which the
+    storage-off bit-identity tests in tests/test_durability.py pin.
+
 MEMBERSHIP REPLAY (log-driven conf changes): _phase_propose_conf mirrors
 kernel propose_conf (one CONF entry per leader, degraded to an empty
 normal entry while one is pending); the apply loop in _phase_def clamps

@@ -163,6 +163,8 @@ class KernelObs:
                    "swarm_kernel_apply_advance_total")
     _READ_NAMES = ("swarm_kernel_reads_served_total",
                    "swarm_kernel_reads_blocked_total")
+    _DUR_NAME = "swarm_kernel_durable_commit_advance_total"
+    _LAG_NAME = "swarm_kernel_fsync_lag"
 
     def __init__(self, obs=None) -> None:
         from swarmkit_tpu.metrics import catalog as obs_catalog
@@ -175,6 +177,8 @@ class KernelObs:
                          for n in self._STAT_NAMES]
         self._m_reads = [obs_catalog.get(self.obs, n)
                          for n in self._READ_NAMES]
+        self._m_dur = obs_catalog.get(self.obs, self._DUR_NAME)
+        self._m_lag = obs_catalog.get(self.obs, self._LAG_NAME)
         self._deltas = obs_scrape.deltas_for(self.obs)
 
     def timed(self, call: str):
@@ -201,6 +205,17 @@ class KernelObs:
                 if d:
                     fam.inc(d)
             out.update(zip(("reads_served", "reads_blocked"), cur_r))
+        if state.sync_mark is not None:
+            # durable-commit advance is a cumulative sum like the stats
+            # counters (dur_commit is per-row monotone, so the sum is
+            # too); fsync lag is a point-in-time width, hence a gauge
+            cur_d = int(jax.device_get(jnp.sum(state.dur_commit)))
+            d = self._deltas.advance((self._DUR_NAME,), cur_d)
+            if d:
+                self._m_dur.inc(d)
+            lag = int(jax.device_get(jnp.max(state.last - state.sync_mark)))
+            self._m_lag.set(lag)
+            out.update(durable_commit=cur_d, fsync_lag=lag)
         return out
 
 

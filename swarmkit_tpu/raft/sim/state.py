@@ -233,6 +233,35 @@ class SimConfig:
     # on) exceeds this bound — the append_flood witness.  With the cap
     # on, the tail never exceeds prop_inflight_cap - 1 + max_props.
     slo_log_occupancy: int = 0
+    # ---- storage model (the durability boundary; dst storage verbs) ------
+    # fsync cadence: the per-row durable watermark `sync_mark` advances
+    # toward `last` only on ticks where tick % fsync_lag_ticks ==
+    # fsync_lag_ticks - 1 (so 1 = fsync every tick, the tightest policy).
+    # 0 disables the storage model entirely — no durable registers are
+    # traced and the compiled program is bit-identical to the pre-storage
+    # kernel.  This is the master knob: every other storage field below
+    # requires fsync_lag_ticks > 0.
+    fsync_lag_ticks: int = 0
+    # Max log entries one fsync round may durable-ize (a batched-write
+    # disk model).  0 = unlimited (the whole unsynced suffix syncs).
+    fsync_batch: int = 0
+    # Ack-gating (the lost_tail / torn_write defense): a follower only
+    # acks append entries — and a row only grants votes / counts its own
+    # leader self-match — up to its durable watermark.  This is the
+    # etcd/raft persistence contract (Ready/Advance: fsync BEFORE
+    # sending MsgAppResp); with it on, every committed entry is fsynced
+    # on a quorum, so losing any crashed minority's unsynced tail can
+    # never lose acked-as-committed data.  Off models the unsafe
+    # ack-before-fsync fast path the DURABILITY invariant exists to
+    # catch.
+    ack_gating: bool = False
+    # Fsync-lag SLO for the DST oracle: when > 0, dst/invariants.py
+    # raises SLO_FSYNC_LAG if any row's unsynced suffix (last -
+    # sync_mark, the quantity disk_stall inflates) exceeds this bound.
+    # With ack_gating + prop_inflight_cap on, the suffix is bounded by
+    # the cap (a leader stops accepting once its uncommitted — hence
+    # unsynced-beyond — backlog fills).
+    slo_fsync_lag: int = 0
 
     @property
     def lease_ticks(self) -> int:
@@ -292,6 +321,20 @@ class SimConfig:
     def mailboxes(self) -> bool:
         return self.latency > 0 or self.latency_jitter > 0 \
             or self.force_mailboxes
+
+    @property
+    def storage_on(self) -> bool:
+        """True when the kernel traces the durable-watermark registers
+        (sync_mark et al.) and the fsync-advance / recovery machinery."""
+        return self.fsync_lag_ticks > 0
+
+    @property
+    def has_vote_guard(self) -> bool:
+        """True when the persisted-vote registers (vg_vote, vg_term) are
+        carried: either the standalone PR-15 defense knob or the full
+        storage model (which subsumes the WAL-shadow — vote durability is
+        part of the durable register set)."""
+        return self.vote_guard or self.storage_on
 
     def __post_init__(self):
         assert self.apply_batch >= self.max_props
@@ -382,6 +425,21 @@ class SimConfig:
         if self.slo_log_occupancy < 0:
             raise ValueError(f"slo_log_occupancy must be >= 0, got "
                              f"{self.slo_log_occupancy}")
+        if self.fsync_lag_ticks < 0:
+            raise ValueError(f"fsync_lag_ticks must be >= 0, got "
+                             f"{self.fsync_lag_ticks}")
+        if self.fsync_batch < 0:
+            raise ValueError(f"fsync_batch must be >= 0, got "
+                             f"{self.fsync_batch}")
+        if self.slo_fsync_lag < 0:
+            raise ValueError(f"slo_fsync_lag must be >= 0, got "
+                             f"{self.slo_fsync_lag}")
+        if not self.storage_on:
+            for knob in ("fsync_batch", "ack_gating", "slo_fsync_lag"):
+                if getattr(self, knob):
+                    raise ValueError(
+                        f"{knob} requires the storage model; set "
+                        f"fsync_lag_ticks >= 1 (1 = fsync every tick)")
         if self.peer_chunk < 0:
             raise ValueError(f"peer_chunk must be >= 0, got {self.peer_chunk}")
         if self.peer_tiled:
@@ -508,6 +566,44 @@ class SimState:
     # cooldown span when the row fires TIMEOUT_NOW for a completing
     # transfer; decremented toward 0 each tick.
     tx_cool: Optional[jax.Array] = None
+    # ---- storage model (cfg.storage_on; the durability boundary) --------
+    # sync_mark [N] i32: the fsynced log watermark — every entry at index
+    # <= sync_mark survives any crash.  Advanced toward `last` by the
+    # fsync_lag_ticks / fsync_batch policy at the top of each tick (before
+    # this tick's appends, so a just-appended entry is never instantly
+    # durable); pinned >= snap_idx (installed/compacted-to snapshots are
+    # durable by definition); frozen while the row is crashed or
+    # disk_stall holds its fsync.  The lost_tail verb truncates back to
+    # it; torn_write truncates one entry below it (the last durable entry
+    # failed its checksum at recovery).
+    sync_mark: Optional[jax.Array] = None
+    # dur_commit [N] i32: the durable commit record — the running max of
+    # min(commit, sync_mark), i.e. the highest commit index this row has
+    # both learned and covered durably.  Recovery never regresses it
+    # (RECOVERY_MONOTONIC); the volatile `commit` may legally fall after
+    # lost_tail/torn_write truncation, and the record survives even when
+    # a torn tail costs the row the entry's own copy (cluster-wide
+    # durability is the DURABILITY invariant's job, not this register's).
+    dur_commit: Optional[jax.Array] = None
+    # ack_frontier [N] i32: oracle bookkeeping, never read by any decision
+    # and never touched by storage verbs — the running max of `commit`
+    # each row has ever observed.  The DURABILITY invariant's witness:
+    # an entry counted committed here must exist on SOME live log after
+    # any crash schedule (max(ack_frontier) <= max(last) cluster-wide).
+    ack_frontier: Optional[jax.Array] = None
+    # fsync_stall [N] bool (transient, one tick): set by the disk_stall
+    # verb before the step; the tick's fsync round skips flagged rows and
+    # (under ack_gating) flagged rows refuse vote grants — a stalled disk
+    # cannot persist the vote record.  Cleared at end of tick.
+    fsync_stall: Optional[jax.Array] = None
+    # snap_bad [N] bool (transient, one tick): set by the snap_corrupt
+    # verb — a snapshot arriving at a flagged row this tick fails its
+    # checksum at restore.  With ack_gating the row refuses the install
+    # (keeps state; the sender's unadvanced progress re-sends); without
+    # it the corrupt image installs and poisons the apply/snap checksum
+    # chain (caught later by CHECKSUM_AGREEMENT).  Cleared at end of
+    # tick.
+    snap_bad: Optional[jax.Array] = None
     # ---- flight recorder (cfg.record_events; flightrec/) ----------------
     # ev_buf [N, event_ring, 4] i32 rows of (tick, code, arg0, arg1);
     # ev_pos [N] is the CUMULATIVE events-written cursor per row (slot of
@@ -677,8 +773,12 @@ def init_state(cfg: SimConfig,
         active_ttl=z(n) if cfg.active_rows_on else None,
         **(dict(vg_vote=jnp.full((n,), NONE, i32),
                 vg_term=jnp.full((n,), NONE, i32))
-           if cfg.vote_guard else {}),
+           if cfg.has_vote_guard else {}),
         **(dict(tx_cool=z(n)) if cfg.transfer_cooldown_ticks > 0 else {}),
+        **(dict(sync_mark=z(n), dur_commit=z(n), ack_frontier=z(n),
+                fsync_stall=jnp.zeros((n,), jnp.bool_),
+                snap_bad=jnp.zeros((n,), jnp.bool_))
+           if cfg.storage_on else {}),
         **(dict(ev_buf=z(n, cfg.event_ring, 4), ev_pos=z(n),
                 ev_alive=jnp.ones((n,), jnp.bool_), ev_drop=z(n))
            if cfg.record_events else {}),

@@ -73,3 +73,19 @@ def test_transfer_abuse_demo_neutralized():
     assert demo["defense_on"]["max_leader_changes"] \
         < demo["defense_off"]["max_leader_changes"], demo
     assert demo["neutralized"], demo
+
+
+@pytest.mark.slow
+def test_lost_tail_demo_neutralized(tmp_path):
+    from tools.dst_sweep import run_lost_tail_demo
+    demo = run_lost_tail_demo(out_path=str(tmp_path / "lost_tail.json"),
+                              verbose=False)
+    # gating-off commits entries a correlated crash then deletes from
+    # every surviving log; the shrunk artifact replays bit-exact with
+    # the differential oracle in lockstep over the clean prefix, and
+    # ack-gating holds the SAME schedules violation-free
+    assert demo["caught"] > 0, demo
+    assert demo["gated_violations"] == 0, demo
+    assert demo["replay_matches"], demo
+    assert demo["oracle_diverged_at"] == -1, demo
+    assert demo["neutralized"], demo

@@ -1,0 +1,581 @@
+"""The durability boundary (ISSUE 16): fsync-aware crash recovery.
+
+Fast tier: storage-registry coherence (profiles <-> schedule leaves <->
+flightrec signature codes), the SimConfig storage knobs with the
+vote-guard fold, generator determinism, the unit semantics of each
+storage-fault verb (truncation to the durable watermark, the snapshot
+floor, the watermark rollback of a torn write, transient-flag hygiene),
+the fsync round itself (cadence, batch clamp, stall/crash freeze, the
+durable-commit fold), the write-through vote record under a stalled
+disk, the DURABILITY / SLO_FSYNC_LAG / RECOVERY_MONOTONIC invariant
+boundaries, the flight-recorder signatures, the crash-right-after-
+snapshot-install recovery identity, the storage-off bit-identity of the
+sync wire, and the host WAL's truncation parity (raft/storage.py drops
+a torn tail on bootstrap, refuses mid-file corruption).
+
+Slow tier: the DURABILITY off-trip / on-clean explore contrast, a crash
+spliced INTO a gating-on snapshot-install window, torn_write at the
+log_chunk band boundary (tiled parity, unit and explore), and 300-tick
+storage-off bit-identity on the tiled / role-sparse / mailbox / sharded
+wires.  The seed-pinned catch -> shrink -> artifact -> replay storage
+sweeps live in tests/test_dst_sweep.py and tests/test_fault_sweep.py.
+"""
+
+import dataclasses
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from swarmkit_tpu import dst
+from swarmkit_tpu.dst.schedule import _OPTIONAL_LEAVES
+from swarmkit_tpu.flightrec import codes as fcodes
+from swarmkit_tpu.flightrec import decode_rings
+from swarmkit_tpu.raft.sim.kernel import step
+from swarmkit_tpu.raft.sim.run import run_ticks
+from swarmkit_tpu.raft.sim.state import (
+    LEADER, NONE, SimConfig, SimState, init_state,
+)
+
+CFG5 = SimConfig(n=5, log_len=64, window=8, apply_batch=16, max_props=8,
+                 keep=4, election_tick=10, seed=0)
+
+# the shared storage config: every fast kernel-step test runs on it so
+# the tier-1 budget pays for ONE traced program (k=4: fsyncs complete on
+# ticks 3, 7, 11, ...)
+SCFG = dataclasses.replace(CFG5, fsync_lag_ticks=4, ack_gating=True)
+
+# the validated sweep contrast (tools/fault_sweep.py STORAGE_SCENARIOS):
+# a lazy watermark six ticks wide, with and without ack gating
+STOR_OFF = dataclasses.replace(CFG5, fsync_lag_ticks=6)
+STOR_ON = dataclasses.replace(STOR_OFF, ack_gating=True)
+
+TRUE5 = jnp.ones((5,), bool)
+step_j = jax.jit(step, static_argnames=("cfg",))
+
+# the registers the durability boundary added: the ONLY permitted
+# divergence between a storage-off run and a storage-on-but-never-
+# gating run (vg_vote/vg_term ride along because cfg.storage_on
+# subsumes the persisted-vote guard — satellite fold)
+STORAGE_REG_FIELDS = frozenset({
+    "sync_mark", "dur_commit", "ack_frontier", "fsync_stall", "snap_bad",
+    "vg_vote", "vg_term",
+})
+
+
+def _arr(base, **updates):
+    """dataclasses.replace with each update applied via .at[idx].set."""
+    fields = {}
+    for name, pairs in updates.items():
+        a = getattr(base, name)
+        for idx, val in pairs:
+            a = a.at[idx].set(val)
+        fields[name] = a
+    return dataclasses.replace(base, **fields)
+
+
+def _stor(cfg=SCFG, **kw):
+    return _arr(init_state(cfg), **kw)
+
+
+def _at_tick(st, t):
+    return dataclasses.replace(st, tick=jnp.asarray(t, st.tick.dtype))
+
+
+def _assert_identical_modulo_storage(a, b):
+    for fld in dataclasses.fields(SimState):
+        if fld.name in STORAGE_REG_FIELDS:
+            continue
+        x, y = getattr(a, fld.name), getattr(b, fld.name)
+        if x is None and y is None:
+            continue
+        assert x is not None and y is not None, fld.name
+        assert np.array_equal(np.asarray(x), np.asarray(y)), \
+            f"{fld.name} diverged"
+
+
+# ---------------------------------------------------------------------------
+# registry coherence: profiles <-> leaves <-> signature codes
+
+
+def test_storage_profiles_are_extra_profiles():
+    assert set(dst.STORAGE_PROFILES) <= set(dst.EXTRA_PROFILES)
+    assert not set(dst.STORAGE_PROFILES) & set(dst.PROFILES)
+    assert not set(dst.STORAGE_PROFILES) & set(dst.ATTACK_PROFILES)
+    assert set(dst.STORAGE_LEAVES) == set(dst.STORAGE_PROFILES)
+    assert set(dst.STORAGE_SIGNATURE_CODES) == set(dst.STORAGE_PROFILES)
+
+
+def test_storage_leaves_are_optional_schedule_fields():
+    fields = {f.name for f in dataclasses.fields(dst.FaultSchedule)}
+    for leaf in dst.STORAGE_LEAVES.values():
+        assert leaf in fields
+        assert leaf in _OPTIONAL_LEAVES
+
+
+def test_storage_signature_codes_resolve_in_flightrec():
+    for code_name in dst.STORAGE_SIGNATURE_CODES.values():
+        code = getattr(fcodes, code_name)
+        assert fcodes.CODE_NAMES[code] == code_name
+
+
+def test_new_invariant_bits_registered():
+    assert dst.bits_to_names(dst.DURABILITY) == ["durability"]
+    assert dst.bits_to_names(dst.RECOVERY_MONOTONIC) == \
+        ["recovery_monotonic"]
+    assert dst.bits_to_names(dst.SLO_FSYNC_LAG) == ["slo_fsync_lag"]
+    # lost data and a regressed durable record are safety violations (the
+    # oracle only trusts the clean prefix); the fsync-lag budget is an SLO
+    assert dst.DURABILITY & dst.SAFETY_BITS
+    assert dst.RECOVERY_MONOTONIC & dst.SAFETY_BITS
+    assert not dst.SLO_FSYNC_LAG & dst.SAFETY_BITS
+
+
+# ---------------------------------------------------------------------------
+# config knobs: the storage model arms as a unit, vote guard folds in
+
+
+def test_storage_registers_allocated_only_when_armed():
+    regs = ("sync_mark", "dur_commit", "ack_frontier", "fsync_stall",
+            "snap_bad")
+    assert not CFG5.storage_on
+    off = init_state(CFG5)
+    for name in regs:
+        assert getattr(off, name) is None, name
+    assert SCFG.storage_on
+    on = init_state(SCFG)
+    for name in regs:
+        assert getattr(on, name) is not None, name
+
+
+def test_storage_knobs_require_fsync_lag():
+    for kw in (dict(fsync_batch=4), dict(ack_gating=True),
+               dict(slo_fsync_lag=4)):
+        with pytest.raises(ValueError, match="fsync_lag_ticks"):
+            dataclasses.replace(CFG5, **kw)
+    with pytest.raises(ValueError):
+        dataclasses.replace(CFG5, fsync_lag_ticks=-1)
+
+
+def test_vote_guard_folds_into_storage_model():
+    # cfg.vote_guard survives as the compat alias; an armed storage model
+    # subsumes it (every vote record is a durable write)
+    assert not CFG5.has_vote_guard
+    assert dataclasses.replace(CFG5, vote_guard=True).has_vote_guard
+    assert not SCFG.vote_guard and SCFG.has_vote_guard
+    st = init_state(SCFG)
+    assert st.vg_vote is not None and st.vg_term is not None
+
+
+# ---------------------------------------------------------------------------
+# generators: determinism, seed sensitivity, the leaf actually fires
+
+
+@pytest.mark.parametrize("profile", dst.STORAGE_PROFILES)
+def test_storage_generator_deterministic_per_seed(profile):
+    # 140 ticks: enough for snap_corrupt's install window (start up to
+    # 2T, outage 5T, corrupt window 2T) to land inside the schedule
+    a = dst.make_schedule(STOR_ON, ticks=140, profile=profile, seed=5)
+    b = dst.make_schedule(STOR_ON, ticks=140, profile=profile, seed=5)
+    c = dst.make_schedule(STOR_ON, ticks=140, profile=profile, seed=6)
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    assert all(np.array_equal(x, y) for x, y in zip(la, lb))
+    lc = jax.tree_util.tree_leaves(c)
+    assert any(not np.array_equal(x, y) for x, y in zip(la, lc))
+    leaf = getattr(a, dst.STORAGE_LEAVES[profile])
+    assert leaf is not None and bool(leaf.any())
+
+
+# ---------------------------------------------------------------------------
+# apply-verb unit semantics (pre-step transforms on hand-built states)
+
+
+def test_lost_tail_truncates_to_watermark():
+    st = _stor(last=[(1, 10)], commit=[(1, 8)], applied=[(1, 8)],
+               sync_mark=[(1, 6)], dur_commit=[(1, 6)])
+    out = dst.apply_lost_tail(st, jnp.arange(5) == 1, TRUE5)
+    assert int(out.last[1]) == 6                       # unsynced tail gone
+    assert int(out.sync_mark[1]) == 6                  # watermark honest
+    assert int(out.commit[1]) == 6                     # re-clamped
+    assert int(out.applied[1]) == 0                    # apply restarts
+    assert int(out.apply_chk[1]) == int(st.snap_chk[1])
+    assert int(out.dur_commit[1]) == 6                 # durable record kept
+    assert int(out.last[0]) == int(st.last[0])         # unflagged untouched
+
+
+def test_lost_tail_floors_at_snapshot_index():
+    # a compacted row's disk image can never truncate below its snapshot
+    st = _stor(last=[(2, 55)], commit=[(2, 52)], applied=[(2, 50)],
+               sync_mark=[(2, 40)], snap_idx=[(2, 50)])
+    out = dst.apply_lost_tail(st, jnp.arange(5) == 2, TRUE5)
+    assert int(out.last[2]) == 50
+    assert int(out.commit[2]) == 50
+    assert int(out.applied[2]) == 50                   # snap_idx
+
+
+def test_torn_write_rolls_back_watermark():
+    st = _stor(last=[(1, 10)], commit=[(1, 8)], applied=[(1, 8)],
+               sync_mark=[(1, 6)])
+    out = dst.apply_torn_write(st, jnp.arange(5) == 1, TRUE5)
+    # the LAST durable entry was torn: one below the watermark, and the
+    # watermark itself rolls back with it (the disk lied about it)
+    assert int(out.last[1]) == 5
+    assert int(out.sync_mark[1]) == 5
+    assert int(out.commit[1]) == 5
+    # the snapshot floor holds even against a tear at the boundary
+    st2 = _stor(last=[(2, 50)], commit=[(2, 50)], applied=[(2, 50)],
+                sync_mark=[(2, 50)], snap_idx=[(2, 50)])
+    out2 = dst.apply_torn_write(st2, jnp.arange(5) == 2, TRUE5)
+    assert int(out2.last[2]) == 50
+    assert int(out2.sync_mark[2]) == 50
+
+
+def test_disk_stall_and_snap_corrupt_flag_live_rows_only():
+    st = init_state(SCFG)
+    alive = jnp.asarray([True, False, True, True, True])
+    mask = jnp.asarray([True, True, False, False, False])
+    out = dst.apply_disk_stall(st, mask, alive)
+    assert np.asarray(out.fsync_stall).tolist() == \
+        [True, False, False, False, False]
+    out2 = dst.apply_snap_corrupt(st, mask, alive)
+    assert np.asarray(out2.snap_bad).tolist() == \
+        [True, False, False, False, False]
+
+
+def test_storage_verbs_noop_without_storage():
+    # Python-gated: a storage-off state traces the exact prior program
+    st = init_state(CFG5)
+    mask = jnp.ones((5,), bool)
+    assert dst.apply_lost_tail(st, mask, TRUE5) is st
+    assert dst.apply_torn_write(st, mask, TRUE5) is st
+    assert dst.apply_disk_stall(st, mask, TRUE5) is st
+    assert dst.apply_snap_corrupt(st, mask, TRUE5) is st
+
+
+def test_storage_verbs_emit_signature_events():
+    cfg = dataclasses.replace(SCFG, record_events=True)
+    st = _stor(cfg, last=[(1, 10), (2, 10)], sync_mark=[(1, 6), (2, 6)])
+    out = dst.apply_lost_tail(st, jnp.arange(5) == 1, TRUE5)
+    out = dst.apply_torn_write(out, jnp.arange(5) == 2, TRUE5)
+    out = dst.apply_disk_stall(out, jnp.arange(5) == 3, TRUE5)
+    out = dst.apply_snap_corrupt(out, jnp.arange(5) == 4, TRUE5)
+    events, dropped = decode_rings(out.ev_buf, out.ev_pos)
+    assert int(dropped.sum()) == 0
+    names = {e.name for e in events}
+    for code_name in dst.STORAGE_SIGNATURE_CODES.values():
+        assert code_name in names
+    for e in events:
+        text = e.describe()
+        assert isinstance(text, str) and text
+
+
+def test_storage_verbs_are_noops_on_recorder_off_states():
+    st = _stor(last=[(1, 10)], sync_mark=[(1, 6)])
+    out = dst.apply_lost_tail(st, jnp.arange(5) == 1, TRUE5)
+    out = dst.apply_disk_stall(out, jnp.arange(5) == 3, TRUE5)
+    assert out.ev_buf is None and out.ev_pos is None
+
+
+# ---------------------------------------------------------------------------
+# the fsync round: cadence, batch clamp, freeze, end-of-tick folds
+
+
+def test_fsync_cadence_batch_and_durable_fold():
+    st = _stor(last=[(0, 10)], commit=[(0, 8)])
+    out = step_j(st, SCFG)
+    assert int(out.sync_mark[0]) == 0                  # tick 0: not due
+    out = step_j(_at_tick(st, 3), SCFG)
+    assert int(out.sync_mark[0]) == 10                 # due, unlimited
+    # the durable record folds min(commit, sync_mark); the oracle
+    # frontier folds commit itself
+    assert int(out.dur_commit[0]) >= 8
+    assert int(out.ack_frontier[0]) >= 8
+    bcfg = dataclasses.replace(SCFG, fsync_batch=4)
+    out = step_j(_at_tick(_stor(bcfg, last=[(0, 10)]), 3), bcfg)
+    assert int(out.sync_mark[0]) == 4                  # clamped per round
+
+
+def test_fsync_freezes_on_stall_and_crash_and_flags_clear():
+    st = _stor(last=[(0, 10), (1, 10), (2, 10)],
+               fsync_stall=[(1, True)], snap_bad=[(3, True)])
+    alive = TRUE5.at[2].set(False)
+    out = step_j(_at_tick(st, 3), SCFG, alive=alive)
+    assert int(out.sync_mark[0]) == 10                 # healthy row syncs
+    assert int(out.sync_mark[1]) == 0                  # stalled disk frozen
+    assert int(out.sync_mark[2]) == 0                  # crashed row frozen
+    # the verb flags are one-tick inputs: consumed, then cleared
+    assert not bool(np.asarray(out.fsync_stall).any())
+    assert not bool(np.asarray(out.snap_bad).any())
+
+
+def test_stalled_disk_refuses_vote_grants():
+    # vote records are write-through (etcd MustSync), not on the fsync
+    # cadence: under ack_gating a row whose disk is stalled cannot
+    # persist the grant, so it refuses — and a candidate that cannot
+    # assemble a quorum of durable grants stays a candidate
+    others = jnp.arange(5) != 0
+
+    def drive(stall):
+        st = _arr(init_state(SCFG), elapsed=[(0, 100)])
+        for _ in range(3):
+            if stall:
+                st = dst.apply_disk_stall(st, others, TRUE5)
+            st = step_j(st, SCFG)
+        return st
+
+    clean = drive(False)
+    assert int(clean.role[0]) == LEADER
+    stalled = drive(True)
+    # refused by a quorum, the candidate loses the poll and steps back
+    # down (etcd VoteLost); no stalled row ever persisted a grant
+    assert not bool((np.asarray(stalled.role) == LEADER).any())
+    assert (np.asarray(stalled.vote)[1:] == NONE).all()
+    assert (np.asarray(clean.vote) == 0).sum() >= 3    # clean quorum
+
+
+def test_crash_right_after_snapshot_install_is_lossless():
+    # a snapshot install jumps the watermark to the snapshot index
+    # (the image hit disk before the restore applied), so a lost_tail
+    # crash on the very next tick finds nothing to truncate
+    st = _stor(last=[(2, 50)], commit=[(2, 50)], applied=[(2, 50)],
+               snap_idx=[(2, 50)], sync_mark=[(2, 50)],
+               dur_commit=[(2, 50)])
+    out = dst.apply_lost_tail(st, jnp.arange(5) == 2, TRUE5)
+    assert int(out.last[2]) == 50
+    assert int(out.commit[2]) == 50
+    assert int(out.applied[2]) == 50
+    assert int(out.dur_commit[2]) == 50
+
+
+# ---------------------------------------------------------------------------
+# invariant boundaries
+
+
+def test_durability_bit_boundary():
+    st = init_state(SCFG)
+    assert not int(dst.check_state(st, SCFG)) & dst.DURABILITY
+    # the witness is cluster-wide: an acked frontier ABOVE every log's
+    # last means some acked-as-committed entry exists on no disk
+    bad = _arr(st, ack_frontier=[(0, 5)])
+    assert int(dst.check_state(bad, SCFG)) & dst.DURABILITY
+    # one surviving copy anywhere satisfies it (replication covers f<q)
+    ok = _arr(st, ack_frontier=[(0, 5)], last=[(4, 5)])
+    assert not int(dst.check_state(ok, SCFG)) & dst.DURABILITY
+
+
+def test_slo_fsync_lag_boundary():
+    cfg = dataclasses.replace(SCFG, slo_fsync_lag=4)
+    at_bound = _arr(init_state(cfg), last=[(0, 4)])
+    assert not int(dst.check_state(at_bound, cfg)) & dst.SLO_FSYNC_LAG
+    over = _arr(init_state(cfg), last=[(0, 5)])
+    assert int(dst.check_state(over, cfg)) & dst.SLO_FSYNC_LAG
+    # bound unset = oracle off even over the line
+    wide = _arr(init_state(SCFG), last=[(0, 50)])
+    assert not int(dst.check_state(wide, SCFG)) & dst.SLO_FSYNC_LAG
+
+
+def test_recovery_monotonic_and_recovering_mask():
+    prev = _stor(last=[(0, 8)], commit=[(0, 8)], applied=[(0, 8)],
+                 dur_commit=[(0, 6)])
+    # a sanctioned recovery: commit/applied rebuilt from durable state
+    new = _stor(last=[(0, 6)], commit=[(0, 6)], dur_commit=[(0, 6)])
+    rec = jnp.arange(5) == 0
+    assert int(dst.check_transition(prev, new)) & dst.COMMIT_MONOTONIC
+    assert int(dst.check_transition(prev, new, recovering=rec)) == 0
+    # the durable record is pinned even for recovering rows
+    worse = _arr(new, dur_commit=[(0, 5)])
+    assert int(dst.check_transition(prev, worse, recovering=rec)) \
+        & dst.RECOVERY_MONOTONIC
+
+
+# ---------------------------------------------------------------------------
+# storage-off transparency: the sync wire, fast (heavier wires below)
+
+
+def test_storage_nogate_bit_identity_sync():
+    _assert_nogate_transparent(CFG5, ticks=120, prop_count=2)
+
+
+def _assert_nogate_transparent(base, ticks, prop_count):
+    """storage armed but ack_gating off must not change one decision:
+    every pre-existing field stays bit-identical to the storage-off run,
+    and only the new registers (plus the folded vote guard) differ."""
+    nogate = dataclasses.replace(base, fsync_lag_ticks=4)
+    off_st, off_tr = run_ticks(init_state(base), base, ticks,
+                               prop_count=prop_count)
+    on_st, on_tr = run_ticks(init_state(nogate), nogate, ticks,
+                             prop_count=prop_count)
+    _assert_identical_modulo_storage(off_st, on_st)
+    assert np.array_equal(np.asarray(off_tr), np.asarray(on_tr))
+    # the storage plane was actually live on the nogate side
+    assert int(jnp.max(on_st.sync_mark)) > 0
+    assert int(jnp.max(on_st.dur_commit)) > 0
+
+
+@pytest.mark.slow  # tier-2: one kernel compile per wire, see ROADMAP
+@pytest.mark.parametrize("wire", ["tiled", "sparse", "mailbox"])
+def test_storage_nogate_bit_identity_wires(wire):
+    base = {
+        # log_chunk must be lane-aligned (multiples of 128), so the tiled
+        # wire needs a ring big enough to band
+        "tiled": lambda: dataclasses.replace(CFG5, log_len=512,
+                                             log_chunk=128),
+        "sparse": lambda: SimConfig(n=16, log_len=64, window=8,
+                                    apply_batch=16, max_props=8, keep=4,
+                                    election_tick=10, seed=3,
+                                    active_rows=8),
+        "mailbox": lambda: dataclasses.replace(CFG5, latency=2,
+                                               latency_jitter=1,
+                                               inflight=2),
+    }[wire]()
+    _assert_nogate_transparent(base, ticks=300, prop_count=2)
+
+
+@pytest.mark.slow
+def test_storage_nogate_bit_identity_sharded():
+    from swarmkit_tpu.parallel import row_mesh, shard_rows
+    base = SimConfig(n=64, log_len=128, window=16, apply_batch=32,
+                     max_props=16, keep=8, seed=11)
+    nogate = dataclasses.replace(base, fsync_lag_ticks=4)
+    mesh = row_mesh(base.n)
+    off_st, off_tr = run_ticks(shard_rows(init_state(base), mesh), base,
+                               300, prop_count=8)
+    on_st, on_tr = run_ticks(shard_rows(init_state(nogate), mesh), nogate,
+                             300, prop_count=8)
+    _assert_identical_modulo_storage(off_st, on_st)
+    assert np.array_equal(np.asarray(off_tr), np.asarray(on_tr))
+    assert int(jnp.max(on_st.sync_mark)) > 0
+
+
+# ---------------------------------------------------------------------------
+# the DURABILITY contrast: correlated loss trips it, ack gating closes it
+
+
+@pytest.mark.slow
+def test_lost_tail_trips_durability_and_gating_closes_it():
+    batch, names = dst.make_batch(STOR_OFF, ticks=120, schedules=8, seed=7,
+                                  profiles=("lost_tail",))
+    r_off = dst.explore(init_state(STOR_OFF), STOR_OFF, batch,
+                        profiles=names, prop_count=2)
+    tripped = int(((r_off.viol & dst.DURABILITY) != 0).sum())
+    assert tripped > 0, [hex(int(v)) for v in r_off.viol]
+    # with gating a commit IMPLIES a durable quorum: the SAME schedules
+    # come back violation-free
+    r_on = dst.explore(init_state(STOR_ON), STOR_ON, batch,
+                       profiles=names, prop_count=2)
+    assert (r_on.viol == 0).all(), [hex(int(v)) for v in r_on.viol]
+
+
+@pytest.mark.slow
+def test_crash_spliced_into_snapshot_install_stays_clean():
+    # the gating-on snap_corrupt schedule already forces a snapshot
+    # install after the victim's outage; splice a cluster-wide lost_tail
+    # crash INTO the corrupt-install window and another right after the
+    # clean install — recovery must rebuild from durable registers with
+    # no invariant trip either time
+    cfg = STOR_ON
+    T = cfg.election_tick
+    ticks = 140
+    sched = dst.make_schedule(cfg, ticks=ticks, profile="snap_corrupt",
+                              seed=3)
+    alive = np.asarray(sched.alive)
+    down = ~alive.all(axis=1)
+    assert down.any()                                  # sanity: an outage
+    heal = int(np.where(down)[0].max()) + 1
+    lost = np.zeros((ticks, cfg.n), bool)
+    lost[min(heal + 1, ticks - 1), :] = True           # mid bad window
+    lost[min(heal + 2 * T + 3, ticks - 1), :] = True   # post clean install
+    spliced = dataclasses.replace(sched, lost_tail=jnp.asarray(lost))
+    viol, _ = dst.replay(cfg, spliced, prop_count=2)
+    assert viol == 0, hex(viol)
+
+
+# ---------------------------------------------------------------------------
+# torn_write at the log_chunk band boundary (tiled lowering parity)
+
+
+@pytest.mark.slow
+def test_torn_write_at_band_boundary_tiled_parity():
+    tiled = dataclasses.replace(SCFG, log_len=512, log_chunk=128)
+    flat = dataclasses.replace(tiled, log_chunk=0)
+    st = _stor(flat, last=[(1, 140)], commit=[(1, 138)],
+               applied=[(1, 138)], sync_mark=[(1, 129)])
+    cut = dst.apply_torn_write(st, jnp.arange(5) == 1, TRUE5)
+    assert int(cut.last[1]) == 128                     # exactly a band edge
+    a = jax.jit(step, static_argnames=("cfg",))(cut, flat)
+    b = jax.jit(step, static_argnames=("cfg",))(cut, tiled)
+    for fld in dataclasses.fields(SimState):
+        x, y = getattr(a, fld.name), getattr(b, fld.name)
+        if x is None and y is None:
+            continue
+        assert np.array_equal(np.asarray(x), np.asarray(y)), \
+            f"{fld.name} diverged across log_chunk"
+
+
+@pytest.mark.slow
+def test_torn_write_explore_agrees_across_log_chunk():
+    tiled = dataclasses.replace(STOR_ON, log_len=512, log_chunk=128)
+    flat = dataclasses.replace(tiled, log_chunk=0)
+    batch, names = dst.make_batch(tiled, ticks=120, schedules=6, seed=11,
+                                  profiles=("torn_write",))
+    r_t = dst.explore(init_state(tiled), tiled, batch, profiles=names,
+                      prop_count=2)
+    r_f = dst.explore(init_state(flat), flat, batch, profiles=names,
+                      prop_count=2)
+    # a single torn disk is contained by replication on both lowerings
+    assert (r_t.viol == 0).all(), [hex(int(v)) for v in r_t.viol]
+    assert np.array_equal(np.asarray(r_t.viol), np.asarray(r_f.viol))
+    assert np.array_equal(np.asarray(r_t.first_tick),
+                          np.asarray(r_f.first_tick))
+
+
+# ---------------------------------------------------------------------------
+# host WAL truncation parity (raft/storage.py <-> the kernel verbs)
+
+
+def _wal_entry(i):
+    from swarmkit_tpu.raft.messages import Entry, EntryType
+    return Entry(index=i, term=1, type=EntryType.NORMAL,
+                 data=b"payload-%d" % i)
+
+
+def test_host_wal_drops_torn_tail_on_bootstrap(tmp_path):
+    from swarmkit_tpu.raft.messages import HardState
+    from swarmkit_tpu.raft.storage import EncryptedRaftLogger
+    log = EncryptedRaftLogger(str(tmp_path))
+    log.bootstrap_new()
+    log.save(HardState(term=1, vote=0, commit=0),
+             [_wal_entry(i) for i in range(1, 6)])
+    (wal,) = glob.glob(os.path.join(str(tmp_path), "raft", "wal-*.log"))
+    blob = open(wal, "rb").read()
+    # a torn final sector: recovery keeps the checksummed prefix — the
+    # host analog of the kernel's lost_tail/torn_write truncation back
+    # to the durable watermark
+    with open(wal, "wb") as f:
+        f.write(blob[:-7])
+    boot = EncryptedRaftLogger(str(tmp_path)).bootstrap_from_disk()
+    assert [e.index for e in boot.entries] == [1, 2, 3, 4]
+    assert boot.hard_state is not None and boot.hard_state.term == 1
+
+
+def test_host_wal_refuses_midfile_corruption(tmp_path):
+    from swarmkit_tpu.raft.messages import HardState
+    from swarmkit_tpu.raft.storage import DataCorrupt, EncryptedRaftLogger
+    log = EncryptedRaftLogger(str(tmp_path))
+    log.bootstrap_new()
+    log.save(HardState(term=1, vote=0, commit=0),
+             [_wal_entry(i) for i in range(1, 6)])
+    (wal,) = glob.glob(os.path.join(str(tmp_path), "raft", "wal-*.log"))
+    blob = bytearray(open(wal, "rb").read())
+    # flip one byte INSIDE an early frame body: valid frames follow, so
+    # this is a lying disk, not a torn tail — recovery must refuse
+    # rather than serve a hole (the fleet defense is replication)
+    blob[10] ^= 0xFF
+    with open(wal, "wb") as f:
+        f.write(bytes(blob))
+    with pytest.raises(DataCorrupt):
+        EncryptedRaftLogger(str(tmp_path)).bootstrap_from_disk()

@@ -39,3 +39,19 @@ def test_attack_sweep_all_scenarios(tmp_path):
     assert len(skips) == len(ATTACK_SCENARIOS) * len(WIRES)
     failed = [r for r in device if not r["ok"]]
     assert not failed, f"attack scenarios failed: {failed}"
+
+
+@pytest.mark.slow
+def test_storage_sweep_all_scenarios(tmp_path):
+    # the durability boundary: trip scenarios caught defense-off, shrunk
+    # to replay-exact artifacts with a bounded oracle in lockstep, clean
+    # with ack-gating on; containment scenarios absorbed with recovery
+    # signature evidence; host wires covered by storage.py parity tests
+    from tools.fault_sweep import STORAGE_SCENARIOS, run_storage_sweep
+    results = run_storage_sweep(out_dir=str(tmp_path), verbose=False)
+    device = [r for r in results if r["wire"] == "device"]
+    skips = [r for r in results if r.get("skipped")]
+    assert len(device) == len(STORAGE_SCENARIOS)
+    assert len(skips) == len(STORAGE_SCENARIOS) * len(WIRES)
+    failed = [r for r in device if not r["ok"]]
+    assert not failed, f"storage scenarios failed: {failed}"

@@ -318,6 +318,91 @@ def run_transfer_abuse_demo(schedules: int = 8, ticks: int = 120,
     return out
 
 
+def run_lost_tail_demo(schedules: int = 8, ticks: int = 120, seed: int = 7,
+                       n: int = 5, prop_count: int = 2, out_path=None,
+                       verbose: bool = True) -> dict:
+    """Seed-pinned durability demo: the `lost_tail` storage fault crashes
+    EVERY row on one tick and truncates each log to its fsynced watermark
+    (correlated power loss, the classic fsync-lag data-loss scenario).
+    Without ack-gating followers acknowledge appends the disk has not yet
+    synced, so the cluster can commit entries no surviving copy holds —
+    the DURABILITY witness (an acked commit above every surviving log)
+    trips at the crash tick.  With ``ack_gating`` rows only ack what
+    their watermark covers, committed implies durable on a quorum, and
+    the SAME schedules come back clean.  The first counterexample is
+    shrunk and dumped as a replay-exact artifact; the differential
+    oracle must hold lockstep over the clean prefix (the crash tick IS
+    the violation tick, so the SAFETY_BITS truncation bounds the compare
+    right before the host oracle's perfect disk stops being a model)."""
+    import dataclasses
+
+    out = {"schedules": schedules, "ticks": ticks, "seed": seed, "n": n}
+    off = dataclasses.replace(_cfg(n, seed, reads=0), fsync_lag_ticks=6)
+    on = dataclasses.replace(off, ack_gating=True)
+    batch, names = dst.make_batch(off, ticks=ticks, schedules=schedules,
+                                  seed=seed, profiles=("lost_tail",))
+    r_off = dst.explore(init_state(off), off, batch, profiles=names,
+                        prop_count=prop_count)
+    caught = [int(s) for s in r_off.violating
+              if int(r_off.viol[s]) & dst.DURABILITY]
+    out["caught"] = len(caught)
+    r_on = dst.explore(init_state(on), on, batch, profiles=names,
+                       prop_count=prop_count)
+    out["gated_violations"] = int((r_on.viol != 0).sum())
+    if not caught:
+        out["neutralized"] = False
+        if verbose:
+            print(f"lost_tail NOT caught with gating off "
+                  f"({schedules}x{ticks}, seed {seed})", flush=True)
+        return out
+
+    s = caught[0]
+    sched = batch.slice(s)
+    before = dst.fault_count(sched)
+    small, evals = dst.shrink(off, sched, dst.DURABILITY, prop_count)
+    v2, f2 = dst.replay(off, small, prop_count)
+    flight = dst.capture_flight(off, small, prop_count, first_tick=f2)
+    art = dst.to_artifact(off, small, seed=seed, profile=names[s], index=s,
+                          prop_count=prop_count, mutation=None,
+                          viol=v2, first_tick=f2, flight=flight)
+    out_path = _cli_common.artifact_path(out_path,
+                                         "dst_repro_lost_tail.json")
+    dst.save_artifact(out_path, art)
+    verdict = dst.replay_artifact(out_path)
+    out.update({
+        "bits": dst.bits_to_names(v2),
+        "first_tick": f2,
+        "fault_count_before": before,
+        "fault_count_after": dst.fault_count(small),
+        "shrink_evals": evals,
+        "artifact": out_path,
+        "replay_matches": verdict["matches_recorded"],
+        "oracle_diverged_at": verdict["oracle"]["diverged_at"],
+    })
+    out["neutralized"] = (out["gated_violations"] == 0
+                          and out["replay_matches"]
+                          and out["oracle_diverged_at"] == -1)
+    if verbose:
+        print(f"lost_tail x{schedules} schedules x {ticks} ticks: "
+              f"gating-off caught {out['caught']} DURABILITY trips "
+              f"(first at tick {f2}), shrunk {before} -> "
+              f"{out['fault_count_after']} fault-events in {evals} replays",
+              flush=True)
+        print(f"repro artifact: {out_path} — replay "
+              f"{'reproduces exactly' if out['replay_matches'] else 'DIVERGED'}, "
+              f"oracle {'lockstep over the clean prefix' if out['oracle_diverged_at'] == -1 else 'diverged at tick %d' % out['oracle_diverged_at']}, "
+              f"gating-on {out['gated_violations']} violations — "
+              f"{'ack-gating makes committed mean durable' if out['neutralized'] else 'NOT neutralized'}",
+              flush=True)
+        tail = flight["record"].window(6)
+        if tail:
+            print(f"flight window (last {len(tail)} device events before "
+                  f"the crash):", flush=True)
+            for e in tail:
+                print("  " + e.describe(), flush=True)
+    return out
+
+
 def replay_artifact_file(path: str, verbose: bool = True) -> dict:
     verdict = dst.replay_artifact(path)
     if verbose:
@@ -368,6 +453,10 @@ def main(argv=None) -> int:
     _cli_common.add_demo_arg(ap, "transfer-abuse",
                              "run ONLY the seed-pinned cooldown-neutralizes-"
                              "transfer-thrash scenario and exit")
+    _cli_common.add_demo_arg(ap, "lost-tail",
+                             "run ONLY the seed-pinned ack-gating-makes-"
+                             "committed-durable scenario (correlated "
+                             "power-loss tail truncation) and exit")
     args = ap.parse_args(argv)
     prop_count = 2 if args.prop_count is None else args.prop_count
 
@@ -394,6 +483,12 @@ def main(argv=None) -> int:
         demo = run_transfer_abuse_demo(
             min(args.schedules, 8), seed=args.seed if args.seed else 7,
             n=args.n, prop_count=prop_count)
+        return 0 if demo["neutralized"] else 1
+
+    if args.lost_tail_demo:
+        demo = run_lost_tail_demo(
+            min(args.schedules, 8), seed=args.seed if args.seed else 7,
+            n=args.n, prop_count=prop_count, out_path=args.out)
         return 0 if demo["neutralized"] else 1
 
     profiles = tuple(p for p in args.profiles.split(",") if p)

@@ -43,6 +43,18 @@ on.  These verbs force device kernel state directly, so they run on the
 device wire only — each host wire gets an explicit skip row (see
 ``ATTACK_WIRE_SKIP``) rather than a silent coverage gap.
 
+With ``--storage`` the sweep runs the storage-fault scenarios
+(``STORAGE_SCENARIOS``): disk truncation, torn writes, corrupt
+snapshots and fsync stalls against the kernel's explicit durability
+model (``SimConfig.fsync_lag_ticks`` / ``ack_gating``).  Trip scenarios
+follow the attack pipeline — defense-off must catch the named bit,
+shrink to a replay-exact artifact, defense-on clean on the SAME
+schedules; containment scenarios must stay violation-free while the
+recovery signature code proves the fault actually bit.  Like the attack
+verbs these force device kernel state, so host wires get explicit skip
+rows (the host wire's real storage is covered by the raft/storage.py
+truncation-parity tests instead).
+
 Usage:
     python tools/fault_sweep.py                       # full sweep
     python tools/fault_sweep.py --wires grpc --plans crash,partition
@@ -50,6 +62,7 @@ Usage:
     python tools/fault_sweep.py --peer-chunk 8        # + device cross-check
     python tools/fault_sweep.py --active-rows 8       # + sparse cross-check
     python tools/fault_sweep.py --attacks all         # adversary pipeline
+    python tools/fault_sweep.py --storage all         # durability pipeline
 """
 
 from __future__ import annotations
@@ -125,6 +138,56 @@ ATTACK_SCENARIOS = {
 ATTACK_WIRE_SKIP = (
     "attack verbs force kernel state arrays between ticks; host Node "
     "wires have no state-injection seam (device-only by design)")
+
+# Storage-fault scenarios (--storage): the durability boundary of the
+# explicit per-row storage model (SimConfig.fsync_lag_ticks arms the
+# sync_mark watermark, SimConfig.ack_gating pins acks/votes/commit to
+# it).  Two shapes:
+#   mode="trip":    defense-off must CATCH the named invariant bit, the
+#                   first counterexample shrinks to a replay-exact
+#                   artifact, defense-on is clean on the SAME schedules
+#                   (the attack-sweep pipeline).
+#   mode="contain": the fault must be ABSORBED — the gated config stays
+#                   violation-free while the recovery signature code
+#                   (STORAGE_SIGNATURE_CODES) proves the verb actually
+#                   fired and was repaired, not silently skipped.
+# `oracle` picks the differential-oracle bound for trip artifacts:
+#   "violation" — the bit is a SAFETY bit and the verb tick IS the
+#                 violation tick, so replay_artifact's SAFETY_BITS
+#                 truncation already compares exactly the clean prefix;
+#   "verb"      — kernel-side divergence precedes the trip (poisoned
+#                 install, stall-refused votes), so the sweep bounds its
+#                 own oracle_trace at the first storage-verb tick (the
+#                 host oracle models a perfect disk; see dst/repro.py).
+STORAGE_SCENARIOS = {
+    "lost_tail": dict(
+        off=dict(fsync_lag_ticks=6),
+        on=dict(fsync_lag_ticks=6, ack_gating=True),
+        ticks=120, prop_count=2, bit="durability", mode="trip",
+        oracle="violation", defense="durable-watermark ack gating"),
+    "torn_write": dict(
+        off=None,
+        on=dict(fsync_lag_ticks=6, ack_gating=True),
+        ticks=120, prop_count=2, bit=None, mode="contain",
+        defense="checksummed-scan truncation + quorum re-replication"),
+    "snap_corrupt": dict(
+        off=dict(fsync_lag_ticks=6),
+        on=dict(fsync_lag_ticks=6, ack_gating=True),
+        ticks=140, prop_count=2, bit="checksum_agreement", mode="trip",
+        oracle="verb", defense="pre-install snapshot checksum verify"),
+    "disk_stall": dict(
+        off=dict(fsync_lag_ticks=2, slo_fsync_lag=8),
+        on=dict(fsync_lag_ticks=2, slo_fsync_lag=8, ack_gating=True,
+                prop_inflight_cap=8),
+        ticks=120, prop_count=2, bit="slo_fsync_lag", mode="trip",
+        oracle="verb",
+        defense="ack gating + per-row inflight cap backpressure"),
+}
+
+STORAGE_WIRE_SKIP = (
+    "storage verbs rewrite kernel log/watermark registers between ticks; "
+    "the host wires' real on-disk WAL is covered by the raft/storage.py "
+    "truncation-parity tests instead")
 
 
 def _free_port() -> int:
@@ -720,6 +783,134 @@ def run_attack_sweep(attacks=None, seed: int = 7, schedules: int = 8,
 
 
 # --------------------------------------------------------------------------
+# storage-fault scenarios (device wire): the durability boundary
+
+
+def run_storage_sweep(faults=None, seed: int = 7, schedules: int = 8,
+                      n: int = 5, out_dir: Optional[str] = None,
+                      wires=WIRES, verbose: bool = True) -> list[dict]:
+    """Seed-pinned end-to-end run of each STORAGE_SCENARIOS row (see the
+    table above for the trip/contain split and the oracle bounds)."""
+    import dataclasses
+
+    import numpy as np
+
+    from swarmkit_tpu import dst
+    from swarmkit_tpu.raft.sim.state import SimConfig, init_state
+
+    faults = list(faults or STORAGE_SCENARIOS)
+    base = SimConfig(n=n, log_len=64, window=8, apply_batch=16, max_props=8,
+                     keep=4, election_tick=10, seed=seed)
+    bit_of = {name: bit for bit, name in dst.BIT_NAMES.items()}
+    results = []
+    for fault in faults:
+        sc = STORAGE_SCENARIOS[fault]
+        t0 = time.monotonic()
+        on = dataclasses.replace(base, **sc["on"])
+        ok, err, notes = True, "", ""
+        try:
+            if sc["mode"] == "contain":
+                batch, names = dst.make_batch(on, ticks=sc["ticks"],
+                                              schedules=schedules, seed=seed,
+                                              profiles=(fault,))
+                r_on = dst.explore(init_state(on), on, batch, profiles=names,
+                                   prop_count=sc["prop_count"])
+                if int((r_on.viol != 0).sum()):
+                    raise AssertionError(
+                        f"gated config not clean under {fault}: "
+                        f"{[hex(int(v)) for v in r_on.viol]}")
+                code = dst.STORAGE_SIGNATURE_CODES[fault]
+                fl = dst.capture_flight(on, batch.slice(0),
+                                        sc["prop_count"], window=400)
+                hits = sum(code in e.describe()
+                           for e in fl["record"].window(400))
+                if not hits:
+                    raise AssertionError(
+                        f"{code} never fired — the {fault} verb was "
+                        f"absorbed without any recovery evidence")
+                notes = (f"contained: 0/{schedules} violations with "
+                         f"{sc['defense']}, {hits} {code} recovery "
+                         f"event(s) on schedule 0")
+            else:
+                off = dataclasses.replace(base, **sc["off"])
+                bit = bit_of[sc["bit"]]
+                batch, names = dst.make_batch(off, ticks=sc["ticks"],
+                                              schedules=schedules, seed=seed,
+                                              profiles=(fault,))
+                r_off = dst.explore(init_state(off), off, batch,
+                                    profiles=names,
+                                    prop_count=sc["prop_count"])
+                caught = [int(s) for s in r_off.violating
+                          if int(r_off.viol[s]) & bit]
+                if not caught:
+                    raise AssertionError(
+                        f"defense-off sweep never tripped {sc['bit']}")
+                r_on = dst.explore(init_state(on), on, batch, profiles=names,
+                                   prop_count=sc["prop_count"])
+                if int((r_on.viol != 0).sum()):
+                    raise AssertionError(
+                        f"defense-on ({sc['defense']}) not clean: "
+                        f"{[hex(int(v)) for v in r_on.viol]}")
+                s = caught[0]
+                one = batch.slice(s)
+                before = dst.fault_count(one)
+                small, evals = dst.shrink(off, one, bit, sc["prop_count"])
+                v2, f2 = dst.replay(off, small, sc["prop_count"])
+                art = dst.to_artifact(off, small, seed=seed, profile=fault,
+                                      index=s, prop_count=sc["prop_count"],
+                                      mutation=None, viol=v2, first_tick=f2)
+                path = _cli_common.artifact_path(
+                    None if out_dir is None
+                    else out_dir.rstrip(os.sep) + os.sep,
+                    f"dst_storage_{fault}.json")
+                dst.save_artifact(path, art)
+                want_trace = sc["oracle"] == "violation"
+                verdict = dst.replay_artifact(path, with_trace=want_trace)
+                if not verdict["matches_recorded"]:
+                    raise AssertionError("artifact replay did not reproduce "
+                                         "the recorded violation")
+                if want_trace:
+                    div = verdict["oracle"]["diverged_at"]
+                else:
+                    leaf = getattr(small, dst.STORAGE_LEAVES[fault])
+                    first_verb = int(np.where(
+                        np.asarray(leaf).any(axis=1))[0].min())
+                    div = dst.oracle_trace(
+                        off, small, sc["prop_count"],
+                        until=first_verb)["diverged_at"]
+                if div != -1:
+                    raise AssertionError(f"differential oracle diverged at "
+                                         f"tick {div}")
+                notes = (f"caught {len(caught)}/{schedules} ({sc['bit']}), "
+                         f"shrunk {before}->{dst.fault_count(small)} "
+                         f"fault-events in {evals} replays, replay exact, "
+                         f"oracle lockstep ({sc['oracle']}-bounded), "
+                         f"defense-on ({sc['defense']}) clean [{path}]")
+        except AssertionError as e:
+            ok, err = False, str(e)
+        results.append({"wire": "device", "plan": fault, "seed": seed,
+                        "ok": ok, "notes": notes, "error": err,
+                        "secs": round(time.monotonic() - t0, 2)})
+        if verbose:
+            r = results[-1]
+            state = "ok  " if ok else "FAIL"
+            line = (f"{state} {'device':8s} {fault:18s} seed={seed} "
+                    f"({r['secs']}s)  {notes}")
+            if not ok:
+                line += f"  {err}"
+            print(line, flush=True)
+        for wire in wires:
+            results.append({"wire": wire, "plan": fault, "seed": seed,
+                            "ok": True, "skipped": STORAGE_WIRE_SKIP,
+                            "notes": f"SKIP: {STORAGE_WIRE_SKIP}",
+                            "secs": 0.0})
+            if verbose:
+                print(f"skip {wire:8s} {fault:18s} seed={seed} "
+                      f"({STORAGE_WIRE_SKIP})", flush=True)
+    return results
+
+
+# --------------------------------------------------------------------------
 # sweep driver
 
 
@@ -799,6 +990,12 @@ def main(argv=None) -> int:
                     f"{tuple(ATTACK_SCENARIOS)}): device-wire "
                     f"counterexample pipeline + explicit per-host-wire "
                     f"skip rows (the verbs have no host seam)")
+    ap.add_argument("--storage", default=None, metavar="LIST",
+                    help=f"run ONLY the seed-pinned storage-fault "
+                    f"scenarios ('all' or a comma list from "
+                    f"{tuple(STORAGE_SCENARIOS)}): device-wire durability "
+                    f"pipeline (catch -> shrink -> replay-exact -> "
+                    f"gating-on clean) + explicit per-host-wire skip rows")
     _cli_common.add_active_rows_arg(ap)
     args = ap.parse_args(argv)
 
@@ -824,6 +1021,21 @@ def main(argv=None) -> int:
         failed = [r for r in results if not r["ok"]]
         ran = [r for r in results if "skipped" not in r]
         print(f"\n{len(ran) - len(failed)}/{len(ran)} attack scenarios "
+              f"passed ({len(results) - len(ran)} host-wire skips)")
+        return 1 if failed else 0
+
+    if args.storage:
+        faults = (list(STORAGE_SCENARIOS) if args.storage == "all"
+                  else [f for f in args.storage.split(",") if f])
+        for f in faults:
+            if f not in STORAGE_SCENARIOS:
+                ap.error(f"unknown storage fault {f!r}; "
+                         f"known: {tuple(STORAGE_SCENARIOS)}")
+        results = run_storage_sweep(faults, seed=seeds[0], wires=wires,
+                                    out_dir=args.flight_dir)
+        failed = [r for r in results if not r["ok"]]
+        ran = [r for r in results if "skipped" not in r]
+        print(f"\n{len(ran) - len(failed)}/{len(ran)} storage scenarios "
               f"passed ({len(results) - len(ran)} host-wire skips)")
         return 1 if failed else 0
 

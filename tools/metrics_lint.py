@@ -41,6 +41,16 @@ Checks, in order:
    ``CODE_NAMES`` entry), and publishes under
    ``swarm_dst_attack_ticks_total{attack=...}`` — an attack verb cannot
    land without scrape-side accounting and a post-mortem signature.
+9. The durability boundary (ISSUE 16) stays wired the same way: every
+   ``dst.schedule.STORAGE_PROFILES`` entry is requestable, drives a
+   FaultSchedule leaf (``STORAGE_LEAVES``), owns a signature code
+   (``STORAGE_SIGNATURE_CODES``), and publishes under the attack
+   counter; the ``FSYNC_*``/``RECOVER_*``/``SNAP_CORRUPT`` flightrec
+   codes exist in ``CODE_NAMES``; the ``swarm_kernel_fsync_lag`` gauge
+   and ``swarm_kernel_durable_commit_advance_total`` counter are in the
+   catalog; and the DURABILITY / RECOVERY_MONOTONIC / SLO_FSYNC_LAG
+   invariant bits are named in the DST artifact schema
+   (``invariants.BIT_NAMES``).
 
 Importable (``run_lint`` returns the problem list) so the pytest wrapper
 in tests/test_metrics_lint.py runs it in-suite; the CLI exits nonzero on
@@ -331,6 +341,62 @@ def run_lint(repo_root: str | None = None) -> list[str]:
         problems.append(f"attacks: {extra!r} wired in ATTACK_LEAVES/"
                         "ATTACK_SIGNATURE_CODES but absent from "
                         "ATTACK_PROFILES")
+
+    # 9. durability-boundary wiring (ISSUE 16): storage-fault profiles,
+    #    their leaves and signature codes, the fsync/recovery metrics,
+    #    and the new invariant bits, pinned like check #8
+    from swarmkit_tpu.dst import invariants as dst_invariants
+
+    for prof in dst_schedule.STORAGE_PROFILES:
+        if prof not in dst_schedule.EXTRA_PROFILES:
+            problems.append(f"storage: profile {prof!r} missing from "
+                            "EXTRA_PROFILES (make_schedule can't name it)")
+        if prof not in dst_schedule._GENERATORS:
+            problems.append(f"storage: profile {prof!r} has no "
+                            "_GENERATORS entry")
+        leaf = dst_schedule.STORAGE_LEAVES.get(prof)
+        if leaf is None or leaf not in sched_fields:
+            problems.append(f"storage: profile {prof!r} has no "
+                            f"FaultSchedule leaf (STORAGE_LEAVES -> "
+                            f"{leaf!r})")
+        if leaf is not None and leaf not in dst_schedule._OPTIONAL_LEAVES:
+            problems.append(f"storage: leaf {leaf!r} missing from "
+                            "_OPTIONAL_LEAVES (artifacts can't carry it)")
+        cname = dst_schedule.STORAGE_SIGNATURE_CODES.get(prof)
+        if cname is None \
+                or cname not in flight_codes.CODE_NAMES.values():
+            problems.append(
+                f"storage: profile {prof!r} signature code {cname!r} is "
+                "not a flightrec CODE_NAMES entry")
+        if att_fam is not None:
+            try:
+                att_fam.labels(attack=prof).inc(0)
+            except MetricError as e:
+                problems.append(f"storage: profile {prof!r} cannot "
+                                f"publish: {e}")
+    for extra in sorted((set(dst_schedule.STORAGE_LEAVES)
+                         | set(dst_schedule.STORAGE_SIGNATURE_CODES))
+                        - set(dst_schedule.STORAGE_PROFILES)):
+        problems.append(f"storage: {extra!r} wired in STORAGE_LEAVES/"
+                        "STORAGE_SIGNATURE_CODES but absent from "
+                        "STORAGE_PROFILES")
+    for cname in ("FSYNC_ADVANCE", "RECOVER_TRUNCATE",
+                  "RECOVER_REJECT_SNAP", "RECOVER_TORN", "FSYNC_STALL",
+                  "SNAP_CORRUPT"):
+        if cname not in flight_codes.CODE_NAMES.values():
+            problems.append(f"storage: flightrec code {cname} missing "
+                            "from CODE_NAMES")
+    for mname, kind in (("swarm_kernel_fsync_lag", "gauge"),
+                        ("swarm_kernel_durable_commit_advance_total",
+                         "counter")):
+        spec = catalog.CATALOG.get(mname)
+        if spec is None or spec.kind != kind:
+            problems.append(f"storage: {mname!r} missing from the catalog "
+                            f"or not a {kind}")
+    for bname in ("durability", "recovery_monotonic", "slo_fsync_lag"):
+        if bname not in dst_invariants.BIT_NAMES.values():
+            problems.append(f"storage: invariant bit {bname!r} missing "
+                            "from invariants.BIT_NAMES (artifact schema)")
     return problems
 
 
