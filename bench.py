@@ -379,6 +379,21 @@ def measure(jax, n: int, entries: int, seed: int, election_tick: int,
     return out
 
 
+def _peak_bytes(jax) -> int | None:
+    """Peak device-memory high-water mark across local devices, or None
+    when the backend doesn't report one (CPU returns None or an empty
+    stats dict — never fabricate a 0 that bench_gate would gate on)."""
+    try:
+        peaks = []
+        for d in jax.local_devices():
+            stats = d.memory_stats()
+            if stats and stats.get("peak_bytes_in_use"):
+                peaks.append(int(stats["peak_bytes_in_use"]))
+        return max(peaks) if peaks else None
+    except Exception:
+        return None
+
+
 def _bench_gauges(config: str, m: dict) -> None:
     """Fold one measure() result into the swarm_bench_* gauge families
     (best-effort: gauges must never cost the bench number)."""
@@ -497,6 +512,12 @@ def main() -> None:
     RESULT["election_ticks"] = m["election_ticks"]
     RESULT["election_s_incl_compile"] = round(m["t_elect"], 2)
     RESULT["election_s_post_compile"] = round(m["t_elect_post"], 3)
+    # Resource series for bench_gate (gated in the growth direction:
+    # compile blow-ups and memory blow-ups are regressions too)
+    RESULT["compile_seconds"] = round(m["t_compile"], 2)
+    pb = _peak_bytes(jax)
+    if pb is not None:
+        RESULT["peak_bytes"] = pb
     tel = _telemetry_json(m)
     if tel is not None:
         RESULT["commit_latency_ticks_p50"] = tel["commit_latency_ticks_p50"]

@@ -8,10 +8,12 @@ events (`decoder.py`), wraps them with provenance into savable records
 Chrome-trace / Perfetto JSON (`export.py`).
 """
 
+from swarmkit_tpu.flightrec.clock import ClockFit, ClockSync, fit_from
 from swarmkit_tpu.flightrec.codes import (
     APPEND_REJECT, CODE_NAMES, COMMIT_ADVANCE, EDGE_DOWN, EDGE_DROP,
-    EDGE_UP, ELECTION_WON, EVENT_WIDTH, FALLBACK_TICK, FAULT_EDGE,
-    SNAPSHOT_RESTORE, TERM_BUMP, ring_append,
+    EDGE_UP, ELECTION_WON, EVENT_WIDTH, EVENT_WIDTH_TAGGED, FALLBACK_TICK,
+    FAULT_EDGE, READ_SERVED, SNAPSHOT_RESTORE, TAGGED_CODES, TERM_BUMP,
+    ring_append,
 )
 from swarmkit_tpu.flightrec.decoder import (
     FlightEvent, decode_rings, decode_state,
@@ -25,11 +27,13 @@ from swarmkit_tpu.flightrec.record import (
 )
 
 __all__ = [
-    "APPEND_REJECT", "CODE_NAMES", "COMMIT_ADVANCE", "EDGE_DOWN",
-    "EDGE_DROP", "EDGE_UP", "ELECTION_WON", "EVENT_WIDTH",
-    "FALLBACK_TICK", "FAULT_EDGE", "SNAPSHOT_RESTORE", "TERM_BUMP",
-    "FlightEvent", "FlightRecord", "capture", "decode_rings",
-    "decode_state", "diff_records", "export_record", "load_record",
+    "APPEND_REJECT", "CODE_NAMES", "COMMIT_ADVANCE", "ClockFit",
+    "ClockSync", "EDGE_DOWN", "EDGE_DROP", "EDGE_UP", "ELECTION_WON",
+    "EVENT_WIDTH", "EVENT_WIDTH_TAGGED", "FALLBACK_TICK", "FAULT_EDGE",
+    "READ_SERVED", "SNAPSHOT_RESTORE", "TAGGED_CODES", "TERM_BUMP",
+    "FlightEvent",
+    "FlightRecord", "capture", "decode_rings", "decode_state",
+    "diff_records", "export_record", "fit_from", "load_record",
     "ring_append", "save_record", "summarize", "to_chrome_trace",
     "validate_chrome_trace",
 ]

@@ -14,7 +14,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-EVENT_WIDTH = 4  # (tick, code, arg0, arg1)
+EVENT_WIDTH = 4         # (tick, code, arg0, arg1)
+EVENT_WIDTH_TAGGED = 5  # (tick, code, arg0, arg1, tag) — cfg.trace_tags
 
 # Codes (ISSUE 5 vocabulary).  args per code:
 #   ELECTION_WON     arg0=new term            arg1=last log index
@@ -87,6 +88,11 @@ CODE_NAMES = {
     SNAP_CORRUPT: "SNAP_CORRUPT",
 }
 
+# Codes whose 5th lane carries a host trace tag when the ring is tagged
+# (cfg.trace_tags, ISSUE 17 causal tracing): the commit/serve instants a
+# host propose or read span is waiting on.  Every other code writes 0.
+TAGGED_CODES = frozenset({COMMIT_ADVANCE, READ_SERVED})
+
 # FAULT_EDGE arg0 values: row went down / came back / its drop degree
 # (in+out partitioned edges) changed.
 EDGE_DOWN = 0
@@ -103,23 +109,30 @@ I32 = jnp.int32
 
 def ring_append(ev_buf: jax.Array, ev_pos: jax.Array, mask: jax.Array,
                 tick: jax.Array, code: int, arg0: jax.Array,
-                arg1: jax.Array):
+                arg1: jax.Array, tag: jax.Array | None = None):
     """Append one event per row where `mask` is True.
 
-    ev_buf [N, cap, 4], ev_pos [N] cumulative cursor, mask [N] bool,
-    tick scalar i32, arg0/arg1 [N] i32.  Rows where mask is False keep
-    their slot contents and cursor.  The write is a plain per-row scatter
-    — the ring is tiny and only traced when cfg.record_events is on, so
-    the kernel's one-write-cond discipline (which protects the [N, L]
-    log carries) does not apply here.  Shapes are row-local, so the same
-    code composes with vmap over a leading schedule axis.
+    ev_buf [N, cap, W] (W = EVENT_WIDTH, or EVENT_WIDTH_TAGGED when the
+    ring carries the trace-tag lane), ev_pos [N] cumulative cursor, mask
+    [N] bool, tick scalar i32, arg0/arg1 [N] i32, tag optional [N] i32
+    written into the 5th lane (0 when None; ignored on untagged rings).
+    Rows where mask is False keep their slot contents and cursor.  The
+    write is a plain per-row scatter — the ring is tiny and only traced
+    when cfg.record_events is on, so the kernel's one-write-cond
+    discipline (which protects the [N, L] log carries) does not apply
+    here.  Shapes are row-local, so the same code composes with vmap
+    over a leading schedule axis.
     """
-    n, cap, _ = ev_buf.shape
+    n, cap, width = ev_buf.shape
     node = jnp.arange(n, dtype=I32)
     slot = (ev_pos % cap).astype(I32)
-    row = jnp.stack([jnp.broadcast_to(tick.astype(I32), (n,)),
-                     jnp.full((n,), code, I32),
-                     arg0.astype(I32), arg1.astype(I32)], axis=-1)
+    lanes = [jnp.broadcast_to(tick.astype(I32), (n,)),
+             jnp.full((n,), code, I32),
+             arg0.astype(I32), arg1.astype(I32)]
+    if width == EVENT_WIDTH_TAGGED:
+        lanes.append(jnp.zeros((n,), I32) if tag is None
+                     else jnp.broadcast_to(tag.astype(I32), (n,)))
+    row = jnp.stack(lanes, axis=-1)
     cur = ev_buf[node, slot]
     ev_buf = ev_buf.at[node, slot].set(
         jnp.where(mask[:, None], row, cur))

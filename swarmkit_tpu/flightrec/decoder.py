@@ -8,7 +8,7 @@ import numpy as np
 
 from swarmkit_tpu.flightrec.codes import (
     BLOCK_DEPOSED, BLOCK_LEASE, CODE_NAMES, EDGE_DOWN, EDGE_DROP, EDGE_UP,
-    FAULT_EDGE,
+    EVENT_WIDTH, EVENT_WIDTH_TAGGED, FAULT_EDGE,
 )
 
 _EDGE_NAMES = {EDGE_DOWN: "down", EDGE_UP: "up", EDGE_DROP: "drop"}
@@ -23,6 +23,7 @@ class FlightEvent:
     arg0: int
     arg1: int
     seq: int        # per-row cumulative event number (cursor position)
+    tag: int = 0    # host trace tag (cfg.trace_tags rings; 0 = untagged)
 
     @property
     def name(self) -> str:
@@ -52,33 +53,45 @@ class FlightEvent:
             body = f"{edge}" + (f" degree={a1}" if a0 == EDGE_DROP else "")
         if body is None:
             body = f"arg0={a0} arg1={a1}"
+        if self.tag:
+            body = f"{body} tag={self.tag:#x}"
         return f"t={self.tick:>5} n{self.node:<4} {self.name:<16} {body}"
 
     def to_dict(self) -> dict:
-        return {"tick": self.tick, "node": self.node, "code": self.code,
-                "name": self.name, "arg0": self.arg0, "arg1": self.arg1,
-                "seq": self.seq}
+        d = {"tick": self.tick, "node": self.node, "code": self.code,
+             "name": self.name, "arg0": self.arg0, "arg1": self.arg1,
+             "seq": self.seq}
+        if self.tag:
+            d["tag"] = self.tag
+        return d
 
 
 def decode_rings(ev_buf, ev_pos) -> tuple[list[FlightEvent], np.ndarray]:
     """Drain rings into a (tick, node, seq)-ordered event list.
 
-    ev_buf [N, cap, 4], ev_pos [N] cumulative cursors (device or numpy).
-    Returns (events, dropped[N]) where dropped counts per-row events
-    overwritten before decoding (cursor - capacity, floored at 0).
+    ev_buf [N, cap, 4] (or [N, cap, 5] when the ring carries the
+    trace-tag lane, cfg.trace_tags), ev_pos [N] cumulative cursors
+    (device or numpy).  Returns (events, dropped[N]) where dropped
+    counts per-row events overwritten before decoding (cursor -
+    capacity, floored at 0).
     """
     buf = np.asarray(ev_buf)
     pos = np.asarray(ev_pos)
-    if buf.ndim != 3 or buf.shape[-1] != 4:
-        raise ValueError(f"ev_buf must be [N, cap, 4], got {buf.shape}")
+    if buf.ndim != 3 or buf.shape[-1] not in (EVENT_WIDTH,
+                                              EVENT_WIDTH_TAGGED):
+        raise ValueError(f"ev_buf must be [N, cap, {EVENT_WIDTH}] or "
+                         f"[N, cap, {EVENT_WIDTH_TAGGED}], got {buf.shape}")
+    tagged = buf.shape[-1] == EVENT_WIDTH_TAGGED
     n, cap, _ = buf.shape
     dropped = np.maximum(pos - cap, 0)
     events: list[FlightEvent] = []
     for node in range(n):
         for k in range(int(dropped[node]), int(pos[node])):
-            t, code, a0, a1 = (int(v) for v in buf[node, k % cap])
+            vals = [int(v) for v in buf[node, k % cap]]
+            t, code, a0, a1 = vals[:4]
+            tag = vals[4] if tagged else 0
             events.append(FlightEvent(tick=t, node=node, code=code,
-                                      arg0=a0, arg1=a1, seq=k))
+                                      arg0=a0, arg1=a1, seq=k, tag=tag))
     events.sort(key=lambda e: (e.tick, e.node, e.seq))
     return events, dropped
 

@@ -61,28 +61,38 @@ class FlightRecord:
     # from the time-series ring (telemetry.decode_series), rendered as
     # Perfetto counter tracks by flightrec/export.py
     counters: list = field(default_factory=list)
+    # tick<->wall-clock sync points (flightrec/clock.py ClockSync.to_dict
+    # payload); lets the export remap device tracks onto the host span
+    # timeline instead of the synthetic tick-as-µs axis
+    clock: Optional[dict] = None
 
     def window(self, last: int = 40) -> list[FlightEvent]:
         """The most recent `last` events — the post-mortem view."""
         return self.events[-last:]
 
     def to_dict(self) -> dict:
-        return {"version": RECORD_VERSION, "n": self.n,
-                "trigger": self.trigger, "meta": self.meta,
-                "dropped": list(self.dropped),
-                "events": [e.to_dict() for e in self.events],
-                "spans": self.spans,
-                "counters": self.counters}
+        d = {"version": RECORD_VERSION, "n": self.n,
+             "trigger": self.trigger, "meta": self.meta,
+             "dropped": list(self.dropped),
+             "events": [e.to_dict() for e in self.events],
+             "spans": self.spans,
+             "counters": self.counters}
+        if self.clock is not None:
+            d["clock"] = self.clock
+        return d
 
 
 def capture(state, *, trigger: str = "manual", meta: Optional[dict] = None,
-            tracer=None, obs=None, cfg=None) -> FlightRecord:
+            tracer=None, obs=None, cfg=None, clock=None) -> FlightRecord:
     """Decode `state`'s rings into a FlightRecord and publish metrics.
 
     Pass `cfg` (the SimConfig the state was built with) to also decode a
     telemetry-enabled state's time-series ring into counter rows, so the
     Perfetto export shows latency/throughput series next to the event
-    instants."""
+    instants.  Pass `clock` (a flightrec/clock.py ClockSync fed at the
+    driver's host<->device boundaries) to bake the tick<->wall-clock sync
+    points into the record; its metrics publish alongside the capture
+    counters."""
     from swarmkit_tpu.metrics import catalog
     from swarmkit_tpu.metrics import registry as obs_registry
 
@@ -99,12 +109,20 @@ def capture(state, *, trigger: str = "manual", meta: Optional[dict] = None,
         for name, points in sorted(decode_series(state, cfg).items()):
             counters += [{"name": name, "tick": t, "value": v}
                          for t, v in points]
+    clock_dict = None
+    if clock is not None:
+        clock_dict = clock if isinstance(clock, dict) else clock.to_dict()
     rec = FlightRecord(events=events, dropped=dropped, n=len(dropped),
                        trigger=trigger, meta=dict(meta or {}), spans=spans,
-                       counters=counters)
+                       counters=counters, clock=clock_dict)
     _RECENT.append(rec)
 
     obs = obs or obs_registry.DEFAULT
+    if clock is not None and not isinstance(clock, dict):
+        try:
+            clock.publish(obs)
+        except Exception:
+            pass  # metrics must never cost the capture
     try:
         m_ev = catalog.get(obs, "swarm_flightrec_events_total")
         by_code: dict[str, int] = {}
@@ -134,12 +152,14 @@ def load_record(path: str) -> FlightRecord:
         raise ValueError(f"unsupported flight-record version "
                          f"{d.get('version')!r} in {path}")
     events = [FlightEvent(tick=e["tick"], node=e["node"], code=e["code"],
-                          arg0=e["arg0"], arg1=e["arg1"], seq=e["seq"])
+                          arg0=e["arg0"], arg1=e["arg1"], seq=e["seq"],
+                          tag=e.get("tag", 0))
               for e in d["events"]]
     return FlightRecord(events=events, dropped=list(d["dropped"]),
                         n=int(d["n"]), trigger=d.get("trigger", "manual"),
                         meta=d.get("meta", {}), spans=d.get("spans", []),
-                        counters=d.get("counters", []))
+                        counters=d.get("counters", []),
+                        clock=d.get("clock"))
 
 
 def summarize(rec: FlightRecord, last: int = 20) -> str:

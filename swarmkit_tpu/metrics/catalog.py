@@ -156,6 +156,27 @@ CATALOG: dict[str, MetricSpec] = {
         "counter", "Flight-record captures, by trigger (manual / "
         "dst_violation / scenario_failure).", ("trigger",)),
 
+    # ---- causal trace fusion (flightrec/clock.py, export.py) -------------
+    "swarm_trace_clock_sync_points_total": MetricSpec(
+        "counter", "Tick<->wall-clock sync points folded into captures "
+        "(ClockSync.publish); each is one host observation of the device "
+        "tick counter.", ()),
+    "swarm_trace_clock_tick_us": MetricSpec(
+        "gauge", "Fitted wall-clock microseconds per simulated tick "
+        "(ClockFit slope, Theil-Sen over the sync points).", ()),
+    "swarm_trace_clock_residual_us": MetricSpec(
+        "gauge", "Worst |fit - sample| residual of the tick<->wall-clock "
+        "fit in microseconds; large values mean the tick rate drifted "
+        "within the capture window.", ()),
+    "swarm_trace_flow_events_total": MetricSpec(
+        "counter", "Chrome-trace flow events (ph s/t/f) emitted by the "
+        "Perfetto export, linking host spans to tagged device instants "
+        "(cfg.trace_tags).", ()),
+    "swarm_trace_flow_orphans_total": MetricSpec(
+        "counter", "Trace tags seen on only one side of the export: "
+        "host_only (ring wrap ate the device instant) or device_only "
+        "(span deque evicted the host span).", ("side",)),
+
     # ---- on-device telemetry plane (telemetry/) --------------------------
     "swarm_telemetry_commit_latency_ticks": MetricSpec(
         "histogram", "Propose-to-commit latency in simulated ticks, "

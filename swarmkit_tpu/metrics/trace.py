@@ -138,6 +138,21 @@ class _SpanCtx:
         self._tracer.finish(self._span)
 
 
+def span_trace_tag(span) -> int:
+    """Device-register form of a span id: a nonzero positive int32.
+
+    The trace-tag rings (SimConfig.trace_tags) carry one i32 lane, so the
+    12-hex span id is folded to 31 bits with the sign bit cleared (the
+    kernel treats 0 as "untagged") and floored at 1.  Accepts a Span or a
+    bare span-id string (the cross-process form).  The export layer
+    (flightrec/export.py) applies the same fold to host span ids when
+    matching flow events, so collisions only blur which of two
+    simultaneous in-flight batches an arrow points at — never safety.
+    """
+    sid = span.span_id if isinstance(span, Span) else span
+    return max(int(sid, 16) & 0x7FFFFFFF, 1)
+
+
 # Process-global tracer, mirroring registry.DEFAULT.
 DEFAULT = Tracer()
 

@@ -202,11 +202,18 @@ def step(state: SimState, cfg: SimConfig,
          alive: Optional[jax.Array] = None,
          drop: Optional[jax.Array] = None,
          prop_count=None,
-         payload_fn: Optional[Callable] = None) -> SimState:
+         payload_fn: Optional[Callable] = None,
+         prop_tag=None) -> SimState:
     """Advance every simulated manager by one tick.
 
     alive: [N] bool — False rows are crashed (frozen, no send/receive).
     drop:  [N, N] bool — drop[i, j] drops all i->j traffic this tick.
+
+    prop_tag: optional scalar i32 host trace tag for the fused propose
+    batch (cfg.trace_tags; metrics/trace.py span_trace_tag) — stamped
+    into the [N, PROP_RING] tag ring and carried to the COMMIT_ADVANCE
+    event the proposing span is waiting on.  Ignored when trace_tags is
+    off.
 
     prop_count/payload_fn: optional FUSED dense propose — bit-identical to
     ``step(propose_dense(state, cfg, payload_fn, prop_count, alive), ...)``
@@ -342,18 +349,20 @@ def step(state: SimState, cfg: SimConfig,
     reads_on = cfg.read_batch > 0
     if reads_on:
         from swarmkit_tpu.raft import read as _rd
-        read_regs = _rd.submit(cfg, _rd.regs_from_state(state), alive,
-                               commit)
+        with jax.named_scope("phase_R0_submit"):
+            read_regs = _rd.submit(cfg, _rd.regs_from_state(state), alive,
+                                   commit)
 
     # ---- Phase A: timers + CheckQuorum + campaign start ------------------
     # Liveness splits from membership: crashed rows freeze entirely;
     # non-member rows still receive and respond (a joiner must be able to
     # catch up before its own view says it belongs) but never campaign
     # (etcd promotable()).
-    is_leader = (role == LEADER) & alive
-    elapsed = jnp.where(alive, elapsed + 1, elapsed)
-    contact = jnp.where(alive, state.contact + 1, state.contact)
-    hb_elapsed = jnp.where(is_leader, hb_elapsed + 1, hb_elapsed)
+    with jax.named_scope("phase_A_timers"):
+        is_leader = (role == LEADER) & alive
+        elapsed = jnp.where(alive, elapsed + 1, elapsed)
+        contact = jnp.where(alive, state.contact + 1, state.contact)
+        hb_elapsed = jnp.where(is_leader, hb_elapsed + 1, hb_elapsed)
     # transfer-abuse cooldown (cfg.transfer_cooldown_ticks): count down one
     # tick here; the register re-arms in _progress_b on the row whose
     # TIMEOUT_NOW actually fired, and the request sites
@@ -377,14 +386,15 @@ def step(state: SimState, cfg: SimConfig,
     storage_on = cfg.storage_on and state.sync_mark is not None
     sync_mark = fsync_did = None
     if storage_on:
-        sync_mark = state.sync_mark
-        fs_due = (now % cfg.fsync_lag_ticks) == cfg.fsync_lag_ticks - 1
-        sync_inc = jnp.maximum(state.last - sync_mark, 0)
-        if cfg.fsync_batch > 0:
-            sync_inc = jnp.minimum(sync_inc, cfg.fsync_batch)
-        sync_ok = alive & ~state.fsync_stall & fs_due
-        sync_mark = sync_mark + jnp.where(sync_ok, sync_inc, 0)
-        fsync_did = sync_ok
+        with jax.named_scope("phase_A_fsync"):
+            sync_mark = state.sync_mark
+            fs_due = (now % cfg.fsync_lag_ticks) == cfg.fsync_lag_ticks - 1
+            sync_inc = jnp.maximum(state.last - sync_mark, 0)
+            if cfg.fsync_batch > 0:
+                sync_inc = jnp.minimum(sync_inc, cfg.fsync_batch)
+            sync_ok = alive & ~state.fsync_stall & fs_due
+            sync_mark = sync_mark + jnp.where(sync_ok, sync_inc, 0)
+            fsync_did = sync_ok
 
     # ---- role-sparse progress (cfg.active_rows_on): the active-row set --
     # Only rows whose node is a leader or candidate ever MUTATE their own
@@ -470,6 +480,7 @@ def step(state: SimState, cfg: SimConfig,
         last_term = jnp.where(prop_ok & (prop_cnt > 0), state.term,
                               last_term)
 
+    @jax.named_scope("phases_ABC_progress")
     def _progress_a(rows, dense, term=term, vote=vote, role=role, lead=lead,
                     elapsed=elapsed, contact=contact,
                     hb_elapsed=hb_elapsed, timeout=timeout, pre=pre,
@@ -1520,6 +1531,7 @@ def step(state: SimState, cfg: SimConfig,
         _b_in = (app_at, app_prev, app_term_box, snp_at, snp_term_box,
                  hbr_at_box, hbr_term_box)
 
+    @jax.named_scope("phase_D_progress")
     def _progress_b(rows, dense, match=match, next_=next_,
                     recent_active=recent_active, probing=probing,
                     tn_at=tn_at, tn_term=tn_term, tn_from=tn_from):
@@ -1853,9 +1865,10 @@ def step(state: SimState, cfg: SimConfig,
         hbr_at_box = _ob["hbr_at"]
 
     # Commit fold, outside the segments (mci_term is a log read).
-    mci_term = _term_own(cfg, log_term, snap_idx, snap_term, last, mci)
-    can_commit = is_leader & (mci > commit) & (mci_term == term)
-    commit = jnp.where(can_commit, mci, commit)
+    with jax.named_scope("phase_D_commit_fold"):
+        mci_term = _term_own(cfg, log_term, snap_idx, snap_term, last, mci)
+        can_commit = is_leader & (mci > commit) & (mci_term == term)
+        commit = jnp.where(can_commit, mci, commit)
 
     # ---- Phase R1: lease renewal + ReadIndex stamping (raft/read/) -------
     # A quorum of member acks in one tick both renews the tick-clock lease
@@ -1864,16 +1877,17 @@ def step(state: SimState, cfg: SimConfig,
     # commits), authorizes stamping the pending batch with the
     # just-updated commit index.
     if reads_on:
-        rd_nack = _ob["rd_nack"]
-        rd_is_leader = (role == LEADER) & alive
-        rd_q_ok = rd_is_leader & (rd_nack >= quorum_row)
-        rd_cterm_ok = (commit > 0) \
-            & (_term_own(cfg, log_term, snap_idx, snap_term, last,
-                         commit) == term)
-        read_regs, rd_confirm = _rd.stamp(
-            cfg, read_regs, alive=alive, role=role, lead=lead, term=term,
-            commit=commit, commit_term_ok=rd_cterm_ok, q_ok=rd_q_ok,
-            transferee=transferee, now=now, drop=drop)
+        with jax.named_scope("phase_R1_stamp"):
+            rd_nack = _ob["rd_nack"]
+            rd_is_leader = (role == LEADER) & alive
+            rd_q_ok = rd_is_leader & (rd_nack >= quorum_row)
+            rd_cterm_ok = (commit > 0) \
+                & (_term_own(cfg, log_term, snap_idx, snap_term, last,
+                             commit) == term)
+            read_regs, rd_confirm = _rd.stamp(
+                cfg, read_regs, alive=alive, role=role, lead=lead,
+                term=term, commit=commit, commit_term_ok=rd_cterm_ok,
+                q_ok=rd_q_ok, transferee=transferee, now=now, drop=drop)
 
     # ---- Phase E: apply + checksum accumulation + conf activation --------
     # Entries (applied, new_applied] are summed in place via the slot->index
@@ -1883,69 +1897,76 @@ def step(state: SimState, cfg: SimConfig,
     # is clamped AT the first conf entry so at most one membership flip
     # lands per row per tick (order within a batch is thereby trivial; the
     # propose-side one-in-flight gate makes >1 conf per window rare anyway).
-    base_applied = jnp.minimum(commit, applied + cfg.apply_batch)
-    base_applied = jnp.where(alive, base_applied, applied)  # crashed: frozen
-    if cfg.tiled:
-        # Per-row gather window instead of a shared chunk band: each row's
-        # apply window (applied, base_applied] is at most apply_batch wide
-        # BY CONSTRUCTION, so a [N, apply_batch] take_along_axis covers it
-        # exactly — no straggler fallback cond needed, and keeping the
-        # buffer out of extra conditionals lets the scan keep it in place
-        # (every lax.cond consuming the log carry risks a defensive
-        # full-capacity copy on the CPU backend).  The U32 checksum sum is
-        # order-independent (modular add), so summing in index order
-        # matches the full pass bit-for-bit.
-        aspan = jnp.arange(cfg.apply_batch, dtype=I32)[None, :]
-        aidx = applied[:, None] + 1 + aspan                     # [N, V]
-        am_e = aidx <= base_applied[:, None]
-    if static_m:
-        # No conf entries can exist (propose masks the tag bit and
-        # propose_conf is a trace-time error): apply the whole batch.
-        new_applied = base_applied
-
-        def _apply_full(ld):
-            own_idx = _idx_at_slots(cfg, last)                   # [N, L]
-            app_mask = (own_idx > applied[:, None]) \
-                & (own_idx <= base_applied[:, None])
-            return jnp.sum(jnp.where(app_mask, _entry_chk(own_idx, ld),
-                                     U32(0)), axis=1, dtype=U32)
-
+    with jax.named_scope("phase_E_apply"):
+        base_applied = jnp.minimum(commit, applied + cfg.apply_batch)
+        base_applied = jnp.where(alive, base_applied, applied)  # crashed:
+        #                                                         frozen
         if cfg.tiled:
-            avals = jnp.take_along_axis(log_data, _slot(cfg, aidx), axis=1)
-            chk_inc = jnp.sum(
-                jnp.where(am_e, _entry_chk(aidx, avals), U32(0)),
-                axis=1, dtype=U32)
-        else:
-            chk_inc = _apply_full(log_data)
-    else:
-        def _apply_full(ld):
-            own_idx = _idx_at_slots(cfg, last)                   # [N, L]
-            win_mask = (own_idx > applied[:, None]) \
-                & (own_idx <= base_applied[:, None])
-            conf_in_win = win_mask & _is_conf(ld)
-            fc = jnp.min(jnp.where(conf_in_win, own_idx, big), axis=1)
-            na = jnp.minimum(base_applied, jnp.where(fc < big, fc, big))
-            app_mask = win_mask & (own_idx <= na[:, None])
-            return (jnp.sum(jnp.where(app_mask, _entry_chk(own_idx, ld),
-                                      U32(0)), axis=1, dtype=U32), fc)
+            # Per-row gather window instead of a shared chunk band: each
+            # row's apply window (applied, base_applied] is at most
+            # apply_batch wide BY CONSTRUCTION, so a [N, apply_batch]
+            # take_along_axis covers it exactly — no straggler fallback
+            # cond needed, and keeping the buffer out of extra
+            # conditionals lets the scan keep it in place (every lax.cond
+            # consuming the log carry risks a defensive full-capacity
+            # copy on the CPU backend).  The U32 checksum sum is
+            # order-independent (modular add), so summing in index order
+            # matches the full pass bit-for-bit.
+            aspan = jnp.arange(cfg.apply_batch, dtype=I32)[None, :]
+            aidx = applied[:, None] + 1 + aspan                 # [N, V]
+            am_e = aidx <= base_applied[:, None]
+        if static_m:
+            # No conf entries can exist (propose masks the tag bit and
+            # propose_conf is a trace-time error): apply the whole batch.
+            new_applied = base_applied
 
-        if cfg.tiled:
-            avals = jnp.take_along_axis(log_data, _slot(cfg, aidx), axis=1)
-            fc = jnp.min(jnp.where(am_e & _is_conf(avals), aidx, big),
-                         axis=1)
-            na = jnp.minimum(base_applied, jnp.where(fc < big, fc, big))
-            chk_inc = jnp.sum(
-                jnp.where(am_e & (aidx <= na[:, None]),
-                          _entry_chk(aidx, avals), U32(0)),
-                axis=1, dtype=U32)
-            first_conf = fc
+            def _apply_full(ld):
+                own_idx = _idx_at_slots(cfg, last)               # [N, L]
+                app_mask = (own_idx > applied[:, None]) \
+                    & (own_idx <= base_applied[:, None])
+                return jnp.sum(jnp.where(app_mask, _entry_chk(own_idx, ld),
+                                         U32(0)), axis=1, dtype=U32)
+
+            if cfg.tiled:
+                avals = jnp.take_along_axis(log_data, _slot(cfg, aidx),
+                                            axis=1)
+                chk_inc = jnp.sum(
+                    jnp.where(am_e, _entry_chk(aidx, avals), U32(0)),
+                    axis=1, dtype=U32)
+            else:
+                chk_inc = _apply_full(log_data)
         else:
-            chk_inc, first_conf = _apply_full(log_data)
-        has_conf = first_conf < big
-        new_applied = jnp.minimum(base_applied,
-                                  jnp.where(has_conf, first_conf, big))
-    apply_chk = apply_chk + chk_inc
-    applied = new_applied
+            def _apply_full(ld):
+                own_idx = _idx_at_slots(cfg, last)               # [N, L]
+                win_mask = (own_idx > applied[:, None]) \
+                    & (own_idx <= base_applied[:, None])
+                conf_in_win = win_mask & _is_conf(ld)
+                fc = jnp.min(jnp.where(conf_in_win, own_idx, big), axis=1)
+                na = jnp.minimum(base_applied,
+                                 jnp.where(fc < big, fc, big))
+                app_mask = win_mask & (own_idx <= na[:, None])
+                return (jnp.sum(jnp.where(app_mask,
+                                          _entry_chk(own_idx, ld),
+                                          U32(0)), axis=1, dtype=U32), fc)
+
+            if cfg.tiled:
+                avals = jnp.take_along_axis(log_data, _slot(cfg, aidx),
+                                            axis=1)
+                fc = jnp.min(jnp.where(am_e & _is_conf(avals), aidx, big),
+                             axis=1)
+                na = jnp.minimum(base_applied, jnp.where(fc < big, fc, big))
+                chk_inc = jnp.sum(
+                    jnp.where(am_e & (aidx <= na[:, None]),
+                              _entry_chk(aidx, avals), U32(0)),
+                    axis=1, dtype=U32)
+                first_conf = fc
+            else:
+                chk_inc, first_conf = _apply_full(log_data)
+            has_conf = first_conf < big
+            new_applied = jnp.minimum(base_applied,
+                                      jnp.where(has_conf, first_conf, big))
+        apply_chk = apply_chk + chk_inc
+        applied = new_applied
 
     if not static_m:
         # Decode + apply the (single) conf entry at new_applied.
@@ -1982,45 +2003,48 @@ def step(state: SimState, cfg: SimConfig,
     # expiry are refused back to the client (READ_BLOCKED accounting —
     # the stale-leader path the DST adversary exercises).
     if reads_on:
-        read_regs, rd_served, rd_srv_cnt, rd_blocked, rd_blk_cnt, \
-            rd_expired = _rd.settle(
-                cfg, read_regs, alive=alive, applied=applied, role=role,
-                was_leader=(state.role == LEADER), now=now,
-                prev_lease_until=state.lease_until)
+        with jax.named_scope("phase_R2_settle"):
+            read_regs, rd_served, rd_srv_cnt, rd_blocked, rd_blk_cnt, \
+                rd_expired = _rd.settle(
+                    cfg, read_regs, alive=alive, applied=applied, role=role,
+                    was_leader=(state.role == LEADER), now=now,
+                    prev_lease_until=state.lease_until)
 
     # ---- Phase F: compaction (ring-pressure driven) ----------------------
     # Compact to applied-keep (mirroring LogEntriesForSlowFollowers=500)
     # when the ring is running out of writable headroom. The checksum at the
     # new watermark is apply_chk minus the contributions of the entries
     # still ahead of it (uint32 wrap-safe).
-    pressure = (last - snap_idx) > (cfg.log_len - 2 * cfg.max_props - 1)
-    new_snap = jnp.maximum(snap_idx, applied - cfg.keep)
-    do_compact = pressure & (new_snap > snap_idx) & alive
-    nst = _term_own(cfg, log_term, snap_idx, snap_term, last, new_snap)
+    with jax.named_scope("phase_F_compact"):
+        pressure = (last - snap_idx) > (cfg.log_len - 2 * cfg.max_props - 1)
+        new_snap = jnp.maximum(snap_idx, applied - cfg.keep)
+        do_compact = pressure & (new_snap > snap_idx) & alive
+        nst = _term_own(cfg, log_term, snap_idx, snap_term, last, new_snap)
 
-    def _ahead_full(ld):
-        own_idx = _idx_at_slots(cfg, last)                       # [N, L]
-        ahead = (own_idx > new_snap[:, None]) & (own_idx <= applied[:, None])
-        return jnp.sum(jnp.where(ahead, _entry_chk(own_idx, ld), U32(0)),
-                       axis=1, dtype=U32)
+        def _ahead_full(ld):
+            own_idx = _idx_at_slots(cfg, last)                   # [N, L]
+            ahead = (own_idx > new_snap[:, None]) \
+                & (own_idx <= applied[:, None])
+            return jnp.sum(jnp.where(ahead, _entry_chk(own_idx, ld),
+                                     U32(0)), axis=1, dtype=U32)
 
-    if cfg.tiled:
-        # Per-row gather window, same trade as the apply pass: the span
-        # (new_snap, applied] is at most `keep` wide by construction
-        # (new_snap >= applied - keep on every row), so [N, keep] indices
-        # cover it exactly with no fallback cond.
-        fspan = jnp.arange(max(cfg.keep, 1), dtype=I32)[None, :]
-        fidx = new_snap[:, None] + 1 + fspan                     # [N, keep]
-        fvals = jnp.take_along_axis(log_data, _slot(cfg, fidx), axis=1)
-        ahead_sum = jnp.sum(
-            jnp.where(fidx <= applied[:, None], _entry_chk(fidx, fvals),
-                      U32(0)), axis=1, dtype=U32)
-    else:
-        ahead_sum = _ahead_full(log_data)
-    nsc = apply_chk - ahead_sum
-    snap_term = jnp.where(do_compact, nst, snap_term)
-    snap_chk = jnp.where(do_compact, nsc, snap_chk)
-    snap_idx = jnp.where(do_compact, new_snap, snap_idx)
+        if cfg.tiled:
+            # Per-row gather window, same trade as the apply pass: the
+            # span (new_snap, applied] is at most `keep` wide by
+            # construction (new_snap >= applied - keep on every row), so
+            # [N, keep] indices cover it exactly with no fallback cond.
+            fspan = jnp.arange(max(cfg.keep, 1), dtype=I32)[None, :]
+            fidx = new_snap[:, None] + 1 + fspan                # [N, keep]
+            fvals = jnp.take_along_axis(log_data, _slot(cfg, fidx), axis=1)
+            ahead_sum = jnp.sum(
+                jnp.where(fidx <= applied[:, None], _entry_chk(fidx, fvals),
+                          U32(0)), axis=1, dtype=U32)
+        else:
+            ahead_sum = _ahead_full(log_data)
+        nsc = apply_chk - ahead_sum
+        snap_term = jnp.where(do_compact, nst, snap_term)
+        snap_chk = jnp.where(do_compact, nsc, snap_chk)
+        snap_idx = jnp.where(do_compact, new_snap, snap_idx)
     if storage_on:
         # a compacted-to snapshot is durable by construction (compaction
         # only discards APPLIED entries, and writing the snapshot is the
@@ -2125,6 +2149,54 @@ def step(state: SimState, cfg: SimConfig,
             jnp.sum(commit - state.commit),
             jnp.sum(applied - state.applied)])
 
+    # Causal trace tags (cfg.trace_tags; ISSUE 17): derive the per-row
+    # tags the tagged _emit calls below stamp into the event ring's 5th
+    # lane.  The commit tag is read off the propose-batch tag ring — the
+    # freshest still-live tagged batch whose index range intersects this
+    # tick's commit advance (the same fold window the telemetry commit
+    # histogram uses, including this tick's fused stamp so an instant-
+    # wire same-tick commit still links) — and the read tag off the [N]
+    # read_tag register, cleared on the kernel's own closed-loop refill
+    # (device-generated batches have no host span to link to).  Python-
+    # gated like both donor planes, so a tags-off program is structurally
+    # identical to a build without the subsystem.
+    tt_fields = {}
+    commit_tag = read_tag_now = None
+    if cfg.trace_tags and state.tel_prop_tag is not None:
+        from swarmkit_tpu.telemetry import series as _ts
+        ttag = state.tel_prop_tag
+        tidx = state.tel_prop_idx
+        tcnt = state.tel_prop_cnt
+        ttick = state.tel_prop_tick
+        if fused_prop:
+            ptag = jnp.zeros((n,), I32) if prop_tag is None else \
+                jnp.broadcast_to(jnp.asarray(prop_tag, I32), (n,))
+            ts_ = now % _ts.PROP_RING
+            ttag = _ts.col_set(ttag, ts_, jnp.where(prop_ok, ptag, 0))
+            tidx = _ts.col_set(tidx, ts_,
+                               jnp.where(prop_ok, prop_last0 + 1, NONE))
+            tcnt = _ts.col_set(tcnt, ts_,
+                               jnp.where(prop_ok, prop_cnt, 0).astype(I32))
+            ttick = _ts.col_set(ttick, ts_,
+                                jnp.where(prop_ok, now, NONE).astype(I32))
+        tlo = jnp.maximum(tidx, state.commit[:, None] + 1)
+        thi = jnp.minimum(tidx + tcnt - 1, commit[:, None])
+        tsel = can_commit[:, None] & (tidx != NONE) & (ttick >= 0) \
+            & (now - ttick < _ts.PROP_RING) & (thi >= tlo) & (ttag != 0)
+        tbest = jnp.argmax(jnp.where(tsel, ttick, -1), axis=1)
+        commit_tag = jnp.where(
+            jnp.any(tsel, axis=1),
+            jnp.take_along_axis(ttag, tbest[:, None], axis=1)[:, 0],
+            0).astype(I32)
+        # step-down wipe mirrors the telemetry batch ring's: a regained
+        # leadership must not link another leader's entries to this tag
+        ttag = jnp.where(is_leader[:, None], ttag, 0)
+        tt_fields = dict(tel_prop_tag=ttag)
+        if reads_on and state.read_tag is not None:
+            tt_refill = alive & (state.read_pend == 0)
+            read_tag_now = jnp.where(tt_refill, 0, state.read_tag)
+            tt_fields["read_tag"] = read_tag_now
+
     # Flight recorder (cfg.record_events; flightrec/codes.py owns the event
     # vocabulary): append coded (tick, code, arg0, arg1) rows into the
     # per-row event ring from the masks this tick already computed.  Like
@@ -2140,10 +2212,10 @@ def step(state: SimState, cfg: SimConfig,
         ev_buf, ev_pos = state.ev_buf, state.ev_pos
         zero = jnp.zeros((n,), I32)
 
-        def _emit(mask, code, a0, a1):
+        def _emit(mask, code, a0, a1, tag=None):
             nonlocal ev_buf, ev_pos
             ev_buf, ev_pos = _fc.ring_append(ev_buf, ev_pos, mask, now,
-                                             code, a0, a1)
+                                             code, a0, a1, tag=tag)
 
         # fault edges: crash/heal transitions + partition-degree changes,
         # detected against the PREVIOUS tick's inputs carried in ev_*
@@ -2176,7 +2248,7 @@ def step(state: SimState, cfg: SimConfig,
         _emit(resp_reject, _fc.APPEND_REJECT, src, reject_hint)
         _emit(do_restore, _fc.SNAPSHOT_RESTORE, src, snap_idx)
         _emit(commit > state.commit, _fc.COMMIT_ADVANCE, commit,
-              commit - state.commit)
+              commit - state.commit, tag=commit_tag)
         if storage_on:
             _emit(sync_mark > state.sync_mark, _fc.FSYNC_ADVANCE,
                   sync_mark, sync_mark - state.sync_mark)
@@ -2192,7 +2264,8 @@ def step(state: SimState, cfg: SimConfig,
             # read lifecycle (masks from phases R1/R2): serves carry the
             # index actually observed, refusals their reason, expiries the
             # count of client reads they bounced
-            _emit(rd_served, _fc.READ_SERVED, applied, rd_srv_cnt)
+            _emit(rd_served, _fc.READ_SERVED, applied, rd_srv_cnt,
+                  tag=read_tag_now)
             _emit(rd_blocked, _fc.READ_BLOCKED, rd_blk_cnt,
                   jnp.where(rd_expired, _fc.BLOCK_LEASE,
                             _fc.BLOCK_DEPOSED).astype(I32))
@@ -2323,6 +2396,7 @@ def step(state: SimState, cfg: SimConfig,
         **sp_fields,
         **ev_fields,
         **tel_fields,
+        **tt_fields,
         **rd_fields,
         **boxes,
     )
@@ -2347,11 +2421,12 @@ def _leader_ok(state: SimState, cfg: SimConfig, alive=None):
 
 
 def propose(state: SimState, cfg: SimConfig, payloads: jax.Array,
-            count, alive=None) -> SimState:
+            count, alive=None, tag=None) -> SimState:
     """Append up to `count` payload entries to every node currently acting
     as leader (clients talk to whoever claims leadership; only a real
     leader's entries can ever commit). payloads: [max_props] uint32
-    (bit 31 is reserved for conf entries and masked off)."""
+    (bit 31 is reserved for conf entries and masked off).  `tag` is an
+    optional scalar host trace tag for this batch (cfg.trace_tags)."""
     n = cfg.n
     node = jnp.arange(n, dtype=I32)
     # a transferring leader rejects proposals (vendor stepLeader MsgProp:
@@ -2385,13 +2460,18 @@ def propose(state: SimState, cfg: SimConfig, payloads: jax.Array,
             tel_prop_tick=_ts.col_set(
                 state.tel_prop_tick, bs,
                 jnp.where(ok, state.tick, NONE).astype(I32)))
+        if cfg.trace_tags and state.tel_prop_tag is not None:
+            tg = jnp.zeros((n,), I32) if tag is None else \
+                jnp.broadcast_to(jnp.asarray(tag, I32), (n,))
+            tel_fields["tel_prop_tag"] = _ts.col_set(
+                state.tel_prop_tag, bs, jnp.where(ok, tg, 0))
     return dataclasses.replace(state, log_term=log_term, log_data=log_data,
                                last=new_last, match=match, **tel_fields)
 
 
 def propose_dense(state: SimState, cfg: SimConfig,
                   payload_fn: Callable[[jax.Array, jax.Array], jax.Array],
-                  count, alive=None) -> SimState:
+                  count, alive=None, tag=None) -> SimState:
     """Gather/scatter-free propose for the benchmark hot path: payloads are
     generated ON DEVICE as payload_fn(tick, k) (k = 0..count-1, uint32
     result), written via the slot->index map as elementwise [N, L] masked
@@ -2464,6 +2544,11 @@ def propose_dense(state: SimState, cfg: SimConfig,
             tel_prop_tick=_ts.col_set(
                 state.tel_prop_tick, bs,
                 jnp.where(ok, state.tick, NONE).astype(I32)))
+        if cfg.trace_tags and state.tel_prop_tag is not None:
+            tg = jnp.zeros((n,), I32) if tag is None else \
+                jnp.broadcast_to(jnp.asarray(tag, I32), (n,))
+            tel_fields["tel_prop_tag"] = _ts.col_set(
+                state.tel_prop_tag, bs, jnp.where(ok, tg, 0))
     return dataclasses.replace(state, log_term=log_term, log_data=log_data,
                                last=new_last, match=match, **tel_fields)
 

@@ -166,12 +166,16 @@ class KernelObs:
     _DUR_NAME = "swarm_kernel_durable_commit_advance_total"
     _LAG_NAME = "swarm_kernel_fsync_lag"
 
-    def __init__(self, obs=None) -> None:
+    def __init__(self, obs=None, clock_sync=None) -> None:
         from swarmkit_tpu.metrics import catalog as obs_catalog
         from swarmkit_tpu.metrics import registry as obs_registry
         from swarmkit_tpu.metrics import scrape as obs_scrape
 
         self.obs = obs or obs_registry.DEFAULT
+        # Optional flightrec/clock.py ClockSync: publish() already pays a
+        # blocking device_get, so each publish doubles as a tick<->wall
+        # sync point for the causal trace export (flightrec/export.py).
+        self.clock_sync = clock_sync
         self._m_tick = obs_catalog.get(self.obs, "swarm_kernel_tick_seconds")
         self._m_stats = [obs_catalog.get(self.obs, n)
                          for n in self._STAT_NAMES]
@@ -188,6 +192,8 @@ class KernelObs:
         """Returns the cumulative stats as a dict (empty when the state
         carries none, i.e. cfg.collect_stats was off and the read path
         is not compiled in)."""
+        if self.clock_sync is not None:
+            sync_point(self.clock_sync, state)
         out: dict[str, int] = {}
         if state.stats is not None:
             cur = [int(v) for v in jax.device_get(state.stats)]
@@ -219,8 +225,23 @@ class KernelObs:
         return out
 
 
+def sync_point(clock, state: SimState) -> int:
+    """Record one (tick, host_ns) clock-correlation sample on `clock`
+    (flightrec/clock.py ClockSync) and return the observed tick.
+
+    The device_get of state.tick is a genuine host<->device sync: when
+    it returns, the device HAS reached that tick, so "now" bounds it
+    from above.  Drivers call this at their natural exchange boundaries
+    (after a run_ticks burst, around propose/read submission) — two or
+    three points across a run are enough for the Theil-Sen fit to remap
+    the flight-ring tracks onto the host span timeline."""
+    tick = int(jax.device_get(state.tick))
+    clock.add(tick)
+    return tick
+
+
 def submit_reads(state: SimState, cfg: SimConfig, count: int,
-                 rows=None) -> SimState:
+                 rows=None, tag=None) -> SimState:
     """Enqueue a linearizable read batch of `count` ops on the selected
     rows (all rows when `rows` is None), step-compatible: the next
     `step()` stamps the batch with a ReadIndex (or serves it under a
@@ -231,6 +252,11 @@ def submit_reads(state: SimState, cfg: SimConfig, count: int,
     the submit-time linearizability goal — max(commit) anywhere — is
     recorded for the LINEARIZABLE_READ oracle.  Requires
     cfg.read_batch > 0 so the read registers are compiled in.
+
+    `tag` is an optional scalar host trace tag for this batch
+    (cfg.trace_tags; metrics/trace.py span_trace_tag): the READ_SERVED
+    event that settles it carries the tag, linking the device instant
+    back to the submitting host span in the Perfetto export.
     """
     if state.read_pend is None:
         raise ValueError("read path is off (SimConfig.read_batch == 0); "
@@ -239,11 +265,17 @@ def submit_reads(state: SimState, cfg: SimConfig, count: int,
         else jnp.zeros((cfg.n,), bool).at[jnp.asarray(rows)].set(True)
     open_ = sel & (state.read_pend == 0)
     goal = jnp.max(state.commit)
+    tag_fields = {}
+    if cfg.trace_tags and state.read_tag is not None:
+        tg = jnp.asarray(0 if tag is None else tag, I32)
+        tag_fields = dict(
+            read_tag=jnp.where(open_, tg, state.read_tag))
     return dataclasses.replace(
         state,
         read_pend=jnp.where(open_, jnp.asarray(count, I32), state.read_pend),
         read_goal=jnp.where(open_, goal, state.read_goal),
-        read_idx=jnp.where(open_, jnp.asarray(NONE, I32), state.read_idx))
+        read_idx=jnp.where(open_, jnp.asarray(NONE, I32), state.read_idx),
+        **tag_fields)
 
 
 def reads_served(state: SimState) -> jax.Array:

@@ -185,6 +185,18 @@ class SimConfig:
     collect_telemetry: bool = False
     telemetry_window: int = 64   # ring columns (stride-wide buckets) kept
     telemetry_stride: int = 8    # ticks aggregated per ring column
+    # Causal trace tags (ISSUE 17): carry a host-assigned trace tag per
+    # propose batch ([N, PROP_RING] alongside the telemetry batch ring)
+    # and per read batch ([N]), widen the flight-recorder event rows to
+    # (tick, code, arg0, arg1, tag), and stamp the tag into the
+    # COMMIT_ADVANCE / READ_SERVED events the host span is waiting on —
+    # the device half of the flow-linked Perfetto export
+    # (flightrec/export.py).  Requires both donor planes: the telemetry
+    # batch ring locates which committed indexes belong to which propose
+    # batch, the event ring carries the stamped instants.  Off by
+    # default and Python-gated like both donors, so a tags-off program
+    # stays bit-identical to a build without the subsystem.
+    trace_tags: bool = False
     # Optional steady-state latency SLO for the DST oracle: when > 0 (and
     # collect_telemetry is on), dst/invariants.py raises SLO_COMMIT_P99
     # if the device-computed p99 propose->commit latency bucket edge
@@ -329,6 +341,14 @@ class SimConfig:
         return self.fsync_lag_ticks > 0
 
     @property
+    def event_width(self) -> int:
+        """Flight-ring row width: the base (tick, code, arg0, arg1)
+        vocabulary, plus the trace-tag lane when cfg.trace_tags."""
+        from swarmkit_tpu.flightrec import codes as _fc
+        return _fc.EVENT_WIDTH_TAGGED if self.trace_tags \
+            else _fc.EVENT_WIDTH
+
+    @property
     def has_vote_guard(self) -> bool:
         """True when the persisted-vote registers (vg_vote, vg_term) are
         carried: either the standalone PR-15 defense knob or the full
@@ -402,6 +422,12 @@ class SimConfig:
                 raise ValueError(
                     f"telemetry_window={self.telemetry_window} is too "
                     f"small to hold a useful history; use >= 8 columns")
+        if self.trace_tags and not (self.record_events
+                                    and self.collect_telemetry):
+            raise ValueError(
+                "trace_tags needs both donor planes: set "
+                "record_events=True (tagged event ring) and "
+                "collect_telemetry=True (propose-batch ring)")
         if self.slo_p99_commit_ticks < 0:
             raise ValueError(f"slo_p99_commit_ticks must be >= 0, got "
                              f"{self.slo_p99_commit_ticks}")
@@ -649,6 +675,15 @@ class SimState:
     tel_prop_idx: Optional[jax.Array] = None
     tel_prop_cnt: Optional[jax.Array] = None
     tel_prop_tick: Optional[jax.Array] = None
+    # ---- causal trace tags (cfg.trace_tags; ISSUE 17) -------------------
+    # tel_prop_tag [N, PROP_RING] rides the propose-batch ring: slot
+    # t % PROP_RING holds the host trace tag of the batch proposed at
+    # tick t (0 = untagged / device-generated).  read_tag [N] holds the
+    # tag of the in-flight read batch (submit_reads(tag=...); cleared to
+    # 0 on the kernel's own closed-loop refill).  Both feed the tagged
+    # 5th lane of ev_buf.
+    tel_prop_tag: Optional[jax.Array] = None
+    read_tag: Optional[jax.Array] = None
     tel_elect_start: Optional[jax.Array] = None
     tel_read_submit: Optional[jax.Array] = None
     tel_commit_hist: Optional[jax.Array] = None
@@ -779,7 +814,7 @@ def init_state(cfg: SimConfig,
                 fsync_stall=jnp.zeros((n,), jnp.bool_),
                 snap_bad=jnp.zeros((n,), jnp.bool_))
            if cfg.storage_on else {}),
-        **(dict(ev_buf=z(n, cfg.event_ring, 4), ev_pos=z(n),
+        **(dict(ev_buf=z(n, cfg.event_ring, cfg.event_width), ev_pos=z(n),
                 ev_alive=jnp.ones((n,), jnp.bool_), ev_drop=z(n))
            if cfg.record_events else {}),
         **(dict(read_pend=z(n), read_goal=z(n),
@@ -788,7 +823,17 @@ def init_state(cfg: SimConfig,
                 read_srv_idx=z(n), read_srv_goal=z(n))
            if cfg.read_batch > 0 else {}),
         **(_telemetry_init(cfg) if cfg.collect_telemetry else {}),
+        **(_trace_tag_init(cfg) if cfg.trace_tags else {}),
     )
+
+
+def _trace_tag_init(cfg: SimConfig) -> dict:
+    from swarmkit_tpu.telemetry import series as tel
+    n, i32 = cfg.n, jnp.int32
+    out = dict(tel_prop_tag=jnp.zeros((n, tel.PROP_RING), i32))
+    if cfg.read_batch > 0:
+        out["read_tag"] = jnp.zeros((n,), i32)
+    return out
 
 
 def _telemetry_init(cfg: SimConfig) -> dict:

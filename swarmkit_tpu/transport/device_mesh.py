@@ -79,6 +79,11 @@ class DeviceMeshNet(Network):
         self._exchange_cache: dict = {}
         self.device_flushes = 0
         self.device_messages = 0
+        # Optional flightrec/clock.py ClockSync: every device exchange is
+        # a host<->device boundary, so each flush records one sync point
+        # on the (device_flushes, host_ns) axes — this wire has no sim
+        # tick, the flush counter is its monotone device-time analog.
+        self.clock_sync = None
         self.obs = obs or obs_registry.DEFAULT
         obs_catalog.get(self.obs, "swarm_transport_mailbox_depth") \
             .set_function(lambda: float(
@@ -291,6 +296,10 @@ class DeviceMeshNet(Network):
         d_lens = np.asarray(d_lens)
         self._m_exchange.observe(time.perf_counter() - t0)
         self.device_flushes += 1
+        if self.clock_sync is not None:
+            # np.asarray above blocked on the exchange, so "now" really
+            # is when the device finished flush #device_flushes
+            self.clock_sync.add(self.device_flushes)
         self.device_messages += len(entries)
         self._m_flushes.inc()
         self._m_messages.inc(len(entries))

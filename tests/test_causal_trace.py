@@ -1,0 +1,494 @@
+"""Causal cross-layer tracing (ISSUE 17): trace-tag propagation, clock
+correlation, flow-linked export, and the console/profiler tools.
+
+The load-bearing guarantees:
+
+- ``trace_tags=False`` (and tags-on-but-untagged) leaves the kernel's
+  consensus outputs bit-identical on the sync, mailbox, and sharded
+  wires — the tag plane is Python-gated like both donor planes.
+- A tagged propose batch surfaces as a tagged COMMIT_ADVANCE event; a
+  tagged read batch as a tagged READ_SERVED event; the export joins
+  those to host spans carrying the same tag with Chrome flow events
+  (``ph`` s/t/f) that validate clean.
+- Clock correlation degrades gracefully: zero sync points -> tick axis,
+  one point -> degenerate anchored fit, a backwards host clock -> the
+  robust fit ignores the non-positive pairwise slopes.
+- A tag on only one side (ring wrap, evicted span) annotates an orphan
+  instead of crashing or emitting a dangling flow.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from swarmkit_tpu.flightrec import (
+    COMMIT_ADVANCE, READ_SERVED, ClockFit, ClockSync, capture, decode_state,
+    fit_from, load_record, save_record, to_chrome_trace,
+    validate_chrome_trace,
+)
+from swarmkit_tpu.flightrec.codes import CODE_NAMES
+from swarmkit_tpu.metrics.trace import Tracer, span_trace_tag
+from swarmkit_tpu.raft.sim import (
+    SimConfig, init_state, run_ticks, run_until_leader, step, submit_reads,
+)
+from swarmkit_tpu.raft.sim.kernel import propose_dense
+from swarmkit_tpu.raft.sim.run import _payload_at
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+
+I32 = jnp.int32
+
+PROP_TAG = 0x517A
+READ_TAG = 0x9E3
+
+
+def small_cfg(**kw):
+    base = dict(n=5, log_len=64, window=8, apply_batch=16, max_props=8,
+                keep=4, election_tick=10, seed=77)
+    base.update(kw)
+    return SimConfig(**base)
+
+
+def tagged_cfg(**kw):
+    return small_cfg(record_events=True, collect_telemetry=True,
+                     trace_tags=True, read_batch=4, **kw)
+
+
+def common_fields(a, b):
+    """Leaf names present (non-None) on both states."""
+    import dataclasses
+    names = []
+    for f in dataclasses.fields(a):
+        if getattr(a, f.name) is not None and getattr(b, f.name) is not None:
+            names.append(f.name)
+    return names
+
+
+def assert_common_bits_equal(a, b):
+    for name in common_fields(a, b):
+        va, vb = np.asarray(getattr(a, name)), np.asarray(getattr(b, name))
+        if name == "ev_buf" and va.shape != vb.shape:
+            # width-4 vs width-5 rings: the shared lanes must match and
+            # the extra tag lane must be all-zero (nothing was tagged)
+            w = min(va.shape[-1], vb.shape[-1])
+            wide = va if va.shape[-1] > w else vb
+            assert (va[..., :w] == vb[..., :w]).all(), "ev_buf diverged"
+            assert (wide[..., w:] == 0).all(), "tag lane not zero"
+            continue
+        assert va.dtype == vb.dtype and (va == vb).all(), \
+            f"field {name} diverged"
+
+
+# ---------------------------------------------------------------------------
+# knob-off / untagged bit-identity across the three wires
+
+
+class TestTagsOffBitIdentity:
+    def _run_pair(self, **wire_kw):
+        cfg_off = small_cfg(record_events=True, collect_telemetry=True,
+                            read_batch=4, **wire_kw)
+        cfg_on = tagged_cfg(**wire_kw)
+        off, tr_off = run_ticks(init_state(cfg_off), cfg_off, 40,
+                                prop_count=4)
+        on, tr_on = run_ticks(init_state(cfg_on), cfg_on, 40, prop_count=4)
+        assert_common_bits_equal(off, on)
+        assert (np.asarray(tr_off) == np.asarray(tr_on)).all()
+        return on
+
+    def test_sync_wire(self):
+        on = self._run_pair()
+        # the tag plane exists but stayed all-zero: nothing ever tagged
+        assert int(jnp.sum(jnp.abs(on.tel_prop_tag))) == 0
+        assert int(jnp.sum(jnp.abs(on.read_tag))) == 0
+
+    @pytest.mark.slow  # tier-1 wall budget: sync wire is the tier-1 guard
+    def test_mailbox_wire(self):
+        self._run_pair(force_mailboxes=True)
+
+    @pytest.mark.slow  # tier-1 wall budget: sync wire is the tier-1 guard
+    def test_sharded_wire(self):
+        from swarmkit_tpu.parallel import row_mesh, shard_rows
+
+        cfg = tagged_cfg(n=8, seed=11)
+        mesh = row_mesh(cfg.n)
+        assert len(mesh.devices.ravel()) == 8
+        plain, tr_p = run_ticks(init_state(cfg), cfg, 30, prop_count=4)
+        sharded, tr_s = run_ticks(shard_rows(init_state(cfg), mesh), cfg,
+                                  30, prop_count=4)
+        assert_common_bits_equal(plain, sharded)
+        assert (np.asarray(tr_p) == np.asarray(tr_s)).all()
+
+
+# ---------------------------------------------------------------------------
+# tag propagation: propose -> COMMIT_ADVANCE, reads -> READ_SERVED
+
+
+@pytest.fixture(scope="module")
+def tagged_run():
+    cfg = tagged_cfg()
+    st = init_state(cfg)
+    st, _ = run_until_leader(st, cfg, max_ticks=200)
+    st = propose_dense(st, cfg, _payload_at, jnp.asarray(4, I32),
+                       tag=PROP_TAG)
+    for _ in range(4):
+        st = step(st, cfg)
+    st = submit_reads(st, cfg, 2, tag=READ_TAG)
+    for _ in range(6):
+        st = step(st, cfg)
+    events, _ = decode_state(st)
+    return cfg, st, events
+
+
+def test_event_ring_carries_tag_lane(tagged_run):
+    cfg, st, _ = tagged_run
+    assert cfg.event_width == 5
+    assert st.ev_buf.shape[-1] == 5
+
+
+def test_propose_tag_reaches_commit_advance(tagged_run):
+    _, _, events = tagged_run
+    tags = {e.tag for e in events if e.code == COMMIT_ADVANCE}
+    assert PROP_TAG in tags
+    # tags only appear on the taggable codes
+    from swarmkit_tpu.flightrec import TAGGED_CODES
+    for e in events:
+        if e.tag:
+            assert e.code in TAGGED_CODES
+
+
+def test_read_tag_reaches_read_served(tagged_run):
+    _, _, events = tagged_run
+    tags = {e.tag for e in events if e.code == READ_SERVED}
+    assert READ_TAG in tags
+
+
+def test_record_roundtrips_tag_and_clock(tagged_run, tmp_path):
+    cfg, st, events = tagged_run
+    clock = ClockSync(fallback_tick_us=2.0)
+    clock.add(1, host_ns=10_000)
+    clock.add(5, host_ns=18_000)
+    rec = capture(st, trigger="manual", cfg=cfg, clock=clock)
+    assert rec.clock and rec.clock["samples"] == [[1, 10_000], [5, 18_000]]
+    p = tmp_path / "rec.json"
+    save_record(rec, str(p))
+    back = load_record(str(p))
+    assert back.clock == rec.clock
+    assert [e.tag for e in back.events] == [e.tag for e in rec.events]
+    assert any(e.tag == PROP_TAG for e in back.events)
+
+
+# ---------------------------------------------------------------------------
+# clock correlation edge cases
+
+
+class TestClockSync:
+    def test_zero_points_means_no_fit(self):
+        cs = ClockSync()
+        assert cs.fit() is None
+        assert fit_from(None) is None
+        assert fit_from(cs) is None
+
+    def test_single_point_degenerate_anchor(self):
+        cs = ClockSync(fallback_tick_us=3.0)
+        cs.add(10, host_ns=1_000_000)
+        f = cs.fit()
+        assert f.degenerate and f.n_samples == 1
+        assert f.slope_ns_per_tick == pytest.approx(3_000.0)
+        assert f.host_ns_at(10) == pytest.approx(1_000_000.0)
+
+    def test_non_monotonic_host_clock_is_robust(self):
+        cs = ClockSync()
+        # 100 ns/tick line, with one NTP step backwards in the middle
+        for tick, ns in ((0, 0), (10, 1_000), (20, 500), (30, 3_000),
+                         (40, 4_000)):
+            cs.add(tick, host_ns=ns)
+        f = cs.fit()
+        assert not f.degenerate
+        assert f.slope_ns_per_tick == pytest.approx(100.0, rel=0.35)
+        assert f.slope_ns_per_tick > 0
+
+    def test_fit_roundtrips_through_dicts(self):
+        cs = ClockSync()
+        cs.add(0, host_ns=100)
+        cs.add(4, host_ns=500)
+        f1 = fit_from(cs.to_dict())
+        f2 = fit_from(cs.fit().to_dict())
+        assert isinstance(f1, ClockFit) and isinstance(f2, ClockFit)
+        assert f1.slope_ns_per_tick == pytest.approx(f2.slope_ns_per_tick)
+        with pytest.raises(TypeError):
+            fit_from(42)
+
+    def test_bounded_collector_discards_oldest(self):
+        from swarmkit_tpu.flightrec.clock import MAX_SYNC_POINTS
+        cs = ClockSync()
+        for t in range(MAX_SYNC_POINTS + 7):
+            cs.add(t, host_ns=t * 10)
+        assert len(cs.samples) == MAX_SYNC_POINTS and cs.discarded == 7
+
+
+# ---------------------------------------------------------------------------
+# flow-linked export (the acceptance journey)
+
+
+def _span(name, start, dur, tag=None, sid="aa01"):
+    attrs = {"trace_tag": tag} if tag else {}
+    return {"name": name, "span_id": sid, "parent_id": None,
+            "start": start, "duration": dur, "attrs": attrs}
+
+
+def _dev_event(tick, code=COMMIT_ADVANCE, tag=0, node=0):
+    return {"tick": tick, "node": node, "code": code,
+            "name": CODE_NAMES[code], "arg0": 1, "arg1": 1, "seq": 0,
+            "tag": tag}
+
+
+def test_flow_links_propose_commit_settle():
+    clock = ClockSync()
+    clock.add(0, host_ns=int(10.0e9))       # tick 0 at t=10s
+    clock.add(100, host_ns=int(10.1e9))     # 1 ms/tick
+    spans = [_span("raft.propose", 10.00, 0.02, tag=PROP_TAG, sid="aa01"),
+             _span("raft.settle", 10.06, 0.01, tag=PROP_TAG, sid="aa02")]
+    events = [_dev_event(40, tag=PROP_TAG)]
+    trace = to_chrome_trace(events, spans, clock=clock)
+    assert validate_chrome_trace(trace) == []
+
+    flows = [e for e in trace["traceEvents"] if e["ph"] in ("s", "t", "f")]
+    assert [e["ph"] for e in flows] == ["s", "t", "f"]
+    assert {e["id"] for e in flows} == {PROP_TAG}
+    s, t, f = flows
+    # propose span -> device commit instant -> settle span, in time order
+    assert s["ts"] < t["ts"] < f["ts"]
+    assert s["pid"] == 2 and t["pid"] == 1 and f["pid"] == 2
+    # the commit instant was remapped to wall clock: tick 40 at +40 ms
+    inst = next(e for e in trace["traceEvents"] if e["ph"] == "i")
+    assert inst["ts"] == pytest.approx(40_000.0, rel=1e-6)
+    assert trace["metadata"]["clock_fit"]["slope_ns_per_tick"] == \
+        pytest.approx(1e6)
+
+
+def test_ring_wrap_orphan_annotates_instead_of_crashing():
+    # host span whose device instant was overwritten by ring wrap...
+    spans = [_span("raft.propose", 1.0, 0.1, tag=7)]
+    # ...and a device instant whose span was evicted from the deque
+    events = [_dev_event(3, tag=9)]
+    trace = to_chrome_trace(events, spans)
+    assert validate_chrome_trace(trace) == []
+    assert not [e for e in trace["traceEvents"]
+                if e["ph"] in ("s", "t", "f")]
+    x = next(e for e in trace["traceEvents"]
+             if e["ph"] == "X" and e["name"] == "raft.propose")
+    assert x["args"]["flow_orphan"] == "no_device_event"
+    inst = next(e for e in trace["traceEvents"] if e["ph"] == "i")
+    assert inst["args"]["flow_orphan"] == "no_host_span"
+
+
+def test_validator_rejects_dangling_flows():
+    bad = {"traceEvents": [
+        {"ph": "s", "pid": 1, "tid": 0, "name": "causal", "ts": 1.0,
+         "id": 5}]}
+    problems = validate_chrome_trace(bad)
+    assert any("dangle" in p for p in problems)
+    assert validate_chrome_trace(
+        {"traceEvents": [{"ph": "t", "pid": 1, "tid": 0, "name": "causal",
+                          "ts": 1.0}]})   # flow without an id
+
+
+def test_captured_run_exports_validated_flow_trace(tagged_run, tmp_path):
+    """The acceptance criterion end-to-end on a REAL kernel run: host
+    propose span, device COMMIT_ADVANCE instant (wall-clock remapped),
+    host settle span, one validated trace connecting them."""
+    from swarmkit_tpu.flightrec.export import export_record
+
+    cfg, st, _ = tagged_run
+    tracer = Tracer()
+    tag = PROP_TAG   # the tag the module fixture proposed with
+    with tracer.span("raft.propose", trace_tag=tag):
+        pass
+    with tracer.span("raft.settle", trace_tag=tag):
+        pass
+    clock = ClockSync()
+    clock.add(0, host_ns=int(1.0e9))
+    clock.add(int(jax.device_get(st.tick)), host_ns=int(2.0e9))
+    rec = capture(st, trigger="scenario", cfg=cfg, tracer=tracer,
+                  clock=clock)
+    path = tmp_path / "trace.json"
+    trace = export_record(rec, str(path))
+    assert validate_chrome_trace(trace) == []
+    with open(path, encoding="utf-8") as f:
+        assert validate_chrome_trace(json.load(f)) == []
+
+    flows = [e for e in trace["traceEvents"] if e["ph"] in ("s", "t", "f")]
+    ours = [e for e in flows if e["id"] == tag]
+    assert {e["ph"] for e in ours} >= {"s", "t", "f"}
+    # at least one flow step rides a device COMMIT_ADVANCE instant
+    commit_inst = [e for e in trace["traceEvents"]
+                   if e["ph"] == "i" and e["name"] == "COMMIT_ADVANCE"
+                   and e["args"].get("trace_tag") == tag]
+    assert commit_inst
+    assert any(t["ph"] == "t" and t["pid"] == 1 for t in ours)
+
+
+def test_span_trace_tag_folds_to_positive_i32():
+    tracer = Tracer()
+    with tracer.span("raft.propose"):
+        pass
+    span = tracer.finished()[0]
+    tag = span_trace_tag(span)
+    assert 1 <= tag <= 0x7FFFFFFF
+    assert tag == span_trace_tag(span.span_id)
+    assert span_trace_tag("000000000000") == 1   # floor at 1, never 0
+
+
+# ---------------------------------------------------------------------------
+# bench_gate: provenance + resource series
+
+
+class TestBenchGateProvenance:
+    def _round(self, tmp_path, name, **kw):
+        d = {"n": 64, "cmd": "x", "rc": 0, "tail": "", "parsed": None}
+        d.update(kw)
+        p = tmp_path / name
+        p.write_text(json.dumps(d))
+        return str(p)
+
+    def test_green_but_empty_is_flagged(self, tmp_path):
+        from bench_gate import check_provenance
+        paths = [
+            self._round(tmp_path, "MULTICHIP_r01.json", ok=True, tail=""),
+            self._round(tmp_path, "MULTICHIP_r02.json", ok=True,
+                        tail='{"multichip_ok": true}'),
+            self._round(tmp_path, "MULTICHIP_r03.json", rc=1, tail=""),
+            self._round(tmp_path, "MULTICHIP_r04.json", skipped=True,
+                        tail=""),
+        ]
+        findings = check_provenance(paths=paths)
+        assert len(findings) == 1 and "MULTICHIP_r01" in findings[0]
+
+    def test_strict_flag_fails_the_cli(self, tmp_path, capsys):
+        from bench_gate import main as gate_main
+        good = {"rc": 0, "parsed": {"value": 100.0}, "tail": "x"}
+        bad = {"rc": 0, "ok": True, "tail": ""}
+        p1 = tmp_path / "BENCH_r01.json"
+        p2 = tmp_path / "BENCH_r02.json"
+        p1.write_text(json.dumps(good))
+        p2.write_text(json.dumps(dict(good, tail="")))
+        assert gate_main([str(p1), str(p2)]) == 0       # flagged, not fatal
+        assert "PROV" in capsys.readouterr().out
+        p2.write_text(json.dumps(bad))
+        assert gate_main([str(p1), str(p2),
+                          "--strict-provenance"]) == 1
+
+    def test_resource_series_gates_growth_not_collapse(self, tmp_path):
+        from bench_gate import run_gate
+
+        def rnd(name, value, compile_s):
+            p = tmp_path / name
+            p.write_text(json.dumps({
+                "rc": 0, "tail": "x",
+                "parsed": {"value": value, "compile_seconds": compile_s}}))
+            return str(p)
+
+        paths = [rnd("BENCH_r01.json", 100.0, 10.0),
+                 rnd("BENCH_r02.json", 120.0, 12.0)]
+        assert run_gate(paths=paths)["ok"]
+        # compile time tripling is a failure even while the rate improves
+        paths.append(rnd("BENCH_r03.json", 150.0, 30.0))
+        report = run_gate(paths=paths)
+        assert not report["ok"]
+        assert any("compile_seconds" in f for f in report["failures"])
+        # a shrinking compile time is never a regression
+        paths[-1] = rnd("BENCH_r03.json", 150.0, 1.0)
+        assert run_gate(paths=paths)["ok"]
+
+
+# ---------------------------------------------------------------------------
+# swarm_top (pure renderer; the live demo loop is slow-marked below)
+
+
+def _fake_snapshot(commits=100.0, leader=1.0):
+    return {"metrics": {"swarm_raft_is_leader": leader,
+                        "swarm_kernel_commit_advance_total": commits,
+                        "swarm_flightrec_captures_total":
+                            {"trigger=manual": 2.0}},
+            "timers": {}, "objects": {"nodes": 3}, "spans": [],
+            "recent_events": [{"describe": "flightrec[manual] 1 span"}]}
+
+
+class TestSwarmTop:
+    def test_render_frame_shows_series_and_rates(self):
+        from swarm_top import TopState, render_frame
+        state = TopState()
+        state.observe({"m1": _fake_snapshot(100.0)}, now=0.0)
+        state.observe({"m1": _fake_snapshot(250.0)}, now=10.0)
+        frame = render_frame({"m1": _fake_snapshot(250.0)}, state)
+        assert "m1" in frame and "[LEADER]" in frame
+        assert "swarm_kernel_commit_advance_total" in frame
+        assert "15.0/s" in frame           # (250-100)/10
+        assert "trigger=manual" in frame   # labeled child flattened
+        assert "flightrec[manual]" in frame
+
+    def test_counter_reset_drops_sample(self):
+        from swarm_top import TopState
+        state = TopState()
+        state.observe({"m1": _fake_snapshot(100.0)}, now=0.0)
+        state.observe({"m1": _fake_snapshot(10.0)}, now=1.0)  # restart
+        # negative delta is not a rate: no sample recorded
+        assert not state.rates["m1"].get(
+            "swarm_kernel_commit_advance_total")
+
+    def test_sparkline_scales_to_max(self):
+        from swarm_top import sparkline
+        assert sparkline([]) == ""
+        line = sparkline([0, 1, 2, 4])
+        assert len(line) == 4 and line[0] == "▁" and line[-1] == "█"
+
+    def test_once_from_snapshot_file(self, tmp_path, capsys):
+        from swarm_top import main as top_main
+        p = tmp_path / "snap.json"
+        p.write_text(json.dumps({"mgr-a": _fake_snapshot(),
+                                 "mgr-b": _fake_snapshot(leader=0.0)}))
+        assert top_main(["--from", str(p), "--once"]) == 0
+        out = capsys.readouterr().out
+        assert "2 manager(s)" in out and "mgr-a" in out and "mgr-b" in out
+
+    def test_unreadable_file_degrades_not_crashes(self, tmp_path, capsys):
+        from swarm_top import main as top_main
+        p = tmp_path / "broken.json"
+        p.write_text("{nope")
+        assert top_main(["--from", str(p), "--once"]) == 0
+        assert "unreadable" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# slow wrappers: the tools end-to-end (tier-1 skips these)
+
+
+@pytest.mark.slow
+def test_profile_tick_end_to_end(tmp_path):
+    from profile_tick import run_profile
+
+    out = run_profile(32, quick=True)
+    assert out["tick_ms"] > 0 and out["compile_seconds"] > 0
+    assert out["missing_scopes"] == []     # named_scope seams reach HLO
+    attributed = sum(p["attributed_ms"] for p in out["phases"].values())
+    # the acceptance bar: per-phase timings sum to the whole tick
+    assert attributed == pytest.approx(out["tick_ms"], rel=0.2)
+    assert out["coverage"] > 0.2           # micro-kernels track the kernel
+
+
+@pytest.mark.slow
+def test_swarm_top_demo_live_frames(capsys):
+    from swarm_top import main as top_main
+
+    assert top_main(["--demo", "--once", "--n", "8"]) == 0
+    out = capsys.readouterr().out
+    assert "sim-quorum" in out
+    assert "swarm_kernel_commit_advance_total" in out
+    assert "/s" in out   # second poll produced rates

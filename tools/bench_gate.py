@@ -12,21 +12,35 @@ against the best prior round, per series:
 - one ``<config>:ratio`` series per A/B dict entry (the densepeer and
   sparseprog tripwires): the dict's ``*_over_dense`` value is gated like
   a rate, so the banded/dense and sparse/dense lowering ratios are
-  standing regression tripwires, not just logged numbers.
+  standing regression tripwires, not just logged numbers;
+- the resource series ``compile_seconds`` / ``peak_bytes`` (when the
+  JSON line carries them), gated in the GROWTH direction — the gate
+  fails when the last point exceeds ``1/(1 - tol) x`` the best (lowest)
+  prior point, catching compile-time and memory blow-ups the rate
+  series can't see.
 
 Rounds with ``rc != 0`` or no parsed line are skipped whole (r01/r02
 in this repo's own history: tunnel faults, not regressions).  A series
 needs at least two points — one historical, one current — to be gated;
-the gate FAILS iff the last point of any gated series falls below
+the gate FAILS iff the last point of any gated rate series falls below
 ``(1 - tol) x`` the best previous point.  The default tolerance is wide
 (50%) because rounds run on whatever hardware the driver had that day —
 this is a collapse detector, not a benchmark diff.
 
-Usage:
-    python tools/bench_gate.py [--tol 0.5] [files...]
+``check_provenance`` is the green-but-empty detector: a round file whose
+``rc`` is 0 and whose ``ok``/``skipped`` flags claim success, but whose
+recorded ``tail`` is empty, proves nothing ran and nothing was recorded
+(MULTICHIP_r05.json is the motivating specimen — the dry-run used to
+print nothing on success).  Findings print as ``PROV`` lines and fail
+the CLI under ``--strict-provenance``; genuinely skipped rounds must say
+``skipped: true`` with a reason instead.
 
-Importable: ``run_gate(paths=None, tol=0.5) -> report dict`` (the slow
-pytest wrapper asserts on the report and on an injected regression).
+Usage:
+    python tools/bench_gate.py [--tol 0.5] [--strict-provenance] [files...]
+
+Importable: ``run_gate(paths=None, tol=0.5) -> report dict`` and
+``check_provenance(paths=None) -> list[str]`` (the slow pytest wrapper
+asserts on the report and on an injected regression).
 """
 
 from __future__ import annotations
@@ -44,6 +58,11 @@ def _is_rate(v) -> bool:
     return isinstance(v, (int, float)) and not isinstance(v, bool) and v > 0
 
 
+# Series where GROWTH (not collapse) is the regression: gated against the
+# lowest prior point instead of the highest.
+RESOURCE_SERIES = ("compile_seconds", "peak_bytes")
+
+
 def _series_points(rounds: list[tuple[str, dict]]) -> dict[str, list]:
     """{series name: [(round name, rate), ...]} in round order."""
     series: dict[str, list] = {}
@@ -51,6 +70,10 @@ def _series_points(rounds: list[tuple[str, dict]]) -> dict[str, list]:
         if _is_rate(parsed.get("value")):
             series.setdefault("headline", []).append(
                 (rname, float(parsed["value"])))
+        for rs in RESOURCE_SERIES:
+            if _is_rate(parsed.get(rs)):
+                series.setdefault(rs, []).append(
+                    (rname, float(parsed[rs])))
         cfgs = parsed.get("configs_entries_per_s")
         for cname, cv in (cfgs or {}).items() if isinstance(cfgs, dict) else ():
             if _is_rate(cv):
@@ -92,12 +115,20 @@ def run_gate(paths=None, tol: float = 0.5) -> dict:
     for sname, pts in sorted(_series_points(rounds).items()):
         entry: dict = {"points": pts, "gated": len(pts) >= 2}
         if entry["gated"]:
-            baseline = max(v for _, v in pts[:-1])
+            resource = sname in RESOURCE_SERIES
+            prior = [v for _, v in pts[:-1]]
+            baseline = min(prior) if resource else max(prior)
             last_round, last = pts[-1]
             entry["baseline"] = baseline
             entry["last"] = last
             entry["ratio"] = round(last / baseline, 4)
-            if last < baseline * (1.0 - tol):
+            if resource:
+                if last > baseline / (1.0 - tol):
+                    report["failures"].append(
+                        f"{sname}: {last:,.1f} in {last_round} exceeds "
+                        f"{1.0 / (1.0 - tol):.2f}x the best prior round "
+                        f"({baseline:,.1f})")
+            elif last < baseline * (1.0 - tol):
                 unit = "" if sname.endswith(":ratio") else " entries/s"
                 report["failures"].append(
                     f"{sname}: {last:,.1f}{unit} in {last_round} is below "
@@ -107,6 +138,35 @@ def run_gate(paths=None, tol: float = 0.5) -> dict:
     return report
 
 
+def check_provenance(paths=None) -> list[str]:
+    """Green-but-empty detector over round artifacts.  A round claiming
+    success (rc=0, ok not false, not skipped) with an empty tail recorded
+    nothing — the run either printed no provenance or the capture lost it;
+    either way the green is unearned.  `paths` defaults to the repo-root
+    MULTICHIP_r*.json + BENCH_r*.json trajectories."""
+    if paths is None:
+        paths = (glob.glob(os.path.join(_ROOT, "MULTICHIP_r*.json"))
+                 + glob.glob(os.path.join(_ROOT, "BENCH_r*.json")))
+    findings: list[str] = []
+    for p in sorted(paths, key=os.path.basename):
+        name = os.path.basename(p)
+        try:
+            with open(p, encoding="utf-8") as f:
+                d = json.load(f)
+        except (OSError, ValueError) as e:
+            findings.append(f"{name}: unreadable ({e})")
+            continue
+        green = d.get("rc") == 0 and d.get("ok") is not False \
+            and not d.get("skipped")
+        if green and not str(d.get("tail") or "").strip():
+            findings.append(
+                f"{name}: green (rc=0, ok={d.get('ok')!r}) but the recorded "
+                "tail is empty — nothing proves the run did anything; "
+                "record the run's JSON line or set skipped=true with a "
+                "reason")
+    return findings
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
     ap.add_argument("files", nargs="*",
@@ -114,7 +174,14 @@ def main(argv=None) -> int:
     ap.add_argument("--tol", type=float, default=0.5,
                     help="allowed fractional drop vs best prior round "
                          "(default 0.5)")
+    ap.add_argument("--strict-provenance", action="store_true",
+                    help="fail on green-but-empty rounds instead of just "
+                         "flagging them")
     args = ap.parse_args(argv)
+
+    prov = check_provenance(paths=args.files or None)
+    for p in prov:
+        print(f"PROV  {p}", flush=True)
 
     report = run_gate(paths=args.files or None, tol=args.tol)
     for s in report["skipped_rounds"]:
@@ -130,6 +197,9 @@ def main(argv=None) -> int:
         print(f"FAIL  {f}", flush=True)
     if not report["series"]:
         print("FAIL  no usable bench rounds found", flush=True)
+        return 1
+    if prov and args.strict_provenance:
+        print(f"FAIL  {len(prov)} green-but-empty round(s)", flush=True)
         return 1
     print("PASS" if report["ok"] else
           f"FAIL  {len(report['failures'])} regressed series", flush=True)

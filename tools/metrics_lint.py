@@ -52,6 +52,15 @@ Checks, in order:
    invariant bits are named in the DST artifact schema
    (``invariants.BIT_NAMES``).
 
+10. The causal-trace fusion layer (ISSUE 17) stays wired: the
+    ``swarm_trace_*`` clock/flow metrics exist with the right kinds and
+    the orphan counter publishes both its ``side`` values; the tagged
+    flight-ring row is exactly one lane wider than the base row
+    (``EVENT_WIDTH_TAGGED == EVENT_WIDTH + 1``); every
+    ``TAGGED_CODES`` member is a ``CODE_NAMES`` code (the decoder keys
+    tag semantics off names); and the decoder's ``FlightEvent`` carries
+    the ``tag`` field the tagged lane decodes into.
+
 Importable (``run_lint`` returns the problem list) so the pytest wrapper
 in tests/test_metrics_lint.py runs it in-suite; the CLI exits nonzero on
 any finding.
@@ -190,7 +199,7 @@ def run_lint(repo_root: str | None = None) -> list[str]:
             problems.append(
                 f"flightrec: CODE_NAMES[{code}] = {cname!r} but the module "
                 f"constant {cname} = {getattr(flight_codes, cname, None)!r}")
-    non_codes = {"EVENT_WIDTH"}
+    non_codes = {"EVENT_WIDTH", "EVENT_WIDTH_TAGGED"}
     arg_prefixes = ("EDGE_", "BLOCK_")
     for attr, val in vars(flight_codes).items():
         if (attr.isupper() and isinstance(val, int)
@@ -397,6 +406,49 @@ def run_lint(repo_root: str | None = None) -> list[str]:
         if bname not in dst_invariants.BIT_NAMES.values():
             problems.append(f"storage: invariant bit {bname!r} missing "
                             "from invariants.BIT_NAMES (artifact schema)")
+
+    # 10. causal-trace fusion wiring (ISSUE 17): the trace-tag lane, its
+    #     decoder field, and the swarm_trace_* clock/flow metrics stay in
+    #     lockstep across codes.py / decoder.py / export.py / catalog
+    import dataclasses as _dc10
+
+    from swarmkit_tpu.flightrec import decoder as flight_decoder
+
+    for mname, kind in (("swarm_trace_clock_sync_points_total", "counter"),
+                        ("swarm_trace_clock_tick_us", "gauge"),
+                        ("swarm_trace_clock_residual_us", "gauge"),
+                        ("swarm_trace_flow_events_total", "counter"),
+                        ("swarm_trace_flow_orphans_total", "counter")):
+        spec = catalog.CATALOG.get(mname)
+        if spec is None or spec.kind != kind:
+            problems.append(f"trace: {mname!r} missing from the catalog "
+                            f"or not a {kind}")
+    orph_spec = catalog.CATALOG.get("swarm_trace_flow_orphans_total")
+    if orph_spec is None or tuple(orph_spec.labels) != ("side",):
+        problems.append("trace: 'swarm_trace_flow_orphans_total' must be "
+                        "labeled by ('side',)")
+    else:
+        fam = catalog.get(MetricsRegistry(strict=True),
+                          "swarm_trace_flow_orphans_total")
+        for side in ("host_only", "device_only"):
+            try:
+                fam.labels(side=side).inc(0)
+            except MetricError as e:
+                problems.append(f"trace: orphan side {side!r} cannot "
+                                f"publish: {e}")
+    if flight_codes.EVENT_WIDTH_TAGGED != flight_codes.EVENT_WIDTH + 1:
+        problems.append(
+            f"trace: EVENT_WIDTH_TAGGED = {flight_codes.EVENT_WIDTH_TAGGED} "
+            f"must be EVENT_WIDTH + 1 = {flight_codes.EVENT_WIDTH + 1} "
+            "(one trace-tag lane on top of the base row)")
+    for code in sorted(flight_codes.TAGGED_CODES):
+        if code not in flight_codes.CODE_NAMES:
+            problems.append(f"trace: TAGGED_CODES member {code} is not a "
+                            "CODE_NAMES code")
+    ev_fields = {f.name for f in _dc10.fields(flight_decoder.FlightEvent)}
+    if "tag" not in ev_fields:
+        problems.append("trace: decoder.FlightEvent lacks the 'tag' field "
+                        "the tagged lane decodes into")
     return problems
 
 

@@ -1,0 +1,368 @@
+"""Per-phase tick profile: where one kernel step spends its time.
+
+The tick kernel's phases are delimited by ``jax.named_scope`` seams
+(raft/sim/kernel.py: phase_A_timers ... phase_F_compact, phase_R0..R2),
+so a compiled module attributes every HLO op to a phase.  CPU runtimes
+expose no per-op timings, so this tool measures each phase with an
+isolated micro-kernel mirroring that phase's dominant array ops at the
+profiled config's exact shapes, then scales the shares onto the
+measured whole-tick time:
+
+- ``raw ms``    — the isolated best-of-k micro-kernel time;
+- ``attributed ms`` — raw share x whole-tick time, so the attributed
+  column sums to the tick by construction;
+- ``coverage``  — sum(raw) / tick_ms, the honesty diagnostic: far from
+  1.0 means the micro-kernels and the fused tick have drifted apart
+  (XLA fuses across phase seams; 0.8-1.3 is typical on CPU).
+
+Also measured: whole-tick compile seconds (lower + backend compile,
+timed separately), device peak memory (``memory_stats()``; None on CPU
+backends that don't report it), and — with ``--capture DIR`` — a
+``jax.profiler.trace`` capture of the timed loop for offline Perfetto
+inspection.
+
+``--bench-json PATH`` appends one JSON line carrying ``compile_seconds``
+/ ``peak_bytes`` / per-phase ms in the same shape bench.py emits, so
+``tools/bench_gate.py`` gates them as resource series.
+``--verify-scopes`` checks the named_scope seams actually survive into
+the compiled HLO (the contract the attribution rests on).
+
+Usage: python tools/profile_tick.py [--n 256] [--quick] [--json]
+                                    [--capture DIR] [--bench-json PATH]
+                                    [--verify-scopes]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from swarmkit_tpu.raft.sim import (  # noqa: E402
+    SimConfig, has_leader, init_state, run_until_leader,
+)
+from swarmkit_tpu.raft.sim.kernel import (  # noqa: E402
+    _entry_chk, _idx_at_slots, _is_conf, step,
+)
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+# The named_scope seams the kernel wraps its phases in; --verify-scopes
+# pins this list against the compiled HLO.
+PHASE_SCOPES = ("phase_R0_submit", "phase_A_timers", "phases_ABC_progress",
+                "phase_D_progress", "phase_D_commit_fold", "phase_R1_stamp",
+                "phase_E_apply", "phase_R2_settle", "phase_F_compact")
+
+
+def profile_config(n: int) -> SimConfig:
+    """The steady-state bench shape (perf_model.steady_rate) plus the
+    read path, so R0-R2 exist to be measured."""
+    return SimConfig(n=n, log_len=8192, window=2048, apply_batch=2048,
+                     max_props=2048, keep=500, seed=42, election_tick=16,
+                     read_batch=32)
+
+
+def _time_call(fn, *args, reps: int = 10):
+    """Best-of wall time in ms (post-warmup)."""
+    out = fn(*args)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best * 1e3
+
+
+def _steady_state(cfg: SimConfig):
+    """Elect a leader and advance into replication steady state."""
+    st = init_state(cfg)
+    st, _ = run_until_leader(st, cfg, max_ticks=512)
+    assert bool(has_leader(st)), f"no leader at n={cfg.n}"
+    pc = jnp.asarray(cfg.max_props, I32)
+
+    def _payload(tick, k):
+        return tick.astype(U32) * U32(1 << 16) + k.astype(U32) + U32(1)
+
+    stepf = jax.jit(lambda s: step(s, cfg, prop_count=pc,
+                                   payload_fn=_payload))
+    for _ in range(4):  # fill pipelines so every phase has real work
+        st = stepf(st)
+    jax.block_until_ready(st.commit)
+    return st, stepf
+
+
+def measure_compile(cfg: SimConfig, state) -> dict:
+    """Whole-tick compile cost, lowering and backend compile separately
+    (a fresh jit closure so nothing is cached)."""
+    pc = jnp.asarray(cfg.max_props, I32)
+    f = jax.jit(lambda s: step(s, cfg, prop_count=pc))
+    t0 = time.perf_counter()
+    lowered = f.lower(state)
+    t_lower = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    compiled = lowered.compile()
+    t_compile = time.perf_counter() - t0
+    return {"lower_seconds": round(t_lower, 3),
+            "compile_seconds": round(t_compile, 3),
+            "compiled": compiled}
+
+
+def peak_bytes() -> int | None:
+    """Device peak-memory high-water mark, or None when the backend
+    doesn't report one (CPU returns None / empty stats — a fabricated 0
+    would read as 'no memory used')."""
+    try:
+        peaks = []
+        for d in jax.local_devices():
+            stats = d.memory_stats()
+            if stats and stats.get("peak_bytes_in_use"):
+                peaks.append(int(stats["peak_bytes_in_use"]))
+        return max(peaks) if peaks else None
+    except Exception:
+        return None
+
+
+def phase_micro(cfg: SimConfig, state, reps: int = 10) -> dict:
+    """Isolated per-phase micro-kernels at the profiled state's exact
+    shapes, mirroring each phase's dominant ops in kernel.py.  Keys are
+    the kernel's named_scope seams (heartbeat fan-out and append-accept
+    ride inside phases_ABC_progress like they do in the kernel)."""
+    n, L = cfg.n, cfg.log_len
+    rounds = L.bit_length() + 1
+    member = jnp.asarray(state.member)
+    granted = jnp.asarray(state.granted)
+    rejected = jnp.asarray(state.rejected)
+    match = jnp.asarray(state.match)
+    log_term = jnp.asarray(state.log_term)
+    log_data = jnp.asarray(state.log_data)
+    last = jnp.asarray(state.last)
+    commit = jnp.asarray(state.commit)
+    applied = jnp.asarray(state.applied)
+    elapsed = jnp.asarray(state.elapsed)
+    timeout = jnp.asarray(state.timeout)
+    rows = {}
+
+    def a_timers(elapsed, timeout, last):
+        e2 = elapsed + 1
+        fire = e2 >= timeout
+        contact = jnp.where(fire, 0, e2)
+        hb = jnp.minimum(e2 % 7, last)
+        return e2, fire, contact, hb
+
+    rows["phase_A_timers"] = _time_call(jax.jit(a_timers), elapsed, timeout,
+                                        last, reps=reps)
+
+    def abc_progress(granted, rejected, member, match, log_term, log_data,
+                     last):
+        # A/B vote tallies (three masked [N, N] reductions) ...
+        votes = (jnp.sum((granted & member).astype(I32), axis=1)
+                 + jnp.sum((rejected & member).astype(I32), axis=1)
+                 + jnp.sum((granted & ~rejected).astype(I32), axis=1))
+        # ... plus Phase C's log traffic: the propose stamp and the
+        # append store each rewrite both [N, L] planes under a slot
+        # mask, the accept check compares terms over the same slots,
+        # and the heartbeat fan-out gathers the leader's send window
+        own_idx = _idx_at_slots(cfg, last)
+        wmask = (own_idx > (last - cfg.window)[:, None]) \
+            & (own_idx <= last[:, None])
+        pmask = (own_idx > last[:, None]) \
+            & (own_idx <= (last + cfg.max_props)[:, None])
+        accept = jnp.sum((log_term == jnp.max(log_term)).astype(I32), axis=1)
+        lt = jnp.where(pmask, jnp.max(log_term), log_term)
+        ld = jnp.where(pmask, log_data + U32(1), log_data)
+        lt = jnp.where(wmask, lt + 1, lt)
+        ld = jnp.where(wmask, ld ^ U32(2654435761), ld)
+        wnd = jnp.take_along_axis(
+            ld, (own_idx % cfg.log_len)[:, : cfg.window], axis=1)
+        # per-peer progress planes (match/next elementwise updates)
+        m2 = jnp.where(member, jnp.maximum(match, last[None, :]), match)
+        return votes, accept, lt, ld, wnd, m2
+
+    rows["phases_ABC_progress"] = _time_call(
+        jax.jit(abc_progress), granted, rejected, member, match, log_term,
+        log_data, last, reps=reps)
+
+    def d_commit(match, member, commit, last):
+        # Phase D: commit bisection — ceil(log2 L)+1 masked count rounds
+        # over the match matrix (kernel.py _progress_b)
+        meff = jnp.where(member, match, -1)
+
+        def body(_, lohi):
+            lo, hi = lohi
+            mid = (lo + hi + 1) // 2
+            cnt = jnp.sum((meff >= mid[:, None]).astype(I32), axis=1)
+            ok = cnt * 2 > n
+            return jnp.where(ok, mid, lo), jnp.where(ok, hi, mid - 1)
+
+        lo, _ = jax.lax.fori_loop(0, rounds, body, (commit, last))
+        return lo
+
+    rows["phase_D_commit_fold"] = _time_call(jax.jit(d_commit), match,
+                                             member, commit, last, reps=reps)
+
+    def e_apply(log_data, last, applied, commit):
+        # Phase E: the apply+checksum pass over the apply window, plus
+        # the conf-entry decode scans (kernel.py Phase E)
+        own_idx = _idx_at_slots(cfg, last)
+        mask = (own_idx > applied[:, None]) & (own_idx <= commit[:, None])
+        chk = jnp.sum(jnp.where(mask, _entry_chk(own_idx, log_data), U32(0)),
+                      axis=1, dtype=U32)
+        icr = _is_conf(log_data)
+        hup = jnp.any(mask & icr, axis=1)
+        return chk, hup
+
+    rows["phase_E_apply"] = _time_call(jax.jit(e_apply), log_data, last,
+                                       applied, commit, reps=reps)
+
+    def f_compact(log_term, log_data, last, applied):
+        # Phase F: pressure check + wipe of the compacted span (one
+        # masked rewrite of both planes behind the new snap_idx)
+        own_idx = _idx_at_slots(cfg, last)
+        snap = jnp.maximum(applied - cfg.keep, 0)
+        wipe = own_idx <= snap[:, None]
+        return (jnp.where(wipe, 0, log_term),
+                jnp.where(wipe, U32(0), log_data))
+
+    rows["phase_F_compact"] = _time_call(jax.jit(f_compact), log_term,
+                                         log_data, last, applied, reps=reps)
+
+    def r_reads(commit, applied, last, member, granted):
+        # R0 submit + R1 stamp (one [N, N] ack-quorum reduction) + R2
+        # settle: eight [N] register vectors of cursor math around it
+        pend = jnp.minimum(last % (cfg.read_batch + 1), cfg.read_batch)
+        goal = jnp.maximum(commit, applied)
+        acks = jnp.sum((granted & member).astype(I32), axis=1)
+        stamped = jnp.where(acks * 2 > n, goal, -1)
+        served = jnp.where((stamped >= 0) & (applied >= stamped), pend, 0)
+        return pend - served, stamped, served
+
+    rows["phase_R0_R2_reads"] = _time_call(jax.jit(r_reads), commit, applied,
+                                           last, member, granted, reps=reps)
+    return rows
+
+
+def verify_scopes(compiled) -> list[str]:
+    """Named-scope seams missing from the compiled HLO (empty = all
+    present).  R0/R1/R2 seams only exist when cfg.read_batch > 0."""
+    txt = compiled.as_text()
+    return [s for s in PHASE_SCOPES if s not in txt]
+
+
+def run_profile(n: int, quick: bool = False, capture_dir: str | None = None
+                ) -> dict:
+    """Measure everything; returns the result dict the CLI renders."""
+    cfg = profile_config(n)
+    reps = 3 if quick else 10
+    st, stepf = _steady_state(cfg)
+
+    comp = measure_compile(cfg, st)
+    compiled = comp.pop("compiled")
+
+    def timed_loop():
+        return _time_call(stepf, st, reps=reps)
+
+    if capture_dir:
+        with jax.profiler.trace(capture_dir):
+            tick_ms = timed_loop()
+    else:
+        tick_ms = timed_loop()
+
+    micro = phase_micro(cfg, st, reps=reps)
+    raw_sum = sum(micro.values())
+    phases = {k: {"raw_ms": round(v, 3),
+                  "attributed_ms": round(tick_ms * v / raw_sum, 3)}
+              for k, v in micro.items()}
+    out = {
+        "n": n, "platform": jax.devices()[0].platform,
+        "tick_ms": round(tick_ms, 3),
+        "coverage": round(raw_sum / tick_ms, 3),
+        "phases": phases,
+        "lower_seconds": comp["lower_seconds"],
+        "compile_seconds": comp["compile_seconds"],
+        "peak_bytes": peak_bytes(),
+        "missing_scopes": verify_scopes(compiled),
+    }
+    if capture_dir:
+        out["capture_dir"] = capture_dir
+    return out
+
+
+def render(out: dict) -> str:
+    lines = [f"## Tick profile: n={out['n']} ({out['platform']}), "
+             f"whole tick {out['tick_ms']:.2f} ms",
+             "",
+             f"compile {out['compile_seconds']:.2f}s "
+             f"(+{out['lower_seconds']:.2f}s lowering), peak memory "
+             + (f"{out['peak_bytes']:,} bytes" if out["peak_bytes"]
+                else "n/a (backend reports none)"),
+             "",
+             "| phase | raw ms | attributed ms | share |",
+             "|---|---|---|---|"]
+    total = sum(p["attributed_ms"] for p in out["phases"].values())
+    for name, p in out["phases"].items():
+        lines.append(f"| {name} | {p['raw_ms']:.3f} | "
+                     f"{p['attributed_ms']:.3f} | "
+                     f"{p['attributed_ms'] / total * 100:.0f}% |")
+    lines.append("")
+    lines.append(f"micro-kernel coverage: {out['coverage']:.2f}x of the "
+                 "fused tick (1.0 = isolated phases account for the whole "
+                 "tick; drift means the micro-kernels need re-syncing with "
+                 "kernel.py)")
+    if out["missing_scopes"]:
+        lines.append(f"WARNING: named_scope seams missing from compiled "
+                     f"HLO: {out['missing_scopes']}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--n", type=int, default=256)
+    ap.add_argument("--quick", action="store_true",
+                    help="3 reps instead of 10")
+    ap.add_argument("--json", action="store_true",
+                    help="print one JSON line instead of markdown")
+    ap.add_argument("--capture", metavar="DIR", default=None,
+                    help="wrap the timed loop in jax.profiler.trace(DIR)")
+    ap.add_argument("--bench-json", metavar="PATH", default=None,
+                    help="append a bench-shaped JSON line (compile_seconds"
+                         "/peak_bytes/phases) to PATH for bench_gate")
+    ap.add_argument("--verify-scopes", action="store_true",
+                    help="exit nonzero if any named_scope seam is missing "
+                         "from the compiled HLO")
+    args = ap.parse_args(argv)
+
+    out = run_profile(args.n, quick=args.quick, capture_dir=args.capture)
+    if args.json:
+        print(json.dumps(out), flush=True)
+    else:
+        print(render(out), flush=True)
+    if args.bench_json:
+        line = {"profile_n": out["n"],
+                "compile_seconds": out["compile_seconds"],
+                "tick_ms": out["tick_ms"],
+                "phases_ms": {k: v["attributed_ms"]
+                              for k, v in out["phases"].items()}}
+        if out["peak_bytes"]:
+            line["peak_bytes"] = out["peak_bytes"]
+        with open(args.bench_json, "a", encoding="utf-8") as f:
+            f.write(json.dumps(line) + "\n")
+    if args.verify_scopes and out["missing_scopes"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
