@@ -261,6 +261,30 @@ CATALOG: dict[str, MetricSpec] = {
         "counter", "Fresh states dropped by the --budget frontier cap "
         "(scan no longer exhaustive), by scope preset.", ("scope",)),
 
+    # ---- multi-raft serving plane (multiraft/) ---------------------------
+    # Names and label sets are pinned to swarmkit_tpu/multiraft/obs.py by
+    # tools/metrics_lint.py check #11.
+    "swarm_multiraft_groups": MetricSpec(
+        "gauge", "Raft groups in the serving plane (leading G axis of "
+        "the grouped state).", ()),
+    "swarm_multiraft_groups_with_leader": MetricSpec(
+        "gauge", "Groups with an acting leader at last publish.", ()),
+    "swarm_multiraft_router_keys_total": MetricSpec(
+        "counter", "Keys handled by the key->group router, by outcome "
+        "(routed = accepted into a per-group batch queue, spilled = "
+        "deferred past one flush by the group's max_props capacity).",
+        ("outcome",)),
+    "swarm_multiraft_leader_changes_total": MetricSpec(
+        "counter", "Per-group leader changes summed over groups: "
+        "publishes where a group's acting leader row differs from the "
+        "previous publish.", ()),
+    "swarm_multiraft_committed_entries_total": MetricSpec(
+        "counter", "Entries committed through consensus summed over "
+        "groups (per group: max commit across rows).", ()),
+    "swarm_multiraft_reads_served_total": MetricSpec(
+        "counter", "Linearizable read ops served summed over groups and "
+        "rows (cfg.read_batch > 0).", ()),
+
     # ---- bench / tools (L6) ----------------------------------------------
     "swarm_bench_entries_per_second": MetricSpec(
         "gauge", "Steady-state committed entries/sec, by bench config.",

@@ -1,0 +1,28 @@
+"""Multi-raft serving plane: G independent raft groups as one program.
+
+Promotes the DST-only batch axis into a first-class serving mode: a
+[G, N, ...] grouped `SimState` advanced by the unmodified tick kernel
+under `jax.vmap`, a host-side key->group `Router`, group->device
+placement over `parallel.group_mesh` / `shard_rows`, and
+`swarm_multiraft_*` observability.  See group.py for the G=1
+bit-identity contract and dst.py for adversary drivability.
+"""
+
+from swarmkit_tpu.multiraft.dst import run_groups_under_schedule
+from swarmkit_tpu.multiraft.group import (
+    aggregate_committed, aggregate_reads_blocked, aggregate_reads_served,
+    group_leader_mask, group_leaders, groups_of, groups_with_leader,
+    init_groups, propose_groups, run_group_ticks, step_groups,
+    submit_reads_groups,
+)
+from swarmkit_tpu.multiraft.obs import METRIC_NAMES, MultiRaftObs
+from swarmkit_tpu.multiraft.router import Router, group_of_key
+
+__all__ = [
+    "METRIC_NAMES", "MultiRaftObs", "Router",
+    "aggregate_committed", "aggregate_reads_blocked",
+    "aggregate_reads_served", "group_leader_mask", "group_leaders",
+    "group_of_key", "groups_of", "groups_with_leader", "init_groups",
+    "propose_groups", "run_group_ticks", "run_groups_under_schedule",
+    "step_groups", "submit_reads_groups",
+]

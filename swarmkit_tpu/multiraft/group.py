@@ -1,0 +1,202 @@
+"""[G, N, ...] multi-group serving plane over the single-group tick kernel.
+
+Production stores shard the keyspace over many small raft groups rather
+than one giant quorum (CockroachDB/TiKV ranges; arXiv:2004.05074 frames
+per-group consensus as the composable unit).  The DST layer already vmaps
+S independent clusters over a leading schedule axis (dst/explore.py);
+this module promotes that batch axis into a first-class SERVING mode: a
+[G, N, ...] state holding G independent groups, advanced one tick at a
+time by `jax.vmap` over the unmodified `kernel.step` — so every
+`SimConfig` lever (tiled log, banded peer reductions, role-sparse
+progress, leases, mailbox wires, storage model) stays live per group,
+and per-group optimizations port mechanically (arXiv:1905.10786).
+
+Bit-identity contract: `step_groups` is PYTHON-GATED on the group count.
+At G == 1 it bypasses vmap entirely and runs the plain single-group
+`step` on the squeezed state, so the compiled program — not just its
+values — is literally today's kernel (pinned by
+tests/test_multiraft.py::test_g1_bit_identity).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from swarmkit_tpu.raft.sim.kernel import propose, step
+from swarmkit_tpu.raft.sim.run import (
+    _payload_at, leader_mask, submit_reads,
+)
+from swarmkit_tpu.raft.sim.state import (
+    SimConfig, SimState, init_state, rand_timeout,
+)
+
+I32 = jnp.int32
+
+
+def groups_of(gstate: SimState) -> int:
+    """Static group count G of a grouped state (leading-axis length)."""
+    return gstate.tick.shape[0]
+
+
+def init_groups(cfg: SimConfig, groups: int,
+                stagger: bool = True) -> SimState:
+    """Stack `groups` fresh independent clusters on a new leading [G] axis.
+
+    Group 0 is bit-identical to ``init_state(cfg)`` — the G=1 serving
+    plane IS the single-group deployment.  With `stagger` (default),
+    groups g > 0 re-randomize their initial election timeouts with g
+    folded into the ``rand_timeout`` term argument (still inside
+    [T, 2T), still deterministic per (node, g, seed)), so a fresh fleet
+    does not campaign in lock-step across groups.
+    """
+    base = init_state(cfg)
+    gstate = jax.tree_util.tree_map(
+        lambda a: jnp.broadcast_to(a, (groups,) + a.shape), base)
+    if stagger and groups > 1:
+        node = jnp.arange(cfg.n, dtype=I32)
+        gid = jnp.arange(groups, dtype=I32)
+        tmo = jax.vmap(
+            lambda g: rand_timeout(cfg, node, jnp.full((cfg.n,), g, I32))
+        )(gid)
+        gstate = dataclasses.replace(gstate, timeout=tmo)
+    return gstate
+
+
+@partial(jax.jit, static_argnames=("cfg", "payload_fn"))
+def step_groups(gstate: SimState, cfg: SimConfig, alive=None, drop=None,
+                prop_count=None, payload_fn=None) -> SimState:
+    """Advance every group one tick (vmapped `kernel.step`, jit-cached
+    per (G, cfg) so host drivers like `Router.flush` pay one trace).
+
+    alive: [G, N] bool, drop: [G, N, N] bool — per-group fault inputs
+    (None = fault-free everywhere).  prop_count is the fused-propose
+    batch size: a scalar applies to all groups, a [G] array gives each
+    group its own count (the router's flush path) — pair it with
+    `payload_fn` exactly as in the single-group drivers.
+
+    G == 1 short-circuits to the plain single-group `step` (module
+    docstring: the bit-identity gate).
+    """
+    if groups_of(gstate) == 1:
+        one = jax.tree_util.tree_map(lambda a: a[0], gstate)
+        pc = None
+        if prop_count is not None:
+            pc = jnp.asarray(prop_count, I32).reshape(-1)[0] \
+                if jnp.ndim(prop_count) else jnp.asarray(prop_count, I32)
+        out = step(one, cfg,
+                   alive=None if alive is None else alive[0],
+                   drop=None if drop is None else drop[0],
+                   prop_count=pc, payload_fn=payload_fn)
+        return jax.tree_util.tree_map(lambda a: a[None], out)
+
+    pc = None if prop_count is None else jnp.asarray(prop_count, I32)
+    pc_axis = 0 if (pc is not None and pc.ndim == 1) else None
+
+    def one(st, alive_g, drop_g, pc_g):
+        return step(st, cfg, alive=alive_g, drop=drop_g,
+                    prop_count=pc_g, payload_fn=payload_fn)
+
+    return jax.vmap(
+        one,
+        in_axes=(0, None if alive is None else 0,
+                 None if drop is None else 0, pc_axis)
+    )(gstate, alive, drop, pc)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def propose_groups(gstate: SimState, cfg: SimConfig, payloads,
+                   counts) -> SimState:
+    """Vmapped host `propose`: payloads [G, max_props] uint32, counts [G].
+
+    Appends each group's batch to whatever row currently claims that
+    group's leadership (same acceptance rules as the single-group API).
+    Outside scans only — scan drivers must use the fused
+    ``step_groups(prop_count=, payload_fn=)`` path to keep the [G, N, L]
+    log buffers in place (kernel.step docstring).
+    """
+    return jax.vmap(
+        lambda st, pl, c: propose(st, cfg, pl, c)
+    )(gstate, jnp.asarray(payloads, jnp.uint32), jnp.asarray(counts, I32))
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def submit_reads_groups(gstate: SimState, cfg: SimConfig,
+                        counts) -> SimState:
+    """Vmapped `submit_reads`: counts [G] linearizable read ops offered to
+    every row of each group (cfg.read_batch > 0)."""
+    return jax.vmap(
+        lambda st, c: submit_reads(st, cfg, c)
+    )(gstate, jnp.asarray(counts, I32))
+
+
+@partial(jax.jit, static_argnames=("cfg", "n_ticks", "prop_count"))
+def run_group_ticks(gstate: SimState, cfg: SimConfig, n_ticks: int,
+                    prop_count: int = 0):
+    """Advance all groups `n_ticks` as one scan-compiled program.
+
+    Per tick: optionally fused-propose `prop_count` entries to each
+    group's leader (deterministic `_payload_at` payloads, as in
+    run_ticks).  Linearizable reads need no explicit driver — with
+    cfg.read_batch > 0 every group's kernel runs its own closed-loop
+    refill (Phase R0), so `aggregate_reads_served` advances on its own.
+
+    Returns (final, trace) with per-tick trace rows
+    [groups_with_leader, aggregate_commit].
+    """
+
+    def body(st, _):
+        if prop_count:
+            st = step_groups(st, cfg,
+                             prop_count=jnp.asarray(prop_count, I32),
+                             payload_fn=_payload_at)
+        else:
+            st = step_groups(st, cfg)
+        row = jnp.stack([groups_with_leader(st), aggregate_committed(st)])
+        return st, row
+
+    return jax.lax.scan(body, gstate, None, length=n_ticks)
+
+
+# --- aggregate observables (the serving plane's headline quantities) -----
+
+def group_leader_mask(gstate: SimState) -> jax.Array:
+    """[G, N] bool: rows currently acting as their group's leader."""
+    return jax.vmap(leader_mask)(gstate)
+
+
+def group_leaders(gstate: SimState) -> jax.Array:
+    """[G] int32: leader row per group, -1 while a group has none."""
+    lm = group_leader_mask(gstate)
+    return jnp.where(jnp.any(lm, axis=-1),
+                     jnp.argmax(lm, axis=-1).astype(I32), -1)
+
+
+def groups_with_leader(gstate: SimState) -> jax.Array:
+    """Scalar: number of groups that currently have an acting leader."""
+    return jnp.sum(jnp.any(group_leader_mask(gstate), axis=-1)
+                   .astype(I32))
+
+
+def aggregate_committed(gstate: SimState) -> jax.Array:
+    """Total entries committed through consensus, summed over groups
+    (per group: max commit across rows, as `committed_entries`)."""
+    return jnp.sum(jnp.max(gstate.commit, axis=-1))
+
+
+def aggregate_reads_served(gstate: SimState) -> jax.Array:
+    """Total linearizable read ops served across all groups and rows
+    (0 when the read path is off)."""
+    if gstate.read_srv is None:
+        return jnp.asarray(0, I32)
+    return jnp.sum(gstate.read_srv)
+
+
+def aggregate_reads_blocked(gstate: SimState) -> jax.Array:
+    """Total read ops refused (deposal / lease expiry) across groups."""
+    if gstate.read_block is None:
+        return jnp.asarray(0, I32)
+    return jnp.sum(gstate.read_block)

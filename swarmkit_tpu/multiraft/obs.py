@@ -1,0 +1,104 @@
+"""swarm_multiraft_* metric names + the serving-plane publisher.
+
+``METRIC_NAMES`` is the scrape-side schema for the multi-raft serving
+plane; ``tools/metrics_lint.py`` check #11 pins it to the catalog in both
+directions (every constant has a spec with exactly these labels, every
+swarm_multiraft_* spec has a constant), the same lockstep discipline the
+flight recorder (#5), telemetry plane (#6), and model checker (#7) get.
+
+`MultiRaftObs` mirrors `KernelObs` (raft/sim/run.py) for the group axis:
+pull the tiny aggregate quantities off device once per publish, fold the
+cumulative ones through the shared per-registry delta seam
+(metrics/scrape.py) so repeated publishes of the same state add nothing,
+and gauge the point-in-time ones.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from swarmkit_tpu.multiraft.group import (
+    aggregate_committed, aggregate_reads_served, group_leaders, groups_of,
+)
+from swarmkit_tpu.raft.sim.state import SimState
+
+METRIC_GROUPS = "swarm_multiraft_groups"
+METRIC_GROUPS_WITH_LEADER = "swarm_multiraft_groups_with_leader"
+METRIC_ROUTER_KEYS = "swarm_multiraft_router_keys_total"
+METRIC_LEADER_CHANGES = "swarm_multiraft_leader_changes_total"
+METRIC_COMMITTED = "swarm_multiraft_committed_entries_total"
+METRIC_READS = "swarm_multiraft_reads_served_total"
+
+# name -> required label names, exactly as the catalog must declare them
+METRIC_NAMES = {
+    METRIC_GROUPS: (),
+    METRIC_GROUPS_WITH_LEADER: (),
+    METRIC_ROUTER_KEYS: ("outcome",),      # routed | spilled
+    METRIC_LEADER_CHANGES: (),
+    METRIC_COMMITTED: (),
+    METRIC_READS: (),
+}
+
+# one valid value per label, for the lint's publishability probe
+SAMPLE_LABELS = {
+    "outcome": "routed",
+}
+
+
+class MultiRaftObs:
+    """Host-side observability for a [G, N, ...] grouped state.
+
+    ``publish(gstate)`` folds the aggregate serving quantities into the
+    swarm_multiraft_* families and returns them as a dict.  Per-group
+    leader changes are detected host-side by diffing each group's leader
+    row against the previous publish: a group whose CURRENT leader is a
+    different concrete row than last time counts one change (the first
+    publish only establishes the baseline; a group that merely lost its
+    leader counts when the replacement appears).  Router outcomes are
+    pushed by the Router through ``router_keys``.
+    """
+
+    def __init__(self, registry=None) -> None:
+        from swarmkit_tpu.metrics import catalog as obs_catalog
+        from swarmkit_tpu.metrics import registry as obs_registry
+        from swarmkit_tpu.metrics import scrape as obs_scrape
+
+        self.obs = registry or obs_registry.DEFAULT
+        self._m = {name: obs_catalog.get(self.obs, name)
+                   for name in METRIC_NAMES}
+        self._deltas = obs_scrape.deltas_for(self.obs)
+        self._last_leaders: np.ndarray | None = None
+
+    def router_keys(self, outcome: str, n: int = 1) -> None:
+        self._m[METRIC_ROUTER_KEYS].labels(outcome=outcome).inc(n)
+
+    def publish(self, gstate: SimState) -> dict:
+        g = groups_of(gstate)
+        leaders = np.asarray(jax.device_get(group_leaders(gstate)))
+        with_leader = int((leaders >= 0).sum())
+        self._m[METRIC_GROUPS].set(g)
+        self._m[METRIC_GROUPS_WITH_LEADER].set(with_leader)
+
+        changes = 0
+        if self._last_leaders is not None:
+            changes = int(((leaders >= 0)
+                           & (leaders != self._last_leaders)).sum())
+            if changes:
+                self._m[METRIC_LEADER_CHANGES].inc(changes)
+        self._last_leaders = leaders
+
+        out = {"groups": g, "groups_with_leader": with_leader,
+               "leader_changes": changes}
+        committed = int(jax.device_get(aggregate_committed(gstate)))
+        d = self._deltas.advance((METRIC_COMMITTED,), committed)
+        if d:
+            self._m[METRIC_COMMITTED].inc(d)
+        out["committed_entries"] = committed
+        if gstate.read_srv is not None:
+            reads = int(jax.device_get(aggregate_reads_served(gstate)))
+            d = self._deltas.advance((METRIC_READS,), reads)
+            if d:
+                self._m[METRIC_READS].inc(d)
+            out["reads_served"] = reads
+        return out

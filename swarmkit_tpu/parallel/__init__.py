@@ -50,6 +50,21 @@ def schedule_mesh(schedules: int, devices: Optional[Sequence] = None) -> Mesh:
     return row_mesh(schedules, devices, axis=SCHEDULE_AXIS)
 
 
+GROUP_AXIS = "groups"
+
+
+def group_mesh(groups: int, devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over the multiraft group axis (multiraft/).
+
+    The serving plane's [G, N, ...] state is embarrassingly data-parallel
+    over G independent raft groups — the leading G axis shards exactly
+    like the DST schedule axis, under its own mesh-axis name so a future
+    two-level layout (groups over hosts, rows over chips) composes with
+    `host_row_mesh` without a rename.
+    """
+    return row_mesh(groups, devices, axis=GROUP_AXIS)
+
+
 DCN_AXIS = "hosts"    # outer: crosses the data-center network
 ICI_AXIS = "chips"    # inner: rides the on-pod interconnect
 
@@ -135,19 +150,30 @@ def row_spec(ndim: int, axis=MANAGER_AXIS) -> P:
     return P(axis, *([None] * (ndim - 1)))
 
 
-def state_shardings(mesh: Mesh, tree, axis=MANAGER_AXIS):
+def state_shardings(mesh: Mesh, tree, axis=MANAGER_AXIS, leading=None):
     """Per-leaf NamedSharding tree: leading axis on the mesh axis (or axes).
 
     Leaves whose leading dimension the mesh does not divide are
     replicated instead of sharded: row-axis state always divides (the
     mesh is built from a divisor of n), so a non-divisible leaf is
-    per-cluster bookkeeping like the [4] stats vector, not row state."""
+    per-cluster bookkeeping like the [4] stats vector, not row state.
+
+    `leading` pins the rule to one axis length: only leaves whose dim 0
+    EQUALS it are sharded (divisibility still required), everything else
+    replicates.  The multiraft serving plane uses this for its [G, ...]
+    group axis — a grouped tree can carry group-shared leaves (router
+    tables, bootstrap configs) whose dim 0 is some multiple of the mesh
+    size by coincidence, and sharding those on the group axis would hand
+    each device the wrong slice of a shared table."""
     names = axis if isinstance(axis, tuple) else (axis,)
     size = 1
     for a in names:
         size *= mesh.shape[a]
 
     def _spec(leaf):
+        if leading is not None and (not leaf.ndim
+                                    or leaf.shape[0] != leading):
+            return P()
         if leaf.ndim and leaf.shape[0] % size == 0:
             return row_spec(leaf.ndim, axis)
         return P()
@@ -155,6 +181,6 @@ def state_shardings(mesh: Mesh, tree, axis=MANAGER_AXIS):
         lambda leaf: NamedSharding(mesh, _spec(leaf)), tree)
 
 
-def shard_rows(tree, mesh: Mesh, axis=MANAGER_AXIS):
+def shard_rows(tree, mesh: Mesh, axis=MANAGER_AXIS, leading=None):
     """device_put a pytree with row-major sharding over the mesh."""
-    return jax.device_put(tree, state_shardings(mesh, tree, axis))
+    return jax.device_put(tree, state_shardings(mesh, tree, axis, leading))

@@ -196,7 +196,12 @@ class KernelObs:
             sync_point(self.clock_sync, state)
         out: dict[str, int] = {}
         if state.stats is not None:
-            cur = [int(v) for v in jax.device_get(state.stats)]
+            # a multiraft grouped state carries [G, 4] stats; the kernel
+            # families are fleet aggregates, so fold the group axis first
+            arr = jax.device_get(state.stats)
+            cur = [int(v) for v in
+                   (arr.sum(axis=0) if getattr(arr, "ndim", 1) > 1
+                    else arr)]
             for name, fam, c in zip(self._STAT_NAMES, self._m_stats, cur):
                 d = self._deltas.advance((name,), c)
                 if d:
@@ -235,7 +240,11 @@ def sync_point(clock, state: SimState) -> int:
     (after a run_ticks burst, around propose/read submission) — two or
     three points across a run are enough for the Theil-Sen fit to remap
     the flight-ring tracks onto the host span timeline."""
-    tick = int(jax.device_get(state.tick))
+    import numpy as _np
+
+    # grouped multiraft states carry a [G] tick vector that advances in
+    # lock-step; any element is the correlation sample (max is robust)
+    tick = int(_np.max(jax.device_get(state.tick)))
     clock.add(tick)
     return tick
 

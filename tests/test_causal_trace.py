@@ -385,6 +385,44 @@ class TestBenchGateProvenance:
         assert gate_main([str(p1), str(p2),
                           "--strict-provenance"]) == 1
 
+    def test_headline_recording_nothing_is_flagged(self, tmp_path):
+        """A green round must carry numbers for what it claims to have
+        measured: an only-config round needs a recorded rate among its
+        matching configs_entries_per_s entries, a full round needs a
+        rate headline value."""
+        from bench_gate import check_provenance
+        paths = [
+            # only-config rounds: recorded / skipped-string-only / no dict
+            self._round(tmp_path, "BENCH_r01.json", tail="x", parsed={
+                "only_config": "multiraft-1024x3",
+                "configs_entries_per_s": {"multiraft-1024x3": 812345.0}}),
+            self._round(tmp_path, "BENCH_r02.json", tail="x", parsed={
+                "only_config": "32768-sharded",
+                "configs_entries_per_s": {
+                    "32768-sharded": "skipped (cpu)"}}),
+            self._round(tmp_path, "BENCH_r03.json", tail="x",
+                        parsed={"only_config": "32768-sharded"}),
+            # a cpu-reduced rename still counts for its parent config
+            self._round(tmp_path, "BENCH_r04.json", tail="x", parsed={
+                "only_config": "32768-sharded",
+                "configs_entries_per_s": {
+                    "32768-sharded-reduced-n4096": 5524.3}}),
+            # an A/B tripwire dict counts as recorded
+            self._round(tmp_path, "BENCH_r05.json", tail="x", parsed={
+                "only_config": "densepeer",
+                "configs_entries_per_s": {
+                    "densepeer-ab": {"banded_over_dense": 0.97}}}),
+            # full rounds: headline value present / absent
+            self._round(tmp_path, "BENCH_r06.json", tail="x",
+                        parsed={"value": 100.0}),
+            self._round(tmp_path, "BENCH_r07.json", tail="x",
+                        parsed={"value": None}),
+        ]
+        findings = check_provenance(paths=paths)
+        flagged = sorted(f.split(":")[0] for f in findings)
+        assert flagged == ["BENCH_r02.json", "BENCH_r03.json",
+                           "BENCH_r07.json"]
+
     def test_resource_series_gates_growth_not_collapse(self, tmp_path):
         from bench_gate import run_gate
 

@@ -17,3 +17,24 @@ def test_metrics_lint_is_clean():
 
     problems = run_lint(REPO_ROOT)
     assert not problems, "\n".join(problems)
+
+
+@pytest.mark.slow
+def test_check11_bites_in_both_directions(monkeypatch):
+    """Check #11 (multi-raft lockstep) flags an obs.py constant with no
+    catalog spec AND a swarm_multiraft_* catalog entry with no constant."""
+    from metrics_lint import run_lint
+
+    from swarmkit_tpu.metrics import catalog
+    from swarmkit_tpu.multiraft import obs as mr_obs
+
+    monkeypatch.setitem(mr_obs.METRIC_NAMES,
+                        "swarm_multiraft_bogus_total", ())
+    orphan = "swarm_multiraft_orphan_total"
+    monkeypatch.setitem(catalog.CATALOG, orphan,
+                        catalog.MetricSpec("counter", "orphan for lint"))
+    problems = run_lint(REPO_ROOT)
+    assert any("swarm_multiraft_bogus_total" in p and "missing from the "
+               "catalog" in p for p in problems), problems
+    assert any(orphan in p and "no multiraft/obs.py constant" in p
+               for p in problems), problems

@@ -138,12 +138,31 @@ def run_gate(paths=None, tol: float = 0.5) -> dict:
     return report
 
 
+def _recorded_number(v) -> bool:
+    """A config entry carries a real number: a positive rate, or an A/B
+    dict with at least one (bench.py rounds ratios/rates the same way)."""
+    if _is_rate(v):
+        return True
+    return isinstance(v, dict) and any(_recorded_number(x)
+                                       for x in v.values())
+
+
 def check_provenance(paths=None) -> list[str]:
-    """Green-but-empty detector over round artifacts.  A round claiming
-    success (rc=0, ok not false, not skipped) with an empty tail recorded
-    nothing — the run either printed no provenance or the capture lost it;
-    either way the green is unearned.  `paths` defaults to the repo-root
-    MULTICHIP_r*.json + BENCH_r*.json trajectories."""
+    """Green-but-empty detector over round artifacts.
+
+    Two findings, both unearned greens:
+
+    - a round claiming success (rc=0, ok not false, not skipped) with an
+      EMPTY TAIL recorded nothing — the run either printed no provenance
+      or the capture lost it (MULTICHIP r02-r05);
+    - a green round whose HEADLINE CONFIG recorded no numbers: an
+      only-config round (parsed carries ``only_config``) none of whose
+      matching ``configs_entries_per_s`` entries is a rate, or a full
+      round whose headline ``value`` is not a positive rate — rc=0 with
+      nothing measured proves nothing about the config it claims.
+
+    `paths` defaults to the repo-root MULTICHIP_r*.json + BENCH_r*.json
+    trajectories."""
     if paths is None:
         paths = (glob.glob(os.path.join(_ROOT, "MULTICHIP_r*.json"))
                  + glob.glob(os.path.join(_ROOT, "BENCH_r*.json")))
@@ -158,12 +177,32 @@ def check_provenance(paths=None) -> list[str]:
             continue
         green = d.get("rc") == 0 and d.get("ok") is not False \
             and not d.get("skipped")
-        if green and not str(d.get("tail") or "").strip():
+        if not green:
+            continue
+        if not str(d.get("tail") or "").strip():
             findings.append(
                 f"{name}: green (rc=0, ok={d.get('ok')!r}) but the recorded "
                 "tail is empty — nothing proves the run did anything; "
                 "record the run's JSON line or set skipped=true with a "
                 "reason")
+            continue
+        parsed = d.get("parsed")
+        if not isinstance(parsed, dict):
+            continue
+        only = parsed.get("only_config")
+        cfgs = parsed.get("configs_entries_per_s")
+        if only:
+            vals = [v for k, v in cfgs.items() if only in k] \
+                if isinstance(cfgs, dict) else []
+            if not any(_recorded_number(v) for v in vals):
+                findings.append(
+                    f"{name}: green but headline config {only!r} recorded "
+                    "no numbers in configs_entries_per_s — the round "
+                    "measured nothing it set out to measure")
+        elif not _is_rate(parsed.get("value")):
+            findings.append(
+                f"{name}: green but the headline recorded no numbers "
+                f"(value={parsed.get('value')!r})")
     return findings
 
 

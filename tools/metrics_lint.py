@@ -61,6 +61,13 @@ Checks, in order:
     tag semantics off names); and the decoder's ``FlightEvent`` carries
     the ``tag`` field the tagged lane decodes into.
 
+11. The multi-raft serving plane (ISSUE 18) keeps its names honest:
+    the ``swarm_multiraft_*`` constants (``multiraft/obs.py
+    METRIC_NAMES``) and the catalog mirror each other exactly in both
+    directions, every declared label publishes with its sample value,
+    and every label has a ``SAMPLE_LABELS`` entry — same lockstep as
+    check #7.
+
 Importable (``run_lint`` returns the problem list) so the pytest wrapper
 in tests/test_metrics_lint.py runs it in-suite; the CLI exits nonzero on
 any finding.
@@ -449,6 +456,45 @@ def run_lint(repo_root: str | None = None) -> list[str]:
     if "tag" not in ev_fields:
         problems.append("trace: decoder.FlightEvent lacks the 'tag' field "
                         "the tagged lane decodes into")
+
+    # 11. multi-raft serving-plane wiring (ISSUE 18): the swarm_multiraft_*
+    #     names the plane publishes (multiraft/obs.py METRIC_NAMES) and the
+    #     catalog stay in the same two-way lockstep as checks #5-#7
+    from swarmkit_tpu.multiraft import obs as mr_obs
+
+    for name, labels in mr_obs.METRIC_NAMES.items():
+        spec = catalog.CATALOG.get(name)
+        if spec is None:
+            problems.append(f"multiraft: {name!r} (multiraft/obs.py) "
+                            "missing from the catalog")
+            continue
+        if tuple(spec.labels) != tuple(labels):
+            problems.append(
+                f"multiraft: {name!r} labels {tuple(spec.labels)} diverge "
+                f"from multiraft.obs.METRIC_NAMES {tuple(labels)}")
+            continue
+        fam = catalog.get(MetricsRegistry(strict=True), name)
+        kwargs = {lb: mr_obs.SAMPLE_LABELS[lb] for lb in labels}
+        try:
+            if spec.kind == "gauge":
+                fam.labels(**kwargs).set(0)
+            else:
+                fam.labels(**kwargs).inc(0)
+        except (MetricError, KeyError) as e:
+            problems.append(f"multiraft: {name!r} cannot publish with "
+                            f"sample labels {kwargs}: {e}")
+    # built from pieces so check #3's literal scan skips this prefix
+    mr_prefix = "_".join(("swarm", "multiraft", ""))
+    for name in catalog.CATALOG:
+        if name.startswith(mr_prefix) \
+                and name not in mr_obs.METRIC_NAMES:
+            problems.append(f"multiraft: catalog entry {name!r} has no "
+                            "multiraft/obs.py constant (the serving plane "
+                            "can't publish it)")
+    for lb in {l for ls in mr_obs.METRIC_NAMES.values() for l in ls}:
+        if lb not in mr_obs.SAMPLE_LABELS:
+            problems.append(f"multiraft: label {lb!r} missing from "
+                            "multiraft.obs.SAMPLE_LABELS")
     return problems
 
 

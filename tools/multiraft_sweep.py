@@ -1,0 +1,98 @@
+"""Multi-raft G-sweep: aggregate serving throughput vs group count.
+
+Runs ``bench.measure_multiraft`` across a list of group counts (default
+G in {64, 256, 1024}, N=3 voters each) plus the single-group headline
+shape (G=1, n=4096) as the contrast row, and prints the PERF.md
+"Multi-raft serving" table: aggregate committed entries/s and
+lease-served reads/s summed over groups, with election settle time and
+compile cost per point.  The contrast is the paper's serving-plane
+story: many small quorums vs one giant one on the SAME tick kernel.
+
+Every point also emits one JSON line on stdout (``--json``) so sweeps
+are machine-diffable like bench.py rounds; the human table goes last.
+
+Usage:
+    python tools/multiraft_sweep.py                  # full sweep
+    python tools/multiraft_sweep.py --groups 64,256 --entries 500000
+    python tools/multiraft_sweep.py --no-single      # skip the G=1 row
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools import _cli_common  # noqa: E402
+
+_cli_common.bootstrap()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--groups", default="64,256,1024",
+                    help="comma-separated group counts (default 64,256,1024)")
+    ap.add_argument("--n", type=int, default=3,
+                    help="voters per group (default 3)")
+    ap.add_argument("--entries", type=int, default=2_000_000,
+                    help="aggregate entries to commit per point")
+    ap.add_argument("--seed", type=int, default=2024)
+    ap.add_argument("--single-n", type=int, default=4096,
+                    help="row count for the single-group contrast row")
+    ap.add_argument("--no-single", action="store_true",
+                    help="skip the G=1 single-group contrast row")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON line per point (before the table)")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    import bench
+
+    rows = []
+    for g in [int(x) for x in args.groups.split(",") if x]:
+        print(f"measuring G={g} n={args.n} ...", file=sys.stderr, flush=True)
+        r = bench.measure_multiraft(jax, g, args.n, args.entries, args.seed)
+        rows.append((f"{g} x n={args.n}", r))
+        if args.json:
+            print(json.dumps({"groups": g, "n": args.n, **{
+                k: round(v, 1) if isinstance(v, float) else v
+                for k, v in r.items()}}), flush=True)
+
+    if not args.no_single:
+        print(f"measuring single group n={args.single_n} ...",
+              file=sys.stderr, flush=True)
+        # the contrast row reports a RATE, so a few hundred ticks of
+        # steady state suffice — don't scale its entry count with the
+        # aggregate target (n=4096 single-group ticks are ~3 orders
+        # costlier than a G x n=3 tick)
+        s = bench.measure(
+            jax, args.single_n, entries=min(args.entries, 200_000),
+            seed=args.seed,
+            election_tick=bench.election_tick_for(args.single_n))
+        rows.append((f"1 x n={args.single_n}",
+                     {"rate": s["rate"], "read_rate": float("nan"),
+                      "groups_with_leader": 1, "groups": 1,
+                      "elect_ticks": s["election_ticks"],
+                      "t_compile": s.get("t_compile", 0.0)}))
+        if args.json:
+            print(json.dumps({"groups": 1, "n": args.single_n,
+                              "rate": round(s["rate"], 1)}), flush=True)
+
+    print("\n| groups | agg entries/s | agg reads/s | led | elect ticks "
+          "| compile s |")
+    print("|---|---|---|---|---|---|")
+    for label, r in rows:
+        reads = ("-" if r["read_rate"] != r["read_rate"]
+                 else f"{r['read_rate']:,.0f}")
+        print(f"| {label} | {r['rate']:,.0f} | {reads} "
+              f"| {r['groups_with_leader']}/{r['groups']} "
+              f"| {r['elect_ticks']} | {r['t_compile']:.1f} |")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
