@@ -722,6 +722,19 @@ def main() -> None:
             # number lands as the separate "multiraft-1024x3-reads"
             # series (bench_gate gates both as throughput series).
             ("multiraft-1024x3", 3, {"_multiraft": 1024}),
+            # batched proposal pipeline A/B (handled specially below):
+            # sequential ProposeValue appends vs 64 in flight through the
+            # store's coalescing pipeline on the SAME 3-manager quorum;
+            # the pinned signal is the batched/sequential proposals/s
+            # ratio (bench_gate gates it via the _over_dense key) — the
+            # PR's >=5x acceptance bar lives here
+            ("cpl-batch64", 3, {"_cpl_ab": True}),
+            # control-plane load harness: 10k simulated agent sessions
+            # over real gRPC sockets (registration, heartbeats, a hot
+            # subset consuming assignments + writing statuses back);
+            # records assignments/s as the gated series, with sustained
+            # agents and heartbeat-RTT p99 alongside
+            ("controlplane-10k", 0, {"_loadharness": 10_000}),
         ):
             if only and only not in name:
                 extra.setdefault(f"filtered-by-only:{only}",
@@ -766,6 +779,85 @@ def main() -> None:
                 extra[name] = "skipped (budget)"
                 continue
             try:
+                if kw.pop("_cpl_ab", False):
+                    # batched-proposal tripwire: the replicated store's
+                    # sequential propose path vs the coalescing pipeline
+                    # at depth 64 on one quorum shape
+                    import asyncio as _aio
+
+                    from swarmkit_tpu.cmd.swarm_bench import \
+                        bench as _cpl_bench
+                    props = int(os.environ.get("BENCH_CPL_PROPOSALS",
+                                               "300"))
+                    dm = _aio.run(_cpl_bench(0, 0, managers=cn,
+                                             proposals=props))
+                    bm = _aio.run(_cpl_bench(0, 0, managers=cn,
+                                             proposals=max(600, 2 * props),
+                                             batch=64))
+                    ratio = bm["proposals_per_s"] / dm["proposals_per_s"]
+                    try:
+                        from swarmkit_tpu.metrics import \
+                            catalog as obs_catalog
+                        from swarmkit_tpu.metrics import \
+                            registry as obs_registry
+                        r = obs_registry.DEFAULT
+                        for tag, mm_ in (("dense", dm), ("batch64", bm)):
+                            obs_catalog.get(
+                                r, "swarm_bench_proposals_per_second"
+                            ).labels(config=f"{name}-{tag}").set(
+                                mm_["proposals_per_s"])
+                    except Exception as e:
+                        log(f"bench gauges failed: {e}")
+                    extra[name] = {
+                        "dense": dm["proposals_per_s"],
+                        "batch64": bm["proposals_per_s"],
+                        "entries_per_proposal": bm["entries_per_proposal"],
+                        "batched_over_dense": round(ratio, 3)}
+                    log(f"config {name}: sequential "
+                        f"{dm['proposals_per_s']:,.0f} vs batch-64 "
+                        f"{bm['proposals_per_s']:,.0f} proposals/s "
+                        f"({ratio:.2f}x, {bm['entries_per_proposal']:.1f} "
+                        f"entries/proposal)")
+                    if ratio < 2.0:
+                        RESULT.setdefault(
+                            "note", f"proposal-pipeline tripwire: batched "
+                            f"rate {bm['proposals_per_s']:,.0f} < 2x "
+                            f"sequential {dm['proposals_per_s']:,.0f}")
+                    continue
+                la = kw.pop("_loadharness", 0)
+                if la:
+                    import asyncio as _aio
+                    import importlib.util as _ilu
+                    spec = _ilu.spec_from_file_location(
+                        "soak_controlplane", os.path.join(
+                            os.path.dirname(os.path.abspath(__file__)),
+                            "tools", "soak_controlplane.py"))
+                    harness = _ilu.module_from_spec(spec)
+                    spec.loader.exec_module(harness)
+                    agents = int(os.environ.get("BENCH_CPL_AGENTS",
+                                                str(la)))
+                    lm = _aio.run(harness.load(
+                        minutes=float(os.environ.get(
+                            "BENCH_CPL_MINUTES", "1.0")),
+                        agents=agents, report_every=30.0,
+                        sustain_floor=0.98))
+                    if "error" in lm:
+                        raise MeasureError(lm["error"])
+                    extra[name] = lm["assignments_per_s"]
+                    extra[f"{name}-agents-sustained"] = \
+                        lm["agents_sustained"]
+                    RESULT["controlplane"] = {
+                        k: lm[k] for k in (
+                            "agents", "agents_sustained", "rtt_p50_ms",
+                            "rtt_p99_ms", "heartbeats_per_s",
+                            "assignments_per_s", "entries_per_proposal")}
+                    log(f"config {name}: {lm['agents_sustained']}/"
+                        f"{lm['agents']} agents sustained, "
+                        f"{lm['assignments_per_s']:.1f} assignments/s, "
+                        f"hb rtt p99 {lm['rtt_p99_ms']:.1f}ms, "
+                        f"{lm['entries_per_proposal']:.1f} "
+                        f"entries/proposal")
+                    continue
                 gcount = kw.pop("_multiraft", 0)
                 if gcount:
                     mm = measure_multiraft(jax, gcount, cn, target_entries,

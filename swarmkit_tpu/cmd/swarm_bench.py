@@ -31,7 +31,8 @@ from swarmkit_tpu.store.memory import Event, match
 
 async def bench(replicas: int, workers: int, managers: int = 1,
                 transport: str = "inproc", tick_interval: float = 0.05,
-                election_tick: int = 4, proposals: int = 0) -> dict:
+                election_tick: int = 4, proposals: int = 0,
+                batch: int = 1, coalesce_window: float = 0.0) -> dict:
     import tempfile
 
     transport_factory = None
@@ -83,27 +84,52 @@ async def bench(replicas: int, workers: int, managers: int = 1,
         return lead.dispatcher
 
     if proposals > 0:
-        # BASELINE.json config 2: N-manager quorum, sequential ProposeValue
-        # appends through the leader's replicated store — per-proposal
-        # commit latency through the real raft path (reference
-        # swarm-bench's role for control-plane throughput)
+        # BASELINE.json config 2: N-manager quorum ProposeValue appends
+        # through the leader's replicated store — per-proposal commit
+        # latency through the real raft path (reference swarm-bench's
+        # role for control-plane throughput).  batch > 1 switches the
+        # store to the coalescing proposal pipeline (store/pipeline.py)
+        # and keeps k appends in flight concurrently, so many txns pack
+        # into one raft round ("k appends/round" in PERF.md).
         from swarmkit_tpu.api import Config as ApiConfig, ConfigSpec
 
+        if batch > 1:
+            from swarmkit_tpu.store.pipeline import CoalesceConfig
+            lead.store.set_coalescing(CoalesceConfig(
+                window=coalesce_window, max_entries=max(batch, 2)))
+
         lat: list[float] = []
-        t0 = time.perf_counter()
-        for i in range(proposals):
+
+        async def one(i: int) -> None:
             p0 = time.perf_counter()
-            await lead.store.update(lambda tx, i=i: tx.create(ApiConfig(
+            await lead.store.update(lambda tx: tx.create(ApiConfig(
                 id=f"bench-cfg-{i}",
                 spec=ConfigSpec(annotations=Annotations(name=f"p{i}"),
                                 data=b"x"))))
             lat.append(time.perf_counter() - p0)
+
+        t0 = time.perf_counter()
+        if batch > 1:
+            for base in range(0, proposals, batch):
+                await asyncio.gather(*(
+                    one(i) for i in range(base,
+                                          min(base + batch, proposals))))
+        else:
+            for i in range(proposals):
+                await one(i)
         total = time.perf_counter() - t0
         lat.sort()
 
         def ppct(p):
             return lat[min(len(lat) - 1, int(p * len(lat)))]
 
+        packed = committed = 0.0
+        if batch > 1:
+            from swarmkit_tpu.metrics import catalog as obs_catalog
+            packed = obs_catalog.get(lead.obs, "swarm_cpl_proposals_total") \
+                .labels(outcome="committed").value
+            committed = obs_catalog.get(lead.obs, "swarm_cpl_txns_total") \
+                .labels(outcome="committed").value
         for m in mgrs:
             await m.stop()
         close = getattr(net, "close", None)
@@ -111,7 +137,10 @@ async def bench(replicas: int, workers: int, managers: int = 1,
             close()
         return {
             "managers": managers, "transport": transport,
-            "proposals": proposals,
+            "proposals": proposals, "batch": batch,
+            "entries_per_proposal": round(committed / packed, 2)
+            if packed else 1.0,
+            "coalesce_window_ms": round(coalesce_window * 1e3, 3),
             "proposals_per_s": round(proposals / total, 1),
             "propose_p50_ms": round(ppct(0.5) * 1e3, 3),
             "propose_p99_ms": round(ppct(0.99) * 1e3, 3),
@@ -191,12 +220,21 @@ def main(argv=None) -> int:
                    help="measure N sequential ProposeValue appends through "
                         "the manager quorum instead of the task-startup "
                         "flow (BASELINE config 2)")
+    p.add_argument("--batch", type=int, default=1,
+                   help="keep k proposals in flight and coalesce them into "
+                        "packed raft rounds via the store's proposal "
+                        "pipeline (1 = the sequential baseline path)")
+    p.add_argument("--coalesce-window", type=float, default=0.0,
+                   help="pipeline gathering window in seconds (0 = one "
+                        "event-loop pass)")
     args = p.parse_args(argv)
     result = asyncio.run(bench(args.replicas, args.workers, args.managers,
                                transport=args.transport,
                                tick_interval=args.tick_interval,
                                election_tick=args.election_tick,
-                               proposals=args.proposals))
+                               proposals=args.proposals,
+                               batch=args.batch,
+                               coalesce_window=args.coalesce_window))
     json.dump(result, sys.stdout)
     sys.stdout.write("\n")
     return 0

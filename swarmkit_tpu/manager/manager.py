@@ -59,7 +59,9 @@ class Manager:
                  election_tick: int = 10, heartbeat_tick: int = 1,
                  seed: int = 0, security=None,
                  encrypter=None, decrypter=None,
-                 transport_factory=None, obs=None) -> None:
+                 transport_factory=None, obs=None,
+                 coalesce=None, sched_use_kernel: bool = False,
+                 sched_commit_debounce: Optional[float] = None) -> None:
         self.node_id = node_id
         self.addr = addr
         self.clock = clock or SystemClock()
@@ -84,6 +86,13 @@ class Manager:
             encrypter=encrypter, decrypter=decrypter,
             transport_factory=transport_factory))
         self.store: MemoryStore = self.raft.store
+        # vectorized control plane knobs: batched proposal pipeline
+        # (store/pipeline.py CoalesceConfig, or True for defaults) and the
+        # jitted [tasks, nodes] scheduler kernel
+        if coalesce is not None:
+            self.store.set_coalescing(coalesce)
+        self._sched_use_kernel = sched_use_kernel
+        self._sched_commit_debounce = sched_commit_debounce
 
         # always-on services (reference: manager.go:526-548)
         self.metrics = Collector(self.store)
@@ -201,6 +210,7 @@ class Manager:
                 pass
             self._leadership_task = None
         await self._become_follower()
+        await self.store.stop_coalescing()
         await self.metrics.stop()
         await self.raft.stop()
 
@@ -251,7 +261,11 @@ class Manager:
                 org=cluster.id, clock=self.clock)
         self.control_api.ca_server = self.ca_server
 
-        sched = Scheduler(self.store, clock=self.clock, obs=self.obs)
+        sched_kw = {}
+        if self._sched_commit_debounce is not None:
+            sched_kw["commit_debounce"] = self._sched_commit_debounce
+        sched = Scheduler(self.store, clock=self.clock, obs=self.obs,
+                          use_kernel=self._sched_use_kernel, **sched_kw)
         replicated = ReplicatedOrchestrator(self.store, clock=self.clock)
         global_ = GlobalOrchestrator(self.store, clock=self.clock)
         reaper = TaskReaper(self.store, clock=self.clock)

@@ -1,0 +1,254 @@
+"""Jitted [tasks, nodes] scheduler kernel.
+
+The host path (scheduler.py ``_schedule_group``) re-runs the filter
+Pipeline and rebuilds the spread DecisionTree once PER TASK — O(T · N)
+Python with an O(N log N) sort inside.  This module expresses the same
+group fan-out as one jitted device program: encoded feasibility columns,
+a ``lax.fori_loop`` greedy pass, and masked lexicographic argmins — the
+same array-native shape the raft tick already has.
+
+**Bit-identity contract.**  Every task in a group shares one spec
+(``_common_spec_key``), so per-(group, node) the filters split into
+
+- *static* checks — Ready, Plugin, Constraint, Platform, plus the
+  initial HostPort occupancy and the zero-reservation sign checks of
+  Resource — evaluated ONCE on the host using the real filter classes
+  (no re-implementation to drift), and
+- *dynamic* checks — Resource cpu/mem/discrete-generic depletion,
+  MaxReplicas, and same-group HostPort self-conflicts — which under an
+  identical-spec group reduce to an integer per-node CAPACITY
+  ``cap[n]`` = how many tasks of this spec the node can take.  The only
+  device-side state is ``a[n]``, tasks assigned so far; feasibility at
+  every step is ``static[n] & (a[n] < cap[n])``, exactly complementing
+  the filters' ``>`` comparisons (host capacities are computed with
+  exact Python integers, so no 64-bit device arithmetic is needed).
+
+Selection replicates ``find_best_nodes(1, ...)``: a stable-sorted
+lexicographic minimum over (taint, count_for_service,
+active_task_count, insertion index), nested inside a (branch load,
+branch first-seen index) minimum when one spread preference level is
+present — the DecisionTree's stable branch ranking and its dict
+insertion order tie-break, re-derived per task from the CURRENT
+feasible set just as the host rebuilds the tree per task.
+
+``encode_group`` returns None — host Pipeline fallback — for the cases
+the encoding does not cover: named generic resources (claim side
+effects) and >1 spread preference levels.  The host Pipeline stays the
+oracle; tests/test_scheduler_kernel.py pins decisions bit-identical on
+randomized task/node sets.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+from swarmkit_tpu.manager.scheduler.filters import (
+    ConstraintFilter, HostPortFilter, Pipeline, PlatformFilter, PluginFilter,
+    ReadyFilter,
+)
+from swarmkit_tpu.manager.scheduler.nodeinfo import NodeInfo, task_reserved
+from swarmkit_tpu.manager.scheduler.nodeset import spread_keys
+
+log = logging.getLogger("swarmkit_tpu.sched_kernel")
+
+# Locked two-way to the catalog by metrics_lint check #12.
+METRIC_NAMES: dict[str, tuple[str, ...]] = {
+    "swarm_sched_kernel_groups_total": ("path",),
+    "swarm_sched_kernel_tasks_total": (),
+    "swarm_sched_kernel_seconds": (),
+}
+SAMPLE_LABELS: dict[str, str] = {"path": "kernel"}
+
+_STATIC_FILTERS = (ReadyFilter, PluginFilter, ConstraintFilter,
+                   PlatformFilter)
+
+
+def _pow2(n: int, floor: int = 8) -> int:
+    out = floor
+    while out < n:
+        out *= 2
+    return out
+
+
+@dataclass
+class GroupEncoding:
+    """Host-encoded columns for one task group (all lists length N, the
+    scheduler's node insertion order)."""
+
+    node_list: list          # NodeInfo, insertion order
+    static_ok: list          # bool
+    cap: list                # int, 0..T+1
+    count0: list             # count_for_service at group start
+    active0: list            # active_task_count at group start
+    taint: list              # bool
+    branch: list             # spread branch id (all 0 when no spread)
+    n_branches: int          # 0 = no spread level
+    has_service: bool
+    gen: dict                # discrete generic reservation (for decode)
+
+
+def encode_group(sample, prefs: list[str], node_list: list[NodeInfo],
+                 fkey: tuple, now: float) -> Optional[GroupEncoding]:
+    """Encode one group's scheduling state; None → host fallback."""
+    t_cap = 1 << 30  # "unbounded" sentinel before clamping
+
+    cpus, mem, gen = task_reserved(sample)
+    res_active = bool(cpus or mem or gen)
+    if gen and any(k in info.available_named
+                   for info in node_list for k in gen):
+        return None   # named generic resources: claim side effects
+    spreads = [p for p in prefs
+               if (p.split("=", 1)[0].strip().lower() if "=" in p
+                   else "spread") == "spread"]
+    if len(spreads) > 1:
+        return None   # multi-level spread tree
+
+    statics = Pipeline(filters=_STATIC_FILTERS)
+    statics.set_task(sample)
+    hostport = HostPortFilter()
+    hostport_active = hostport.set_task(sample)
+
+    p = sample.spec.placement
+    max_replicas = p.max_replicas if p is not None else 0
+    service_id = sample.service_id
+
+    static_ok, cap, count0, active0, taintv = [], [], [], [], []
+    branch, branch_ids = [], {}
+    for info in node_list:
+        ok = statics.process(info)
+        c = t_cap
+        if res_active:
+            # exact complements of ResourceFilter.check under repeated
+            # identical reservations, computed with Python bigints:
+            # after a assignments, available = initial - a*need, and
+            # "need > available" fails ⇔ a >= floor(initial/need)
+            for need, avail in ((cpus, info.available_cpus),
+                                (mem, info.available_memory)):
+                if need > 0:
+                    c = min(c, avail // need if avail >= 0 else 0)
+                elif avail < 0:
+                    ok = False     # "0 > avail" fails the host check
+            for k, v in gen.items():
+                avail = info.available_generic.get(k, 0)
+                if v > 0:
+                    c = min(c, avail // v if avail >= 0 else 0)
+                elif avail < 0:
+                    ok = False
+        if max_replicas > 0 and service_id:
+            # serviceless tasks never bump count_for_service, so the host
+            # check stays 0 < max forever — no capacity bound
+            c = min(c, max_replicas - info.count_for_service(service_id))
+        if hostport_active:
+            if not hostport.check(info):
+                ok = False
+            # same-group tasks publish the same host ports: one per node
+            c = min(c, 1)
+        static_ok.append(bool(ok))
+        cap.append(max(0, min(c, t_cap)))
+        count0.append(info.count_for_service(service_id))
+        active0.append(info.active_task_count())
+        # idempotent: the host comparator calls taint() repeatedly with
+        # the same `now`; one call returns the same value and leaves
+        # recent_failures in the same pruned state
+        taintv.append(bool(info.taint(fkey, now)))
+        if spreads:
+            key = spread_keys(spreads, info)[0]
+            branch.append(branch_ids.setdefault(key, len(branch_ids)))
+        else:
+            branch.append(0)
+    return GroupEncoding(node_list=node_list, static_ok=static_ok, cap=cap,
+                         count0=count0, active0=active0, taint=taintv,
+                         branch=branch, n_branches=len(branch_ids),
+                         has_service=bool(service_id), gen=gen)
+
+
+# --------------------------------------------------------------------------
+# device kernel
+
+def _build_place():
+    import jax
+    import jax.numpy as jnp
+
+    BIG = jnp.int32(1 << 30)
+
+    def _refine(m, vals):
+        """Narrow mask m to the entries minimizing vals (lexicographic
+        stage; an all-false mask stays all-false)."""
+        best = jnp.where(m, vals, BIG).min()
+        return m & (vals == best)
+
+    @partial(jax.jit, static_argnames=("t_pad", "b_pad", "spread"))
+    def place(static_ok, cap, count0, active0, taint, branch,
+              has_service, t_count, *, t_pad, b_pad, spread):
+        n = static_ok.shape[0]
+        idx = jnp.arange(n, dtype=jnp.int32)
+
+        def body(i, state):
+            a, choices = state
+            count = count0 + a * has_service
+            active = active0 + a
+            feas = static_ok & (a < cap)
+            found = feas.any() & (i < t_count)
+            if spread:
+                load_b = jnp.zeros(b_pad, jnp.int32).at[branch].add(
+                    jnp.where(feas, count, 0))
+                any_b = jnp.zeros(b_pad, jnp.bool_).at[branch].max(feas)
+                first_b = jnp.full(b_pad, BIG, jnp.int32).at[branch].min(
+                    jnp.where(feas, idx, BIG))
+                bm = _refine(any_b, load_b)
+                bm = _refine(bm, first_b)
+                bidx = jnp.argmax(bm).astype(jnp.int32)
+                feas = feas & (branch == bidx)
+            m = _refine(feas, taint.astype(jnp.int32))
+            m = _refine(m, count)
+            m = _refine(m, active)
+            pick = jnp.where(m, idx, BIG).min().astype(jnp.int32)
+            choice = jnp.where(found, pick, jnp.int32(-1))
+            a = a.at[choice].add(jnp.where(found, 1, 0).astype(jnp.int32))
+            return a, choices.at[i].set(choice)
+
+        a0 = jnp.zeros(n, jnp.int32)
+        out0 = jnp.full(t_pad, -1, jnp.int32)
+        _, choices = jax.lax.fori_loop(0, t_pad, body, (a0, out0))
+        return choices
+
+    return place
+
+
+_PLACE = None
+
+
+def place_group(enc: GroupEncoding, n_tasks: int) -> list[int]:
+    """Run the jitted kernel; returns per-task node indices (-1 = no
+    fit), FIFO over the group."""
+    global _PLACE
+    import numpy as np
+
+    if _PLACE is None:
+        _PLACE = _build_place()
+    n = len(enc.node_list)
+    n_pad = _pow2(n)
+    t_pad = _pow2(n_tasks)
+    b_pad = _pow2(max(1, enc.n_branches), floor=1)
+    t_clamp = min(1 << 20, t_pad) + 1
+
+    def col(vals, fill, dtype):
+        arr = np.full(n_pad, fill, dtype=dtype)
+        arr[:n] = vals
+        return arr
+
+    choices = _PLACE(
+        col([bool(v) for v in enc.static_ok], False, np.bool_),
+        col([min(v, t_clamp) for v in enc.cap], 0, np.int32),
+        col(enc.count0, 0, np.int32),
+        col(enc.active0, 0, np.int32),
+        col([bool(v) for v in enc.taint], False, np.bool_),
+        col(enc.branch, 0, np.int32),
+        np.int32(1 if enc.has_service else 0),
+        np.int32(n_tasks),
+        t_pad=t_pad, b_pad=b_pad,
+        spread=enc.n_branches > 0)
+    return [int(c) for c in np.asarray(choices)[:n_tasks]]

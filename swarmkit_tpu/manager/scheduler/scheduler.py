@@ -33,10 +33,26 @@ MAX_LATENCY = 1.0         # reference: scheduler.go:124
 
 class Scheduler:
     def __init__(self, store: MemoryStore, clock: Optional[Clock] = None,
-                 obs: Optional[obs_registry.MetricsRegistry] = None) -> None:
+                 obs: Optional[obs_registry.MetricsRegistry] = None,
+                 commit_debounce: float = COMMIT_DEBOUNCE,
+                 max_latency: float = MAX_LATENCY,
+                 use_kernel: bool = False) -> None:
         self.store = store
         self.clock = clock or SystemClock()
         self.obs = obs or obs_registry.DEFAULT
+        # debounce knobs ride the injected Clock, so tests and the load
+        # harness can run debounce-accurate without wall-clock sleeps
+        self.commit_debounce = commit_debounce
+        self.max_latency = max_latency
+        # jitted [tasks, nodes] group-placement kernel (kernel.py); the
+        # host Pipeline below stays the oracle and the fallback
+        self.use_kernel = use_kernel
+        self._m_kernel_groups = obs_catalog.get(
+            self.obs, "swarm_sched_kernel_groups_total")
+        self._m_kernel_tasks = obs_catalog.get(
+            self.obs, "swarm_sched_kernel_tasks_total")
+        self._m_kernel_seconds = obs_catalog.get(
+            self.obs, "swarm_sched_kernel_seconds")
         self._m_latency = obs_catalog.get(
             self.obs, "swarm_scheduler_latency_seconds")
         self._m_decisions = obs_catalog.get(
@@ -102,14 +118,14 @@ class Scheduler:
                     try:
                         nxt = watcher.try_get()
                         if nxt is None:
-                            await self.clock.sleep(COMMIT_DEBOUNCE)
+                            await self.clock.sleep(self.commit_debounce)
                             nxt = watcher.try_get()
                             if nxt is None:
                                 break
                         dirty = self._handle(nxt) or dirty
                     except Exception:
                         raise
-                    if self.clock.now() - start > MAX_LATENCY:
+                    if self.clock.now() - start > self.max_latency:
                         break
                 if dirty and self._running:
                     await self.tick()
@@ -334,6 +350,12 @@ class Scheduler:
                 return tb
             return better(a, b)
 
+        if self.use_kernel:
+            out = self._schedule_group_kernel(tasks, sample, prefs, fkey, now)
+            if out is not None:
+                return out
+            self._m_kernel_groups.labels(path="host").inc()
+
         out = []
         for task in tasks:
             candidates = self.node_set.find_best_nodes(
@@ -352,6 +374,37 @@ class Scheduler:
                 assigned.assigned_generic = info.claim_named(gen)
             info.add_task(assigned)
             out.append((task, info.id, assigned))
+        return out
+
+    def _schedule_group_kernel(self, tasks, sample, prefs, fkey, now
+                               ) -> Optional[list]:
+        """Jitted group fan-out (kernel.py); None → host fallback for the
+        cases the encoding does not cover."""
+        from swarmkit_tpu.manager.scheduler import kernel as sched_kernel
+
+        node_list = list(self.node_set.nodes.values())
+        if not node_list:
+            return []
+        with self._m_kernel_seconds.time():
+            enc = sched_kernel.encode_group(sample, prefs, node_list,
+                                            fkey, now)
+            if enc is None:
+                return None
+            choices = sched_kernel.place_group(enc, len(tasks))
+        self._m_kernel_groups.labels(path="kernel").inc()
+        out = []
+        _, _, gen = task_reserved(sample)
+        for task, c in zip(tasks, choices):
+            if c < 0:
+                continue
+            info = node_list[c]
+            assigned = task.copy()
+            assigned.node_id = info.id
+            if gen:
+                assigned.assigned_generic = info.claim_named(gen)
+            info.add_task(assigned)
+            out.append((task, info.id, assigned))
+            self._m_kernel_tasks.inc()
         return out
 
     async def _apply(self, decisions: list[tuple[object, str, object]]) -> None:

@@ -285,6 +285,36 @@ CATALOG: dict[str, MetricSpec] = {
         "counter", "Linearizable read ops served summed over groups and "
         "rows (cfg.read_batch > 0).", ()),
 
+    # ---- coalescing proposal pipeline (store/pipeline.py) ----------------
+    # Names and label sets are pinned to swarmkit_tpu/store/pipeline.py by
+    # tools/metrics_lint.py check #12.
+    "swarm_cpl_proposals_total": MetricSpec(
+        "counter", "Packed raft proposals flushed by the coalescing "
+        "pipeline, by outcome (committed / failed).", ("outcome",)),
+    "swarm_cpl_txns_total": MetricSpec(
+        "counter", "Store transactions routed through the coalescing "
+        "pipeline, by outcome (committed / failed).", ("outcome",)),
+    "swarm_cpl_batch_entries": MetricSpec(
+        "histogram", "Transactions packed per raft proposal (the "
+        "amortization factor of the batched pipeline).", (),
+        buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256)),
+    "swarm_cpl_queue_depth": MetricSpec(
+        "gauge", "Transactions queued behind the in-flight packed "
+        "proposal.", ()),
+
+    # ---- jitted scheduler kernel (manager/scheduler/kernel.py) -----------
+    # Names and label sets are pinned to manager/scheduler/kernel.py by
+    # tools/metrics_lint.py check #12.
+    "swarm_sched_kernel_groups_total": MetricSpec(
+        "counter", "Task groups scheduled, by path (kernel = jitted "
+        "[tasks, nodes] kernel, host = host Pipeline fallback).",
+        ("path",)),
+    "swarm_sched_kernel_tasks_total": MetricSpec(
+        "counter", "Tasks placed through the jitted kernel path.", ()),
+    "swarm_sched_kernel_seconds": MetricSpec(
+        "histogram", "Wall time of one kernel group-placement call "
+        "(encode + device + decode).", (), buckets=_TICK_BUCKETS),
+
     # ---- bench / tools (L6) ----------------------------------------------
     "swarm_bench_entries_per_second": MetricSpec(
         "gauge", "Steady-state committed entries/sec, by bench config.",
@@ -309,6 +339,18 @@ CATALOG: dict[str, MetricSpec] = {
     "swarm_bench_election_ticks": MetricSpec(
         "gauge", "Simulated ticks until first leader election, by bench "
         "config.", ("config",)),
+    "swarm_bench_proposals_per_second": MetricSpec(
+        "gauge", "Store proposals committed per second over the real "
+        "control plane, by bench config.", ("config",)),
+    "swarm_bench_assignments_per_second": MetricSpec(
+        "gauge", "Task assignments delivered to simulated agents per "
+        "second under control-plane load, by bench config.", ("config",)),
+    "swarm_bench_agents_sustained": MetricSpec(
+        "gauge", "Simulated agent sessions concurrently sustained by the "
+        "load harness, by bench config.", ("config",)),
+    "swarm_bench_heartbeat_rtt_p99_seconds": MetricSpec(
+        "gauge", "Client-observed heartbeat round-trip p99 under "
+        "control-plane load, by bench config.", ("config",)),
 }
 
 

@@ -1,0 +1,45 @@
+"""Tier-2 wrapper for the gRPC control-plane load harness
+(tools/soak_controlplane.py): a 500-agent, 1-minute run over the real
+wire must sustain the fleet, place work through the kernel scheduler,
+and keep heartbeat RTT sane.
+
+Slow-marked: ~90s wall (manager quorum + 500 gRPC sessions).  The 5k/10k
+acceptance runs live in bench.py (``controlplane-10k``); this pins the
+harness itself against regressions at a size tier-2 can afford.
+"""
+
+import importlib.util
+import pathlib
+
+import pytest
+
+from tests.conftest import async_test
+
+_TOOLS = pathlib.Path(__file__).resolve().parent.parent / "tools"
+
+
+def _load_harness():
+    spec = importlib.util.spec_from_file_location(
+        "soak_controlplane", _TOOLS / "soak_controlplane.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.slow  # tier-2: real gRPC wire, 500 sessions, ~90s
+@async_test
+async def test_load_harness_sustains_500_agents():
+    harness = _load_harness()
+    r = await harness.load(minutes=1.0, agents=500, active=64,
+                           heartbeat=5.0, report_every=1e9,
+                           sustain_floor=0.98)
+    assert "error" not in r, r.get("error")
+    assert r["agents_sustained"] >= int(0.98 * 500)
+    # work actually flowed: assignments placed and acked over the wire
+    assert r["assignments"] > 0
+    assert r["status_writes"] > 0
+    # scheduler kernel path engaged for the placement groups
+    assert r["kernel_groups"] > 0
+    # heartbeats went through the coalescing pipeline in packed proposals
+    assert r["entries_per_proposal"] > 1.0
+    assert r["rtt_p99_ms"] < 5_000.0

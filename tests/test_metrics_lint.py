@@ -38,3 +38,33 @@ def test_check11_bites_in_both_directions(monkeypatch):
                "catalog" in p for p in problems), problems
     assert any(orphan in p and "no multiraft/obs.py constant" in p
                for p in problems), problems
+
+
+@pytest.mark.slow
+def test_check12_bites_in_both_directions(monkeypatch):
+    """Check #12 (vectorized control plane) flags a pipeline/kernel
+    constant with no catalog spec AND an orphaned swarm_cpl_* /
+    swarm_sched_kernel_* catalog entry."""
+    from metrics_lint import run_lint
+
+    from swarmkit_tpu.manager.scheduler import kernel as sched_kernel
+    from swarmkit_tpu.metrics import catalog
+    from swarmkit_tpu.store import pipeline as cpl_pipeline
+
+    monkeypatch.setitem(cpl_pipeline.METRIC_NAMES,
+                        "swarm_cpl_bogus_total", ())
+    monkeypatch.setitem(sched_kernel.METRIC_NAMES,
+                        "swarm_sched_kernel_bogus_total", ())
+    for orphan in ("swarm_cpl_orphan_total",
+                   "swarm_sched_kernel_orphan_total"):
+        monkeypatch.setitem(catalog.CATALOG, orphan,
+                            catalog.MetricSpec("counter", "orphan for lint"))
+    problems = run_lint(REPO_ROOT)
+    assert any("swarm_cpl_bogus_total" in p and "missing from the catalog"
+               in p for p in problems), problems
+    assert any("swarm_sched_kernel_bogus_total" in p and "missing from "
+               "the catalog" in p for p in problems), problems
+    assert any("swarm_cpl_orphan_total" in p and "can't publish" in p
+               for p in problems), problems
+    assert any("swarm_sched_kernel_orphan_total" in p and "can't publish"
+               in p for p in problems), problems

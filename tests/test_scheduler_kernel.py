@@ -1,0 +1,215 @@
+"""Jitted scheduler kernel vs the host Pipeline: bit-identical decisions.
+
+The kernel (manager/scheduler/kernel.py) must make EXACTLY the choices
+``_schedule_group``'s host loop makes — same node per task in FIFO order
+— across randomized node fleets, resource reservations, constraints,
+max-replicas caps, spread preferences, failure taints and pre-existing
+load.  The host Pipeline stays the oracle; any mismatch is a kernel bug
+by definition.  Uncovered encodings (named generic resources, multi-level
+spread) must return None and fall back to the host path.
+"""
+
+import random
+
+from swarmkit_tpu.api import (
+    Annotations, NodeAvailability, NodeDescription, NodeResources, NodeSpec,
+    NodeState, Placement, Platform, Resources,
+    ResourceRequirements, Task, TaskSpec, TaskState, TaskStatus,
+)
+from swarmkit_tpu.api.objects import Node, NodeStatus
+from swarmkit_tpu.manager.scheduler.nodeinfo import NodeInfo
+from swarmkit_tpu.manager.scheduler.scheduler import Scheduler
+from swarmkit_tpu.metrics import catalog as obs_catalog
+from swarmkit_tpu.metrics.registry import MetricsRegistry
+from swarmkit_tpu.store import MemoryStore
+from tests.conftest import async_test
+
+GIG = 1 << 30
+
+
+def _node(i, cpus, mem, zone, ready=True, generic=None, named=None):
+    return Node(
+        id=f"n{i:02d}",
+        spec=NodeSpec(annotations=Annotations(name=f"n{i:02d}",
+                                              labels={"zone": zone}),
+                      availability=NodeAvailability.ACTIVE),
+        description=NodeDescription(
+            hostname=f"h{i}",
+            platform=Platform(architecture="x86_64", os="linux"),
+            resources=NodeResources(nano_cpus=cpus, memory_bytes=mem,
+                                    generic=dict(generic or {}),
+                                    generic_named=dict(named or {}))),
+        status=NodeStatus(state=NodeState.READY if ready
+                          else NodeState.DOWN),
+    )
+
+
+def _task(i, service="svc", cpus=0, mem=0, constraints=None, prefs=None,
+          max_replicas=0, generic=None):
+    spec = TaskSpec()
+    if cpus or mem or generic:
+        spec.resources = ResourceRequirements(
+            reservations=Resources(nano_cpus=cpus, memory_bytes=mem,
+                                   generic=dict(generic or {})))
+    if constraints or prefs or max_replicas:
+        spec.placement = Placement(constraints=constraints or [],
+                                   preferences=prefs or [],
+                                   max_replicas=max_replicas)
+    return Task(id=f"t{i:03d}", service_id=service, slot=i, spec=spec,
+                status=TaskStatus(state=TaskState.PENDING),
+                desired_state=int(TaskState.RUNNING))
+
+
+def _running(i, node_id, service):
+    t = _task(1000 + i, service=service)
+    t.node_id = node_id
+    t.status.state = TaskState.RUNNING
+    return t
+
+
+def _sched(use_kernel: bool) -> Scheduler:
+    return Scheduler(MemoryStore(), obs=MetricsRegistry(),
+                     use_kernel=use_kernel)
+
+
+def _random_world(rng):
+    """One randomized (nodes, existing tasks, group) scenario; returns a
+    builder so host and kernel schedulers get IDENTICAL independent
+    copies (scheduling mutates NodeInfo)."""
+    n_nodes = rng.randint(1, 12)
+    zones = ["a", "b", "c"]
+    nodes = []
+    for i in range(n_nodes):
+        nodes.append(dict(
+            i=i,
+            cpus=rng.choice([1, 2, 4, 8]) * 1_000_000_000,
+            mem=rng.choice([1, 2, 4, 8]) * GIG,
+            zone=rng.choice(zones),
+            ready=rng.random() > 0.15,
+            n_existing=rng.randint(0, 3),
+        ))
+    service = rng.choice(["svc", "svc", "svc", ""])
+    t_kw = dict(
+        service=service,
+        cpus=rng.choice([0, 0, 500_000_000, 1_500_000_000, 3_000_000_000]),
+        mem=rng.choice([0, 0, GIG // 2, 2 * GIG]),
+        constraints=rng.choice(
+            [None, None, ["node.labels.zone==a"],
+             ["node.labels.zone!=b"]]),
+        prefs=rng.choice([None, None, ["spread=node.labels.zone"]]),
+        max_replicas=rng.choice([0, 0, 0, 1, 2]),
+    )
+    n_tasks = rng.randint(1, 16)
+    taint_nodes = [nd["i"] for nd in nodes if rng.random() < 0.2]
+
+    def build(sched: Scheduler) -> list:
+        tasks = [_task(i, **t_kw) for i in range(n_tasks)]
+        fkey = NodeInfo.failure_key(tasks[0])
+        now = sched.clock.now()
+        for nd in nodes:
+            existing = {}
+            for j in range(nd["n_existing"]):
+                et = _running(nd["i"] * 10 + j, f"n{nd['i']:02d}",
+                              ["svc", "other"][j % 2])
+                existing[et.id] = et
+            info = NodeInfo(_node(nd["i"], nd["cpus"], nd["mem"],
+                                  nd["zone"], nd["ready"]), existing)
+            if nd["i"] in taint_nodes:
+                # enough recent failures to taint this service's key
+                for _ in range(4):
+                    info.recent_failures.setdefault(fkey, []).append(now)
+            sched.node_set.add_or_update(info)
+        return tasks
+
+    return build
+
+
+def _decide(sched: Scheduler, tasks: list) -> list[tuple[str, str]]:
+    return [(t.id, node_id) for t, node_id, _ in
+            sched._schedule_group(tasks)]
+
+
+@async_test
+async def test_randomized_differential_bit_identical():
+    rng = random.Random(1234)
+    kernel_used = 0
+    for trial in range(60):
+        build = _random_world(rng)
+        host, kern = _sched(False), _sched(True)
+        tasks_h = build(host)
+        tasks_k = build(kern)
+        dh = _decide(host, tasks_h)
+        dk = _decide(kern, tasks_k)
+        assert dh == dk, (f"trial {trial}: host {dh} != kernel {dk}")
+        kernel_used += int(obs_catalog.get(
+            kern.obs, "swarm_sched_kernel_groups_total")
+            .labels(path="kernel").value)
+    # the suite must actually exercise the device path, not fall back
+    # everywhere
+    assert kernel_used >= 30, f"kernel path ran only {kernel_used}/60 trials"
+
+
+@async_test
+async def test_kernel_resource_exhaustion_matches_host():
+    """More tasks than fleet capacity: the same prefix places, the same
+    tail stays unplaced, on both paths."""
+    host, kern = _sched(False), _sched(True)
+    for s in (host, kern):
+        for i in range(3):
+            s.node_set.add_or_update(NodeInfo(
+                _node(i, 2_000_000_000, 2 * GIG, "a"), {}))
+    tasks = [_task(i, cpus=1_000_000_000, mem=GIG) for i in range(10)]
+    dh = _decide(host, list(tasks))
+    dk = _decide(kern, [t.copy() for t in tasks])
+    assert dh == dk
+    assert len(dh) == 6  # 2 per node fit
+
+
+@async_test
+async def test_kernel_spread_tie_break_matches_host():
+    host, kern = _sched(False), _sched(True)
+    for s in (host, kern):
+        for i, zone in enumerate(["a", "a", "b", "b", "c"]):
+            s.node_set.add_or_update(NodeInfo(
+                _node(i, 4_000_000_000, 4 * GIG, zone), {}))
+    tasks = [_task(i, prefs=["spread=node.labels.zone"])
+             for i in range(11)]
+    dh = _decide(host, list(tasks))
+    dk = _decide(kern, [t.copy() for t in tasks])
+    assert dh == dk and len(dh) == 11
+
+
+@async_test
+async def test_kernel_falls_back_on_named_generic_and_multispread():
+    """Uncovered encodings return None and the host path decides — with
+    the fallback counter bumped, never a wrong kernel answer."""
+    from swarmkit_tpu.manager.scheduler import kernel as mod
+
+    # named generic resources (discrete device ids) are not encodable
+    node = _node(0, 4_000_000_000, 4 * GIG, "a",
+                 named={"gpu": ["gpu0", "gpu1"]})
+    info = NodeInfo(node, {})
+    t = _task(0, generic={"gpu": 1})
+    enc = mod.encode_group(t, [], [info], NodeInfo.failure_key(t), 0.0)
+    assert enc is None
+
+    t2 = _task(1)
+    enc2 = mod.encode_group(
+        t2, ["spread=node.labels.zone", "spread=node.labels.rack"],
+        [NodeInfo(_node(1, 4_000_000_000, 4 * GIG, "a"), {})],
+        NodeInfo.failure_key(t2), 0.0)
+    assert enc2 is None
+
+    kern = _sched(True)
+    kern.node_set.add_or_update(info)
+    d = _decide(kern, [t])
+    assert d == [("t000", "n00")]
+    assert int(obs_catalog.get(
+        kern.obs, "swarm_sched_kernel_groups_total")
+        .labels(path="host").value) == 1
+
+
+@async_test
+async def test_kernel_empty_node_set():
+    kern = _sched(True)
+    assert _decide(kern, [_task(0)]) == []

@@ -68,6 +68,14 @@ Checks, in order:
     and every label has a ``SAMPLE_LABELS`` entry — same lockstep as
     check #7.
 
+12. The vectorized control plane (ISSUE 19) keeps the same lockstep:
+    the ``swarm_cpl_*`` names the coalescing proposal pipeline publishes
+    (``store/pipeline.py METRIC_NAMES``) and the ``swarm_sched_kernel_*``
+    names the jitted scheduler kernel publishes
+    (``manager/scheduler/kernel.py METRIC_NAMES``) mirror the catalog in
+    both directions, every declared label publishes with its sample
+    value, and every label has a ``SAMPLE_LABELS`` entry.
+
 Importable (``run_lint`` returns the problem list) so the pytest wrapper
 in tests/test_metrics_lint.py runs it in-suite; the CLI exits nonzero on
 any finding.
@@ -495,6 +503,53 @@ def run_lint(repo_root: str | None = None) -> list[str]:
         if lb not in mr_obs.SAMPLE_LABELS:
             problems.append(f"multiraft: label {lb!r} missing from "
                             "multiraft.obs.SAMPLE_LABELS")
+
+    # 12. vectorized-control-plane wiring (ISSUE 19): the coalescing
+    #     proposal pipeline (store/pipeline.py, swarm_cpl_*) and the
+    #     jitted scheduler kernel (manager/scheduler/kernel.py,
+    #     swarm_sched_kernel_*) keep the same two-way catalog lockstep
+    #     as checks #7/#11
+    from swarmkit_tpu.manager.scheduler import kernel as sched_kernel
+    from swarmkit_tpu.store import pipeline as cpl_pipeline
+
+    for tag, mod, prefix_parts in (
+            ("cpl", cpl_pipeline, ("swarm", "cpl", "")),
+            ("sched-kernel", sched_kernel, ("swarm", "sched", "kernel",
+                                            ""))):
+        for name, labels in mod.METRIC_NAMES.items():
+            spec = catalog.CATALOG.get(name)
+            if spec is None:
+                problems.append(f"{tag}: {name!r} ({mod.__name__}) "
+                                "missing from the catalog")
+                continue
+            if tuple(spec.labels) != tuple(labels):
+                problems.append(
+                    f"{tag}: {name!r} labels {tuple(spec.labels)} diverge "
+                    f"from {mod.__name__}.METRIC_NAMES {tuple(labels)}")
+                continue
+            fam = catalog.get(MetricsRegistry(strict=True), name)
+            kwargs = {lb: mod.SAMPLE_LABELS[lb] for lb in labels}
+            try:
+                if spec.kind == "gauge":
+                    fam.labels(**kwargs).set(0)
+                elif spec.kind == "histogram":
+                    fam.labels(**kwargs).observe(0)
+                else:
+                    fam.labels(**kwargs).inc(0)
+            except (MetricError, KeyError) as e:
+                problems.append(f"{tag}: {name!r} cannot publish with "
+                                f"sample labels {kwargs}: {e}")
+        # built from pieces so check #3's literal scan skips the prefix
+        prefix = "_".join(prefix_parts)
+        for name in catalog.CATALOG:
+            if name.startswith(prefix) and name not in mod.METRIC_NAMES:
+                problems.append(f"{tag}: catalog entry {name!r} has no "
+                                f"{mod.__name__} constant (the plane "
+                                "can't publish it)")
+        for lb in {l for ls in mod.METRIC_NAMES.values() for l in ls}:
+            if lb not in mod.SAMPLE_LABELS:
+                problems.append(f"{tag}: label {lb!r} missing from "
+                                f"{mod.__name__}.SAMPLE_LABELS")
     return problems
 
 
