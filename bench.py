@@ -379,7 +379,8 @@ def measure(jax, n: int, entries: int, seed: int, election_tick: int,
     return out
 
 
-def measure_multiraft(jax, groups: int, n: int, entries: int, seed: int):
+def measure_multiraft(jax, groups: int, n: int, entries: int, seed: int,
+                      collect_telemetry: bool = False):
     """Aggregate throughput of the [G, N] multi-raft serving plane.
 
     Elect leaders across all G groups (staggered timeouts), then time
@@ -394,9 +395,15 @@ def measure_multiraft(jax, groups: int, n: int, entries: int, seed: int):
     from swarmkit_tpu import multiraft, parallel
     from swarmkit_tpu.raft.sim import SimConfig
 
+    # telemetry side: per-group commit latency at this shape is tick-scale,
+    # so the 64-deep batch ring covers every populatable bucket while
+    # keeping the per-tick fold proportional to the tiny per-group kernel
+    # (state.py telemetry_prop_ring: the fleet-scale telemetry cost lever)
     cfg = SimConfig(n=n, log_len=512, window=128, apply_batch=64,
                     max_props=32, keep=64, seed=seed, election_tick=10,
                     read_batch=32, read_leases=True, static_members=True,
+                    collect_telemetry=collect_telemetry,
+                    telemetry_prop_ring=64 if collect_telemetry else 0,
                     collect_stats=os.environ.get(
                         "BENCH_COLLECT_STATS", "1") != "0")
     gstate = multiraft.init_groups(cfg, groups)
@@ -722,6 +729,14 @@ def main() -> None:
             # number lands as the separate "multiraft-1024x3-reads"
             # series (bench_gate gates both as throughput series).
             ("multiraft-1024x3", 3, {"_multiraft": 1024}),
+            # grouped-telemetry overhead tripwire (handled specially
+            # below): the SAME [G=256, N=3] fleet measured bare and with
+            # per-group telemetry (latency histograms + series rings)
+            # folding in-kernel every tick; the pinned signal is the
+            # telemetry/bare aggregate-rate ratio (bench_gate gates it
+            # via the _over_dense key) — the fleet health plane's
+            # "grouped telemetry stays within box noise" claim lives here
+            ("multiraft-telemetry", 3, {"_multiraft_tel_ab": 256}),
             # batched proposal pipeline A/B (handled specially below):
             # sequential ProposeValue appends vs 64 in flight through the
             # store's coalescing pipeline on the SAME 3-manager quorum;
@@ -886,6 +901,42 @@ def main() -> None:
                         f"across {mm['groups_with_leader']}/{mm['groups']} "
                         f"led groups (elected in {mm['elect_ticks']} "
                         f"ticks)")
+                    continue
+                tel_groups = kw.pop("_multiraft_tel_ab", 0)
+                if tel_groups:
+                    # grouped-telemetry overhead tripwire: one fleet
+                    # shape, bare vs telemetry-on; the pinned signal is
+                    # the telemetry/bare aggregate-rate ratio
+                    dm = measure_multiraft(jax, tel_groups, cn,
+                                           target_entries, seed=7)
+                    tm = measure_multiraft(jax, tel_groups, cn,
+                                           target_entries, seed=7,
+                                           collect_telemetry=True)
+                    ratio = tm["rate"] / dm["rate"]
+                    try:
+                        from swarmkit_tpu.metrics import \
+                            catalog as obs_catalog
+                        from swarmkit_tpu.metrics import \
+                            registry as obs_registry
+                        fam = obs_catalog.get(
+                            obs_registry.DEFAULT,
+                            "swarm_bench_entries_per_second")
+                        fam.labels(config=f"{name}-dense").set(dm["rate"])
+                        fam.labels(config=f"{name}-on").set(tm["rate"])
+                    except Exception as e:
+                        log(f"bench gauges failed: {e}")
+                    extra[name] = {
+                        "dense": round(dm["rate"], 1),
+                        "telemetry": round(tm["rate"], 1),
+                        "telemetry_over_dense": round(ratio, 3)}
+                    log(f"config {name}: bare {dm['rate']:,.0f} vs "
+                        f"telemetry {tm['rate']:,.0f} aggregate entries/s "
+                        f"({ratio:.2f}x) across {tel_groups} groups")
+                    if ratio < 0.8:
+                        RESULT.setdefault(
+                            "note", f"grouped-telemetry tripwire: "
+                            f"telemetry rate {tm['rate']:,.0f} < 0.8x "
+                            f"bare {dm['rate']:,.0f} at {name}")
                     continue
                 if kw.pop("_peer_ab", False):
                     # densepeer tripwire: one shape, both peer lowerings;

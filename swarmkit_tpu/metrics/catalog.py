@@ -284,6 +284,37 @@ CATALOG: dict[str, MetricSpec] = {
     "swarm_multiraft_reads_served_total": MetricSpec(
         "counter", "Linearizable read ops served summed over groups and "
         "rows (cfg.read_batch > 0).", ()),
+    "swarm_multiraft_group_commit_latency_ticks": MetricSpec(
+        "gauge", "Per-group propose-to-commit latency in simulated ticks "
+        "(bucket upper edge of the group's on-device telemetry "
+        "histogram), by group index and quantile (p50 / p99).  Published "
+        "only while the plane holds at most GROUP_LABEL_CAP groups.",
+        ("group", "quantile")),
+    "swarm_multiraft_group_leader_changes_total": MetricSpec(
+        "counter", "Leader changes per group: publishes where this "
+        "group's acting leader row differs from the previous publish "
+        "(the churn-rate input for the SLO engine).", ("group",)),
+    "swarm_multiraft_group_heat": MetricSpec(
+        "gauge", "EWMA hot-group heat score, by group index: router "
+        "spills (weighted SPILL_WEIGHT x) fused with per-group commit "
+        "rate (multiraft/heat.py).  All groups up to GROUP_LABEL_CAP, "
+        "top HEAT_TOP_K hottest beyond.", ("group",)),
+
+    # ---- SLO burn-rate engine (slo/) -------------------------------------
+    # Names and label sets are pinned to swarmkit_tpu/slo/engine.py by
+    # tools/metrics_lint.py check #13.
+    "swarm_slo_state": MetricSpec(
+        "gauge", "Alert state of one SLO for one group: 0 = ok, 1 = "
+        "warn, 2 = page (slo/engine.py state machine with hysteresis).",
+        ("slo", "group")),
+    "swarm_slo_burn_rate": MetricSpec(
+        "gauge", "Burn rate of one SLO's error budget over the fast / "
+        "slow evaluation window (1.0 = burning exactly the budget).",
+        ("slo", "group", "window")),
+    "swarm_slo_transitions_total": MetricSpec(
+        "counter", "SLO state-machine transitions, by SLO, group, and "
+        "the state ENTERED (warn escalations, page escalations, "
+        "recoveries to ok).", ("slo", "group", "state")),
 
     # ---- coalescing proposal pipeline (store/pipeline.py) ----------------
     # Names and label sets are pinned to swarmkit_tpu/store/pipeline.py by

@@ -12,17 +12,19 @@ from swarmkit_tpu.multiraft.dst import run_groups_under_schedule
 from swarmkit_tpu.multiraft.group import (
     aggregate_committed, aggregate_reads_blocked, aggregate_reads_served,
     group_leader_mask, group_leaders, groups_of, groups_with_leader,
-    init_groups, propose_groups, run_group_ticks, step_groups,
-    submit_reads_groups,
+    init_groups, propose_groups, run_group_ticks, slice_group,
+    step_groups, submit_reads_groups,
 )
+from swarmkit_tpu.multiraft.heat import SPILL_WEIGHT, HeatTracker
 from swarmkit_tpu.multiraft.obs import METRIC_NAMES, MultiRaftObs
 from swarmkit_tpu.multiraft.router import Router, group_of_key
 
 __all__ = [
     "METRIC_NAMES", "MultiRaftObs", "Router",
+    "HeatTracker", "SPILL_WEIGHT",
     "aggregate_committed", "aggregate_reads_blocked",
     "aggregate_reads_served", "group_leader_mask", "group_leaders",
     "group_of_key", "groups_of", "groups_with_leader", "init_groups",
     "propose_groups", "run_group_ticks", "run_groups_under_schedule",
-    "step_groups", "submit_reads_groups",
+    "slice_group", "step_groups", "submit_reads_groups",
 ]

@@ -16,6 +16,19 @@ At G == 1 it bypasses vmap entirely and runs the plain single-group
 `step` on the squeezed state, so the compiled program — not just its
 values — is literally today's kernel (pinned by
 tests/test_multiraft.py::test_g1_bit_identity).
+
+Grouped telemetry (ISSUE 20) rides the same two gates and adds none of
+its own: with ``cfg.collect_telemetry`` on, `init_state` carries the
+telemetry leaves (histograms, [NUM_SERIES, window] ring, propose-batch
+stamps), `init_groups` broadcasts them to [G, ...] like every other
+leaf, and the vmapped kernel's Python-gated end-of-tick telemetry block
+folds each group's lane independently — so every group carries its own
+latency histograms and [G, NUM_SERIES, window] series rings with zero
+kernel changes.  Telemetry OFF keeps the leaves ``None`` (never traced,
+bit-identical program), and the G == 1 short-circuit covers the
+telemetry leaves exactly like the rest of the state; both pins live in
+tests/test_multiraft.py::TestGroupedTelemetry.  `slice_group` extracts
+one group's plain SimState for the single-group summarize/publish path.
 """
 
 from __future__ import annotations
@@ -40,6 +53,16 @@ I32 = jnp.int32
 def groups_of(gstate: SimState) -> int:
     """Static group count G of a grouped state (leading-axis length)."""
     return gstate.tick.shape[0]
+
+
+def slice_group(gstate: SimState, g: int) -> SimState:
+    """One group's plain (ungrouped) SimState — every leaf indexed at g.
+
+    The seam between the [G, ...] plane and the single-group host
+    tooling: the sliced state is exactly what `telemetry.obs
+    .summarize_state` / `flightrec.decode_state` / `KernelObs.publish`
+    consume."""
+    return jax.tree_util.tree_map(lambda a: a[g], gstate)
 
 
 def init_groups(cfg: SimConfig, groups: int,

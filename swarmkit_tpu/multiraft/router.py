@@ -62,6 +62,10 @@ class Router:
         self._reads = np.zeros((groups,), np.int64)
         self.routed = 0                     # keys accepted into queues
         self.spilled = 0                    # flushes deferred by capacity
+        # cumulative per-group flow, the heat detector's inputs
+        # (multiraft/heat.py): offered keys and capacity spills by group
+        self.routed_by_group = np.zeros((groups,), np.int64)
+        self.spilled_by_group = np.zeros((groups,), np.int64)
 
     def group_of(self, key) -> int:
         return group_of_key(key, self.groups, self.seed)
@@ -72,6 +76,7 @@ class Router:
         g = self.group_of(key)
         self._writes[g].append(int(payload) & 0x7FFFFFFF)
         self.routed += 1
+        self.routed_by_group[g] += 1
         if self.obs is not None:
             self.obs.router_keys("routed")
         return g
@@ -82,6 +87,7 @@ class Router:
         g = self.group_of(key)
         self._reads[g] += count
         self.routed += count
+        self.routed_by_group[g] += count
         if self.obs is not None:
             self.obs.router_keys("routed", count)
         return g
@@ -98,7 +104,9 @@ class Router:
         spilled = 0
         for g, q in enumerate(self._writes):
             take = min(len(q), cap)
-            spilled += len(q) - take
+            over = len(q) - take
+            spilled += over
+            self.spilled_by_group[g] += over
             if take:
                 payloads[g, :take] = q[:take]
                 counts[g] = take

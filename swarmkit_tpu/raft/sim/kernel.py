@@ -2168,10 +2168,11 @@ def step(state: SimState, cfg: SimConfig,
         tidx = state.tel_prop_idx
         tcnt = state.tel_prop_cnt
         ttick = state.tel_prop_tick
+        t_ring = ttag.shape[1]        # cfg.telemetry_prop_ring or default
         if fused_prop:
             ptag = jnp.zeros((n,), I32) if prop_tag is None else \
                 jnp.broadcast_to(jnp.asarray(prop_tag, I32), (n,))
-            ts_ = now % _ts.PROP_RING
+            ts_ = now % t_ring
             ttag = _ts.col_set(ttag, ts_, jnp.where(prop_ok, ptag, 0))
             tidx = _ts.col_set(tidx, ts_,
                                jnp.where(prop_ok, prop_last0 + 1, NONE))
@@ -2182,7 +2183,7 @@ def step(state: SimState, cfg: SimConfig,
         tlo = jnp.maximum(tidx, state.commit[:, None] + 1)
         thi = jnp.minimum(tidx + tcnt - 1, commit[:, None])
         tsel = can_commit[:, None] & (tidx != NONE) & (ttick >= 0) \
-            & (now - ttick < _ts.PROP_RING) & (thi >= tlo) & (ttag != 0)
+            & (now - ttick < t_ring) & (thi >= tlo) & (ttag != 0)
         tbest = jnp.argmax(jnp.where(tsel, ttick, -1), axis=1)
         commit_tag = jnp.where(
             jnp.any(tsel, axis=1),
@@ -2294,11 +2295,12 @@ def step(state: SimState, cfg: SimConfig,
         bidx = state.tel_prop_idx
         bcnt = state.tel_prop_cnt
         btick = state.tel_prop_tick
+        ring = bidx.shape[1]          # cfg.telemetry_prop_ring or default
         if fused_prop:
             # stamp this tick's fused appends as ONE batch record: every
             # entry of the batch shares the propose tick, so the stamp is
             # a single-column write, not a per-entry scatter
-            bs = now % _ts.PROP_RING
+            bs = now % ring
             bidx = _ts.col_set(bidx, bs,
                                jnp.where(prop_ok, prop_last0 + 1, NONE))
             bcnt = _ts.col_set(
@@ -2324,7 +2326,7 @@ def step(state: SimState, cfg: SimConfig,
         hi = jnp.minimum(bidx + bcnt - 1, commit[:, None])
         cw = jnp.maximum(hi - lo + 1, 0)
         cfold = can_commit[:, None] & (bidx != NONE) & (btick >= 0) \
-            & (now - btick < _ts.PROP_RING) & (cw > 0)
+            & (now - btick < ring) & (cw > 0)
         tel_commit_hist = _ts.hist_fold(state.tel_commit_hist, cfold,
                                         now - btick, weight=cw)
         # is_leader here is the settled post-A/B role this tick (the same
@@ -2450,7 +2452,7 @@ def propose(state: SimState, cfg: SimConfig, payloads: jax.Array,
         # telemetry stamp: one batch record in the (row, tick) ring — the
         # whole append shares this client-arrival tick
         from swarmkit_tpu.telemetry import series as _ts
-        bs = state.tick % _ts.PROP_RING
+        bs = state.tick % state.tel_prop_idx.shape[1]
         cnt = jnp.asarray(count, I32)
         tel_fields = dict(
             tel_prop_idx=_ts.col_set(state.tel_prop_idx, bs,
@@ -2535,7 +2537,7 @@ def propose_dense(state: SimState, cfg: SimConfig,
         # dense path changes how payloads are materialised, not the
         # measurement semantics
         from swarmkit_tpu.telemetry import series as _ts
-        bs = state.tick % _ts.PROP_RING
+        bs = state.tick % state.tel_prop_idx.shape[1]
         tel_fields = dict(
             tel_prop_idx=_ts.col_set(state.tel_prop_idx, bs,
                                      jnp.where(ok, state.last + 1, NONE)),

@@ -185,6 +185,18 @@ class SimConfig:
     collect_telemetry: bool = False
     telemetry_window: int = 64   # ring columns (stride-wide buckets) kept
     telemetry_stride: int = 8    # ticks aggregated per ring column
+    # Propose-batch ring depth (0 = telemetry.series.PROP_RING = 512).
+    # The commit-latency fold scans the whole [N, ring] ring every tick,
+    # so the ring is the telemetry plane's dominant cost at SMALL N — the
+    # multi-raft fleet's tiny per-group shapes (kernel work is a few
+    # [N, window] passes) see ~2x from the default depth where n=256
+    # quorums see noise.  A ring of R measures latencies up to R/2 ticks
+    # (coverage rule: ring >= 2x the largest histogram edge it must
+    # resolve; batches older than R ticks age out unmeasured), so fleets
+    # whose per-group commit latency is tick-scale can drop to 64 and
+    # keep every bucket they can populate.  PERF.md "Fleet health"
+    # documents the A/B.
+    telemetry_prop_ring: int = 0
     # Causal trace tags (ISSUE 17): carry a host-assigned trace tag per
     # propose batch ([N, PROP_RING] alongside the telemetry batch ring)
     # and per read batch ([N]), widen the flight-recorder event rows to
@@ -422,6 +434,12 @@ class SimConfig:
                 raise ValueError(
                     f"telemetry_window={self.telemetry_window} is too "
                     f"small to hold a useful history; use >= 8 columns")
+            if self.telemetry_prop_ring < 0 or \
+                    0 < self.telemetry_prop_ring < 16:
+                raise ValueError(
+                    f"telemetry_prop_ring={self.telemetry_prop_ring} "
+                    f"must be 0 (default depth) or >= 16 (a ring of R "
+                    f"only measures latencies up to R/2 ticks)")
         if self.trace_tags and not (self.record_events
                                     and self.collect_telemetry):
             raise ValueError(
@@ -830,7 +848,8 @@ def init_state(cfg: SimConfig,
 def _trace_tag_init(cfg: SimConfig) -> dict:
     from swarmkit_tpu.telemetry import series as tel
     n, i32 = cfg.n, jnp.int32
-    out = dict(tel_prop_tag=jnp.zeros((n, tel.PROP_RING), i32))
+    ring = cfg.telemetry_prop_ring or tel.PROP_RING
+    out = dict(tel_prop_tag=jnp.zeros((n, ring), i32))
     if cfg.read_batch > 0:
         out["read_tag"] = jnp.zeros((n,), i32)
     return out
@@ -839,11 +858,12 @@ def _trace_tag_init(cfg: SimConfig) -> dict:
 def _telemetry_init(cfg: SimConfig) -> dict:
     from swarmkit_tpu.telemetry import series as tel
     n, i32 = cfg.n, jnp.int32
+    ring = cfg.telemetry_prop_ring or tel.PROP_RING
     z = lambda *s: jnp.zeros(s, i32)  # noqa: E731
     return dict(
-        tel_prop_idx=jnp.full((n, tel.PROP_RING), NONE, i32),
-        tel_prop_cnt=z(n, tel.PROP_RING),
-        tel_prop_tick=jnp.full((n, tel.PROP_RING), NONE, i32),
+        tel_prop_idx=jnp.full((n, ring), NONE, i32),
+        tel_prop_cnt=z(n, ring),
+        tel_prop_tick=jnp.full((n, ring), NONE, i32),
         tel_elect_start=jnp.full((n,), NONE, i32),
         tel_read_submit=jnp.full((n,), NONE, i32),
         tel_commit_hist=z(tel.NUM_BUCKETS),

@@ -1,0 +1,22 @@
+"""Fleet health plane: declarative SLOs + multi-window burn-rate alerting.
+
+The host-side alerting layer over the multi-raft serving plane (ISSUE
+20): slo/spec.py declares WHAT is promised (the `SLO_CATALOG` of five
+objectives — commit latency, read availability, durability lag, leader
+stability, router capacity), slo/source.py reads the per-group evidence
+off a grouped state + router each scrape, and slo/engine.py grades it
+with fast/slow burn-rate windows and an ok -> warn -> page state machine
+with hysteresis, publishing ``swarm_slo_*`` and appending host alert
+records.  tools/swarm_top.py renders the active alerts as a panel.
+"""
+
+from swarmkit_tpu.slo.engine import (
+    METRIC_NAMES, SAMPLE_LABELS, STATE_NAMES, SloEngine,
+)
+from swarmkit_tpu.slo.source import FleetSource
+from swarmkit_tpu.slo.spec import SLO_CATALOG, SloSpec
+
+__all__ = [
+    "METRIC_NAMES", "SAMPLE_LABELS", "SLO_CATALOG", "STATE_NAMES",
+    "FleetSource", "SloEngine", "SloSpec",
+]

@@ -97,6 +97,26 @@ def summarize_state(state, cfg) -> dict:
     return out
 
 
+def summarize_groups(gstate, cfg) -> list:
+    """Per-group ``summarize_state`` over a [G, N, ...] grouped state.
+
+    One device_get of the whole tree, then host-side slicing: group g's
+    summary is exactly what a solo run of that group would report (the
+    grouped kernel folds each lane independently — pinned by
+    tests/test_multiraft.py::TestGroupedTelemetry).  Returns
+    ``[{"enabled": False}] * G`` when telemetry is off.
+    """
+    import jax
+
+    groups = int(gstate.tick.shape[0])
+    if getattr(gstate, "tel_commit_hist", None) is None:
+        return [{"enabled": False} for _ in range(groups)]
+    host = jax.tree_util.tree_map(np.asarray, jax.device_get(gstate))
+    return [summarize_state(
+        jax.tree_util.tree_map(lambda a, g=g: a[g], host), cfg)
+        for g in range(groups)]
+
+
 class TelemetryObs:
     """Publishes a telemetry-enabled SimState into a metrics registry."""
 

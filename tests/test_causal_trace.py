@@ -472,6 +472,29 @@ class TestSwarmTop:
         assert "trigger=manual" in frame   # labeled child flattened
         assert "flightrec[manual]" in frame
 
+    def test_render_frame_shows_fleet_health_panels(self):
+        from swarm_top import TopState, render_frame
+        snap = _fake_snapshot()
+        snap["hottest"] = [2, 0, 1]
+        snap["slo_active"] = [{"slo": "leader_churn", "group": 2,
+                               "state": "page"}]
+        snap["alerts"] = [{"scrape": 4, "slo": "leader_churn", "group": 2,
+                           "from": "ok", "to": "page",
+                           "fast_burn": 10.0, "slow_burn": 7.5}]
+        frame = render_frame({"fleet": snap}, TopState())
+        assert "hottest groups: g2 g0 g1" in frame
+        assert "SLO ALERTS (1 active):" in frame
+        assert "!! PAGE  leader_churn group=2" in frame
+        assert "ok->page" in frame and "burn fast 10.0x" in frame
+
+    def test_render_frame_all_ok_banner(self):
+        from swarm_top import TopState, render_frame
+        snap = _fake_snapshot()
+        snap["slo_active"] = []            # present-but-empty: fleet is ok
+        frame = render_frame({"fleet": snap}, TopState())
+        assert "SLO ALERTS: none — all objectives ok" in frame
+        assert "hottest groups" not in frame
+
     def test_counter_reset_drops_sample(self):
         from swarm_top import TopState
         state = TopState()
@@ -530,3 +553,9 @@ def test_swarm_top_demo_live_frames(capsys):
     assert "sim-quorum" in out
     assert "swarm_kernel_commit_advance_total" in out
     assert "/s" in out   # second poll produced rates
+    # fleet-health panels (ISSUE 20): the demo's second manager runs a
+    # deliberately overloaded multi-raft fleet through the SLO engine
+    assert "sim-fleet" in out
+    assert "swarm_multiraft_group_heat" in out
+    assert "hottest groups:" in out
+    assert "SLO ALERTS" in out

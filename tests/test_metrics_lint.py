@@ -68,3 +68,24 @@ def test_check12_bites_in_both_directions(monkeypatch):
                for p in problems), problems
     assert any("swarm_sched_kernel_orphan_total" in p and "can't publish"
                in p for p in problems), problems
+
+
+@pytest.mark.slow
+def test_check13_bites_in_both_directions(monkeypatch):
+    """Check #13 (fleet health plane) flags an engine constant with no
+    catalog spec AND a swarm_slo_* catalog entry with no constant."""
+    from metrics_lint import run_lint
+
+    from swarmkit_tpu.metrics import catalog
+    from swarmkit_tpu.slo import engine as slo_engine
+
+    monkeypatch.setitem(slo_engine.METRIC_NAMES,
+                        "swarm_slo_bogus_total", ())
+    orphan = "swarm_slo_orphan_total"
+    monkeypatch.setitem(catalog.CATALOG, orphan,
+                        catalog.MetricSpec("counter", "orphan for lint"))
+    problems = run_lint(REPO_ROOT)
+    assert any("swarm_slo_bogus_total" in p and "missing from the catalog"
+               in p for p in problems), problems
+    assert any(orphan in p and "has no slo/engine.py constant" in p
+               for p in problems), problems

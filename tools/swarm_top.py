@@ -14,11 +14,18 @@ Three data sources:
   so pointing it at files a cluster rewrites gives a live view with no
   coupling to this process.
 - ``--demo`` — in-process: a small batched-sim quorum (raft/sim) with
-  KernelObs publishing into a private registry; each frame advances a
-  tick burst with proposals and snapshots it.  Exists so the console
-  is demonstrable (and testable) without an asyncio cluster.
+  KernelObs publishing into a private registry, plus a multi-raft
+  fleet driven through the Router / FleetSource / SloEngine loop so
+  the fleet-health panels light up.  Exists so the console is
+  demonstrable (and testable) without an asyncio cluster.
 - importable — ``render_frame(snapshots)`` is pure: tests and other
   tools feed real ``metrics_snapshot()`` dicts straight in.
+
+Fleet-health panels (ISSUE 20): a snapshot may carry ``hottest``
+(group indices from ``MultiRaftObs.hottest_groups``), ``slo_active``
+(the SLO engine's non-ok states), and ``alerts`` (recent burn-rate
+transition records); render_frame shows them as a per-manager alerts
+block under the metric rows.
 
 Counter RATES (per second, with a sparkline over the last ~40 polls)
 come from deltas between polls, computed host-side in ``TopState`` —
@@ -48,7 +55,7 @@ HISTORY = 40
 # metrics_lint's catalog cross-reference skips them.)
 DEFAULT_FILTER = tuple("swarm_%s_" % s for s in (
     "kernel", "raft", "trace", "flightrec", "telemetry", "store",
-    "transport"))
+    "transport", "multiraft", "slo"))
 
 
 def sparkline(values, width: int = 16) -> str:
@@ -143,6 +150,25 @@ def render_frame(snapshots: dict, state: TopState | None = None,
             rate_s = f"{rate[-1]:10.1f}/s" if rate else " " * 12
             val_s = f"{v:14,.0f}" if v == int(v) else f"{v:14,.3f}"
             lines.append(f"  {name[:58]:<58}{val_s} {rate_s} {graph}")
+        hottest = snap.get("hottest")
+        if hottest:
+            lines.append("  hottest groups: "
+                         + " ".join(f"g{g}" for g in hottest))
+        active = snap.get("slo_active")
+        if active is not None:
+            if active:
+                lines.append(f"  SLO ALERTS ({len(active)} active):")
+                for a in active[:8]:
+                    lines.append(f"  !! {a['state'].upper():<5} "
+                                 f"{a['slo']} group={a['group']}")
+            else:
+                lines.append("  SLO ALERTS: none — all objectives ok")
+        for rec in (snap.get("alerts") or [])[-3:]:
+            lines.append(
+                f"  ⚠ scrape {rec['scrape']}: {rec['slo']} "
+                f"g{rec['group']} {rec['from']}->{rec['to']} "
+                f"(burn fast {rec['fast_burn']}x / slow "
+                f"{rec['slow_burn']}x)")
         for ev in (snap.get("recent_events") or [])[-3:]:
             desc = ev.get("describe") or ev.get("name") or "?"
             lines.append(f"  • {str(desc)[: width - 4]}")
@@ -174,39 +200,77 @@ def source_files(paths):
     return poll
 
 
-def source_demo(n: int = 16, burst: int = 8):
-    """Poll function over an in-process batched-sim quorum: each call
-    advances `burst` ticks with proposals and publishes KernelObs
-    counters into a private registry."""
+def source_demo(n: int = 16, burst: int = 8, groups: int = 4):
+    """Poll function over an in-process batched-sim quorum PLUS a small
+    multi-raft fleet: each call advances a tick burst on both, publishes
+    KernelObs / MultiRaftObs counters into private registries, and runs
+    the fleet through FleetSource -> SloEngine so the alerts + heat
+    panels render.  The fleet is deliberately offered ~4x its per-tick
+    proposal capacity, so the router spills, one hot group heats up, and
+    the spill_ratio SLO pages within a few polls — the demo shows the
+    health plane FIRING, not just idle."""
     import jax.numpy as jnp
 
+    from swarmkit_tpu import multiraft
     from swarmkit_tpu.metrics import registry as obs_registry
+    from swarmkit_tpu.multiraft.obs import MultiRaftObs
     from swarmkit_tpu.raft.sim import (
         SimConfig, init_state, run_ticks, run_until_leader,
     )
     from swarmkit_tpu.raft.sim.run import KernelObs
+    from swarmkit_tpu.slo import FleetSource, SloEngine
 
     cfg = SimConfig(n=n, log_len=256, window=16, apply_batch=32,
                     max_props=16, keep=8, election_tick=10, seed=7,
                     collect_stats=True, read_batch=4)
     reg = obs_registry.MetricsRegistry()
     obs = KernelObs(obs=reg)
-    box = {"st": None}
+    fleet_cfg = SimConfig(n=5, log_len=128, window=16, apply_batch=16,
+                          max_props=8, keep=8, election_tick=10, seed=7,
+                          collect_stats=True, collect_telemetry=True)
+    fleet_reg = obs_registry.MetricsRegistry()
+    fleet_obs = MultiRaftObs(registry=fleet_reg)
+    router = multiraft.Router(fleet_cfg, groups, obs=fleet_obs)
+    source = FleetSource(fleet_cfg)
+    engine = SloEngine(registry=fleet_reg)
+    box = {"st": None, "gs": None, "key": 0}
 
     def poll() -> dict:
         if box["st"] is None:
             st = init_state(cfg)
             st, _ = run_until_leader(st, cfg, max_ticks=512)
             box["st"] = st
+            gs = multiraft.init_groups(fleet_cfg, groups)
+            gs, _ = multiraft.run_group_ticks(gs, fleet_cfg, 60)
+            box["gs"] = gs
         st, _ = run_ticks(box["st"], cfg, n_ticks=burst,
                           prop_count=cfg.max_props)
         box["st"] = st
         obs.publish(st)
-        return {"sim-quorum": {
-            "metrics": reg.snapshot(),
-            "objects": {"managers": n,
-                        "tick": int(jnp.max(st.tick))},
-            "spans": [], "recent_events": []}}
+        # overload the fleet: ~4x per-tick capacity, one flush per poll
+        for _ in range(4 * fleet_cfg.max_props * groups):
+            router.offer(f"key/{box['key']}", box["key"] & 0xFFFF)
+            box["key"] += 1
+        gs = router.flush(box["gs"])
+        gs, _ = multiraft.run_group_ticks(gs, fleet_cfg, burst)
+        box["gs"] = gs
+        fleet_obs.publish(gs, router=router)
+        engine.observe(source.scrape(gs, router=router))
+        return {
+            "sim-quorum": {
+                "metrics": reg.snapshot(),
+                "objects": {"managers": n,
+                            "tick": int(jnp.max(st.tick))},
+                "spans": [], "recent_events": []},
+            "sim-fleet": {
+                "metrics": fleet_reg.snapshot(),
+                "objects": {"groups": groups,
+                            "tick": int(jnp.max(gs.tick))},
+                "spans": [], "recent_events": [],
+                "hottest": fleet_obs.hottest_groups(4),
+                "slo_active": engine.active(),
+                "alerts": list(engine.alerts)[-5:]},
+        }
 
     return poll
 
